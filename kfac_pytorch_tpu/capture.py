@@ -1,0 +1,243 @@
+"""Activation / output-gradient capture — the TPU replacement for torch hooks.
+
+The reference captures per-layer inputs ``a`` with forward-pre-hooks and
+output-gradients ``g`` with full-backward-hooks (reference:
+kfac/kfac_preconditioner_base.py:122-149). JAX has no hooks; this module
+implements the functional equivalent:
+
+- **activations**: KFAC-aware layers (``kfac_pytorch_tpu.nn``) ``sow`` their
+  input into the ``'kfac_a'`` Flax collection, returned as auxiliary output
+  of ``apply`` when that collection is marked mutable.
+- **output-gradients**: each layer adds a zero-valued *tap* variable (from
+  the ``'kfac_tap'`` collection) to its pre-activation output
+  ``y = y + tap``. Differentiating the loss w.r.t. the taps yields exactly
+  ``dL/dy`` — the backward-hook ``grad_output`` — in the *same* backward
+  pass that produces the parameter gradients.
+- **static layer metadata** (kind, dims, conv geometry, param paths) is
+  recorded at trace time through a thread-local registry, once, at setup
+  (``collect_layer_meta``) — the analogue of ``_register_module_hooks``
+  walking ``model.modules()``.
+
+The capture cost is paid only in training steps that update factors
+(``steps % fac_update_freq == 0`` gating lives in the trainer, which picks a
+compiled step variant without capture otherwise — same semantics as the
+hook gating at kfac/kfac_preconditioner_base.py:122-130).
+"""
+
+import dataclasses
+import threading
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Collection names.
+ACTS = 'kfac_a'    # sown layer inputs
+TAPS = 'kfac_tap'  # differentiable zero taps on layer outputs
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerMeta:
+    """Static description of one KFAC-supported layer.
+
+    The analogue of the reference's ``self.modules`` entries plus the
+    geometry that ``ComputeA``/``ComputeG`` read off the torch module
+    (reference: kfac/utils.py:78-140).
+    """
+    name: str                 # '/'.join(path) — stable registry key
+    path: Tuple[str, ...]     # module path inside the params pytree
+    kind: str                 # 'dense' | 'conv'
+    use_bias: bool
+    in_dim: int               # true factor-A dim (incl. bias column)
+    out_dim: int              # true factor-G dim
+    kernel_shape: Tuple[int, ...]   # param 'kernel' shape
+    kernel_size: Optional[Tuple[int, int]] = None   # conv only
+    strides: Optional[Tuple[int, int]] = None       # conv only
+    padding: Optional[Tuple[Tuple[int, int], Tuple[int, int]]] = None  # explicit
+
+    @property
+    def grad_shape(self):
+        """Matrix-form gradient shape [out_dim, in_dim] (bias col included)."""
+        return (self.out_dim, self.in_dim)
+
+
+# ---------------------------------------------------------------------------
+# Trace-time metadata registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY = threading.local()
+
+
+def _registry_active() -> bool:
+    return getattr(_REGISTRY, 'active', False)
+
+
+def report_layer(meta: LayerMeta) -> None:
+    """Called by kfac_pytorch_tpu.nn layers during a recorded trace."""
+    if _registry_active():
+        _REGISTRY.layers[meta.name] = meta
+
+
+class _record_layers:
+    def __enter__(self):
+        _REGISTRY.layers = {}
+        _REGISTRY.active = True
+        return _REGISTRY.layers
+
+    def __exit__(self, *exc):
+        _REGISTRY.active = False
+        return False
+
+
+def collect_layer_meta(model, variables, *args, exclude_vocabulary_size=None,
+                       **kwargs):
+    """Discover KFAC-supported layers by tracing one apply (zero FLOPs).
+
+    Returns ``{name: LayerMeta}`` in call order. ``exclude_vocabulary_size``
+    drops dense layers with that output dim — the tied-embedding pre-softmax
+    exclusion (reference: kfac_preconditioner_base.py:139-140).
+    """
+    with _record_layers() as layers:
+        jax.eval_shape(
+            lambda v: model.apply(v, *args, mutable=[ACTS, TAPS], **kwargs),
+            variables)
+    metas = dict(layers)
+    if exclude_vocabulary_size is not None:
+        metas = {k: m for k, m in metas.items()
+                 if not (m.kind == 'dense'
+                         and m.out_dim == exclude_vocabulary_size)}
+    return metas
+
+
+# ---------------------------------------------------------------------------
+# Apply / init helpers
+# ---------------------------------------------------------------------------
+
+def init(model, rngs, *args, **kwargs):
+    """``model.init`` that strips capture collections from the variables.
+
+    During ``init`` all collections are mutable, so taps and sown
+    activations would otherwise leak into the returned (checkpointable)
+    variables dict.
+    """
+    variables = model.init(rngs, *args, **kwargs)
+    variables = dict(variables)
+    variables.pop(ACTS, None)
+    variables.pop(TAPS, None)
+    return variables
+
+
+def make_zero_taps(model, variables, *args, **kwargs):
+    """Build the zero-tap pytree for one batch shape via ``eval_shape`` (free
+    at trace time). The returned pytree is the differentiable input whose
+    gradient is ``{layer: dL/dy}``."""
+    shapes = jax.eval_shape(
+        lambda v: model.apply(v, *args, mutable=[ACTS, TAPS], **kwargs),
+        variables)
+    tap_shapes = shapes[1][TAPS]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), tap_shapes)
+
+
+def apply_with_capture(model, variables, *args, taps=None, mutable=(),
+                       **kwargs):
+    """Run ``model.apply`` with capture active.
+
+    Args:
+      variables: full variables dict (params, batch_stats, ...).
+      taps: zero-tap pytree from :func:`make_zero_taps`; differentiate the
+        loss w.r.t. it to obtain output-gradients.
+      mutable: extra mutable collections (e.g. ``['batch_stats']``).
+
+    Returns ``(outputs, acts, other_mutated)`` where ``acts`` is the
+    ``{layer: a}`` activation pytree.
+    """
+    v = dict(variables)
+    if taps is not None:
+        v[TAPS] = taps
+    out, mutated = model.apply(v, *args, mutable=[ACTS] + list(mutable),
+                               **kwargs)
+    mutated = dict(mutated)
+    acts = mutated.pop(ACTS, {})
+    return out, acts, mutated
+
+
+def value_and_grad_with_capture(model, loss_fn, variables, *args,
+                                mutable=(), wrt='params', **kwargs):
+    """One fwd+bwd pass returning loss, outputs, param grads, and (a, g).
+
+    The canonical capture entrypoint — the functional equivalent of the
+    reference's forward/backward with hooks armed (one ``model(data)`` +
+    ``loss.backward()``, kfac_preconditioner_base.py:122-130).
+
+    ``loss_fn(outputs)`` must return a scalar (close over targets).
+    Returns ``(loss, outputs, grads, acts, gs, other_mutated)`` with
+    ``acts``/``gs`` keyed like the capture collections.
+    """
+    taps = make_zero_taps(model, variables, *args, **kwargs)
+    params = variables[wrt]
+    rest = {k: val for k, val in variables.items() if k != wrt}
+
+    def wrapped(p, t):
+        out, acts, mutated = apply_with_capture(
+            model, {wrt: p, **rest}, *args, taps=t, mutable=mutable, **kwargs)
+        loss = loss_fn(out)
+        return loss, (out, acts, mutated)
+
+    (loss, (out, acts, mutated)), (grads, gs) = jax.value_and_grad(
+        wrapped, argnums=(0, 1), has_aux=True)(params, taps)
+    return loss, out, grads, acts, gs, mutated
+
+
+# ---------------------------------------------------------------------------
+# Pytree path utilities (layer name <-> collection / params subtrees)
+# ---------------------------------------------------------------------------
+
+def get_path(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def set_path(tree, path, value):
+    """Functionally set ``tree[path] = value`` (dicts only)."""
+    if not path:
+        return value
+    out = dict(tree)
+    out[path[0]] = set_path(tree[path[0]], path[1:], value)
+    return out
+
+
+def layer_act(acts, meta: LayerMeta):
+    """Pull layer ``meta``'s sown activation out of the capture pytree."""
+    return get_path(acts, meta.path)['a']
+
+
+def layer_g(gs, meta: LayerMeta):
+    """Pull layer ``meta``'s output-gradient out of the tap-grad pytree."""
+    return get_path(gs, meta.path)['g']
+
+
+def canonical_padding(in_size, kernel_size, strides, padding):
+    """Resolve a Flax-style padding spec to explicit per-dim (lo, hi) pairs
+    for the given input spatial size — factor A's im2col must see exactly
+    the padding the conv used."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == 'VALID':
+            return ((0, 0), (0, 0))
+        if p == 'SAME':
+            out = []
+            for s, k, st in zip(in_size, kernel_size, strides):
+                o = -(-s // st)  # ceil
+                total = max((o - 1) * st + k - s, 0)
+                out.append((total // 2, total - total // 2))
+            return tuple(out)
+        raise ValueError(f'unsupported padding {padding!r}')
+    out = []
+    for p in padding:
+        if isinstance(p, (tuple, list)):
+            out.append((int(p[0]), int(p[1])))
+        else:
+            out.append((int(p), int(p)))
+    return tuple(out)

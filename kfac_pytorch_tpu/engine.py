@@ -1,0 +1,340 @@
+"""Traced K-FAC step phases over the planned stacked-bucket layout.
+
+Each function here is the XLA-uniform counterpart of one phase of the
+reference pipeline (kfac_preconditioner_base.py:151-230):
+
+  compute_layer_stats    ≙ _compute_factors   (ComputeA/ComputeG per layer)
+  update_factors         ≙ running-avg update + _communicate_factors
+                           (pmean for MPD; none for DP — inv_dp.py:93-95)
+  compute_decomposition  ≙ _compute_inverse   (batched eigh / Cholesky on
+                           the local shard = the distributed computation)
+  gather_decomposition   ≙ _communicate_inverse (all-gather rows ≙
+                           per-owner broadcast, eigen.py:122-134)
+  compute_pred_*         ≙ _compute_pred (+ _communicate_pred for the
+                           owner-computes path, inv.py:164-175)
+  preconditioned_grads   ≙ _update_grad_in_place incl. KL clip
+                           (inv.py:188-217)
+
+All functions are written per-device: under a mesh they run inside
+shard_map with the factor/decomposition state sharded on axis 0 (rows are
+device-major, see plan.py); with ``axis_name=None`` they degenerate to the
+world=1 path with zero communication.
+
+Deviation from the reference: ``_add_value_to_diagonal`` there mutates the
+stored running-average factor in place (inv.py:106-129), so damping
+accumulates into the factor state across inverse updates. Here damping is
+applied to a temporary — the mathematically intended semantics.
+"""
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kfac_pytorch_tpu import capture, ops
+from kfac_pytorch_tpu.parallel import collectives as coll
+
+_PRED_PRECISION = lax.Precision.HIGHEST
+
+
+def _key(bdim):
+    return str(bdim)
+
+
+# ---------------------------------------------------------------------------
+# Grad matrix <-> param pytree
+# ---------------------------------------------------------------------------
+
+def layer_grad_matrix(meta, grads):
+    """Matrix-form gradient [out_dim, in_dim(+bias col)] in fp32.
+
+    Parity: ``_get_grad`` (reference: kfac_preconditioner_inv.py:145-154):
+    conv kernels flatten to [out, kh*kw*c_in] (HWIO flatten matches the
+    patch feature order, see ops/factors.py), bias appended as a column.
+    """
+    sub = capture.get_path(grads, meta.path)
+    k = sub['kernel']
+    if meta.kind == 'dense':
+        gm = k.T
+    else:
+        kh, kw, cin, cout = meta.kernel_shape
+        gm = k.reshape(kh * kw * cin, cout).T
+    gm = gm.astype(jnp.float32)
+    if meta.use_bias:
+        gm = jnp.concatenate([gm, sub['bias'].astype(jnp.float32)[:, None]],
+                             axis=1)
+    return gm
+
+
+def write_grad_matrix(meta, grads, mat):
+    """Inverse of :func:`layer_grad_matrix`: scatter a preconditioned
+    matrix back into the grads pytree (reference:
+    kfac_preconditioner_inv.py:178-186)."""
+    sub = dict(capture.get_path(grads, meta.path))
+    if meta.use_bias:
+        w, b = mat[:, :-1], mat[:, -1]
+        sub['bias'] = b.astype(sub['bias'].dtype)
+    else:
+        w = mat
+    if meta.kind == 'dense':
+        kernel = w.T
+    else:
+        kh, kw, cin, cout = meta.kernel_shape
+        kernel = w.T.reshape(kh, kw, cin, cout)
+    sub['kernel'] = kernel.astype(sub['kernel'].dtype)
+    return capture.set_path(grads, meta.path, sub)
+
+
+def _pad_mat(mat, dg, da):
+    out, inn = mat.shape
+    return jnp.pad(mat, ((0, dg - out), (0, da - inn)))
+
+
+# ---------------------------------------------------------------------------
+# Phase 1: factor statistics
+# ---------------------------------------------------------------------------
+
+def compute_layer_stats(plan, acts, gs, batch_averaged=True):
+    """Per-layer Kronecker factor statistics from captured (a, g)."""
+    a_list, g_list = [], []
+    for meta in plan.metas:
+        a = capture.layer_act(acts, meta)
+        g = capture.layer_g(gs, meta)
+        if meta.kind == 'dense':
+            a_list.append(ops.compute_a_dense(a, meta.use_bias))
+            g_list.append(ops.compute_g_dense(g, batch_averaged))
+        else:
+            a_list.append(ops.compute_a_conv(
+                a, meta.kernel_size, meta.strides, meta.padding,
+                meta.use_bias))
+            g_list.append(ops.compute_g_conv(g, batch_averaged))
+    return a_list, g_list
+
+
+def stack_stats(plan, a_list, g_list):
+    """Scatter per-layer stats into the global stacked-bucket layout
+    (identity padding; dummy rows are identity)."""
+    out = {}
+    for bdim in plan.bucket_dims:
+        b = plan.buckets[bdim]
+        rows = []
+        for s in b.slot_of_row:
+            if s is None:
+                rows.append(jnp.eye(bdim, dtype=jnp.float32))
+            else:
+                mat = (a_list[s.layer_idx] if s.side == 'A'
+                       else g_list[s.layer_idx])
+                rows.append(ops.identity_pad(mat, bdim))
+        out[_key(bdim)] = jnp.stack(rows)
+    return out
+
+
+def update_factors(plan, factors_local, stats_stacked, factor_decay,
+                   stats_reduce, axis_name):
+    """Running-average update of the local factor shard.
+
+    ``stats_reduce='pmean'``: MPD semantics — factors are the global-batch
+    average (reference allreduce, inv.py:94-103).
+    ``stats_reduce='local'``: DP semantics — the owner's local-batch stats
+    only, no factor communication at all (reference: inv_dp.py:60-95).
+    """
+    new = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        b = plan.buckets[bdim]
+        stats = stats_stacked[key]
+        if stats_reduce == 'pmean':
+            stats = coll.pmean(stats, axis_name)
+        idx = coll.axis_index(axis_name)
+        local = lax.dynamic_slice_in_dim(stats, idx * b.per_dev, b.per_dev,
+                                         axis=0)
+        new[key] = ops.update_running_avg(local, factors_local[key],
+                                          factor_decay)
+    return new
+
+
+# ---------------------------------------------------------------------------
+# Phase 2: decomposition (batched, on the local shard)
+# ---------------------------------------------------------------------------
+
+def _local_table(arr, axis_name):
+    """Pick this device's row of a static [P, ...] table."""
+    return jnp.take(jnp.asarray(arr), coll.axis_index(axis_name), axis=0)
+
+
+def compute_decomposition(plan, factors_local, damping, method, eps,
+                          axis_name):
+    """Batched eigh or pi-damped Cholesky inverse of the local factor rows.
+
+    eigh parity: eigen.py:98-119 / eigen_dp.py:62-75 (eigenvalue clamp
+    ``d * (d > eps)``). Cholesky parity: inv.py:109-129 with
+    ``pi = sqrt((trA/dimA)/(trG/dimG))`` scaled damping; both factor sides
+    reduce to ``sqrt(damping * own_trace_avg / mate_trace_avg)`` on their
+    diagonal, so one uniform expression covers A and G slots.
+    """
+    if method == 'eigh':
+        evals, evecs = {}, {}
+        for bdim in plan.bucket_dims:
+            key = _key(bdim)
+            d, q = ops.sym_eig(factors_local[key])
+            evals[key] = ops.clamp_eigvals(d, eps)
+            evecs[key] = q
+        return {'evals': evals, 'evecs': evecs}
+
+    # cholesky: per-slot traces (mate maps guarantee co-location, plan.py)
+    trace_parts = []
+    for bdim in plan.bucket_dims:
+        b = plan.buckets[bdim]
+        tdl = _local_table(b.true_dims.reshape(plan.num_devices, b.per_dev),
+                           axis_name)
+        trace_parts.append(ops.masked_trace(factors_local[_key(bdim)], tdl))
+    flat_tr = jnp.concatenate(trace_parts)
+
+    flat_dims = []
+    for bdim in plan.bucket_dims:
+        b = plan.buckets[bdim]
+        flat_dims.append(_local_table(
+            b.true_dims.reshape(plan.num_devices, b.per_dev), axis_name))
+    flat_dim = jnp.concatenate(flat_dims).astype(jnp.float32)
+    flat_avg = flat_tr / flat_dim
+
+    invs = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        b = plan.buckets[bdim]
+        off = plan.local_flat_offsets[bdim]
+        own_avg = lax.dynamic_slice_in_dim(flat_avg, off, b.per_dev)
+        mate_avg = jnp.take(flat_avg, _local_table(b.mate_flat, axis_name))
+        damp_vec = jnp.sqrt(damping * own_avg / mate_avg)
+        damped = ops.add_scaled_identity(factors_local[key], damp_vec)
+        invs[key] = ops.psd_inverse(damped)
+    return {'invs': invs}
+
+
+def gather_decomposition(plan, decomp_local, axis_name, communicate=True):
+    """All-gather decomposition rows to every device (comm_inverse mode).
+
+    ≙ per-owner broadcast of QA/dA/QG/dG or inverse factors (reference:
+    eigen.py:122-134, inv.py:132-142). With ``communicate=False`` (the
+    CommunicateInverse ablation) rows are placed at the owner's offset with
+    zeros elsewhere — shapes stay global, zero comm.
+    """
+    if communicate:
+        return jax.tree.map(lambda x: coll.all_gather_rows(x, axis_name),
+                            decomp_local)
+
+    def place(x):
+        per_dev = x.shape[0]
+        full = jnp.zeros((plan.num_devices * per_dev,) + x.shape[1:], x.dtype)
+        idx = coll.axis_index(axis_name)
+        return lax.dynamic_update_slice_in_dim(full, x, idx * per_dev, axis=0)
+
+    return jax.tree.map(place, decomp_local)
+
+
+# ---------------------------------------------------------------------------
+# Phase 3: preconditioning
+# ---------------------------------------------------------------------------
+
+def _pred_eigh(qg, dg, qa, da, gstack, damping):
+    v1 = jnp.einsum('mji,mjk,mkl->mil', qg, gstack, qa,
+                    precision=_PRED_PRECISION)
+    v2 = v1 / (dg[:, :, None] * da[:, None, :] + damping)
+    return jnp.einsum('mij,mjk,mlk->mil', qg, v2, qa,
+                      precision=_PRED_PRECISION)
+
+
+def _pred_inv(invg, inva, gstack, damping):
+    del damping  # damping was folded into the inverse
+    return jnp.einsum('mij,mjk,mkl->mil', invg, gstack, inva,
+                      precision=_PRED_PRECISION)
+
+
+def _group_grad_stack(plan, pg, grad_mats):
+    return jnp.stack([_pad_mat(grad_mats[int(i)], pg.dg, pg.da)
+                      for i in pg.layer_idx])
+
+
+def compute_pred_replicated(plan, decomp, grad_mats, damping, method):
+    """Preconditioning with replicated (gathered) decompositions — every
+    device computes every layer's pred, zero comm (reference eigen path:
+    all ranks run _compute_pred after broadcast, eigen.py:137-144)."""
+    preds = [None] * plan.num_layers
+    for pg in plan.pred_groups:
+        gstack = _group_grad_stack(plan, pg, grad_mats)
+        if method == 'eigh':
+            qa = decomp['evecs'][_key(pg.da)][pg.row_a]
+            da = decomp['evals'][_key(pg.da)][pg.row_a]
+            qg = decomp['evecs'][_key(pg.dg)][pg.row_g]
+            dg = decomp['evals'][_key(pg.dg)][pg.row_g]
+            pred = _pred_eigh(qg, dg, qa, da, gstack, damping)
+        else:
+            inva = decomp['invs'][_key(pg.da)][pg.row_a]
+            invg = decomp['invs'][_key(pg.dg)][pg.row_g]
+            pred = _pred_inv(invg, inva, gstack, damping)
+        for pos, i in enumerate(pg.layer_idx):
+            meta = plan.metas[int(i)]
+            preds[int(i)] = pred[pos, :meta.out_dim, :meta.in_dim]
+    return preds
+
+
+def compute_pred_local(plan, decomp_local, grad_mats, damping, method,
+                       axis_name, communicate=True):
+    """Owner-computes preconditioning + all-gather of the results
+    (comm_pred mode — the DP-KFAC flagship path: only final preconditioned
+    gradients travel, reference inv_dp.py:126-138 + inv.py:164-175)."""
+    preds = [None] * plan.num_layers
+    for pg in plan.pred_groups:
+        gstack = _group_grad_stack(plan, pg, grad_mats)
+        members = _local_table(pg.local_member, axis_name)
+        g_loc = jnp.take(gstack, members, axis=0)
+        ra = _local_table(pg.local_row_a, axis_name)
+        rg = _local_table(pg.local_row_g, axis_name)
+        if method == 'eigh':
+            qa = jnp.take(decomp_local['evecs'][_key(pg.da)], ra, axis=0)
+            da = jnp.take(decomp_local['evals'][_key(pg.da)], ra, axis=0)
+            qg = jnp.take(decomp_local['evecs'][_key(pg.dg)], rg, axis=0)
+            dg = jnp.take(decomp_local['evals'][_key(pg.dg)], rg, axis=0)
+            pred_loc = _pred_eigh(qg, dg, qa, da, g_loc, damping)
+        else:
+            inva = jnp.take(decomp_local['invs'][_key(pg.da)], ra, axis=0)
+            invg = jnp.take(decomp_local['invs'][_key(pg.dg)], rg, axis=0)
+            pred_loc = _pred_inv(invg, inva, g_loc, damping)
+        if communicate:
+            gathered = coll.all_gather_rows(pred_loc, axis_name)
+        else:
+            gathered = gather_decomposition(
+                plan, pred_loc, axis_name, communicate=False)
+        for pos, i in enumerate(pg.layer_idx):
+            meta = plan.metas[int(i)]
+            row = int(pg.gathered_row[pos])
+            preds[int(i)] = gathered[row, :meta.out_dim, :meta.in_dim]
+    return preds
+
+
+# ---------------------------------------------------------------------------
+# Phase 4: KL clip + write-back
+# ---------------------------------------------------------------------------
+
+def preconditioned_grads(plan, grads, grad_mats, preds, lr, kl_clip,
+                         skip_clip=False):
+    """Scale preds by the KL clip factor and scatter into the grads pytree.
+
+    Parity: ``_update_grad_in_place`` (reference: inv.py:188-217):
+    ``nu = min(1, sqrt(kl_clip / |sum(pred * grad * lr^2)|))``; non-KFAC
+    params pass through untouched.
+    """
+    if kl_clip is not None and not skip_clip:
+        vg = jnp.zeros((), jnp.float32)
+        for i in range(plan.num_layers):
+            vg = vg + jnp.sum(preds[i] * grad_mats[i])
+        vg = vg * (lr ** 2)
+        nu = jnp.minimum(1.0, jnp.sqrt(kl_clip / jnp.abs(vg)))
+    else:
+        nu = jnp.float32(1.0)
+    new_grads = grads
+    for i, meta in enumerate(plan.metas):
+        new_grads = write_grad_matrix(meta, new_grads, preds[i] * nu)
+    return new_grads

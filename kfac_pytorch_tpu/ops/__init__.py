@@ -1,0 +1,25 @@
+"""Pure-functional math ops for K-FAC on TPU (MXU-batched, fp32 factors)."""
+
+from kfac_pytorch_tpu.ops.factors import (
+    extract_patches,
+    compute_a_dense,
+    compute_a_conv,
+    compute_g_dense,
+    compute_g_conv,
+    update_running_avg,
+)
+from kfac_pytorch_tpu.ops.linalg import (
+    psd_inverse,
+    sym_eig,
+    clamp_eigvals,
+    add_scaled_identity,
+    masked_trace,
+    identity_pad,
+)
+
+__all__ = [
+    'extract_patches', 'compute_a_dense', 'compute_a_conv',
+    'compute_g_dense', 'compute_g_conv', 'update_running_avg',
+    'psd_inverse', 'sym_eig', 'clamp_eigvals', 'add_scaled_identity',
+    'masked_trace', 'identity_pad',
+]

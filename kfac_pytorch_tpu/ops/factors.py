@@ -1,0 +1,155 @@
+"""Kronecker-factor statistics ops.
+
+Semantics parity with the reference math layer (reference:
+kfac/utils.py:33-140) but laid out for TPU: NHWC activations, HWIO conv
+kernels, im2col via ``lax.conv_general_dilated_patches`` (one fused XLA op
+instead of unfold+transpose chains), and all covariance GEMMs emitted as
+single ``dot_general`` calls with fp32 accumulation so XLA tiles them onto
+the MXU.
+
+Conventions
+-----------
+- Dense activations ``a``: ``[N, ..., d_in]`` — any middle dims are a
+  sequence axis and are mean-reduced (reference: kfac/utils.py:97-99).
+- Conv activations ``a``: ``[N, H, W, C]`` (NHWC; the reference is NCHW).
+- Output-gradients ``g`` mirror the activations with ``d_out``/``C_out``.
+- Factors are fp32 regardless of activation dtype (the reference computes
+  them in fp32, optionally via fp16-in/fp32-accum tensor-core GEMM,
+  kfac/utils.py:155-158 — the MXU bf16-in/fp32-accum path is the native
+  equivalent here).
+- The feature order of conv patches is ``(kh, kw, c_in)`` to match the
+  flattening of an HWIO kernel, so factor A indexes align with
+  ``kernel.reshape(-1, c_out)`` (the reference's ``(c_in, kh, kw)`` order
+  likewise matches torch's OIHW flatten, kfac/utils.py:33-54 +
+  kfac_preconditioner_inv.py:145-154).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Factor statistics are accumulated in fp32. Inputs may be bf16 (model
+# compute dtype) — dot_general with preferred_element_type=f32 is the MXU's
+# native mixed-precision mode.
+_FACTOR_DTYPE = jnp.float32
+
+
+def _stat_gemm(x, n):
+    """Return ``x^T @ (x / n)`` in fp32 — the covariance GEMM of every factor."""
+    return lax.dot_general(
+        x, x / n,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=_FACTOR_DTYPE,
+    ).astype(_FACTOR_DTYPE)
+
+
+def extract_patches(x, kernel_size, strides, padding):
+    """im2col: ``[N, H, W, C] -> [N, OH, OW, kh*kw*C]``.
+
+    Feature order is ``(kh, kw, c)`` — matches HWIO kernel flattening.
+    Parity: ``_extract_patches`` (reference: kfac/utils.py:33-54).
+
+    Args:
+      x: NHWC input feature maps.
+      kernel_size: ``(kh, kw)``.
+      strides: ``(sh, sw)``.
+      padding: ``(ph, pw)`` symmetric pad, or an explicit
+        ``[(lo, hi), (lo, hi)]`` list (as produced by Flax padding configs).
+    """
+    n, h, w, c = x.shape
+    kh, kw = kernel_size
+    if isinstance(padding, str):
+        pads = padding
+    elif len(padding) == 2 and not isinstance(padding[0], (tuple, list)):
+        pads = [(padding[0], padding[0]), (padding[1], padding[1])]
+    else:
+        pads = [tuple(p) for p in padding]
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=tuple(strides),
+        padding=pads, dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+    oh, ow = patches.shape[1:3]
+    # conv_general_dilated_patches emits features channel-major (c, kh, kw);
+    # reorder to (kh, kw, c) to align with HWIO kernel flattening.
+    patches = patches.reshape(n, oh, ow, c, kh * kw)
+    patches = patches.transpose(0, 1, 2, 4, 3).reshape(n, oh, ow, kh * kw * c)
+    return patches
+
+
+def _append_ones_column(x):
+    ones = jnp.ones(x.shape[:-1] + (1,), dtype=x.dtype)
+    return jnp.concatenate([x, ones], axis=-1)
+
+
+def compute_a_dense(a, use_bias):
+    """Factor A for a dense layer: ``[d_in(+1), d_in(+1)]``.
+
+    Sequence axes are mean-reduced before the outer product; a ones column is
+    appended when the layer has a bias. Parity: ``ComputeA.linear``
+    (reference: kfac/utils.py:97-103).
+    """
+    if a.ndim > 2:
+        a = a.mean(axis=tuple(range(1, a.ndim - 1)))
+    n = a.shape[0]
+    if use_bias:
+        a = _append_ones_column(a)
+    return _stat_gemm(a, n)
+
+
+def compute_a_conv(a, kernel_size, strides, padding, use_bias):
+    """Factor A for a conv layer: ``[kh*kw*C(+1), kh*kw*C(+1)]``.
+
+    im2col rows are spatially normalized (each row divided by the number of
+    spatial positions) before the covariance GEMM; the bias ones column is
+    appended before that normalization. Parity: ``ComputeA.conv2d``
+    (reference: kfac/utils.py:86-94).
+    """
+    n = a.shape[0]
+    patches = extract_patches(a, kernel_size, strides, padding)
+    spatial = patches.shape[1] * patches.shape[2]
+    rows = patches.reshape(-1, patches.shape[-1])
+    if use_bias:
+        rows = _append_ones_column(rows)
+    rows = rows / spatial
+    return _stat_gemm(rows, n)
+
+
+def compute_g_dense(g, batch_averaged=True):
+    """Factor G for a dense layer from output-gradients ``[N, ..., d_out]``.
+
+    When the loss is batch-averaged, the implicit 1/N is undone so G is the
+    covariance of per-example gradients. Parity: ``ComputeG.linear``
+    (reference: kfac/utils.py:131-140).
+    """
+    if g.ndim > 2:
+        g = g.mean(axis=tuple(range(1, g.ndim - 1)))
+    n = g.shape[0]
+    if batch_averaged:
+        g = g * n
+    return _stat_gemm(g, n)
+
+
+def compute_g_conv(g, batch_averaged=True):
+    """Factor G for a conv layer from output-gradients ``[N, OH, OW, C]``.
+
+    Spatial positions are treated as extra samples, scaled by the spatial
+    size to undo the conv-as-sum normalization. Parity: ``ComputeG.conv2d``
+    (reference: kfac/utils.py:118-129).
+    """
+    n = g.shape[0]
+    spatial = g.shape[1] * g.shape[2]
+    rows = g.reshape(-1, g.shape[-1])
+    if batch_averaged:
+        rows = rows * n
+    rows = rows * spatial
+    return _stat_gemm(rows, rows.shape[0])
+
+
+def update_running_avg(new, current, alpha):
+    """Functional running average: ``alpha * new + (1 - alpha) * current``.
+
+    Parity: ``update_running_avg`` (reference: kfac/utils.py:66-71), but
+    returns the new value instead of mutating in place (XLA will fuse the
+    axpy into surrounding ops).
+    """
+    alpha = jnp.asarray(alpha, dtype=current.dtype)
+    return current * (1.0 - alpha) + new.astype(current.dtype) * alpha

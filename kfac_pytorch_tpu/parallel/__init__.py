@@ -1,0 +1,29 @@
+"""Distribution layer: mesh helpers, layer→device scheduling, collectives.
+
+Replaces the reference's Horovod/NCCL/MPI backend (reference:
+kfac/backend.py, packages/tcmm/src/communicator.{h,cpp}) with
+jax.sharding.Mesh + shard_map + XLA collectives over ICI/DCN.
+"""
+
+from kfac_pytorch_tpu.parallel.partition import (
+    round_robin_assign,
+    balanced_assign,
+    block_partition,
+)
+from kfac_pytorch_tpu.parallel.collectives import (
+    pmean,
+    psum,
+    all_gather_rows,
+    axis_index,
+    axis_size,
+)
+from kfac_pytorch_tpu.parallel.mesh import (
+    make_mesh,
+    data_parallel_specs,
+)
+
+__all__ = [
+    'round_robin_assign', 'balanced_assign', 'block_partition',
+    'pmean', 'psum', 'all_gather_rows', 'axis_index', 'axis_size',
+    'make_mesh', 'data_parallel_specs',
+]

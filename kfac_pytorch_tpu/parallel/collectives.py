@@ -1,0 +1,47 @@
+"""Thin collective wrappers with a degenerate world=1 path.
+
+The reference guards every collective behind ``backend.comm.size() > 1``
+(kfac_preconditioner_base.py:204-221) so single-process runs exercise the
+full math path with zero comm; passing ``axis_name=None`` here gives the
+same property. With an axis name, these lower to XLA collectives scheduled
+over ICI (psum / all-gather), which also subsume the reference's tcmm
+multi-stream overlap (communicator.cpp:62-72) via XLA async scheduling.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pmean(x, axis_name):
+    if axis_name is None:
+        return x
+    return lax.pmean(x, axis_name)
+
+
+def psum(x, axis_name):
+    if axis_name is None:
+        return x
+    return lax.psum(x, axis_name)
+
+
+def all_gather_rows(x, axis_name):
+    """Concatenate per-device row blocks along axis 0 (device-major) —
+    the owner-broadcast replacement: owners hold their rows, the gather
+    replicates all rows everywhere (reference broadcast-from-owner:
+    kfac_preconditioner_eigen.py:122-134, inv.py:164-175)."""
+    if axis_name is None:
+        return x
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def axis_index(axis_name):
+    if axis_name is None:
+        return jnp.int32(0)
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    if axis_name is None:
+        return 1
+    return lax.axis_size(axis_name)

@@ -1,0 +1,33 @@
+"""Mesh construction helpers — the launch/cluster layer, TPU-style.
+
+The reference establishes the process group via mpirun + Horovod/torchrun
+(launch_horovod.sh:32, kfac/backend.py:29-48). On TPU the equivalent is one
+jax.sharding.Mesh over all devices (multi-host via jax.distributed); data
+parallelism is a mesh axis, not a process abstraction.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(num_devices=None, axis_name='batch', devices=None):
+    """1-D data-parallel mesh over the first ``num_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def data_parallel_specs(axis_name='batch'):
+    """(replicated, batch-sharded) PartitionSpecs for the common case."""
+    return P(), P(axis_name)
+
+
+def shard_batch(mesh, axis_name, batch):
+    """Place a host batch with its leading axis sharded over the mesh —
+    the DistributedSampler equivalent (reference:
+    examples/pytorch_cifar10_resnet.py:180-192)."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

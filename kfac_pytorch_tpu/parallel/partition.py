@@ -1,0 +1,69 @@
+"""Layer/factor → device scheduling (host-side, static).
+
+The reference schedules preconditioner work round-robin
+(kfac_preconditioner_inv.py:62-77, with the factor-wise interleaved variant
+at kfac_preconditioner_eigen.py:75-94) and ships a smarter load-balanced
+block partition as research code (scripts/dp_block_partition.py:11-76).
+Here both are first-class policies; the assignment decides the row order of
+the stacked factor buckets, so "rank owns layer" becomes "mesh index owns
+stacked-array rows".
+"""
+
+import numpy as np
+
+
+def round_robin_assign(n_items, num_devices):
+    """item i → device i % P. Parity: kfac_preconditioner_inv.py:62-77 (and,
+    applied to an interleaved A/G slot sequence, eigen.py:75-94)."""
+    return np.arange(n_items, dtype=np.int64) % num_devices
+
+
+def balanced_assign(costs, num_devices):
+    """Greedy longest-processing-time assignment: sort by cost descending,
+    place each item on the least-loaded device.
+
+    The practical equivalent of the optimal bottleneck block partition the
+    reference prototypes (scripts/dp_block_partition.py:11-76) — LPT is
+    within 4/3 of optimal makespan and, unlike the contiguous block
+    partition, is order-free (row order inside buckets is ours to choose).
+    """
+    costs = np.asarray(costs, dtype=np.float64)
+    owners = np.zeros(len(costs), dtype=np.int64)
+    load = np.zeros(num_devices, dtype=np.float64)
+    for i in np.argsort(-costs, kind='stable'):
+        d = int(np.argmin(load))
+        owners[i] = d
+        load[d] += costs[i]
+    return owners
+
+
+def block_partition(costs, num_devices):
+    """Optimal contiguous bottleneck partition via dynamic programming.
+
+    Functional parity with the reference's research scheduler
+    (scripts/dp_block_partition.py:11-76): split an ordered cost list into
+    ``num_devices`` contiguous blocks minimizing the max block sum. Returns
+    an owner array. Useful when assignment must preserve layer order.
+    """
+    costs = np.asarray(costs, dtype=np.float64)
+    n = len(costs)
+    p = min(num_devices, n) if n else num_devices
+    prefix = np.concatenate([[0.0], np.cumsum(costs)])
+    # dp[k][i]: min bottleneck splitting first i items into k blocks
+    dp = np.full((p + 1, n + 1), np.inf)
+    cut = np.zeros((p + 1, n + 1), dtype=np.int64)
+    dp[0, 0] = 0.0
+    for k in range(1, p + 1):
+        for i in range(1, n + 1):
+            for j in range(k - 1, i):
+                cand = max(dp[k - 1, j], prefix[i] - prefix[j])
+                if cand < dp[k, i]:
+                    dp[k, i] = cand
+                    cut[k, i] = j
+    owners = np.zeros(n, dtype=np.int64)
+    i = n
+    for k in range(p, 0, -1):
+        j = cut[k, i]
+        owners[j:i] = k - 1
+        i = j
+    return owners

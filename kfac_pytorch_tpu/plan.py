@@ -1,0 +1,275 @@
+"""Static factor-distribution plan (host-side, built once at setup).
+
+The reference's scheduling maps layers to ranks and branches per-rank at
+runtime (``if rank == rank_a`` — kfac_preconditioner_inv_dp.py:80-90).
+XLA wants one uniform program, so the plan instead fixes a *layout*:
+
+- every Kronecker factor ("slot": one layer's A or G) is identity-padded to
+  a bucket dim and stacked into one ``[rows, D, D]`` array per bucket;
+- rows are ordered device-major (device d owns rows
+  ``[d*per_dev, (d+1)*per_dev)``), so sharding axis 0 over the mesh puts
+  each factor on its owner and batched eigh/inverse on the local shard *is*
+  the distributed computation;
+- preconditioning batches layers by their (G-bucket, A-bucket) pair so the
+  per-layer triple matmuls run as batched einsums on the MXU.
+
+Identity padding is numerically exact (see ops/linalg.py). The stacked
+sharded-eigh layout is the TPU-idiomatic form of tcmm's multiBcast fused
+compute+broadcast (reference: packages/tcmm/src/communicator.cpp:75-117).
+"""
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kfac_pytorch_tpu.capture import LayerMeta
+from kfac_pytorch_tpu.parallel.partition import (
+    balanced_assign, round_robin_assign)
+
+
+def default_bucket_fn(dim, min_bucket=128):
+    """Pad dim → nearest of {min, 1.5·2^k, 2^k} ≥ dim. Keeps eigh padding
+    waste ≤ 1.5³ while staying lane-aligned (TPU tiles are 128 wide)."""
+    if dim <= min_bucket:
+        return min_bucket
+    b = min_bucket
+    while True:
+        if dim <= b:
+            return b
+        if dim <= b + b // 2:
+            return b + b // 2
+        b *= 2
+
+
+@dataclasses.dataclass(frozen=True)
+class Slot:
+    layer_idx: int
+    side: str        # 'A' | 'G'
+    dim: int         # true (unpadded) dim
+    owner: int
+
+
+@dataclasses.dataclass
+class Bucket:
+    """One stacked factor array: [n_rows, dim, dim], device-major rows."""
+    dim: int
+    per_dev: int
+    n_rows: int
+    slot_of_row: List[Optional[Slot]]       # None → dummy pad row
+    true_dims: np.ndarray                   # [n_rows]; dummies get dim
+    valid: np.ndarray                       # [n_rows] bool
+    # pi-damping mate maps (cholesky variants; rank_a == rank_g layouts):
+    # for each row: flat local index (concat over buckets, per device) of
+    # the other factor of the same layer, plus dims and side sign.
+    mate_flat: Optional[np.ndarray] = None  # [P, per_dev]
+    own_dim: Optional[np.ndarray] = None    # [P, per_dev]
+    mate_dim: Optional[np.ndarray] = None   # [P, per_dev]
+    side_is_a: Optional[np.ndarray] = None  # [P, per_dev] bool
+
+
+@dataclasses.dataclass
+class PredGroup:
+    """Layers sharing (G-bucket, A-bucket): batched preconditioning unit."""
+    dg: int
+    da: int
+    layer_idx: np.ndarray       # [M] global layer indices (static order)
+    row_a: np.ndarray           # [M] global row in bucket da
+    row_g: np.ndarray           # [M] global row in bucket dg
+    # comm_pred (owner-computes) maps:
+    k_per_dev: int = 0
+    local_member: Optional[np.ndarray] = None   # [P, K] index into layer_idx
+    local_valid: Optional[np.ndarray] = None    # [P, K] bool
+    local_row_a: Optional[np.ndarray] = None    # [P, K] row in local da shard
+    local_row_g: Optional[np.ndarray] = None    # [P, K] row in local dg shard
+    gathered_row: Optional[np.ndarray] = None   # [M] row in all-gathered P*K
+
+
+@dataclasses.dataclass
+class FactorPlan:
+    metas: List[LayerMeta]
+    num_devices: int
+    comm_mode: str                      # 'inverse' | 'pred'
+    buckets: Dict[int, Bucket]
+    # per layer: (bucket_a, row_a_global, bucket_g, row_g_global, owner)
+    layer_rows: List[Tuple[int, int, int, int, int]]
+    pred_groups: List[PredGroup]
+    bucket_dims: List[int]              # sorted bucket keys (stable order)
+    local_flat_offsets: Dict[int, int]  # bucket dim -> offset into the
+                                        # per-device concatenated slot vector
+
+    @property
+    def num_layers(self):
+        return len(self.metas)
+
+
+def _slot_cost(dim):
+    # eigh/cholesky cost model ~ D^3 (reference fits a linear+cubic model,
+    # scripts/inverse_model.py / comm_models.py:21-50; cubic term dominates)
+    return float(dim) ** 3
+
+
+def build_plan(metas: Dict[str, LayerMeta], num_devices: int, comm_mode: str,
+               assignment: str = 'round_robin',
+               distribute_layer_factors: bool = False,
+               bucket_fn: Callable[[int], int] = default_bucket_fn):
+    """Build the static layout.
+
+    Ownership parity: round-robin layer→rank (kfac_preconditioner_inv.py:
+    62-77); with ``distribute_layer_factors`` (comm_mode='inverse' only) the
+    interleaved A/G slot round-robin of eigen.py:75-94; 'balanced' uses the
+    LPT scheduler (the dp_block_partition.py upgrade).
+    """
+    meta_list = list(metas.values())
+    L = len(meta_list)
+    P = num_devices
+    if comm_mode == 'pred' and distribute_layer_factors:
+        raise ValueError(
+            'factor-wise distribution requires communicating inverses '
+            '(reference asserts rank_a == rank_g for comm_pred, '
+            'kfac_preconditioner_inv.py:169)')
+
+    # --- ownership ------------------------------------------------------
+    if distribute_layer_factors:
+        # interleaved slot sequence [A0, G0, A1, G1, ...]
+        dims = []
+        for m in meta_list:
+            dims.extend([m.in_dim, m.out_dim])
+        if assignment == 'balanced':
+            owners = balanced_assign([_slot_cost(d) for d in dims], P)
+        else:
+            owners = round_robin_assign(2 * L, P)
+        slot_owner = [(int(owners[2 * i]), int(owners[2 * i + 1]))
+                      for i in range(L)]
+        layer_owner = [a for a, _ in slot_owner]  # nominal (unused for pred)
+    else:
+        if assignment == 'balanced':
+            costs = [_slot_cost(m.in_dim) + _slot_cost(m.out_dim)
+                     for m in meta_list]
+            owners = balanced_assign(costs, P)
+        else:
+            owners = round_robin_assign(L, P)
+        layer_owner = [int(o) for o in owners]
+        slot_owner = [(o, o) for o in layer_owner]
+
+    # --- buckets --------------------------------------------------------
+    slots: List[Slot] = []
+    for i, m in enumerate(meta_list):
+        oa, og = slot_owner[i]
+        slots.append(Slot(i, 'A', m.in_dim, oa))
+        slots.append(Slot(i, 'G', m.out_dim, og))
+
+    by_bucket: Dict[int, List[Slot]] = {}
+    for s in slots:
+        by_bucket.setdefault(bucket_fn(s.dim), []).append(s)
+
+    buckets: Dict[int, Bucket] = {}
+    slot_row: Dict[Tuple[int, str], Tuple[int, int]] = {}  # → (bucket, row)
+    for bdim in sorted(by_bucket):
+        members = by_bucket[bdim]
+        rows_by_dev: List[List[Slot]] = [[] for _ in range(P)]
+        for s in members:
+            rows_by_dev[s.owner].append(s)
+        per_dev = max(1, max(len(r) for r in rows_by_dev))
+        n_rows = P * per_dev
+        slot_of_row: List[Optional[Slot]] = [None] * n_rows
+        true_dims = np.full(n_rows, bdim, dtype=np.int32)
+        valid = np.zeros(n_rows, dtype=bool)
+        for d in range(P):
+            for k, s in enumerate(rows_by_dev[d]):
+                r = d * per_dev + k
+                slot_of_row[r] = s
+                true_dims[r] = s.dim
+                valid[r] = True
+                slot_row[(s.layer_idx, s.side)] = (bdim, r)
+        buckets[bdim] = Bucket(dim=bdim, per_dev=per_dev, n_rows=n_rows,
+                               slot_of_row=slot_of_row, true_dims=true_dims,
+                               valid=valid)
+
+    bucket_dims = sorted(buckets)
+    # flat local-slot indexing: per device, concat of its local rows over
+    # buckets in bucket_dims order
+    local_flat_offsets = {}
+    off = 0
+    for bdim in bucket_dims:
+        local_flat_offsets[bdim] = off
+        off += buckets[bdim].per_dev
+
+    # --- pi-damping mate maps (only meaningful when rank_a == rank_g) ---
+    if not distribute_layer_factors:
+        for bdim in bucket_dims:
+            b = buckets[bdim]
+            mate_flat = np.zeros((P, b.per_dev), dtype=np.int32)
+            own_dim = np.full((P, b.per_dev), bdim, dtype=np.int32)
+            mate_dim = np.full((P, b.per_dev), bdim, dtype=np.int32)
+            side_is_a = np.ones((P, b.per_dev), dtype=bool)
+            for d in range(P):
+                for k in range(b.per_dev):
+                    r = d * b.per_dev + k
+                    s = b.slot_of_row[r]
+                    self_flat = local_flat_offsets[bdim] + k
+                    if s is None:
+                        mate_flat[d, k] = self_flat  # dummy: pi = 1
+                        continue
+                    mate_side = 'G' if s.side == 'A' else 'A'
+                    mb, mr = slot_row[(s.layer_idx, mate_side)]
+                    md = mr // buckets[mb].per_dev
+                    assert md == d, 'mate slot must be co-located'
+                    mate_flat[d, k] = (local_flat_offsets[mb]
+                                       + mr - md * buckets[mb].per_dev)
+                    own_dim[d, k] = s.dim
+                    mate_dim[d, k] = buckets[mb].true_dims[mr]
+                    side_is_a[d, k] = s.side == 'A'
+            b.mate_flat, b.own_dim = mate_flat, own_dim
+            b.mate_dim, b.side_is_a = mate_dim, side_is_a
+
+    # --- per-layer row lookup ------------------------------------------
+    layer_rows = []
+    for i, m in enumerate(meta_list):
+        ba, ra = slot_row[(i, 'A')]
+        bg, rg = slot_row[(i, 'G')]
+        layer_rows.append((ba, ra, bg, rg, layer_owner[i]))
+
+    # --- pred groups ----------------------------------------------------
+    groups: Dict[Tuple[int, int], List[int]] = {}
+    for i, m in enumerate(meta_list):
+        key = (bucket_fn(m.out_dim), bucket_fn(m.in_dim))
+        groups.setdefault(key, []).append(i)
+
+    pred_groups = []
+    for (dg, da), lidx in sorted(groups.items()):
+        lidx = np.asarray(lidx, dtype=np.int32)
+        row_a = np.asarray([layer_rows[i][1] for i in lidx], dtype=np.int32)
+        row_g = np.asarray([layer_rows[i][3] for i in lidx], dtype=np.int32)
+        pg = PredGroup(dg=dg, da=da, layer_idx=lidx, row_a=row_a, row_g=row_g)
+        if comm_mode == 'pred':
+            members_by_dev: List[List[int]] = [[] for _ in range(P)]
+            for mpos, i in enumerate(lidx):
+                members_by_dev[layer_rows[i][4]].append(mpos)
+            K = max(1, max(len(v) for v in members_by_dev))
+            local_member = np.zeros((P, K), dtype=np.int32)
+            local_valid = np.zeros((P, K), dtype=bool)
+            local_row_a = np.zeros((P, K), dtype=np.int32)
+            local_row_g = np.zeros((P, K), dtype=np.int32)
+            gathered_row = np.zeros(len(lidx), dtype=np.int32)
+            for d in range(P):
+                for k, mpos in enumerate(members_by_dev[d]):
+                    i = int(lidx[mpos])
+                    ba, ra, bg, rg, owner = layer_rows[i]
+                    local_member[d, k] = mpos
+                    local_valid[d, k] = True
+                    local_row_a[d, k] = ra - d * buckets[ba].per_dev
+                    local_row_g[d, k] = rg - d * buckets[bg].per_dev
+                    gathered_row[mpos] = d * K + k
+            pg.k_per_dev = K
+            pg.local_member = local_member
+            pg.local_valid = local_valid
+            pg.local_row_a = local_row_a
+            pg.local_row_g = local_row_g
+            pg.gathered_row = gathered_row
+        pred_groups.append(pg)
+
+    return FactorPlan(metas=meta_list, num_devices=P, comm_mode=comm_mode,
+                      buckets=buckets, layer_rows=layer_rows,
+                      pred_groups=pred_groups, bucket_dims=bucket_dims,
+                      local_flat_offsets=local_flat_offsets)

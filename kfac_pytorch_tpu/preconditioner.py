@@ -1,0 +1,279 @@
+"""The K-FAC preconditioner facade: four variants behind one engine.
+
+Reference surface parity (kfac/__init__.py:8-16 and the four
+kfac_preconditioner_*.py classes) via three orthogonal engine switches:
+
+  variant       stats_reduce   method      comm_mode
+  ----------    ------------   ---------   -------------------------------
+  inverse       pmean (MPD)    cholesky    'pred' (default) or 'inverse'
+                                           per communicate_inverse_or_not
+                                           (inv.py:41)
+  eigen         pmean (MPD)    eigh        'inverse' (forced, eigen.py:52)
+  inverse_dp    local  (DP)    cholesky    'pred' (forced, inv_dp.py:52)
+  eigen_dp      local  (DP)    eigh        'pred' (forced — the flagship,
+                                           train_cifar10.sh:19)
+
+Unlike the reference's stateful ``torch.optim.Optimizer`` subclass, the
+preconditioner is a pure-functional transformation: ``step`` maps
+``(state, grads, captured stats) -> (preconditioned grads, state)`` and is
+designed to be traced inside jit / shard_map. Host-side knobs
+(``fac_update_freq`` / ``kfac_update_freq`` / ``damping``) select static
+step variants and feed traced scalars — the KFACParamScheduler mutates them
+without recompilation.
+"""
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from kfac_pytorch_tpu import engine
+from kfac_pytorch_tpu.plan import build_plan, default_bucket_fn
+
+
+class KFACState(flax.struct.PyTreeNode):
+    """Factor + decomposition state, stacked-bucket layout (plan.py).
+
+    ``factors``/decomposition arrays are globally shaped ``[rows, D, D]``;
+    under a mesh the factor rows are sharded over the kfac axis (see
+    ``KFAC.state_pspecs``). The reference equivalents are the per-module
+    dicts m_A/m_G/m_inv_A/m_inv_G/m_QA/m_dA/...
+    (kfac_preconditioner_base.py:107-110).
+    """
+    step: jnp.ndarray
+    factors: Dict[str, jnp.ndarray]
+    decomp: Dict[str, Dict[str, jnp.ndarray]]
+
+
+@flax.struct.dataclass
+class KFACHyperParams:
+    """Traced hyper-parameters (schedulable without recompile)."""
+    lr: jnp.ndarray
+    damping: jnp.ndarray
+
+
+_VARIANTS = {
+    'inverse': dict(stats_reduce='pmean', method='cholesky', comm_mode=None),
+    'eigen': dict(stats_reduce='pmean', method='eigh', comm_mode='inverse'),
+    'inverse_dp': dict(stats_reduce='local', method='cholesky',
+                       comm_mode='pred'),
+    'eigen_dp': dict(stats_reduce='local', method='eigh', comm_mode='pred'),
+}
+
+
+class KFAC:
+    """Distributed K-FAC gradient preconditioner.
+
+    Args mirror the reference constructor (kfac_preconditioner_base.py:66-99)
+    plus the mesh placement knobs:
+
+      variant: one of 'inverse' | 'eigen' | 'inverse_dp' | 'eigen_dp'.
+      lr, damping, fac_update_freq, kfac_update_freq, kl_clip,
+      factor_decay, exclude_vocabulary_size, hook_enabled, exclude_parts:
+        reference semantics.
+      communicate_inverse_or_not: 'inverse' variant only — communicate
+        inverse KFs instead of preconditioned grads (inv.py:41).
+      num_devices / axis_name: size of the kfac mesh axis and its name
+        inside shard_map; axis_name=None is the world=1 zero-comm path.
+      assignment: 'round_robin' (reference) | 'balanced' (LPT scheduler).
+      distribute_layer_factors: eigen variant — put A and G of one layer on
+        different devices when the mesh outnumbers layers (eigen.py:66-71);
+        default auto.
+    """
+
+    def __init__(self, variant='eigen_dp', lr=0.1, damping=0.001,
+                 fac_update_freq=1, kfac_update_freq=1,
+                 communicate_inverse_or_not=False, kl_clip=0.001,
+                 factor_decay=0.95, exclude_vocabulary_size=None,
+                 hook_enabled=True, exclude_parts='', batch_averaged=True,
+                 num_devices=1, axis_name=None, assignment='round_robin',
+                 distribute_layer_factors=None, bucket_fn=None, eps=1e-10):
+        if variant not in _VARIANTS:
+            raise KeyError(f'unknown variant {variant!r}')
+        cfg = dict(_VARIANTS[variant])
+        if cfg['comm_mode'] is None:  # 'inverse' variant honors the flag
+            cfg['comm_mode'] = ('inverse' if communicate_inverse_or_not
+                                else 'pred')
+        self.variant = variant
+        self.stats_reduce = cfg['stats_reduce']
+        self.method = cfg['method']
+        self.comm_mode = cfg['comm_mode']
+        self.lr = lr
+        self.damping = damping
+        self.fac_update_freq = fac_update_freq
+        self.kfac_update_freq = kfac_update_freq
+        self.kl_clip = kl_clip if (kl_clip is not None and kl_clip > 0) \
+            else None
+        self.factor_decay = factor_decay
+        self.exclude_vocabulary_size = exclude_vocabulary_size
+        self.hook_enabled = hook_enabled
+        self.batch_averaged = batch_averaged
+        self.num_devices = num_devices
+        self.axis_name = axis_name
+        self.assignment = assignment
+        self.distribute_layer_factors = distribute_layer_factors
+        self.bucket_fn = bucket_fn or default_bucket_fn
+        self.eps = eps
+        # exclude_parts ablation flags (kfac_preconditioner_base.py:96-99)
+        self.exclude_communicate_inverse = 'CommunicateInverse' in exclude_parts
+        self.exclude_compute_inverse = 'ComputeInverse' in exclude_parts
+        self.exclude_communicate_factor = 'CommunicateFactor' in exclude_parts
+        self.exclude_compute_factor = 'ComputeFactor' in exclude_parts
+        self.plan = None
+
+    # -- setup ------------------------------------------------------------
+
+    def setup(self, metas):
+        """Build the static factor plan from capture layer metadata.
+
+        ≙ _register_module_hooks + schedule_module_ranks (reference:
+        kfac_preconditioner_base.py:132-149, inv.py:62-77). The vocab-size
+        exclusion is applied here if not already filtered.
+        """
+        if self.exclude_vocabulary_size is not None:
+            metas = {k: m for k, m in metas.items()
+                     if not (m.kind == 'dense'
+                             and m.out_dim == self.exclude_vocabulary_size)}
+        distribute = self.distribute_layer_factors
+        if self.variant == 'eigen' and distribute is None:
+            # reference auto rule: factor-wise split iff world > #layers
+            # (eigen.py:66-71)
+            distribute = self.num_devices > len(metas)
+        self.plan = build_plan(
+            metas, num_devices=self.num_devices, comm_mode=self.comm_mode,
+            assignment=self.assignment,
+            distribute_layer_factors=bool(distribute),
+            bucket_fn=self.bucket_fn)
+        return self.plan
+
+    def init(self):
+        """Initial state: identity factors (reference initializes running
+        averages at identity, inv.py:82-90), zero decompositions
+        (eigen.py:100-107)."""
+        assert self.plan is not None, 'call setup() first'
+        plan = self.plan
+        factors, dzero = {}, {}
+        for bdim in plan.bucket_dims:
+            b = plan.buckets[bdim]
+            factors[str(bdim)] = jnp.broadcast_to(
+                jnp.eye(bdim, dtype=jnp.float32),
+                (b.n_rows, bdim, bdim))
+        if self.method == 'eigh':
+            decomp = {
+                'evals': {str(d): jnp.zeros(
+                    (plan.buckets[d].n_rows, d), jnp.float32)
+                    for d in plan.bucket_dims},
+                'evecs': {str(d): jnp.zeros(
+                    (plan.buckets[d].n_rows, d, d), jnp.float32)
+                    for d in plan.bucket_dims},
+            }
+        else:
+            decomp = {
+                'invs': {str(d): jnp.zeros(
+                    (plan.buckets[d].n_rows, d, d), jnp.float32)
+                    for d in plan.bucket_dims},
+            }
+        return KFACState(step=jnp.zeros((), jnp.int32), factors=factors,
+                         decomp=decomp)
+
+    def state_pspecs(self, axis_name=None):
+        """PartitionSpecs matching the state layout: factor rows sharded
+        over the kfac axis; decompositions sharded in comm_pred mode,
+        replicated (post-gather) in comm_inverse mode."""
+        axis_name = axis_name or self.axis_name
+        sharded = P(axis_name)
+        replicated = P()
+        factors = {k: sharded for k in (str(d) for d in self.plan.bucket_dims)}
+        dspec = sharded if self.comm_mode == 'pred' else replicated
+        decomp = jax.tree.map(lambda _: dspec, self._decomp_structure())
+        return KFACState(step=replicated, factors=factors, decomp=decomp)
+
+    def _decomp_structure(self):
+        if self.method == 'eigh':
+            return {'evals': {str(d): 0 for d in self.plan.bucket_dims},
+                    'evecs': {str(d): 0 for d in self.plan.bucket_dims}}
+        return {'invs': {str(d): 0 for d in self.plan.bucket_dims}}
+
+    # -- host-side gating (trainer chooses compiled step variants) --------
+
+    def should_update_factors(self, step: int) -> bool:
+        return self.hook_enabled and step % self.fac_update_freq == 0
+
+    def should_update_inverse(self, step: int) -> bool:
+        return step % self.kfac_update_freq == 0
+
+    # -- the step ---------------------------------------------------------
+
+    def step(self, state: KFACState, grads, acts=None, gs=None,
+             hyper: Optional[KFACHyperParams] = None, *,
+             update_factors: bool = True, update_inverse: bool = True,
+             axis_name: str = '__default__'):
+        """One K-FAC step: (state, grads, captured stats) ->
+        (preconditioned grads, new state).
+
+        Pure and traceable; call inside jit / shard_map. ``update_factors``
+        and ``update_inverse`` are STATIC — the trainer picks them from
+        ``should_update_*`` (the steps-%-freq gating of
+        kfac_preconditioner_base.py:198-213 moved to the host).
+
+        Parity with step() (kfac_preconditioner_base.py:185-230): factor
+        stats + running-avg update (+ pmean for MPD), decomposition on the
+        local shard, gather/owner-pred per comm mode, KL-clipped write-back.
+        """
+        assert self.plan is not None, 'call setup() first'
+        plan = self.plan
+        if axis_name == '__default__':
+            axis_name = self.axis_name
+        if hyper is None:
+            hyper = KFACHyperParams(lr=jnp.float32(self.lr),
+                                    damping=jnp.float32(self.damping))
+        damping = jnp.asarray(hyper.damping, jnp.float32)
+        lr = jnp.asarray(hyper.lr, jnp.float32)
+
+        factors = state.factors
+        decomp = state.decomp
+
+        if update_factors and not self.exclude_compute_factor:
+            a_list, g_list = engine.compute_layer_stats(
+                plan, acts, gs, self.batch_averaged)
+            stats = engine.stack_stats(plan, a_list, g_list)
+            reduce = self.stats_reduce
+            if self.exclude_communicate_factor:
+                reduce = 'local'
+            factors = engine.update_factors(
+                plan, factors, stats, self.factor_decay, reduce, axis_name)
+
+        if self.exclude_compute_inverse:
+            # ablation: no decomposition -> grads pass through
+            # (kfac_preconditioner_base.py:206-226)
+            return grads, state.replace(step=state.step + 1, factors=factors)
+
+        if update_inverse:
+            decomp_local = engine.compute_decomposition(
+                plan, factors, damping, self.method, self.eps, axis_name)
+            if self.comm_mode == 'inverse':
+                decomp = engine.gather_decomposition(
+                    plan, decomp_local, axis_name,
+                    communicate=not self.exclude_communicate_inverse)
+            else:
+                decomp = decomp_local
+
+        grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
+        if self.comm_mode == 'inverse':
+            preds = engine.compute_pred_replicated(
+                plan, decomp, grad_mats, damping, self.method)
+        else:
+            preds = engine.compute_pred_local(
+                plan, decomp, grad_mats, damping, self.method, axis_name,
+                communicate=not self.exclude_communicate_inverse)
+
+        new_grads = engine.preconditioned_grads(
+            plan, grads, grad_mats, preds, lr, self.kl_clip,
+            skip_clip=self.exclude_communicate_inverse)
+        new_state = state.replace(step=state.step + 1, factors=factors,
+                                  decomp=decomp)
+        return new_grads, new_state

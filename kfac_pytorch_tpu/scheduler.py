@@ -1,0 +1,52 @@
+"""Epoch-indexed hyper-parameter scheduling for the preconditioner.
+
+Parity: ``KFACParamScheduler`` (reference:
+kfac_preconditioner_base.py:233-301) — multiplicative decay of damping and
+of the factor/inverse update frequencies at listed epochs. Here damping is
+a host float fed to the traced step as a scalar (no recompilation) and the
+frequencies gate which compiled step variant the trainer invokes.
+"""
+
+
+class KFACParamScheduler:
+    def __init__(self, kfac, damping_alpha=1, damping_schedule=None,
+                 update_freq_alpha=1, update_freq_schedule=None,
+                 start_epoch=0):
+        self.kfac = kfac
+        self.damping_base = kfac.damping
+        self.damping_alpha = damping_alpha
+        self.damping_factor_func = self._factor_func(
+            damping_schedule, damping_alpha)
+        self.fac_update_freq_base = kfac.fac_update_freq
+        self.kfac_update_freq_base = kfac.kfac_update_freq
+        self.update_freq_factor_func = self._factor_func(
+            update_freq_schedule, update_freq_alpha)
+        self.epoch = start_epoch
+        if start_epoch:
+            self._apply()
+
+    @staticmethod
+    def _factor_func(schedule, alpha):
+        schedule = sorted(schedule, reverse=True) if schedule else []
+
+        def factor(epoch):
+            f = 1.0
+            for e in schedule:
+                if epoch >= e:
+                    f *= alpha
+            return f
+
+        return factor
+
+    def _apply(self):
+        self.kfac.damping = (self.damping_base
+                             * self.damping_factor_func(self.epoch))
+        f = self.update_freq_factor_func(self.epoch)
+        self.kfac.fac_update_freq = max(1, int(self.fac_update_freq_base * f))
+        self.kfac.kfac_update_freq = max(1, int(self.kfac_update_freq_base * f))
+
+    def step(self, epoch=None):
+        """Advance to ``epoch`` (or by one) and update the wrapped KFAC's
+        damping and update frequencies (reference: base.py:288-301)."""
+        self.epoch = epoch if epoch is not None else self.epoch + 1
+        self._apply()

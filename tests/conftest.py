@@ -1,0 +1,18 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+The reference has no cluster-free multi-node test path (SURVEY.md §4); here
+every distributed code path runs on a simulated mesh
+(--xla_force_host_platform_device_count), the JAX-native equivalent.
+"""
+
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ['XLA_FLAGS'] = (
+    os.environ.get('XLA_FLAGS', '')
+    + ' --xla_force_host_platform_device_count=8')
+
+import jax  # noqa: E402
+
+# fp32 matmuls in tests: exact math, not MXU bf16 passthrough.
+jax.config.update('jax_default_matmul_precision', 'highest')

@@ -1,0 +1,104 @@
+"""Golden tests for Kronecker-factor statistics ops.
+
+Oracles are independent numpy implementations of the documented reference
+semantics (reference: kfac/utils.py:33-140).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kfac_pytorch_tpu import ops
+
+
+def np_patches(x, kh, kw, sh, sw, ph, pw):
+    """Naive im2col oracle: NHWC -> [N, OH, OW, kh*kw*C], (kh, kw, c) order."""
+    n, h, w, c = x.shape
+    xp = np.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    out = np.zeros((n, oh, ow, kh * kw * c), dtype=x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, i * sh:i * sh + kh, j * sw:j * sw + kw, :]
+            out[:, i, j, :] = win.reshape(n, -1)  # (kh, kw, c) row-major
+    return out
+
+
+def test_extract_patches_matches_naive():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 5, 3).astype(np.float32)
+    got = np.asarray(ops.extract_patches(jnp.asarray(x), (3, 2), (2, 1), (1, 0)))
+    want = np_patches(x, 3, 2, 2, 1, 1, 0)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize('use_bias', [True, False])
+def test_compute_a_dense(use_bias):
+    rng = np.random.RandomState(1)
+    a = rng.randn(8, 5).astype(np.float32)
+    am = np.concatenate([a, np.ones((8, 1), np.float32)], 1) if use_bias else a
+    want = am.T @ am / 8
+    got = np.asarray(ops.compute_a_dense(jnp.asarray(a), use_bias))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_compute_a_dense_seq_mean():
+    # sequence inputs are token-averaged first (reference kfac/utils.py:97-99)
+    rng = np.random.RandomState(2)
+    a = rng.randn(4, 7, 5).astype(np.float32)
+    am = a.mean(1)
+    am = np.concatenate([am, np.ones((4, 1), np.float32)], 1)
+    want = am.T @ am / 4
+    got = np.asarray(ops.compute_a_dense(jnp.asarray(a), True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize('use_bias', [True, False])
+def test_compute_a_conv(use_bias):
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 5, 5, 2).astype(np.float32)
+    p = np_patches(x, 3, 3, 1, 1, 1, 1)  # [3,5,5,18]
+    spatial = p.shape[1] * p.shape[2]
+    rows = p.reshape(-1, p.shape[-1])
+    if use_bias:
+        rows = np.concatenate([rows, np.ones((rows.shape[0], 1), np.float32)], 1)
+    rows = rows / spatial
+    want = rows.T @ rows / 3
+    got = np.asarray(ops.compute_a_conv(jnp.asarray(x), (3, 3), (1, 1), (1, 1),
+                                        use_bias))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize('batch_averaged', [True, False])
+def test_compute_g_dense(batch_averaged):
+    rng = np.random.RandomState(4)
+    g = rng.randn(6, 4).astype(np.float32)
+    scaled = g * 6 if batch_averaged else g
+    want = scaled.T @ scaled / 6 if batch_averaged else g.T @ g / 6
+    # batch_averaged: G = g^T (g*N) = (gN)^T (gN) / N
+    want = (g * 6).T @ (g * 6) / 6 if batch_averaged else g.T @ g / 6
+    got = np.asarray(ops.compute_g_dense(jnp.asarray(g), batch_averaged))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize('batch_averaged', [True, False])
+def test_compute_g_conv(batch_averaged):
+    rng = np.random.RandomState(5)
+    g = rng.randn(3, 4, 4, 6).astype(np.float32)  # NHWC
+    n, oh, ow, c = g.shape
+    spatial = oh * ow
+    rows = g.reshape(-1, c)
+    if batch_averaged:
+        rows = rows * n
+    rows = rows * spatial
+    want = rows.T @ rows / (n * spatial)
+    got = np.asarray(ops.compute_g_conv(jnp.asarray(g), batch_averaged))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_update_running_avg():
+    cur = jnp.ones((3, 3))
+    new = jnp.full((3, 3), 2.0)
+    out = ops.update_running_avg(new, cur, 0.25)
+    np.testing.assert_allclose(np.asarray(out), 0.75 * 1 + 0.25 * 2)

@@ -1,0 +1,81 @@
+"""Batched symmetric linalg + exactness of identity padding."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import ops
+
+
+def _spd(rng, *shape):
+    a = rng.randn(*shape).astype(np.float32)
+    return a @ np.swapaxes(a, -1, -2) + shape[-1] * np.eye(shape[-1],
+                                                           dtype=np.float32)
+
+
+def test_psd_inverse_batched():
+    rng = np.random.RandomState(0)
+    x = _spd(rng, 5, 8, 8)
+    inv = np.asarray(ops.psd_inverse(jnp.asarray(x)))
+    np.testing.assert_allclose(inv, np.linalg.inv(x), rtol=1e-3, atol=1e-4)
+
+
+def test_sym_eig_reconstructs():
+    rng = np.random.RandomState(1)
+    x = _spd(rng, 3, 6, 6)
+    d, q = ops.sym_eig(jnp.asarray(x))
+    rec = np.asarray(q) @ (np.asarray(d)[..., None] * np.swapaxes(np.asarray(q), -1, -2))
+    np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-3)
+
+
+def test_clamp_eigvals():
+    d = jnp.asarray([-1.0, 1e-12, 0.5])
+    out = np.asarray(ops.clamp_eigvals(d, 1e-10))
+    np.testing.assert_allclose(out, [0.0, 0.0, 0.5])
+
+
+def test_add_scaled_identity_vector():
+    x = jnp.zeros((2, 3, 3))
+    out = np.asarray(ops.add_scaled_identity(x, jnp.asarray([1.0, 2.0])))
+    np.testing.assert_allclose(out[0], np.eye(3))
+    np.testing.assert_allclose(out[1], 2 * np.eye(3))
+
+
+def test_masked_trace():
+    x = jnp.asarray(np.diag([1.0, 2.0, 3.0, 4.0]).astype(np.float32))
+    assert float(ops.masked_trace(x, 2)) == 3.0
+    batch = jnp.stack([x, x])
+    np.testing.assert_allclose(
+        np.asarray(ops.masked_trace(batch, jnp.asarray([2, 3]))), [3.0, 6.0])
+
+
+def test_identity_pad_exact_for_eigen_pred():
+    """Padding factors with identity must not change the preconditioned
+    gradient (the exactness claim in ops/linalg.py)."""
+    rng = np.random.RandomState(2)
+    da, dg, pad = 5, 4, 3
+    A = _spd(rng, da, da)
+    G = _spd(rng, dg, dg)
+    grad = rng.randn(dg, da).astype(np.float32)
+    damping = 0.01
+
+    def eigen_pred(A, G, grad):
+        dA, QA = np.linalg.eigh(A)
+        dG, QG = np.linalg.eigh(G)
+        v1 = QG.T @ grad @ QA
+        v2 = v1 / (np.outer(dG, dA) + damping)
+        return QG @ v2 @ QA.T
+
+    want = eigen_pred(A, G, grad)
+    Ap = np.asarray(ops.identity_pad(jnp.asarray(A), da + pad))
+    Gp = np.asarray(ops.identity_pad(jnp.asarray(G), dg + pad))
+    gp = np.zeros((dg + pad, da + pad), np.float32)
+    gp[:dg, :da] = grad
+    got = eigen_pred(Ap, Gp, gp)[:dg, :da]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # explicit-inverse path
+    want_inv = np.linalg.inv(G + 0.1 * np.eye(dg)) @ grad @ np.linalg.inv(
+        A + 0.1 * np.eye(da))
+    got_inv = (np.linalg.inv(Gp + 0.1 * np.eye(dg + pad)) @ gp
+               @ np.linalg.inv(Ap + 0.1 * np.eye(da + pad)))[:dg, :da]
+    np.testing.assert_allclose(got_inv, want_inv, rtol=1e-4, atol=1e-5)
