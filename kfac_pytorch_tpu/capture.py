@@ -128,15 +128,29 @@ def init(model, rngs, *args, **kwargs):
     return variables
 
 
-def make_zero_taps(model, variables, *args, **kwargs):
+def make_zero_taps(model, variables, *args, axis_name=None, **kwargs):
     """Build the zero-tap pytree for one batch shape via ``eval_shape`` (free
     at trace time). The returned pytree is the differentiable input whose
-    gradient is ``{layer: dL/dy}``."""
+    gradient is ``{layer: dL/dy}``.
+
+    ``axis_name``: REQUIRED inside shard_map over a data-parallel axis.
+    Zero constants are device-invariant, and JAX's vma-aware autodiff psums
+    gradients of invariant inputs across the axis — which would silently
+    sum per-example output-gradients from different devices. Marking the
+    taps varying keeps their gradients local (each device sees its own
+    ``g``, the reference's per-rank hook semantics,
+    kfac_preconditioner_base.py:127-130).
+    """
     shapes = jax.eval_shape(
         lambda v: model.apply(v, *args, mutable=[ACTS, TAPS], **kwargs),
         variables)
     tap_shapes = shapes[1][TAPS]
-    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), tap_shapes)
+    taps = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), tap_shapes)
+    if axis_name is not None:
+        taps = jax.tree.map(lambda t: jax.lax.pcast(t, to='varying',
+                                                    axis_name=axis_name),
+                            taps)
+    return taps
 
 
 def apply_with_capture(model, variables, *args, taps=None, mutable=(),
@@ -163,7 +177,8 @@ def apply_with_capture(model, variables, *args, taps=None, mutable=(),
 
 
 def value_and_grad_with_capture(model, loss_fn, variables, *args,
-                                mutable=(), wrt='params', **kwargs):
+                                mutable=(), wrt='params', axis_name=None,
+                                **kwargs):
     """One fwd+bwd pass returning loss, outputs, param grads, and (a, g).
 
     The canonical capture entrypoint — the functional equivalent of the
@@ -171,10 +186,15 @@ def value_and_grad_with_capture(model, loss_fn, variables, *args,
     ``loss.backward()``, kfac_preconditioner_base.py:122-130).
 
     ``loss_fn(outputs)`` must return a scalar (close over targets).
+    Pass ``axis_name`` when calling inside shard_map over a data-parallel
+    axis (see :func:`make_zero_taps`); param grads then come back psummed
+    over the axis (divide by axis size — ``parallel.average_grads``) while
+    ``gs`` stays per-device local.
     Returns ``(loss, outputs, grads, acts, gs, other_mutated)`` with
     ``acts``/``gs`` keyed like the capture collections.
     """
-    taps = make_zero_taps(model, variables, *args, **kwargs)
+    taps = make_zero_taps(model, variables, *args, axis_name=axis_name,
+                          **kwargs)
     params = variables[wrt]
     rest = {k: val for k, val in variables.items() if k != wrt}
 

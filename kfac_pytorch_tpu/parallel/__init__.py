@@ -14,6 +14,7 @@ from kfac_pytorch_tpu.parallel.collectives import (
     pmean,
     psum,
     all_gather_rows,
+    average_grads,
     axis_index,
     axis_size,
 )
@@ -24,6 +25,7 @@ from kfac_pytorch_tpu.parallel.mesh import (
 
 __all__ = [
     'round_robin_assign', 'balanced_assign', 'block_partition',
-    'pmean', 'psum', 'all_gather_rows', 'axis_index', 'axis_size',
+    'pmean', 'psum', 'all_gather_rows', 'average_grads', 'axis_index',
+    'axis_size',
     'make_mesh', 'data_parallel_specs',
 ]

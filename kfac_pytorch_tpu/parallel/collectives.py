@@ -29,10 +29,40 @@ def all_gather_rows(x, axis_name):
     """Concatenate per-device row blocks along axis 0 (device-major) —
     the owner-broadcast replacement: owners hold their rows, the gather
     replicates all rows everywhere (reference broadcast-from-owner:
-    kfac_preconditioner_eigen.py:122-134, inv.py:164-175)."""
+    kfac_preconditioner_eigen.py:122-134, inv.py:164-175).
+
+    Implemented as scatter-to-own-offset + psum rather than
+    ``lax.all_gather`` so shard_map's varying-manual-axes checker can
+    statically prove the result replicated (all_gather output is not
+    inferred invariant in current JAX); XLA lowers the masked psum to an
+    ICI collective either way.
+    """
     if axis_name is None:
         return x
-    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    n = lax.axis_size(axis_name)
+    per = x.shape[0]
+    full = jnp.zeros((n * per,) + x.shape[1:], x.dtype)
+    full = lax.dynamic_update_slice_in_dim(
+        full, x, lax.axis_index(axis_name) * per, axis=0)
+    return lax.psum(full, axis_name)
+
+
+def average_grads(grads, axis_name):
+    """Data-parallel gradient averaging inside shard_map.
+
+    JAX's vma-aware shard_map already psums the gradient of a varying loss
+    w.r.t. replicated (invariant) params — the allreduce the reference gets
+    from hvd.DistributedOptimizer / DDP (examples/pytorch_cifar10_resnet.py:
+    252-264) is inserted automatically by autodiff. With a per-device
+    local-mean loss that psum yields the *sum* of shard means, so dividing
+    by the axis size gives the global-batch average (Horovod's
+    ``op=Average``). Tap gradients are varying, hence stay local — exactly
+    the per-device ``g`` DP-KFAC's factor statistics need.
+    """
+    if axis_name is None:
+        return grads
+    n = lax.axis_size(axis_name)
+    return jax.tree.map(lambda g: g / n, grads)
 
 
 def axis_index(axis_name):

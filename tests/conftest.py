@@ -7,12 +7,16 @@ every distributed code path runs on a simulated mesh
 
 import os
 
-os.environ['JAX_PLATFORMS'] = 'cpu'
+# XLA_FLAGS is read when the CPU client initializes (lazily), so setting it
+# here is early enough; JAX_PLATFORMS is captured at jax import time (which
+# already happened in sitecustomize), so the platform must go through
+# jax.config instead.
 os.environ['XLA_FLAGS'] = (
     os.environ.get('XLA_FLAGS', '')
     + ' --xla_force_host_platform_device_count=8')
 
 import jax  # noqa: E402
 
+jax.config.update('jax_platforms', 'cpu')
 # fp32 matmuls in tests: exact math, not MXU bf16 passthrough.
 jax.config.update('jax_default_matmul_precision', 'highest')

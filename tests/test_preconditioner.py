@@ -1,0 +1,161 @@
+"""End-to-end single-device preconditioner correctness.
+
+The oracle is a straightforward per-layer dense implementation of the
+documented K-FAC math (reference semantics: kfac_preconditioner_inv.py /
+eigen_dp.py) with no bucketing, padding, or stacking — the stacked-bucket
+engine must reproduce it exactly (identity padding is exact).
+"""
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import capture, ops
+from kfac_pytorch_tpu import nn as knn
+
+
+class MLP(linen.Module):
+    @linen.compact
+    def __call__(self, x):
+        x = knn.Dense(8, name='fc1')(x)
+        x = linen.relu(x)
+        x = knn.Dense(3, name='fc2')(x)
+        return x
+
+
+def _setup(variant, **kw):
+    model = MLP()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 5), jnp.float32)
+    y = jnp.asarray(rng.randn(4, 3), jnp.float32)
+    variables = capture.init(model, jax.random.PRNGKey(0), x)
+    metas = capture.collect_layer_meta(model, variables, x)
+    precond = kfac.KFAC(variant=variant, num_devices=1, axis_name=None,
+                        bucket_fn=lambda d: 16, **kw)
+    precond.setup(metas)
+    state = precond.init()
+    loss_fn = lambda out: jnp.mean((out - y) ** 2)
+    loss, out, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+        model, loss_fn, variables, x)
+    return precond, state, grads, acts, gs, metas
+
+
+def _grad_mat(grads, name):
+    g = grads[name]['kernel'].T
+    return np.concatenate([np.asarray(g),
+                           np.asarray(grads[name]['bias'])[:, None]], 1)
+
+
+def _oracle_factors(acts, gs, metas, decay):
+    """step-0 running averages: alpha*stat + (1-alpha)*I."""
+    out = {}
+    for name, m in metas.items():
+        A = np.asarray(ops.compute_a_dense(acts[name]['a'], True))
+        G = np.asarray(ops.compute_g_dense(gs[name]['g'], True))
+        mA = decay * A + (1 - decay) * np.eye(A.shape[0], dtype=np.float32)
+        mG = decay * G + (1 - decay) * np.eye(G.shape[0], dtype=np.float32)
+        out[name] = (mA, mG)
+    return out
+
+
+def _kl_clip(preds, gmats, lr, kl):
+    vg = sum(float(np.sum(p * g)) for p, g in zip(preds, gmats)) * lr ** 2
+    return min(1.0, np.sqrt(kl / abs(vg)))
+
+
+@pytest.mark.parametrize('variant', ['eigen_dp', 'eigen'])
+def test_eigen_variants_match_oracle(variant):
+    lr, damping, decay, kl = 0.1, 0.003, 0.95, 0.001
+    precond, state, grads, acts, gs, metas = _setup(
+        variant, lr=lr, damping=damping, factor_decay=decay, kl_clip=kl)
+    new_grads, new_state = precond.step(state, grads, acts, gs)
+
+    factors = _oracle_factors(acts, gs, metas, decay)
+    preds, gmats = [], []
+    for name in metas:
+        mA, mG = factors[name]
+        dA, QA = np.linalg.eigh(mA)
+        dG, QG = np.linalg.eigh(mG)
+        dA = dA * (dA > 1e-10)
+        dG = dG * (dG > 1e-10)
+        gm = _grad_mat(grads, name)
+        v1 = QG.T @ gm @ QA
+        v2 = v1 / (np.outer(dG, dA) + damping)
+        preds.append(QG @ v2 @ QA.T)
+        gmats.append(gm)
+    nu = _kl_clip(preds, gmats, lr, kl)
+    for name, pred in zip(metas, preds):
+        got = _grad_mat(new_grads, name)
+        np.testing.assert_allclose(got, pred * nu, rtol=1e-3, atol=1e-4)
+    assert int(new_state.step) == 1
+
+
+@pytest.mark.parametrize('variant', ['inverse_dp', 'inverse'])
+def test_inverse_variants_match_oracle(variant):
+    lr, damping, decay, kl = 0.1, 0.003, 0.95, 0.001
+    precond, state, grads, acts, gs, metas = _setup(
+        variant, lr=lr, damping=damping, factor_decay=decay, kl_clip=kl)
+    new_grads, _ = precond.step(state, grads, acts, gs)
+
+    factors = _oracle_factors(acts, gs, metas, decay)
+    preds, gmats = [], []
+    for name in metas:
+        mA, mG = factors[name]
+        pi = np.sqrt((np.trace(mA) / mA.shape[0]) / (np.trace(mG) / mG.shape[0]))
+        Ad = mA + np.sqrt(damping) * pi * np.eye(mA.shape[0])
+        Gd = mG + np.sqrt(damping) / pi * np.eye(mG.shape[0])
+        gm = _grad_mat(grads, name)
+        preds.append(np.linalg.inv(Gd) @ gm @ np.linalg.inv(Ad))
+        gmats.append(gm)
+    nu = _kl_clip(preds, gmats, lr, kl)
+    for name, pred in zip(metas, preds):
+        got = _grad_mat(new_grads, name)
+        np.testing.assert_allclose(got, pred * nu, rtol=1e-3, atol=1e-4)
+
+
+def test_stale_decomposition_reuse():
+    """Steps without update flags must reuse the stored decomposition and
+    running factors (freq gating, kfac_preconditioner_base.py:198-213)."""
+    precond, state, grads, acts, gs, metas = _setup('eigen_dp')
+    g1, s1 = precond.step(state, grads, acts, gs)
+    # same grads, no updates -> same pred from stored decomp
+    g2, s2 = precond.step(s1, grads, update_factors=False,
+                          update_inverse=False)
+    for name in metas:
+        np.testing.assert_allclose(np.asarray(g1[name]['kernel']),
+                                   np.asarray(g2[name]['kernel']), atol=1e-6)
+    # factors unchanged when update_factors=False
+    for k in s1.factors:
+        np.testing.assert_allclose(np.asarray(s1.factors[k]),
+                                   np.asarray(s2.factors[k]), atol=0)
+
+
+def test_no_kl_clip_and_plain_passthrough():
+    precond, state, grads, acts, gs, metas = _setup('eigen_dp', kl_clip=None)
+    new_grads, _ = precond.step(state, grads, acts, gs)
+    assert new_grads['fc1']['kernel'].shape == grads['fc1']['kernel'].shape
+    # exclude ComputeInverse -> grads unchanged
+    precond2, state2, grads2, acts2, gs2, _ = _setup(
+        'eigen_dp', exclude_parts='ComputeInverse')
+    out, _ = precond2.step(state2, grads2, acts2, gs2)
+    np.testing.assert_allclose(np.asarray(out['fc1']['kernel']),
+                               np.asarray(grads2['fc1']['kernel']), atol=0)
+
+
+def test_param_scheduler():
+    precond, *_ = _setup('eigen_dp', damping=0.03, fac_update_freq=2,
+                         kfac_update_freq=10)
+    sched = kfac.KFACParamScheduler(
+        precond, damping_alpha=0.5, damping_schedule=[2, 4],
+        update_freq_alpha=2, update_freq_schedule=[3])
+    sched.step(2)
+    assert np.isclose(precond.damping, 0.015)
+    assert precond.kfac_update_freq == 10
+    sched.step(4)
+    assert np.isclose(precond.damping, 0.0075)
+    assert precond.fac_update_freq == 4 and precond.kfac_update_freq == 20
+    assert precond.should_update_factors(8)
+    assert not precond.should_update_factors(9)
