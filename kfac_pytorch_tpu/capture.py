@@ -100,7 +100,7 @@ def collect_layer_meta(model, variables, *args, exclude_vocabulary_size=None,
     """
     with _record_layers() as layers:
         jax.eval_shape(
-            lambda v: model.apply(v, *args, mutable=[ACTS, TAPS], **kwargs),
+            lambda v: model.apply(v, *args, mutable=True, **kwargs),
             variables)
     metas = dict(layers)
     if exclude_vocabulary_size is not None:
@@ -142,7 +142,7 @@ def make_zero_taps(model, variables, *args, axis_name=None, **kwargs):
     kfac_preconditioner_base.py:127-130).
     """
     shapes = jax.eval_shape(
-        lambda v: model.apply(v, *args, mutable=[ACTS, TAPS], **kwargs),
+        lambda v: model.apply(v, *args, mutable=True, **kwargs),
         variables)
     tap_shapes = shapes[1][TAPS]
     taps = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), tap_shapes)

@@ -1,0 +1,29 @@
+"""Model zoo — the reference's example models rebuilt in Flax/NHWC with
+KFAC-aware layers (reference zoo: examples/cifar_resnet.py,
+cifar_vgg.py, cifar_wide_resnet.py, imagenet_resnet.py,
+imagenet_inceptionv4.py, examples/transformer/, wikitext_models.py)."""
+
+from kfac_pytorch_tpu.models.cifar_resnet import (
+    resnet20, resnet32, resnet44, resnet56, resnet110)
+from kfac_pytorch_tpu.models.cifar_vgg import vgg11, vgg13, vgg16, vgg19
+from kfac_pytorch_tpu.models.cifar_wide_resnet import wrn_28_10
+from kfac_pytorch_tpu.models.imagenet_resnet import (
+    resnet18, resnet34, resnet50, resnet101, resnet152,
+    resnext50_32x4d, resnext101_32x8d)
+
+
+def get_model(name, num_classes=10, **kw):
+    """Name-based factory mirroring the ``--model`` flag surface of the
+    reference entrypoints (examples/pytorch_cifar10_resnet.py:203-217)."""
+    registry = {
+        'resnet20': resnet20, 'resnet32': resnet32, 'resnet44': resnet44,
+        'resnet56': resnet56, 'resnet110': resnet110,
+        'vgg11': vgg11, 'vgg13': vgg13, 'vgg16': vgg16, 'vgg19': vgg19,
+        'wrn-28-10': wrn_28_10, 'wideresnet': wrn_28_10,
+        'resnet18': resnet18, 'resnet34': resnet34, 'resnet50': resnet50,
+        'resnet101': resnet101, 'resnet152': resnet152,
+        'resnext50': resnext50_32x4d, 'resnext101': resnext101_32x8d,
+    }
+    if name not in registry:
+        raise KeyError(f'unknown model {name!r}')
+    return registry[name](num_classes=num_classes, **kw)

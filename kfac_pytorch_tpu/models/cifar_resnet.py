@@ -1,0 +1,95 @@
+"""CIFAR ResNet-20/32/44/56/110 (He et al. 2015, option-A shortcuts).
+
+Same architecture family as the reference zoo (examples/cifar_resnet.py:
+36-120: 6n+2 layers, 3 stages of 16/32/64 planes, bias-free 3x3 convs,
+zero-pad subsampling shortcuts, kaiming-normal init) rebuilt as Flax/NHWC
+with KFAC capture layers. Param counts match the reference table
+(resnet20 0.27M ... resnet110 1.7M).
+"""
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import nn as knn
+
+_kaiming = linen.initializers.kaiming_normal()
+
+
+class BasicBlock(linen.Module):
+    planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        in_planes = x.shape[-1]
+        norm = partial(linen.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        conv = partial(knn.Conv, kernel_size=(3, 3), padding=(1, 1),
+                       use_bias=False, kernel_init=_kaiming, dtype=self.dtype)
+        out = conv(self.planes, strides=(self.stride, self.stride),
+                   name='conv1')(x)
+        out = linen.relu(norm(name='bn1')(out))
+        out = conv(self.planes, strides=(1, 1), name='conv2')(out)
+        out = norm(name='bn2')(out)
+        if self.stride != 1 or in_planes != self.planes:
+            # option A: stride-2 subsample + zero-pad channels (parameter-
+            # free, the CIFAR paper's choice; examples/cifar_resnet.py:66-71)
+            sc = x[:, ::2, ::2, :]
+            pad = (self.planes - in_planes) // 2
+            sc = jnp.pad(sc, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+        else:
+            sc = x
+        return linen.relu(out + sc)
+
+
+class CifarResNet(linen.Module):
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        x = knn.Conv(16, (3, 3), strides=(1, 1), padding=(1, 1),
+                     use_bias=False, kernel_init=_kaiming, dtype=self.dtype,
+                     name='conv1')(x)
+        x = linen.BatchNorm(use_running_average=not train, momentum=0.9,
+                            dtype=self.dtype, name='bn1')(x)
+        x = linen.relu(x)
+        for stage, (planes, n) in enumerate(zip((16, 32, 64),
+                                                self.num_blocks)):
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = BasicBlock(planes, stride, dtype=self.dtype,
+                               name=f'layer{stage + 1}_{i}')(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = knn.Dense(self.num_classes, kernel_init=_kaiming,
+                      dtype=self.dtype, name='fc')(x)
+        return x
+
+
+def _make(n, num_classes=10, **kw):
+    return CifarResNet(num_blocks=(n, n, n), num_classes=num_classes, **kw)
+
+
+def resnet20(num_classes=10, **kw):
+    return _make(3, num_classes, **kw)
+
+
+def resnet32(num_classes=10, **kw):
+    return _make(5, num_classes, **kw)
+
+
+def resnet44(num_classes=10, **kw):
+    return _make(7, num_classes, **kw)
+
+
+def resnet56(num_classes=10, **kw):
+    return _make(9, num_classes, **kw)
+
+
+def resnet110(num_classes=10, **kw):
+    return _make(18, num_classes, **kw)

@@ -1,0 +1,67 @@
+"""CIFAR VGG-11/13/16/19 with BatchNorm.
+
+Same family as the reference zoo (examples/cifar_vgg.py: conv-BN-relu
+stacks from the standard cfg tables, maxpool between stages, single
+classifier head) in Flax/NHWC with KFAC capture layers.
+"""
+
+from typing import Sequence, Union
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import nn as knn
+
+_CFG = {
+    'vgg11': (64, 'M', 128, 'M', 256, 256, 'M', 512, 512, 'M', 512, 512, 'M'),
+    'vgg13': (64, 64, 'M', 128, 128, 'M', 256, 256, 'M', 512, 512, 'M',
+              512, 512, 'M'),
+    'vgg16': (64, 64, 'M', 128, 128, 'M', 256, 256, 256, 'M',
+              512, 512, 512, 'M', 512, 512, 512, 'M'),
+    'vgg19': (64, 64, 'M', 128, 128, 'M', 256, 256, 256, 256, 'M',
+              512, 512, 512, 512, 'M', 512, 512, 512, 512, 'M'),
+}
+
+_kaiming = linen.initializers.kaiming_normal()
+
+
+class CifarVGG(linen.Module):
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        i = 0
+        for v in self.cfg:
+            if v == 'M':
+                x = linen.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = knn.Conv(v, (3, 3), padding=(1, 1), use_bias=False,
+                             kernel_init=_kaiming, dtype=self.dtype,
+                             name=f'conv{i}')(x)
+                x = linen.BatchNorm(use_running_average=not train,
+                                    momentum=0.9, dtype=self.dtype,
+                                    name=f'bn{i}')(x)
+                x = linen.relu(x)
+                i += 1
+        x = x.reshape(x.shape[0], -1)
+        x = knn.Dense(self.num_classes, kernel_init=_kaiming,
+                      dtype=self.dtype, name='classifier')(x)
+        return x
+
+
+def vgg11(num_classes=10, **kw):
+    return CifarVGG(cfg=_CFG['vgg11'], num_classes=num_classes, **kw)
+
+
+def vgg13(num_classes=10, **kw):
+    return CifarVGG(cfg=_CFG['vgg13'], num_classes=num_classes, **kw)
+
+
+def vgg16(num_classes=10, **kw):
+    return CifarVGG(cfg=_CFG['vgg16'], num_classes=num_classes, **kw)
+
+
+def vgg19(num_classes=10, **kw):
+    return CifarVGG(cfg=_CFG['vgg19'], num_classes=num_classes, **kw)

@@ -1,0 +1,75 @@
+"""Wide ResNet (WRN-28-10) for CIFAR (Zagoruyko & Komodakis 2016).
+
+Same family as the reference zoo (examples/cifar_wide_resnet.py:
+pre-activation BN-relu-conv blocks, widen factor, dropout-free default) in
+Flax/NHWC with KFAC capture layers.
+"""
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import nn as knn
+
+_kaiming = linen.initializers.kaiming_normal()
+
+
+class WideBlock(linen.Module):
+    planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        in_planes = x.shape[-1]
+        bn = lambda name: linen.BatchNorm(use_running_average=not train,
+                                          momentum=0.9, dtype=self.dtype,
+                                          name=name)
+        out = linen.relu(bn('bn1')(x))
+        shortcut_src = out if (self.stride != 1
+                               or in_planes != self.planes) else x
+        out = knn.Conv(self.planes, (3, 3),
+                       strides=(self.stride, self.stride), padding=(1, 1),
+                       use_bias=False, kernel_init=_kaiming,
+                       dtype=self.dtype, name='conv1')(out)
+        out = linen.relu(bn('bn2')(out))
+        out = knn.Conv(self.planes, (3, 3), strides=(1, 1), padding=(1, 1),
+                       use_bias=False, kernel_init=_kaiming,
+                       dtype=self.dtype, name='conv2')(out)
+        if self.stride != 1 or in_planes != self.planes:
+            sc = knn.Conv(self.planes, (1, 1),
+                          strides=(self.stride, self.stride), padding=(0, 0),
+                          use_bias=False, kernel_init=_kaiming,
+                          dtype=self.dtype, name='shortcut')(shortcut_src)
+        else:
+            sc = x
+        return out + sc
+
+
+class WideResNet(linen.Module):
+    depth: int = 28
+    widen: int = 10
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        n = (self.depth - 4) // 6
+        widths = (16, 16 * self.widen, 32 * self.widen, 64 * self.widen)
+        x = knn.Conv(widths[0], (3, 3), padding=(1, 1), use_bias=False,
+                     kernel_init=_kaiming, dtype=self.dtype, name='conv1')(x)
+        for stage in range(3):
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = WideBlock(widths[stage + 1], stride, dtype=self.dtype,
+                              name=f'block{stage + 1}_{i}')(x, train=train)
+        x = linen.relu(linen.BatchNorm(use_running_average=not train,
+                                       momentum=0.9, dtype=self.dtype,
+                                       name='bn_out')(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = knn.Dense(self.num_classes, kernel_init=_kaiming,
+                      dtype=self.dtype, name='fc')(x)
+        return x
+
+
+def wrn_28_10(num_classes=10, **kw):
+    return WideResNet(depth=28, widen=10, num_classes=num_classes, **kw)

@@ -1,0 +1,181 @@
+"""ImageNet ResNet-18/34/50/101/152 and ResNeXt variants.
+
+Same family as the reference zoo (examples/imagenet_resnet.py:1-364, a
+torchvision-0.5 copy: 7x7 stem, maxpool, Basic/Bottleneck stages, optional
+groups/width for ResNeXt, zero-init of the last block BN) rebuilt in
+Flax/NHWC with KFAC capture layers. ResNet-50 is the flagship benchmark
+model (BASELINE.md north-star).
+
+Grouped convolutions (ResNeXt) are not K-FAC-supported layers in the
+reference either (hooks attach but factor math assumes dense conv); here
+grouped convs use plain linen.Conv so they are transparently excluded from
+preconditioning.
+"""
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import nn as knn
+
+_kaiming = linen.initializers.kaiming_normal()
+
+
+def _norm(train, dtype, name, scale_init=None):
+    kw = dict(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+              dtype=dtype, name=name)
+    if scale_init is not None:
+        kw['scale_init'] = scale_init
+    return linen.BatchNorm(**kw)
+
+
+class BasicBlock(linen.Module):
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    groups: int = 1
+    base_width: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        identity = x
+        out = knn.Conv(self.planes, (3, 3),
+                       strides=(self.stride, self.stride), padding=(1, 1),
+                       use_bias=False, kernel_init=_kaiming,
+                       dtype=self.dtype, name='conv1')(x)
+        out = linen.relu(_norm(train, self.dtype, 'bn1')(out))
+        out = knn.Conv(self.planes, (3, 3), padding=(1, 1), use_bias=False,
+                       kernel_init=_kaiming, dtype=self.dtype,
+                       name='conv2')(out)
+        # zero-init gamma on the residual-final BN (torchvision
+        # zero_init_residual analogue; reference imagenet_resnet.py)
+        out = _norm(train, self.dtype, 'bn2',
+                    scale_init=linen.initializers.zeros_init())(out)
+        if self.downsample:
+            identity = knn.Conv(self.planes, (1, 1),
+                                strides=(self.stride, self.stride),
+                                padding=(0, 0), use_bias=False,
+                                kernel_init=_kaiming, dtype=self.dtype,
+                                name='ds_conv')(x)
+            identity = _norm(train, self.dtype, 'ds_bn')(identity)
+        return linen.relu(out + identity)
+
+
+class Bottleneck(linen.Module):
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    groups: int = 1
+    base_width: int = 64
+    dtype: jnp.dtype = jnp.float32
+    expansion: int = 4
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        width = int(self.planes * (self.base_width / 64.0)) * self.groups
+        identity = x
+        out = knn.Conv(width, (1, 1), padding=(0, 0), use_bias=False,
+                       kernel_init=_kaiming, dtype=self.dtype,
+                       name='conv1')(x)
+        out = linen.relu(_norm(train, self.dtype, 'bn1')(out))
+        if self.groups == 1:
+            out = knn.Conv(width, (3, 3),
+                           strides=(self.stride, self.stride),
+                           padding=(1, 1), use_bias=False,
+                           kernel_init=_kaiming, dtype=self.dtype,
+                           name='conv2')(out)
+        else:  # grouped conv (ResNeXt): not a K-FAC layer
+            out = linen.Conv(width, (3, 3),
+                             strides=(self.stride, self.stride),
+                             padding=[(1, 1), (1, 1)], use_bias=False,
+                             feature_group_count=self.groups,
+                             kernel_init=_kaiming, dtype=self.dtype,
+                             name='conv2')(out)
+        out = linen.relu(_norm(train, self.dtype, 'bn2')(out))
+        out = knn.Conv(self.planes * self.expansion, (1, 1), padding=(0, 0),
+                       use_bias=False, kernel_init=_kaiming,
+                       dtype=self.dtype, name='conv3')(out)
+        out = _norm(train, self.dtype, 'bn3',
+                    scale_init=linen.initializers.zeros_init())(out)
+        if self.downsample:
+            identity = knn.Conv(self.planes * self.expansion, (1, 1),
+                                strides=(self.stride, self.stride),
+                                padding=(0, 0), use_bias=False,
+                                kernel_init=_kaiming, dtype=self.dtype,
+                                name='ds_conv')(x)
+            identity = _norm(train, self.dtype, 'ds_bn')(identity)
+        return linen.relu(out + identity)
+
+
+class ResNet(linen.Module):
+    block: type
+    layers: Sequence[int]
+    num_classes: int = 1000
+    groups: int = 1
+    width_per_group: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        x = knn.Conv(64, (7, 7), strides=(2, 2), padding=(3, 3),
+                     use_bias=False, kernel_init=_kaiming, dtype=self.dtype,
+                     name='conv1')(x)
+        x = linen.relu(_norm(train, self.dtype, 'bn1')(x))
+        x = linen.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1),
+                                                               (1, 1)))
+        expansion = getattr(self.block, 'expansion', 1)
+        in_planes = 64
+        for stage, (planes, n) in enumerate(zip((64, 128, 256, 512),
+                                                self.layers)):
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                downsample = (stride != 1
+                              or in_planes != planes * expansion)
+                x = self.block(planes=planes, stride=stride,
+                               downsample=downsample, groups=self.groups,
+                               base_width=self.width_per_group,
+                               dtype=self.dtype,
+                               name=f'layer{stage + 1}_{i}')(x, train=train)
+                in_planes = planes * expansion
+        x = jnp.mean(x, axis=(1, 2))
+        x = knn.Dense(self.num_classes, kernel_init=_kaiming,
+                      dtype=self.dtype, name='fc')(x)
+        return x
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(block=BasicBlock, layers=(2, 2, 2, 2),
+                  num_classes=num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet(block=BasicBlock, layers=(3, 4, 6, 3),
+                  num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(block=Bottleneck, layers=(3, 4, 6, 3),
+                  num_classes=num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(block=Bottleneck, layers=(3, 4, 23, 3),
+                  num_classes=num_classes, **kw)
+
+
+def resnet152(num_classes=1000, **kw):
+    return ResNet(block=Bottleneck, layers=(3, 8, 36, 3),
+                  num_classes=num_classes, **kw)
+
+
+def resnext50_32x4d(num_classes=1000, **kw):
+    return ResNet(block=Bottleneck, layers=(3, 4, 6, 3), groups=32,
+                  width_per_group=4, num_classes=num_classes, **kw)
+
+
+def resnext101_32x8d(num_classes=1000, **kw):
+    return ResNet(block=Bottleneck, layers=(3, 4, 23, 3), groups=32,
+                  width_per_group=8, num_classes=num_classes, **kw)

@@ -1,0 +1,175 @@
+"""Training-loop integration: the canonical K-FAC + SGD step.
+
+The reference hot loop (examples/pytorch_cifar10_resnet.py:292-327) is
+
+    zero_grad -> forward (hooks save a) -> backward (hooks save g)
+    -> optimizer.synchronize (grad allreduce) -> preconditioner.step()
+    -> optimizer.step()
+
+Here the whole iteration is ONE jitted function per (update_factors,
+update_inverse) combination — the steps-%-freq gating picks a compiled
+variant on the host, so non-update steps never pay capture or
+decomposition cost (the hook-gating semantics of
+kfac_preconditioner_base.py:122-130 at zero runtime price). Under a mesh
+the step runs inside shard_map: forward/backward on the local batch shard,
+param grads psummed by autodiff (the gradient allreduce), K-FAC engine
+collectives over the same axis.
+"""
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu.parallel import collectives as coll
+from kfac_pytorch_tpu.preconditioner import KFACHyperParams
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    kfac_state: Any
+    extra_vars: Any  # batch_stats etc. (non-param collections)
+
+
+def sgd(lr_schedule, momentum=0.9, weight_decay=0.0, nesterov=False):
+    """torch.optim.SGD-equivalent optax chain (reference harness optimizer,
+    examples/pytorch_cifar10_resnet.py:222-229): grad + wd*param, then
+    momentum buffer, then lr scaling. K-FAC preconditioning happens before
+    this chain, matching preconditioner.step() -> optimizer.step()."""
+    parts = []
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(optax.trace(decay=momentum, nesterov=nesterov))
+    parts.append(optax.scale_by_learning_rate(lr_schedule))
+    return optax.chain(*parts)
+
+
+def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
+                     extra_mutable=(), sync_extra_vars=True, donate=True):
+    """Build the per-iteration function family.
+
+    Args:
+      model: Flax module built from kfac_pytorch_tpu.nn layers.
+      tx: optax transformation (e.g. ``sgd(...)``).
+      precond: a set-up ``KFAC`` instance, or None for the pure-SGD baseline
+        (the ``kfac=0`` convention, reference README.md:80).
+      loss_fn: ``loss_fn(outputs, batch) -> scalar`` (local-mean loss).
+      axis_name/mesh: data-parallel axis; None for single device.
+      extra_mutable: extra mutable collections (e.g. ('batch_stats',)).
+      sync_extra_vars: pmean mutated collections across the axis so
+        replicated state stays replicated (BN running stats).
+
+    Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
+    dispatches between up to four compiled variants using the
+    preconditioner's host-side update frequencies.
+    """
+
+    def one_step(state, batch, hyper, update_factors, update_inverse):
+        x, y = batch['input'], batch['label']
+        variables = {'params': state.params, **state.extra_vars}
+        use_capture = precond is not None and update_factors
+
+        if use_capture:
+            loss, out, grads, acts, gs, mutated = \
+                capture.value_and_grad_with_capture(
+                    model, lambda o: loss_fn(o, batch), variables, x,
+                    mutable=extra_mutable, axis_name=axis_name)
+        else:
+            def plain_loss(params):
+                out, mutated = model.apply(
+                    {'params': params, **state.extra_vars}, x,
+                    mutable=list(extra_mutable))
+                return loss_fn(out, batch), (out, mutated)
+
+            (loss, (out, mutated)), grads = jax.value_and_grad(
+                plain_loss, has_aux=True)(state.params)
+            acts = gs = None
+
+        grads = coll.average_grads(grads, axis_name)
+        loss = coll.pmean(loss, axis_name)
+
+        kfac_state = state.kfac_state
+        if precond is not None:
+            grads, kfac_state = precond.step(
+                kfac_state, grads, acts, gs, hyper=hyper,
+                update_factors=update_factors,
+                update_inverse=update_inverse, axis_name=axis_name)
+
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        extra_vars = dict(state.extra_vars)
+        for k in extra_mutable:
+            if k in mutated:
+                v = mutated[k]
+                if sync_extra_vars:
+                    v = coll.pmean(v, axis_name)
+                extra_vars[k] = v
+
+        new_state = state.replace(step=state.step + 1, params=params,
+                                  opt_state=opt_state, kfac_state=kfac_state,
+                                  extra_vars=extra_vars)
+        return new_state, {'loss': loss}
+
+    state_specs_cache = {}
+
+    def make_variant(update_factors, update_inverse):
+        fn = functools.partial(one_step, update_factors=update_factors,
+                               update_inverse=update_inverse)
+        if axis_name is None:
+            return jax.jit(fn, donate_argnums=(0,) if donate else ())
+        kspecs = (precond.state_pspecs(axis_name) if precond is not None
+                  else P())
+        sspecs = TrainState(step=P(), params=P(), opt_state=P(),
+                            kfac_state=kspecs, extra_vars=P())
+        sharded = jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(sspecs, P(axis_name), P()),
+            out_specs=(sspecs, P()))
+        return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    variants = {}
+
+    def step_fn(state, batch, lr=None, damping=None):
+        step = int(state.step)
+        if precond is None:
+            uf = ui = False
+        else:
+            uf = precond.should_update_factors(step)
+            ui = precond.should_update_inverse(step)
+        key = (uf, ui)
+        if key not in variants:
+            variants[key] = make_variant(uf, ui)
+        hyper = KFACHyperParams(
+            lr=jnp.float32(lr if lr is not None
+                           else getattr(precond, 'lr', 0.0)),
+            damping=jnp.float32(damping if damping is not None
+                                else getattr(precond, 'damping', 0.0)))
+        return variants[key](state, batch, hyper)
+
+    return step_fn
+
+
+def init_train_state(model, tx, precond, rng, sample_input):
+    """Initialize params, optimizer and K-FAC state (plus discovery of the
+    capture layer metadata if the preconditioner isn't set up yet)."""
+    variables = capture.init(model, rng, sample_input)
+    params = variables.pop('params')
+    kfac_state = None
+    if precond is not None:
+        if precond.plan is None:
+            metas = capture.collect_layer_meta(
+                model, {'params': params, **variables}, sample_input)
+            precond.setup(metas)
+        kfac_state = precond.init()
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=tx.init(params), kfac_state=kfac_state,
+                      extra_vars=variables)

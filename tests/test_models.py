@@ -1,0 +1,56 @@
+"""Model zoo sanity: shapes, param counts (vs the reference's published
+table, examples/cifar_resnet.py:10-20), and KFAC layer discovery."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import capture, models
+
+
+def _count(params):
+    return sum(np.prod(p.shape) for p in jax.tree.leaves(params))
+
+
+def test_cifar_resnet20_params_and_layers():
+    model = models.resnet20()
+    x = jnp.ones((2, 32, 32, 3))
+    variables = capture.init(model, jax.random.PRNGKey(0), x)
+    n = _count(variables['params'])
+    assert n == 269_722, n  # exact match with the reference model's
+    # parameter count (torch sum(p.numel()) on examples/cifar_resnet.py)
+    metas = capture.collect_layer_meta(model, variables, x, train=False)
+    # 20 layers: 19 convs + fc
+    assert len(metas) == 20
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+
+
+def test_cifar_resnet110_layer_count():
+    model = models.resnet110()
+    x = jnp.ones((1, 32, 32, 3))
+    variables = capture.init(model, jax.random.PRNGKey(0), x)
+    metas = capture.collect_layer_meta(model, variables, x, train=False)
+    assert len(metas) == 110
+
+
+def test_vgg16_builds():
+    model = models.vgg16(num_classes=100)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = capture.init(model, jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 100)
+    metas = capture.collect_layer_meta(model, variables, x, train=False)
+    assert len(metas) == 14  # 13 convs + classifier
+
+
+def test_imagenet_resnet50_params():
+    model = models.resnet50()
+    x = jnp.ones((1, 64, 64, 3))
+    variables = capture.init(model, jax.random.PRNGKey(0), x)
+    n = _count(variables['params'])
+    # torchvision resnet50: 25,557,032 params
+    assert abs(n - 25_557_032) / 25_557_032 < 0.01, n
+    metas = capture.collect_layer_meta(model, variables, x, train=False)
+    assert len(metas) == 54  # 53 convs + fc (BASELINE.md: 54-56 layers)

@@ -1,0 +1,81 @@
+"""Training-loop integration: K-FAC + SGD on tiny problems, single device
+and sharded mesh, with BatchNorm state threading and freq-gated dispatch."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import models, training
+
+
+def _batch(n=16, classes=10, hw=16):
+    rng = np.random.RandomState(0)
+    return {'input': jnp.asarray(rng.randn(n, hw, hw, 3), jnp.float32),
+            'label': jnp.asarray(rng.randint(0, classes, n))}
+
+
+def _ce(outputs, batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, batch['label']).mean()
+
+
+def test_kfac_training_reduces_loss_resnet20():
+    model = models.resnet20()
+    batch = _batch()
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
+                        fac_update_freq=2, kfac_update_freq=2,
+                        num_devices=1, axis_name=None)
+    tx = training.sgd(0.1, momentum=0.9, weight_decay=5e-4)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0), batch['input'])
+    step = training.build_train_step(model, tx, precond, _ce,
+                                     extra_mutable=('batch_stats',))
+    losses = []
+    for _ in range(6):
+        state, m = step(state, batch, lr=0.1, damping=0.003)
+        losses.append(float(m['loss']))
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 6
+    assert int(state.kfac_state.step) == 6
+
+
+def test_sgd_baseline_no_precond():
+    model = models.resnet20()
+    batch = _batch()
+    tx = training.sgd(0.1, momentum=0.9)
+    state = training.init_train_state(model, tx, None,
+                                      jax.random.PRNGKey(0), batch['input'])
+    step = training.build_train_step(model, tx, None, _ce,
+                                     extra_mutable=('batch_stats',))
+    state, m0 = step(state, batch)  # state is donated: always re-thread
+    l0 = float(m0['loss'])
+    state, _ = step(state, batch)
+    state, m = step(state, batch)
+    assert float(m['loss']) < l0
+
+
+def test_sharded_training_runs_and_matches_replicated_params():
+    """Full train step under shard_map on 4 devices: runs, loss finite,
+    params stay replicated (vma-checked by construction)."""
+    ndev = 4
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ('batch',))
+    model = models.resnet20()
+    batch = _batch(n=8)
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
+                        num_devices=ndev, axis_name='batch')
+    tx = training.sgd(0.1, momentum=0.9)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0), batch['input'])
+    step = training.build_train_step(model, tx, precond, _ce,
+                                     axis_name='batch', mesh=mesh,
+                                     extra_mutable=('batch_stats',))
+    state, m = step(state, batch, lr=0.1, damping=0.003)
+    assert np.isfinite(float(m['loss']))
+    state, m2 = step(state, batch, lr=0.1, damping=0.003)
+    assert np.isfinite(float(m2['loss']))
