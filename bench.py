@@ -1,0 +1,106 @@
+"""Headline benchmark: ResNet-50 ImageNet-shape training with eigen_dp
+K-FAC on one TPU chip — imgs/sec/chip and K-FAC step overhead vs SGD.
+
+Mirrors the reference's SPEED mode (examples/pytorch_imagenet_resnet.py:21,
+388-394: mean iteration time over ~60 steady-state iterations) and its
+efficiency config (train_imagenet.sh: bs 32/chip, eigen_dp, damping 0.002,
+factor+inverse update every iteration — the setting behind the
+time_breakdown.py anchors).
+
+vs_baseline: reference 1-GPU K-FAC iteration 0.487 s at bs 32
+(scripts/time_breakdown.py:26) = 65.7 imgs/s.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import models, training
+
+BATCH = 32
+IMG = 224
+WARMUP = 5
+ITERS = 30
+BASELINE_KFAC_ITER_S = 0.487  # scripts/time_breakdown.py:26 (1 GPU, bs 32)
+
+
+def _ce(outputs, batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, batch['label']).mean()
+
+
+def _time_steps(step, state, batch, iters, **kw):
+    for _ in range(WARMUP):
+        state, m = step(state, batch, **kw)
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch, **kw)
+    jax.block_until_ready(m)
+    return (time.perf_counter() - t0) / iters, state
+
+
+def main():
+    rng = np.random.RandomState(0)
+    batch = {
+        'input': jnp.asarray(rng.randn(BATCH, IMG, IMG, 3), jnp.bfloat16),
+        'label': jnp.asarray(rng.randint(0, 1000, BATCH)),
+    }
+    model = models.resnet50(dtype=jnp.bfloat16)
+    tx = training.sgd(0.0125, momentum=0.9, weight_decay=5e-5)
+
+    # --- SGD baseline ---------------------------------------------------
+    state = training.init_train_state(model, tx, None, jax.random.PRNGKey(0),
+                                      batch['input'])
+    sgd_step = training.build_train_step(model, tx, None, _ce,
+                                         extra_mutable=('batch_stats',))
+    sgd_s, _ = _time_steps(sgd_step, state, batch, ITERS)
+
+    # --- K-FAC eigen_dp, update every iteration (reference breakdown
+    # setting) -----------------------------------------------------------
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.0125, damping=0.002,
+                        fac_update_freq=1, kfac_update_freq=1,
+                        num_devices=1, axis_name=None,
+                        assignment='balanced')
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0), batch['input'])
+    kfac_step = training.build_train_step(model, tx, precond, _ce,
+                                          extra_mutable=('batch_stats',))
+    kfac_s, state = _time_steps(kfac_step, state, batch, ITERS,
+                                lr=0.0125, damping=0.002)
+
+    # --- amortized setting (kfac freq 10, the deployed configuration,
+    # pytorch_imagenet_resnet.py:94) -------------------------------------
+    precond.fac_update_freq = 10
+    precond.kfac_update_freq = 10
+    amort_s, _ = _time_steps(kfac_step, state, batch, ITERS,
+                             lr=0.0125, damping=0.002)
+
+    imgs_per_sec = BATCH / kfac_s
+    result = {
+        'metric': 'resnet50_imagenet_kfac_imgs_per_sec_per_chip',
+        'value': round(imgs_per_sec, 2),
+        'unit': 'imgs/s',
+        'vs_baseline': round(kfac_s and imgs_per_sec
+                             / (BATCH / BASELINE_KFAC_ITER_S), 3),
+        'extra': {
+            'sgd_iter_s': round(sgd_s, 4),
+            'kfac_iter_s_freq1': round(kfac_s, 4),
+            'kfac_iter_s_freq10': round(amort_s, 4),
+            'kfac_overhead_vs_sgd_freq1': round(kfac_s / sgd_s, 3),
+            'kfac_overhead_vs_sgd_freq10': round(amort_s / sgd_s, 3),
+            'batch': BATCH, 'img': IMG, 'device': str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,197 @@
+"""CIFAR-10/100 ResNet trainer — the canonical K-FAC example.
+
+Flag-surface parity with the reference entrypoint
+(examples/pytorch_cifar10_resnet.py:44-107): same names for model, batch
+size, lr schedule, K-FAC hyper-parameters (`--kfac-update-freq 0` = pure
+SGD baseline, README.md:80), `--exclude-parts` phase ablation, and the
+SPEED profiling mode (mean/std iteration time over ~60 steady-state
+iterations, reference :39-40, 333-344). Runs on real CIFAR if
+``--dir`` points at the standard archives, else deterministic synthetic
+data (dataset-free container).
+
+Usage (single chip):
+  python examples/cifar10_resnet.py --model resnet32 --epochs 3
+Multi-device mesh:
+  python examples/cifar10_resnet.py --num-devices 8 --model resnet110
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import data as kdata
+from kfac_pytorch_tpu import models, training, utils
+
+SPEED_ITERS = 60
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description='CIFAR K-FAC trainer (TPU)')
+    p.add_argument('--model', default='resnet32')
+    p.add_argument('--dataset', default='cifar10',
+                   choices=['cifar10', 'cifar100'])
+    p.add_argument('--dir', default=None, help='dataset directory')
+    p.add_argument('--batch-size', type=int, default=128)
+    p.add_argument('--val-batch-size', type=int, default=128)
+    p.add_argument('--epochs', type=int, default=100)
+    p.add_argument('--base-lr', type=float, default=0.1)
+    p.add_argument('--lr-decay', nargs='+', type=int, default=[35, 75, 90])
+    p.add_argument('--warmup-epochs', type=int, default=5)
+    p.add_argument('--wd', type=float, default=5e-4)
+    p.add_argument('--momentum', type=float, default=0.9)
+    # K-FAC (reference: pytorch_cifar10_resnet.py:75-95)
+    p.add_argument('--kfac-update-freq', type=int, default=10,
+                   help='0 disables K-FAC (pure SGD)')
+    p.add_argument('--kfac-cov-update-freq', type=int, default=1)
+    p.add_argument('--kfac-name', default='eigen_dp',
+                   choices=list(kfac.KFAC_VARIANTS))
+    p.add_argument('--stat-decay', type=float, default=0.95)
+    p.add_argument('--damping', type=float, default=0.003)
+    p.add_argument('--kl-clip', type=float, default=0.001)
+    p.add_argument('--damping-alpha', type=float, default=0.5)
+    p.add_argument('--damping-decay', nargs='+', type=int, default=None)
+    p.add_argument('--kfac-update-freq-alpha', type=float, default=10)
+    p.add_argument('--kfac-update-freq-decay', nargs='+', type=int,
+                   default=None)
+    p.add_argument('--exclude-parts', default='')
+    p.add_argument('--assignment', default='round_robin',
+                   choices=['round_robin', 'balanced'])
+    # mesh / runtime
+    p.add_argument('--num-devices', type=int, default=1)
+    p.add_argument('--seed', type=int, default=42)
+    p.add_argument('--speed', action='store_true',
+                   help='SPEED mode: time ~60 iterations and exit')
+    p.add_argument('--log-dir', default='./logs')
+    p.add_argument('--checkpoint-dir', default=None)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    num_classes = 10 if args.dataset == 'cifar10' else 100
+    use_kfac = args.kfac_update_freq > 0
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    logfile = os.path.join(
+        args.log_dir,
+        f'{args.dataset}_{args.model}_kfac{args.kfac_update_freq}_'
+        f'{args.kfac_name}_bs{args.batch_size}_nd{args.num_devices}.log')
+    logging.basicConfig(
+        level=logging.INFO, format='%(asctime)s %(message)s',
+        handlers=[logging.StreamHandler(), logging.FileHandler(logfile)],
+        force=True)
+    log = logging.getLogger()
+    log.info('args: %s', vars(args))
+
+    (train_x, train_y), (val_x, val_y) = kdata.get_cifar(
+        args.dir, num_classes)
+    train_loader = kdata.Loader(train_x, train_y, args.batch_size,
+                                train=True, augment=kdata.augment_cifar,
+                                seed=args.seed)
+    val_loader = kdata.Loader(val_x, val_y, args.val_batch_size, train=False)
+
+    model = models.get_model(args.model, num_classes=num_classes)
+    steps_per_epoch = train_loader.steps_per_epoch
+    lr_fn = utils.warmup_multistep(
+        args.base_lr, steps_per_epoch, args.warmup_epochs, args.lr_decay,
+        scale=max(1, args.num_devices * args.batch_size // 128))
+    tx = training.sgd(lr_fn, momentum=args.momentum, weight_decay=args.wd)
+
+    precond = None
+    scheduler = None
+    if use_kfac:
+        precond = kfac.get_kfac_module(args.kfac_name)(
+            lr=args.base_lr, damping=args.damping,
+            fac_update_freq=args.kfac_cov_update_freq,
+            kfac_update_freq=args.kfac_update_freq,
+            kl_clip=args.kl_clip, factor_decay=args.stat_decay,
+            exclude_parts=args.exclude_parts,
+            num_devices=args.num_devices,
+            axis_name='batch' if args.num_devices > 1 else None,
+            assignment=args.assignment)
+        scheduler = kfac.KFACParamScheduler(
+            precond, damping_alpha=args.damping_alpha,
+            damping_schedule=args.damping_decay,
+            update_freq_alpha=args.kfac_update_freq_alpha,
+            update_freq_schedule=args.kfac_update_freq_decay)
+
+    mesh = None
+    axis = None
+    if args.num_devices > 1:
+        mesh = Mesh(np.array(jax.devices()[:args.num_devices]), ('batch',))
+        axis = 'batch'
+
+    def loss_fn(outputs, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, batch['label']).mean()
+
+    sample = jnp.zeros((args.batch_size, 32, 32, 3), jnp.float32)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(args.seed), sample)
+    step = training.build_train_step(model, tx, precond, loss_fn,
+                                     axis_name=axis, mesh=mesh,
+                                     extra_mutable=('batch_stats',))
+
+    @jax.jit
+    def eval_step(params, extra_vars, batch):
+        out = model.apply({'params': params, **extra_vars}, batch['input'],
+                          train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            out, batch['label']).mean()
+        acc = utils.accuracy(out, batch['label'])
+        return loss, acc
+
+    if args.speed:
+        batch = next(train_loader.epoch())
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        times = []
+        for i in range(SPEED_ITERS + 5):
+            t0 = time.perf_counter()
+            state, m = step(state, batch, lr=lr_fn(i),
+                            damping=precond.damping if precond else 0.0)
+            jax.block_until_ready(m['loss'])
+            if i >= 5:
+                times.append(time.perf_counter() - t0)
+        log.info('SPEED: iter time %.4f +- %.4f s (imgs/sec %.1f)',
+                 np.mean(times), np.std(times),
+                 args.batch_size / np.mean(times))
+        return
+
+    for epoch in range(args.epochs):
+        train_loss = utils.Metric('train_loss')
+        t0 = time.time()
+        for batch in train_loader.epoch():
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            s = int(state.step)
+            state, m = step(state, batch, lr=lr_fn(s),
+                            damping=precond.damping if precond else 0.0)
+            train_loss.update(m['loss'], len(batch['label']))
+        val_loss = utils.Metric('val_loss')
+        val_acc = utils.Metric('val_acc')
+        for batch in val_loader.epoch():
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            l, a = eval_step(state.params, state.extra_vars, batch)
+            val_loss.update(l, len(batch['label']))
+            val_acc.update(a, len(batch['label']))
+        log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
+                 '(%.1fs)', epoch, train_loss.avg, val_loss.avg,
+                 val_acc.avg, time.time() - t0)
+        if scheduler is not None:
+            scheduler.step(epoch + 1)
+        if args.checkpoint_dir:
+            utils.save_checkpoint(args.checkpoint_dir, epoch, state)
+
+
+if __name__ == '__main__':
+    main()

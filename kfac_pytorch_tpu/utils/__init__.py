@@ -1,0 +1,15 @@
+"""Harness utilities — parity with the reference's examples/utils.py."""
+
+from kfac_pytorch_tpu.utils.metrics import Metric, accuracy
+from kfac_pytorch_tpu.utils.lr import (
+    warmup_multistep, polynomial_decay, inverse_sqrt)
+from kfac_pytorch_tpu.utils.losses import (
+    label_smoothing_cross_entropy, sample_pseudo_labels)
+from kfac_pytorch_tpu.utils.checkpoint import (
+    save_checkpoint, restore_checkpoint, find_resume_epoch)
+
+__all__ = [
+    'Metric', 'accuracy', 'warmup_multistep', 'polynomial_decay',
+    'inverse_sqrt', 'label_smoothing_cross_entropy', 'sample_pseudo_labels',
+    'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',
+]

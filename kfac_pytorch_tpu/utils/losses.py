@@ -1,0 +1,28 @@
+"""Loss helpers.
+
+Parity: ``LabelSmoothLoss`` (reference: examples/utils.py:20-32) and the
+pseudo-label sampler used for true-Fisher Monte-Carlo factor estimation
+(reference: examples/utils.py:83-90).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def label_smoothing_cross_entropy(outputs, labels, smoothing=0.1,
+                                  num_classes=None):
+    """CE against a smoothed one-hot target (reference:
+    examples/utils.py:20-32)."""
+    if num_classes is None:
+        num_classes = outputs.shape[-1]
+    logp = jax.nn.log_softmax(outputs, axis=-1)
+    onehot = jax.nn.one_hot(labels, num_classes)
+    target = onehot * (1.0 - smoothing) + smoothing / num_classes
+    return -(target * logp).sum(axis=-1).mean()
+
+
+def sample_pseudo_labels(rng, outputs):
+    """Sample labels from the model's predictive distribution — the
+    true-Fisher MC estimator's backward targets (reference:
+    examples/utils.py:83-90)."""
+    return jax.random.categorical(rng, outputs, axis=-1)

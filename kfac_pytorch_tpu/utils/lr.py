@@ -1,0 +1,64 @@
+"""Learning-rate schedules used by the reference harness.
+
+Parity: warmup + multiplicative multi-step decay
+(reference: examples/utils.py:54-66), polynomial decay (:68-80), and the
+Transformer inverse-sqrt warmup (examples/transformer/Optim.py:40-63).
+Step-indexed callables, traceable under jit (optax evaluates them on the
+traced step counter), so they are written with jnp ops, no Python
+branching.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def warmup_multistep(base_lr, steps_per_epoch, warmup_epochs, decay_epochs,
+                     decay_factor=0.1, init_scale=None, scale=1.0):
+    """Linear warmup from ``base_lr*init_scale`` to ``base_lr*scale`` over
+    ``warmup_epochs``, then multiply by ``decay_factor`` at each epoch in
+    ``decay_epochs``. ``scale`` is the large-batch multiplier (the
+    reference scales base lr by world size,
+    examples/pytorch_imagenet_resnet.py:219-231)."""
+    if init_scale is None:
+        init_scale = 1.0 / max(scale, 1.0)
+    boundaries = jnp.asarray(sorted(decay_epochs or []), jnp.float32)
+
+    def schedule(step):
+        epoch = jnp.asarray(step, jnp.float32) / steps_per_epoch
+        warm_frac = epoch / max(warmup_epochs, 1e-9)
+        warm = base_lr * (init_scale + (scale - init_scale)
+                          * jnp.minimum(warm_frac, 1.0))
+        k = jnp.sum(epoch >= boundaries) if boundaries.size else 0
+        decayed = base_lr * scale * (decay_factor ** k)
+        if warmup_epochs:
+            return jnp.where(epoch < warmup_epochs, warm, decayed)
+        return decayed
+
+    return schedule
+
+
+def polynomial_decay(base_lr, total_steps, power=2.0, warmup_steps=0,
+                     scale=1.0):
+    """Polynomial decay to zero (reference: examples/utils.py:68-80)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = base_lr * scale * step / max(warmup_steps, 1)
+        t = jnp.clip(step - warmup_steps, 0, total_steps - warmup_steps)
+        frac = 1.0 - t / max(total_steps - warmup_steps, 1)
+        decayed = base_lr * scale * (frac ** power)
+        return jnp.where(step < warmup_steps, warm, decayed)
+
+    return schedule
+
+
+def inverse_sqrt(d_model, warmup_steps=4000, lr_mul=1.0):
+    """Transformer schedule: ``lr_mul * d^-0.5 * min(s^-0.5, s*w^-1.5)``
+    (reference: examples/transformer/Optim.py:40-63)."""
+
+    def schedule(step):
+        s = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        return lr_mul * (d_model ** -0.5) * jnp.minimum(
+            s ** -0.5, s * warmup_steps ** -1.5)
+
+    return schedule

@@ -1,0 +1,40 @@
+"""Training metrics.
+
+Parity: the distributed ``Metric`` accumulator and ``accuracy``
+(reference: examples/utils.py:6-9, 39-52). The reference allreduce-averages
+each update across ranks; here values produced by a jitted/shard_map step
+are already replicated, so the accumulator is a plain weighted host
+average — the collective happened on-device.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Metric:
+    """Weighted running average of scalars (loss, accuracy)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.total = 0.0
+        self.n = 0.0
+
+    def update(self, val, n=1):
+        self.total += float(val) * n
+        self.n += n
+
+    @property
+    def avg(self):
+        return self.total / max(self.n, 1e-12)
+
+
+def accuracy(outputs, labels):
+    """Top-1 accuracy from logits (reference: examples/utils.py:6-9)."""
+    pred = jnp.argmax(outputs, axis=-1)
+    return jnp.mean((pred == labels).astype(jnp.float32))
+
+
+def topk_accuracy(outputs, labels, k=5):
+    topk = jnp.argsort(outputs, axis=-1)[:, -k:]
+    hit = (topk == labels[:, None]).any(axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
