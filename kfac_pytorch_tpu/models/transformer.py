@@ -1,0 +1,217 @@
+"""Vanilla encoder-decoder Transformer (Attention Is All You Need).
+
+Same family as the reference zoo (examples/transformer/Models.py:128-198:
+d_model 512, 6 layers, 8 heads, d_inner 2048, sinusoidal positions,
+post-norm residual blocks, optional target-embedding/projection weight
+sharing and emb/prj sqrt(d_model) scaling). All attention and FFN
+projections are KFAC Dense layers; embeddings are not K-FAC-supported (as
+in the reference, which hooks only Linear/Conv2d) and the pre-softmax
+vocab projection is excluded via ``exclude_vocabulary_size``
+(reference: examples/pytorch_multi30k_transformer.py:297).
+
+K-FAC sequence handling matches the reference: factor statistics average
+over the token axis (kfac/utils.py:97-99 — see ops.compute_a_dense).
+"""
+
+import math
+from typing import Optional
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu import nn as knn
+
+
+def sinusoid_position_encoding(n_position, d_model):
+    pos = np.arange(n_position)[:, None]
+    dim = np.arange(d_model)[None, :]
+    angle = pos / np.power(10000, 2 * (dim // 2) / d_model)
+    enc = np.zeros((n_position, d_model), np.float32)
+    enc[:, 0::2] = np.sin(angle[:, 0::2])
+    enc[:, 1::2] = np.cos(angle[:, 1::2])
+    return jnp.asarray(enc)
+
+
+class MultiHeadAttention(linen.Module):
+    """Post-norm multi-head attention (reference:
+    examples/transformer/SubLayers.py:11-61)."""
+    n_head: int
+    d_model: int
+    d_k: int
+    d_v: int
+    dropout: float = 0.1
+
+    @linen.compact
+    def __call__(self, q_in, k_in, v_in, mask=None, train=True):
+        h, dk, dv = self.n_head, self.d_k, self.d_v
+        residual = q_in
+        q = knn.Dense(h * dk, use_bias=False, name='w_q')(q_in)
+        k = knn.Dense(h * dk, use_bias=False, name='w_k')(k_in)
+        v = knn.Dense(h * dv, use_bias=False, name='w_v')(v_in)
+        B, Lq = q.shape[0], q.shape[1]
+        Lk = k.shape[1]
+        q = q.reshape(B, Lq, h, dk).transpose(0, 2, 1, 3)
+        k = k.reshape(B, Lk, h, dk).transpose(0, 2, 1, 3)
+        v = v.reshape(B, Lk, h, dv).transpose(0, 2, 1, 3)
+        attn = jnp.einsum('bhqd,bhkd->bhqk', q, k) / math.sqrt(dk)
+        if mask is not None:
+            attn = jnp.where(mask, attn, -1e9)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = linen.Dropout(self.dropout, deterministic=not train)(attn)
+        out = jnp.einsum('bhqk,bhkd->bhqd', attn, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, Lq, h * dv)
+        out = knn.Dense(self.d_model, use_bias=False, name='w_o')(out)
+        out = linen.Dropout(self.dropout, deterministic=not train)(out)
+        out = linen.LayerNorm(epsilon=1e-6, name='ln')(out + residual)
+        return out
+
+
+class PositionwiseFFN(linen.Module):
+    """Post-norm FFN (reference: SubLayers.py:135-162)."""
+    d_model: int
+    d_inner: int
+    dropout: float = 0.1
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        residual = x
+        h = knn.Dense(self.d_inner, name='w_1')(x)
+        h = linen.relu(h)
+        h = knn.Dense(self.d_model, name='w_2')(h)
+        h = linen.Dropout(self.dropout, deterministic=not train)(h)
+        return linen.LayerNorm(epsilon=1e-6, name='ln')(h + residual)
+
+
+class EncoderLayer(linen.Module):
+    d_model: int
+    d_inner: int
+    n_head: int
+    d_k: int
+    d_v: int
+    dropout: float = 0.1
+
+    @linen.compact
+    def __call__(self, x, mask, train=True):
+        x = MultiHeadAttention(self.n_head, self.d_model, self.d_k, self.d_v,
+                               self.dropout, name='self_attn')(
+                                   x, x, x, mask, train)
+        return PositionwiseFFN(self.d_model, self.d_inner, self.dropout,
+                               name='ffn')(x, train)
+
+
+class DecoderLayer(linen.Module):
+    d_model: int
+    d_inner: int
+    n_head: int
+    d_k: int
+    d_v: int
+    dropout: float = 0.1
+
+    @linen.compact
+    def __call__(self, x, enc_out, self_mask, cross_mask, train=True):
+        x = MultiHeadAttention(self.n_head, self.d_model, self.d_k, self.d_v,
+                               self.dropout, name='self_attn')(
+                                   x, x, x, self_mask, train)
+        x = MultiHeadAttention(self.n_head, self.d_model, self.d_k, self.d_v,
+                               self.dropout, name='cross_attn')(
+                                   x, enc_out, enc_out, cross_mask, train)
+        return PositionwiseFFN(self.d_model, self.d_inner, self.dropout,
+                               name='ffn')(x, train)
+
+
+class Transformer(linen.Module):
+    """Reference-parity constructor surface (Models.py:128-170)."""
+    n_src_vocab: int
+    n_trg_vocab: int
+    src_pad_idx: int = 1
+    trg_pad_idx: int = 1
+    d_word_vec: int = 512
+    d_model: int = 512
+    d_inner: int = 2048
+    n_layers: int = 6
+    n_head: int = 8
+    d_k: int = 64
+    d_v: int = 64
+    dropout: float = 0.1
+    n_position: int = 200
+    trg_emb_prj_weight_sharing: bool = True
+    scale_emb_or_prj: str = 'prj'
+
+    def setup(self):
+        self.src_emb = linen.Embed(self.n_src_vocab, self.d_word_vec,
+                                   name='src_emb')
+        self.trg_emb = linen.Embed(self.n_trg_vocab, self.d_word_vec,
+                                   name='trg_emb')
+        self.pos_enc = sinusoid_position_encoding(self.n_position,
+                                                  self.d_word_vec)
+        self.enc_layers = [
+            EncoderLayer(self.d_model, self.d_inner, self.n_head, self.d_k,
+                         self.d_v, self.dropout, name=f'enc_{i}')
+            for i in range(self.n_layers)]
+        self.dec_layers = [
+            DecoderLayer(self.d_model, self.d_inner, self.n_head, self.d_k,
+                         self.d_v, self.dropout, name=f'dec_{i}')
+            for i in range(self.n_layers)]
+        self.enc_ln = linen.LayerNorm(epsilon=1e-6, name='enc_ln')
+        self.dec_ln = linen.LayerNorm(epsilon=1e-6, name='dec_ln')
+        self.drop = linen.Dropout(self.dropout)
+        if not self.trg_emb_prj_weight_sharing:
+            # untied head stays a KFAC layer but is excluded by vocab size
+            # at preconditioner setup (base.py:139-140 semantics)
+            self.trg_proj = knn.Dense(self.n_trg_vocab, use_bias=False,
+                                      name='trg_proj')
+
+    def encode(self, src_seq, src_mask, train=True):
+        x = self.src_emb(src_seq)
+        scale_emb = (self.scale_emb_or_prj == 'emb'
+                     and self.trg_emb_prj_weight_sharing)
+        if scale_emb:
+            x = x * self.d_model ** 0.5
+        x = self.drop(x + self.pos_enc[None, :x.shape[1]],
+                      deterministic=not train)
+        x = self.enc_ln(x)
+        for layer in self.enc_layers:
+            x = layer(x, src_mask, train=train)
+        return x
+
+    def decode(self, trg_seq, enc_out, self_mask, cross_mask, train=True):
+        x = self.trg_emb(trg_seq)
+        scale_emb = (self.scale_emb_or_prj == 'emb'
+                     and self.trg_emb_prj_weight_sharing)
+        if scale_emb:
+            x = x * self.d_model ** 0.5
+        x = self.drop(x + self.pos_enc[None, :x.shape[1]],
+                      deterministic=not train)
+        x = self.dec_ln(x)
+        for layer in self.dec_layers:
+            x = layer(x, enc_out, self_mask, cross_mask, train=train)
+        return x
+
+    def project(self, dec_out, train=True):
+        del train  # projection has no mode-dependent behavior
+        if self.trg_emb_prj_weight_sharing:
+            logits = dec_out @ self.trg_emb.embedding.T
+            if self.scale_emb_or_prj == 'prj':
+                logits = logits * self.d_model ** -0.5
+        else:
+            logits = self.trg_proj(dec_out)
+        return logits
+
+    def __call__(self, src_seq, trg_seq, train=True):
+        src_mask = (src_seq != self.src_pad_idx)[:, None, None, :]
+        trg_pad = (trg_seq != self.trg_pad_idx)[:, None, None, :]
+        L = trg_seq.shape[1]
+        causal = jnp.tril(jnp.ones((L, L), bool))[None, None]
+        self_mask = trg_pad & causal
+        enc_out = self.encode(src_seq, src_mask, train=train)
+        dec_out = self.decode(trg_seq, enc_out, self_mask, src_mask,
+                              train=train)
+        return self.project(dec_out)
+
+
+def multi30k_transformer(n_src_vocab, n_trg_vocab, **kw):
+    """The Multi-30k configuration (reference:
+    examples/pytorch_multi30k_transformer.py harness defaults)."""
+    return Transformer(n_src_vocab=n_src_vocab, n_trg_vocab=n_trg_vocab, **kw)

@@ -53,7 +53,8 @@ def sgd(lr_schedule, momentum=0.9, weight_decay=0.0, nesterov=False):
 
 
 def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
-                     extra_mutable=(), sync_extra_vars=True, donate=True):
+                     extra_mutable=(), sync_extra_vars=True, donate=True,
+                     dropout_seed=None):
     """Build the per-iteration function family.
 
     Args:
@@ -73,20 +74,29 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     """
 
     def one_step(state, batch, hyper, update_factors, update_inverse):
-        x, y = batch['input'], batch['label']
+        x = batch['input']
         variables = {'params': state.params, **state.extra_vars}
         use_capture = precond is not None and update_factors
+        rngs = None
+        if dropout_seed is not None:
+            key = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
+                                     state.step)
+            if axis_name is not None:
+                # per-device dropout masks (DistributedSampler-style
+                # decorrelation of the local batches)
+                key = jax.random.fold_in(key, coll.axis_index(axis_name))
+            rngs = {'dropout': key}
 
         if use_capture:
             loss, out, grads, acts, gs, mutated = \
                 capture.value_and_grad_with_capture(
                     model, lambda o: loss_fn(o, batch), variables, x,
-                    mutable=extra_mutable, axis_name=axis_name)
+                    mutable=extra_mutable, axis_name=axis_name, rngs=rngs)
         else:
             def plain_loss(params):
                 out, mutated = model.apply(
                     {'params': params, **state.extra_vars}, x,
-                    mutable=list(extra_mutable))
+                    mutable=list(extra_mutable), rngs=rngs)
                 return loss_fn(out, batch), (out, mutated)
 
             (loss, (out, mutated)), grads = jax.value_and_grad(

@@ -1,0 +1,78 @@
+"""Op microbenchmarks: eigh / Cholesky-inverse / factor GEMMs vs size.
+
+Port of the reference's offline benches (scripts/bench_ops.py,
+scripts/inverse_model.py: eig/gemm timing over dims, replay of real
+ResNet-50 factor shapes) for the TPU ops layer. Also A/B-tests the
+internal matmul precision of XLA's eigh (QDWH is matmul-bound, so
+precision config moves its cost by multiples).
+
+Usage: python scripts/bench_ops.py [--dims 512 1024 2304 4608] [--batch 4]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu import ops
+
+# ResNet-50 per-layer factor dims (reference: scripts/inverse_model.py:19-20)
+RESNET50_A_DIMS = [147, 64, 256, 576, 512, 1024, 1152, 2048, 2304, 4608,
+                   2049]
+RESNET50_G_DIMS = [64, 128, 256, 512, 1024, 2048, 1000]
+
+
+def timeit(fn, *args, warmup=2, iters=5):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def spd(rng, batch, dim):
+    a = rng.randn(batch, dim, dim).astype(np.float32) / np.sqrt(dim)
+    x = a @ a.transpose(0, 2, 1) + np.eye(dim, dtype=np.float32)
+    return jnp.asarray(x)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--dims', nargs='+', type=int,
+                   default=[256, 512, 1024, 2304, 4608])
+    p.add_argument('--batch', type=int, default=4)
+    args = p.parse_args()
+    rng = np.random.RandomState(0)
+
+    print(f'device: {jax.devices()[0]}')
+    for prec in ['default', 'tensorfloat32', 'highest']:
+        with jax.default_matmul_precision(prec):
+            eigh_j = jax.jit(lambda x: ops.sym_eig(x))
+            inv_j = jax.jit(lambda x: ops.psd_inverse(x))
+            for d in args.dims:
+                x = spd(rng, args.batch, d)
+                te = timeit(eigh_j, x)
+                ti = timeit(inv_j, x)
+                print(f'prec={prec:14s} dim={d:5d} batch={args.batch} '
+                      f'eigh={te * 1e3:9.1f} ms  chol_inv={ti * 1e3:8.1f} ms')
+
+    # factor GEMM (the ComputeA hot op) at conv-layer shapes
+    gemm = jax.jit(lambda a: ops.compute_a_conv(a, (3, 3), (1, 1), (1, 1),
+                                                False))
+    for c, hw in [(64, 56), (256, 28), (512, 14)]:
+        a = jnp.asarray(rng.randn(32, hw, hw, c).astype(np.float32))
+        t = timeit(gemm, a)
+        print(f'compute_a_conv c={c:4d} hw={hw:3d} bs=32: {t * 1e3:8.1f} ms')
+
+
+if __name__ == '__main__':
+    main()
