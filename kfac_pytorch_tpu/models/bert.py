@@ -1,0 +1,151 @@
+"""BERT encoder (base/large configs) with a SQuAD span-prediction head.
+
+Same workload family as the reference's SQuAD fine-tune
+(examples/pytorch_squad_bert.py: HuggingFace BERT-base, K-FAC on the dense
+layers with the 30522-vocab head excluded, :394/:443-450). Built from
+scratch in Flax: all attention/FFN/pooler projections are KFAC Dense
+layers; embeddings stay plain (K-FAC supports Linear/Conv only, as in the
+reference). Post-norm transformer encoder, GELU FFN, learned positions.
+"""
+
+from typing import Optional
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu import nn as knn
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw):
+        kw.setdefault('hidden_size', 1024)
+        kw.setdefault('num_hidden_layers', 24)
+        kw.setdefault('num_attention_heads', 16)
+        kw.setdefault('intermediate_size', 4096)
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """For tests / smoke runs."""
+        kw.setdefault('vocab_size', 128)
+        kw.setdefault('hidden_size', 64)
+        kw.setdefault('num_hidden_layers', 2)
+        kw.setdefault('num_attention_heads', 4)
+        kw.setdefault('intermediate_size', 128)
+        kw.setdefault('max_position_embeddings', 64)
+        return cls(**kw)
+
+
+class BertSelfAttention(linen.Module):
+    config: BertConfig
+
+    @linen.compact
+    def __call__(self, x, mask, train=True):
+        c = self.config
+        h = c.num_attention_heads
+        d = c.hidden_size // h
+        q = knn.Dense(c.hidden_size, name='query')(x)
+        k = knn.Dense(c.hidden_size, name='key')(x)
+        v = knn.Dense(c.hidden_size, name='value')(x)
+        B, L = x.shape[:2]
+        q = q.reshape(B, L, h, d).transpose(0, 2, 1, 3)
+        k = k.reshape(B, L, h, d).transpose(0, 2, 1, 3)
+        v = v.reshape(B, L, h, d).transpose(0, 2, 1, 3)
+        attn = jnp.einsum('bhqd,bhkd->bhqk', q, k) / np.sqrt(d)
+        if mask is not None:
+            attn = attn + (1.0 - mask[:, None, None, :]) * -1e9
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = linen.Dropout(c.attention_probs_dropout_prob,
+                             deterministic=not train)(attn)
+        out = jnp.einsum('bhqk,bhkd->bhqd', attn, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, L, c.hidden_size)
+        out = knn.Dense(c.hidden_size, name='output')(out)
+        out = linen.Dropout(c.hidden_dropout_prob,
+                            deterministic=not train)(out)
+        return linen.LayerNorm(epsilon=c.layer_norm_eps, name='ln')(out + x)
+
+
+class BertLayer(linen.Module):
+    config: BertConfig
+
+    @linen.compact
+    def __call__(self, x, mask, train=True):
+        c = self.config
+        x = BertSelfAttention(c, name='attention')(x, mask, train)
+        h = knn.Dense(c.intermediate_size, name='intermediate')(x)
+        h = jax.nn.gelu(h, approximate=False)
+        h = knn.Dense(c.hidden_size, name='ffn_output')(h)
+        h = linen.Dropout(c.hidden_dropout_prob, deterministic=not train)(h)
+        return linen.LayerNorm(epsilon=c.layer_norm_eps, name='ln')(h + x)
+
+
+class BertEncoder(linen.Module):
+    config: BertConfig
+
+    @linen.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 train=True):
+        c = self.config
+        B, L = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, L), jnp.float32)
+        word = linen.Embed(c.vocab_size, c.hidden_size, name='word_emb')(
+            input_ids)
+        pos = linen.Embed(c.max_position_embeddings, c.hidden_size,
+                          name='pos_emb')(jnp.arange(L)[None])
+        typ = linen.Embed(c.type_vocab_size, c.hidden_size,
+                          name='type_emb')(token_type_ids)
+        x = linen.LayerNorm(epsilon=c.layer_norm_eps, name='emb_ln')(
+            word + pos + typ)
+        x = linen.Dropout(c.hidden_dropout_prob, deterministic=not train)(x)
+        for i in range(c.num_hidden_layers):
+            x = BertLayer(c, name=f'layer_{i}')(x, attention_mask, train)
+        return x
+
+
+class BertForQuestionAnswering(linen.Module):
+    """SQuAD span head: Dense(hidden -> 2) over the sequence (HF parity;
+    the reference fine-tunes exactly this, pytorch_squad_bert.py)."""
+    config: BertConfig
+
+    @linen.compact
+    def __call__(self, inputs, train=True):
+        input_ids, token_type_ids, attention_mask = inputs
+        x = BertEncoder(self.config, name='bert')(
+            input_ids, token_type_ids, attention_mask, train=train)
+        logits = knn.Dense(2, name='qa_outputs')(x)
+        start, end = logits[..., 0], logits[..., 1]
+        return start, end
+
+
+def bert_base_qa(**kw):
+    return BertForQuestionAnswering(BertConfig.base(**kw))
+
+
+def bert_tiny_qa(**kw):
+    return BertForQuestionAnswering(BertConfig.tiny(**kw))
