@@ -90,13 +90,20 @@ def _normalize(x):
 
 
 def augment_cifar(rng, x):
-    """Pad-4 random crop + horizontal flip, vectorized
-    (reference transform stack: examples/pytorch_cifar10_resnet.py:157-163)."""
+    """Pad-4 random crop + horizontal flip
+    (reference transform stack: examples/pytorch_cifar10_resnet.py:157-163).
+    Uses the native batched kernel (native/kfac_native.cc) when available;
+    numpy fallback otherwise."""
     n, h, w, c = x.shape
+    offs = rng.randint(0, 9, size=(n, 2)).astype(np.int32)
+    flips = (rng.rand(n) < 0.5)
+    from kfac_pytorch_tpu import native_lib
+    out = native_lib.augment_crop_flip(
+        x.astype(np.float32, copy=False), offs, flips.astype(np.uint8))
+    if out is not None:
+        return out
     xp = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode='reflect')
     out = np.empty_like(x)
-    offs = rng.randint(0, 9, size=(n, 2))
-    flips = rng.rand(n) < 0.5
     for i in range(n):
         oy, ox = offs[i]
         win = xp[i, oy:oy + h, ox:ox + w]

@@ -1,0 +1,178 @@
+"""Inception-v4 (Szegedy et al. 2016).
+
+Same family as the reference zoo (examples/imagenet_inceptionv4.py:9-358,
+a Cadene-style port: conv-BN-relu units, stem, 4xInception-A,
+Reduction-A, 7xInception-B, Reduction-B, 3xInception-C, avgpool, fc) in
+Flax/NHWC with KFAC capture layers. One of the reference's 64-GPU
+efficiency workloads (batch.sh:30).
+"""
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import nn as knn
+
+_kaiming = linen.initializers.kaiming_normal()
+
+
+class ConvUnit(linen.Module):
+    """conv + BN + relu (reference BasicConv2d)."""
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: tuple = (0, 0)
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        x = knn.Conv(self.features, self.kernel, strides=self.strides,
+                     padding=self.padding, use_bias=False,
+                     kernel_init=_kaiming, dtype=self.dtype, name='conv')(x)
+        x = linen.BatchNorm(use_running_average=not train, momentum=0.9,
+                            epsilon=1e-3, dtype=self.dtype, name='bn')(x)
+        return linen.relu(x)
+
+
+def _pool(x, kind, window=(3, 3), strides=(1, 1), padding=(1, 1)):
+    pads = ((padding[0], padding[0]), (padding[1], padding[1]))
+    if kind == 'max':
+        return linen.max_pool(x, window, strides=strides, padding=pads)
+    return linen.avg_pool(x, window, strides=strides, padding=pads,
+                          count_include_pad=False)
+
+
+class Stem(linen.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        x = ConvUnit(32, (3, 3), (2, 2), dtype=d, name='c1')(x, train)
+        x = ConvUnit(32, (3, 3), dtype=d, name='c2')(x, train)
+        x = ConvUnit(64, (3, 3), padding=(1, 1), dtype=d, name='c3')(x, train)
+        a = _pool(x, 'max', strides=(2, 2), padding=(0, 0))
+        b = ConvUnit(96, (3, 3), (2, 2), dtype=d, name='c4')(x, train)
+        x = jnp.concatenate([a, b], -1)
+        a = ConvUnit(64, (1, 1), dtype=d, name='a1')(x, train)
+        a = ConvUnit(96, (3, 3), dtype=d, name='a2')(a, train)
+        b = ConvUnit(64, (1, 1), dtype=d, name='b1')(x, train)
+        b = ConvUnit(64, (1, 7), padding=(0, 3), dtype=d, name='b2')(b, train)
+        b = ConvUnit(64, (7, 1), padding=(3, 0), dtype=d, name='b3')(b, train)
+        b = ConvUnit(96, (3, 3), dtype=d, name='b4')(b, train)
+        x = jnp.concatenate([a, b], -1)
+        a = ConvUnit(192, (3, 3), (2, 2), dtype=d, name='d1')(x, train)
+        b = _pool(x, 'max', strides=(2, 2), padding=(0, 0))
+        return jnp.concatenate([a, b], -1)
+
+
+class InceptionA(linen.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        b0 = ConvUnit(96, (1, 1), dtype=d, name='b0')(x, train)
+        b1 = ConvUnit(64, (1, 1), dtype=d, name='b1a')(x, train)
+        b1 = ConvUnit(96, (3, 3), padding=(1, 1), dtype=d, name='b1b')(b1, train)
+        b2 = ConvUnit(64, (1, 1), dtype=d, name='b2a')(x, train)
+        b2 = ConvUnit(96, (3, 3), padding=(1, 1), dtype=d, name='b2b')(b2, train)
+        b2 = ConvUnit(96, (3, 3), padding=(1, 1), dtype=d, name='b2c')(b2, train)
+        b3 = _pool(x, 'avg')
+        b3 = ConvUnit(96, (1, 1), dtype=d, name='b3')(b3, train)
+        return jnp.concatenate([b0, b1, b2, b3], -1)
+
+
+class ReductionA(linen.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        b0 = ConvUnit(384, (3, 3), (2, 2), dtype=d, name='b0')(x, train)
+        b1 = ConvUnit(192, (1, 1), dtype=d, name='b1a')(x, train)
+        b1 = ConvUnit(224, (3, 3), padding=(1, 1), dtype=d, name='b1b')(b1, train)
+        b1 = ConvUnit(256, (3, 3), (2, 2), dtype=d, name='b1c')(b1, train)
+        b2 = _pool(x, 'max', strides=(2, 2), padding=(0, 0))
+        return jnp.concatenate([b0, b1, b2], -1)
+
+
+class InceptionB(linen.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        b0 = ConvUnit(384, (1, 1), dtype=d, name='b0')(x, train)
+        b1 = ConvUnit(192, (1, 1), dtype=d, name='b1a')(x, train)
+        b1 = ConvUnit(224, (1, 7), padding=(0, 3), dtype=d, name='b1b')(b1, train)
+        b1 = ConvUnit(256, (7, 1), padding=(3, 0), dtype=d, name='b1c')(b1, train)
+        b2 = ConvUnit(192, (1, 1), dtype=d, name='b2a')(x, train)
+        b2 = ConvUnit(192, (7, 1), padding=(3, 0), dtype=d, name='b2b')(b2, train)
+        b2 = ConvUnit(224, (1, 7), padding=(0, 3), dtype=d, name='b2c')(b2, train)
+        b2 = ConvUnit(224, (7, 1), padding=(3, 0), dtype=d, name='b2d')(b2, train)
+        b2 = ConvUnit(256, (1, 7), padding=(0, 3), dtype=d, name='b2e')(b2, train)
+        b3 = _pool(x, 'avg')
+        b3 = ConvUnit(128, (1, 1), dtype=d, name='b3')(b3, train)
+        return jnp.concatenate([b0, b1, b2, b3], -1)
+
+
+class ReductionB(linen.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        b0 = ConvUnit(192, (1, 1), dtype=d, name='b0a')(x, train)
+        b0 = ConvUnit(192, (3, 3), (2, 2), dtype=d, name='b0b')(b0, train)
+        b1 = ConvUnit(256, (1, 1), dtype=d, name='b1a')(x, train)
+        b1 = ConvUnit(256, (1, 7), padding=(0, 3), dtype=d, name='b1b')(b1, train)
+        b1 = ConvUnit(320, (7, 1), padding=(3, 0), dtype=d, name='b1c')(b1, train)
+        b1 = ConvUnit(320, (3, 3), (2, 2), dtype=d, name='b1d')(b1, train)
+        b2 = _pool(x, 'max', strides=(2, 2), padding=(0, 0))
+        return jnp.concatenate([b0, b1, b2], -1)
+
+
+class InceptionC(linen.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        b0 = ConvUnit(256, (1, 1), dtype=d, name='b0')(x, train)
+        b1 = ConvUnit(384, (1, 1), dtype=d, name='b1a')(x, train)
+        b1a = ConvUnit(256, (1, 3), padding=(0, 1), dtype=d, name='b1b')(b1, train)
+        b1b = ConvUnit(256, (3, 1), padding=(1, 0), dtype=d, name='b1c')(b1, train)
+        b2 = ConvUnit(384, (1, 1), dtype=d, name='b2a')(x, train)
+        b2 = ConvUnit(448, (3, 1), padding=(1, 0), dtype=d, name='b2b')(b2, train)
+        b2 = ConvUnit(512, (1, 3), padding=(0, 1), dtype=d, name='b2c')(b2, train)
+        b2a = ConvUnit(256, (1, 3), padding=(0, 1), dtype=d, name='b2d')(b2, train)
+        b2b = ConvUnit(256, (3, 1), padding=(1, 0), dtype=d, name='b2e')(b2, train)
+        b3 = _pool(x, 'avg')
+        b3 = ConvUnit(256, (1, 1), dtype=d, name='b3')(b3, train)
+        return jnp.concatenate([b0, b1a, b1b, b2a, b2b, b3], -1)
+
+
+class InceptionV4(linen.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        x = Stem(dtype=d, name='stem')(x, train)
+        for i in range(4):
+            x = InceptionA(dtype=d, name=f'mixed_a{i}')(x, train)
+        x = ReductionA(dtype=d, name='reduction_a')(x, train)
+        for i in range(7):
+            x = InceptionB(dtype=d, name=f'mixed_b{i}')(x, train)
+        x = ReductionB(dtype=d, name='reduction_b')(x, train)
+        for i in range(3):
+            x = InceptionC(dtype=d, name=f'mixed_c{i}')(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = knn.Dense(self.num_classes, kernel_init=_kaiming, dtype=d,
+                      name='fc')(x)
+        return x
+
+
+def inception_v4(num_classes=1000, **kw):
+    return InceptionV4(num_classes=num_classes, **kw)

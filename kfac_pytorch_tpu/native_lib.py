@@ -1,0 +1,98 @@
+"""ctypes bindings for the native runtime library (native/kfac_native.cc).
+
+Builds lazily with cc if the shared object is missing (no pybind11 in
+this image; plain C linkage + ctypes). Every entry point has a numpy
+fallback in pure Python — the native path is an acceleration, not a
+requirement (mirrors how the reference keeps tcmm optional,
+kfac/utils.py:7).
+"""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), '..', 'native')
+_LIB_PATH = os.path.join(_DIR, 'libkfac_native.so')
+_lib = None
+_tried = False
+
+
+def _build():
+    src = os.path.join(_DIR, 'kfac_native.cc')
+    subprocess.run(['c++', '-O2', '-shared', '-fPIC', '-o', _LIB_PATH, src],
+                   check=True, capture_output=True)
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_LIB_PATH):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.block_partition.restype = ctypes.c_double
+        lib.block_partition.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.lpt_assign.restype = ctypes.c_double
+        lib.lpt_assign.argtypes = lib.block_partition.argtypes
+        lib.augment_crop_flip.restype = None
+        lib.augment_crop_flip.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_float)]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def block_partition(costs, num_devices):
+    lib = get_lib()
+    costs = np.ascontiguousarray(costs, np.float64)
+    owners = np.zeros(len(costs), np.int64)
+    if lib is None:
+        from kfac_pytorch_tpu.parallel import partition
+        return partition.block_partition(costs, num_devices)
+    lib.block_partition(_ptr(costs, ctypes.c_double), len(costs),
+                        num_devices, _ptr(owners, ctypes.c_int64))
+    return owners
+
+
+def lpt_assign(costs, num_devices):
+    lib = get_lib()
+    costs = np.ascontiguousarray(costs, np.float64)
+    owners = np.zeros(len(costs), np.int64)
+    if lib is None:
+        from kfac_pytorch_tpu.parallel import partition
+        return partition.balanced_assign(costs, num_devices)
+    lib.lpt_assign(_ptr(costs, ctypes.c_double), len(costs), num_devices,
+                   _ptr(owners, ctypes.c_int64))
+    return owners
+
+
+def augment_crop_flip(x, offs, flips, pad=4):
+    """Native batched pad-crop-flip; x: [N,H,W,C] float32."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, np.float32)
+    offs = np.ascontiguousarray(offs, np.int32)
+    flips = np.ascontiguousarray(flips, np.uint8)
+    out = np.empty_like(x)
+    n, h, w, c = x.shape
+    lib.augment_crop_flip(_ptr(x, ctypes.c_float), n, h, w, c, pad,
+                          _ptr(offs, ctypes.c_int32),
+                          _ptr(flips, ctypes.c_uint8),
+                          _ptr(out, ctypes.c_float))
+    return out

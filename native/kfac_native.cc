@@ -1,0 +1,120 @@
+// kfac_native: host-side native runtime components.
+//
+// The reference's native layer (packages/tcmm: cuSOLVER eig, cuBLAS GEMM,
+// NCCL+MPI communicator) maps almost entirely onto on-chip XLA ops and
+// ICI collectives in this framework (see SURVEY.md §2.2). What remains
+// host-side — and is worth native code — is:
+//
+//  1. the factor-work scheduler: optimal contiguous bottleneck partition
+//     (dynamic programming, O(P·N²); reference research code:
+//     scripts/dp_block_partition.py:11-76) and LPT greedy assignment,
+//     called at plan-build time for large layer counts;
+//  2. the input-pipeline augmentation kernel: batched pad-4 random crop +
+//     horizontal flip (the reference's torchvision transform stack,
+//     examples/pytorch_cifar10_resnet.py:157-163), which in Python costs a
+//     per-image interpreter loop on the host data path.
+//
+// Exposed with plain C linkage for ctypes (no pybind11 in this image).
+//
+// Build: cc -O2 -shared -fPIC -o libkfac_native.so kfac_native.cc
+// (or the CMakeLists.txt alongside).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+extern "C" {
+
+// Optimal contiguous bottleneck partition of `costs[0..n)` into `p`
+// blocks; writes block owner per item into `owners`. Returns the
+// bottleneck cost.
+double block_partition(const double* costs, int64_t n, int64_t p,
+                       int64_t* owners) {
+  if (n == 0) return 0.0;
+  int64_t k = std::min<int64_t>(p, n);
+  std::vector<double> prefix(n + 1, 0.0);
+  for (int64_t i = 0; i < n; ++i) prefix[i + 1] = prefix[i] + costs[i];
+  const double inf = std::numeric_limits<double>::infinity();
+  std::vector<double> dp((k + 1) * (n + 1), inf);
+  std::vector<int64_t> cut((k + 1) * (n + 1), 0);
+  dp[0] = 0.0;
+  for (int64_t b = 1; b <= k; ++b) {
+    for (int64_t i = 1; i <= n; ++i) {
+      for (int64_t j = b - 1; j < i; ++j) {
+        double cand = std::max(dp[(b - 1) * (n + 1) + j],
+                               prefix[i] - prefix[j]);
+        if (cand < dp[b * (n + 1) + i]) {
+          dp[b * (n + 1) + i] = cand;
+          cut[b * (n + 1) + i] = j;
+        }
+      }
+    }
+  }
+  int64_t i = n;
+  for (int64_t b = k; b >= 1; --b) {
+    int64_t j = cut[b * (n + 1) + i];
+    for (int64_t t = j; t < i; ++t) owners[t] = b - 1;
+    i = j;
+  }
+  return dp[k * (n + 1) + n];
+}
+
+// Greedy longest-processing-time assignment (order-free balanced
+// scheduler). Writes owner per item; returns the makespan.
+double lpt_assign(const double* costs, int64_t n, int64_t p,
+                  int64_t* owners) {
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return costs[a] > costs[b]; });
+  std::vector<double> load(p, 0.0);
+  for (int64_t idx : order) {
+    int64_t best = 0;
+    for (int64_t d = 1; d < p; ++d)
+      if (load[d] < load[best]) best = d;
+    owners[idx] = best;
+    load[best] += costs[idx];
+  }
+  return *std::max_element(load.begin(), load.end());
+}
+
+// Batched pad-4 reflect crop + horizontal flip for [N, H, W, C] float32
+// images. offs: [N, 2] crop offsets in [0, 2*pad]; flips: [N] 0/1.
+void augment_crop_flip(const float* x, int64_t n, int64_t h, int64_t w,
+                       int64_t c, int64_t pad, const int32_t* offs,
+                       const uint8_t* flips, float* out) {
+  const int64_t hp = h + 2 * pad, wp = w + 2 * pad;
+  std::vector<float> padded(hp * wp * c);
+  for (int64_t i = 0; i < n; ++i) {
+    const float* img = x + i * h * w * c;
+    // reflect pad
+    for (int64_t y = 0; y < hp; ++y) {
+      int64_t sy = y - pad;
+      if (sy < 0) sy = -sy;
+      if (sy >= h) sy = 2 * h - 2 - sy;
+      for (int64_t xx = 0; xx < wp; ++xx) {
+        int64_t sx = xx - pad;
+        if (sx < 0) sx = -sx;
+        if (sx >= w) sx = 2 * w - 2 - sx;
+        std::memcpy(&padded[(y * wp + xx) * c], &img[(sy * w + sx) * c],
+                    c * sizeof(float));
+      }
+    }
+    const int64_t oy = offs[2 * i], ox = offs[2 * i + 1];
+    float* dst = out + i * h * w * c;
+    for (int64_t y = 0; y < h; ++y) {
+      const float* row = &padded[((y + oy) * wp + ox) * c];
+      if (flips[i]) {
+        for (int64_t xx = 0; xx < w; ++xx)
+          std::memcpy(&dst[(y * w + xx) * c], &row[(w - 1 - xx) * c],
+                      c * sizeof(float));
+      } else {
+        std::memcpy(&dst[y * w * c], row, w * c * sizeof(float));
+      }
+    }
+  }
+}
+
+}  // extern "C"

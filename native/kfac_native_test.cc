@@ -1,0 +1,52 @@
+// Standalone native-layer test (the analogue of the reference's
+// packages/tcmm/tests/main.cpp smoke binaries). Exits nonzero on failure.
+
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+extern "C" {
+double block_partition(const double*, int64_t, int64_t, int64_t*);
+double lpt_assign(const double*, int64_t, int64_t, int64_t*);
+void augment_crop_flip(const float*, int64_t, int64_t, int64_t, int64_t,
+                       int64_t, const int32_t*, const uint8_t*, float*);
+}
+
+int main() {
+  // block partition: [5,1,1,1,5] into 3 -> bottleneck 5
+  std::vector<double> costs = {5, 1, 1, 1, 5};
+  std::vector<int64_t> owners(5);
+  double b = block_partition(costs.data(), 5, 3, owners.data());
+  assert(b == 5.0);
+  assert(owners[0] == 0 && owners[4] == 2);
+
+  // LPT: [4,3,3,2] on 2 devices -> makespan 6
+  std::vector<double> c2 = {4, 3, 3, 2};
+  std::vector<int64_t> o2(4);
+  double m = lpt_assign(c2.data(), 4, 2, o2.data());
+  assert(m == 6.0);
+
+  // augmentation: zero offset+pad reproduces identity; flip reverses
+  const int64_t n = 1, h = 4, w = 4, cch = 2;
+  std::vector<float> img(h * w * cch);
+  for (size_t i = 0; i < img.size(); ++i) img[i] = float(i);
+  std::vector<int32_t> offs = {4, 4};  // center crop of pad-4 == identity
+  std::vector<uint8_t> flips = {0};
+  std::vector<float> out(img.size());
+  augment_crop_flip(img.data(), n, h, w, cch, 4, offs.data(), flips.data(),
+                    out.data());
+  for (size_t i = 0; i < img.size(); ++i) assert(out[i] == img[i]);
+  flips[0] = 1;
+  augment_crop_flip(img.data(), n, h, w, cch, 4, offs.data(), flips.data(),
+                    out.data());
+  for (int64_t y = 0; y < h; ++y)
+    for (int64_t x = 0; x < w; ++x)
+      for (int64_t ch = 0; ch < cch; ++ch)
+        assert(out[(y * w + x) * cch + ch] ==
+               img[(y * w + (w - 1 - x)) * cch + ch]);
+
+  std::printf("kfac_native_test: all checks passed\n");
+  return 0;
+}

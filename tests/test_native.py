@@ -1,0 +1,49 @@
+"""Native library parity: C++ schedulers/augmentation vs numpy."""
+
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import native_lib
+from kfac_pytorch_tpu.parallel import partition
+
+
+@pytest.mark.skipif(native_lib.get_lib() is None,
+                    reason='native build unavailable')
+def test_block_partition_matches_python():
+    rng = np.random.RandomState(0)
+    costs = rng.rand(40) * 10
+    for p in (1, 3, 8):
+        nat = native_lib.block_partition(costs, p)
+        py = partition.block_partition(costs, p)
+        # both optimal: bottleneck costs must match (owner arrays may
+        # differ between equally-optimal partitions)
+        def bot(owners):
+            return max(costs[owners == d].sum() for d in range(p)
+                       if (owners == d).any())
+        assert np.isclose(bot(nat), bot(py))
+
+
+@pytest.mark.skipif(native_lib.get_lib() is None,
+                    reason='native build unavailable')
+def test_lpt_matches_python():
+    rng = np.random.RandomState(1)
+    costs = rng.rand(30)
+    nat = native_lib.lpt_assign(costs, 4)
+    py = partition.balanced_assign(costs, 4)
+    np.testing.assert_array_equal(nat, py)
+
+
+@pytest.mark.skipif(native_lib.get_lib() is None,
+                    reason='native build unavailable')
+def test_augment_matches_numpy():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 8, 8, 3).astype(np.float32)
+    offs = rng.randint(0, 9, size=(3, 2)).astype(np.int32)
+    flips = np.array([0, 1, 0], np.uint8)
+    nat = native_lib.augment_crop_flip(x, offs, flips)
+    xp = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode='reflect')
+    for i in range(3):
+        oy, ox = offs[i]
+        win = xp[i, oy:oy + 8, ox:ox + 8]
+        want = win[:, ::-1] if flips[i] else win
+        np.testing.assert_allclose(nat[i], want)
