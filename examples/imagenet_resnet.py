@@ -1,0 +1,215 @@
+"""ImageNet ResNet-50 / InceptionV4 trainer with DP-KFAC — the flagship
+workload (BASELINE.md north-star: 55-epoch K-FAC schedule vs 90-epoch
+SGD).
+
+Flag-surface parity with the reference entrypoint
+(examples/pytorch_imagenet_resnet.py): checkpoint/auto-resume
+(:162-167, 305-312), label smoothing (:321), KFACParamScheduler wiring
+(:281-287), batches-per-allreduce gradient accumulation (:355-367),
+warmup + multi-step LR scaled by world size (:219-231). Reads an
+ImageFolder-style numpy cache from ``--train-dir`` if present, else
+deterministic synthetic ImageNet-shaped data.
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import data as kdata
+from kfac_pytorch_tpu import models, training, utils
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description='ImageNet K-FAC trainer (TPU)')
+    p.add_argument('--model', default='resnet50')
+    p.add_argument('--train-dir', default=None)
+    p.add_argument('--val-dir', default=None)
+    p.add_argument('--batch-size', type=int, default=32)
+    p.add_argument('--val-batch-size', type=int, default=32)
+    p.add_argument('--batches-per-allreduce', type=int, default=1)
+    p.add_argument('--epochs', type=int, default=55)
+    p.add_argument('--base-lr', type=float, default=0.0125)
+    p.add_argument('--lr-decay', nargs='+', type=int,
+                   default=[25, 35, 40, 45, 50])
+    p.add_argument('--warmup-epochs', type=int, default=5)
+    p.add_argument('--wd', type=float, default=5e-5)
+    p.add_argument('--label-smoothing', type=float, default=0.1)
+    p.add_argument('--img-size', type=int, default=224)
+    # K-FAC (reference defaults: train_imagenet.sh)
+    p.add_argument('--kfac-update-freq', type=int, default=1)
+    p.add_argument('--kfac-cov-update-freq', type=int, default=1)
+    p.add_argument('--kfac-name', default='eigen_dp')
+    p.add_argument('--stat-decay', type=float, default=0.95)
+    p.add_argument('--damping', type=float, default=0.002)
+    p.add_argument('--kl-clip', type=float, default=0.001)
+    p.add_argument('--damping-alpha', type=float, default=0.5)
+    p.add_argument('--damping-decay', nargs='+', type=int, default=None)
+    p.add_argument('--kfac-update-freq-alpha', type=float, default=10)
+    p.add_argument('--kfac-update-freq-decay', nargs='+', type=int,
+                   default=None)
+    p.add_argument('--exclude-parts', default='')
+    p.add_argument('--assignment', default='balanced')
+    p.add_argument('--num-devices', type=int, default=1)
+    p.add_argument('--seed', type=int, default=42)
+    p.add_argument('--speed', action='store_true')
+    p.add_argument('--bf16', action='store_true', default=True)
+    p.add_argument('--log-dir', default='./logs')
+    p.add_argument('--checkpoint-format', default='./checkpoints')
+    p.add_argument('--synthetic-size', type=int, default=1024)
+    return p.parse_args()
+
+
+def get_data(args):
+    if args.train_dir and os.path.exists(
+            os.path.join(args.train_dir, 'images.npy')):
+        x = np.load(os.path.join(args.train_dir, 'images.npy'),
+                    mmap_mode='r')
+        y = np.load(os.path.join(args.train_dir, 'labels.npy'))
+        return (x, y), (x[:1024], y[:1024])
+    shape = (args.img_size, args.img_size, 3)
+    train = kdata.synthetic_classification(args.synthetic_size, shape, 1000,
+                                           seed=1)
+    val = kdata.synthetic_classification(256, shape, 1000, seed=2)
+    return train, val
+
+
+def main():
+    args = parse_args()
+    os.makedirs(args.log_dir, exist_ok=True)
+    logging.basicConfig(
+        level=logging.INFO, format='%(asctime)s %(message)s', force=True,
+        handlers=[logging.StreamHandler(),
+                  logging.FileHandler(os.path.join(
+                      args.log_dir,
+                      f'imagenet_{args.model}_{args.kfac_name}_'
+                      f'nd{args.num_devices}.log'))])
+    log = logging.getLogger()
+    log.info('args: %s', vars(args))
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = models.get_model(args.model, num_classes=1000, dtype=dtype)
+    (train_x, train_y), (val_x, val_y) = get_data(args)
+    train_loader = kdata.Loader(train_x, train_y, args.batch_size,
+                                train=True, seed=args.seed)
+    val_loader = kdata.Loader(val_x, val_y, args.val_batch_size, train=False)
+
+    steps_per_epoch = train_loader.steps_per_epoch
+    scale = max(1, args.num_devices * args.batches_per_allreduce)
+    lr_fn = utils.warmup_multistep(args.base_lr, steps_per_epoch,
+                                   args.warmup_epochs, args.lr_decay,
+                                   scale=scale)
+    tx = training.sgd(lr_fn, momentum=0.9, weight_decay=args.wd)
+    if args.batches_per_allreduce > 1:
+        tx = optax.MultiSteps(tx, args.batches_per_allreduce)
+
+    use_kfac = args.kfac_update_freq > 0
+    precond = None
+    scheduler = None
+    if use_kfac:
+        precond = kfac.get_kfac_module(args.kfac_name)(
+            lr=args.base_lr, damping=args.damping,
+            fac_update_freq=args.kfac_cov_update_freq,
+            kfac_update_freq=args.kfac_update_freq,
+            kl_clip=args.kl_clip, factor_decay=args.stat_decay,
+            exclude_parts=args.exclude_parts,
+            num_devices=args.num_devices,
+            axis_name='batch' if args.num_devices > 1 else None,
+            assignment=args.assignment)
+
+    mesh, axis = None, None
+    if args.num_devices > 1:
+        mesh = Mesh(np.array(jax.devices()[:args.num_devices]), ('batch',))
+        axis = 'batch'
+
+    def loss_fn(outputs, batch):
+        return utils.label_smoothing_cross_entropy(
+            outputs, batch['label'], smoothing=args.label_smoothing)
+
+    sample = jnp.zeros((args.batch_size, args.img_size, args.img_size, 3),
+                       dtype)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(args.seed), sample)
+    if use_kfac:
+        scheduler = kfac.KFACParamScheduler(
+            precond, damping_alpha=args.damping_alpha,
+            damping_schedule=args.damping_decay,
+            update_freq_alpha=args.kfac_update_freq_alpha,
+            update_freq_schedule=args.kfac_update_freq_decay)
+
+    # auto-resume (reference: pytorch_imagenet_resnet.py:162-167,305-312)
+    start_epoch = 0
+    resume = utils.find_resume_epoch(args.checkpoint_format, args.epochs)
+    if resume is not None:
+        state = utils.restore_checkpoint(args.checkpoint_format, resume,
+                                         state)
+        start_epoch = resume + 1
+        if scheduler is not None:
+            scheduler.step(start_epoch)
+        log.info('resumed from checkpoint-%d', resume)
+
+    step = training.build_train_step(model, tx, precond, loss_fn,
+                                     axis_name=axis, mesh=mesh,
+                                     extra_mutable=('batch_stats',))
+
+    @jax.jit
+    def eval_step(params, extra_vars, batch):
+        out = model.apply({'params': params, **extra_vars},
+                          batch['input'].astype(dtype), train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            out.astype(jnp.float32), batch['label']).mean()
+        return loss, utils.accuracy(out, batch['label'])
+
+    if args.speed:
+        batch = next(train_loader.epoch())
+        batch = {'input': jnp.asarray(batch['input'], dtype),
+                 'label': jnp.asarray(batch['label'])}
+        times = []
+        for i in range(65):
+            t0 = time.perf_counter()
+            state, m = step(state, batch, lr=lr_fn(i),
+                            damping=precond.damping if precond else 0.0)
+            jax.block_until_ready(m['loss'])
+            if i >= 5:
+                times.append(time.perf_counter() - t0)
+        log.info('SPEED: iter %.4f +- %.4f s (%.1f imgs/s)',
+                 np.mean(times), np.std(times),
+                 args.batch_size / np.mean(times))
+        return
+
+    for epoch in range(start_epoch, args.epochs):
+        t0 = time.time()
+        tm = utils.Metric('train_loss')
+        for batch in train_loader.epoch():
+            b = {'input': jnp.asarray(batch['input'], dtype),
+                 'label': jnp.asarray(batch['label'])}
+            s = int(state.step)
+            state, m = step(state, b, lr=lr_fn(s),
+                            damping=precond.damping if precond else 0.0)
+            tm.update(m['loss'])
+        vl, va = utils.Metric('vl'), utils.Metric('va')
+        for batch in val_loader.epoch():
+            b = {'input': jnp.asarray(batch['input']),
+                 'label': jnp.asarray(batch['label'])}
+            l, a = eval_step(state.params, state.extra_vars, b)
+            vl.update(l)
+            va.update(a)
+        log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
+                 '(%.1fs)', epoch, tm.avg, vl.avg, va.avg, time.time() - t0)
+        if scheduler is not None:
+            scheduler.step(epoch + 1)
+        utils.save_checkpoint(args.checkpoint_format, epoch, state)
+
+
+if __name__ == '__main__':
+    main()
