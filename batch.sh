@@ -1,0 +1,24 @@
+#!/bin/bash
+# Experiment batcher — the reference's convergence/efficiency preset runner
+# (batch.sh:26-32), one line per workload at its published configuration.
+# Usage: bash batch.sh [efficiency|convergence]
+
+mode="${1:-efficiency}"
+cd "$(dirname "$0")"
+
+if [ "$mode" = "efficiency" ]; then
+  # speed presets (reference batch.sh:26-32)
+  dnn=resnet110 batch_size=128 nworkers=4 bash train_cifar10.sh --speed
+  dnn=vgg16 batch_size=128 nworkers=4 bash train_cifar100.sh --speed
+  dnn=resnet50 batch_size=32 nworkers=8 bash train_imagenet.sh --speed
+  dnn=inceptionv4 batch_size=16 nworkers=8 bash train_imagenet.sh --speed
+  batch_size=128 nworkers=8 bash train_multi30k.sh --speed
+  batch_size=4 nworkers=8 bash train_squad.sh
+else
+  # convergence presets
+  dnn=resnet110 bash train_cifar10.sh
+  dnn=vgg16 bash train_cifar100.sh
+  dnn=resnet50 bash train_imagenet.sh
+  bash train_multi30k.sh
+  bash train_squad.sh
+fi

@@ -78,6 +78,8 @@ def parse_args():
 
 
 def main():
+    from kfac_pytorch_tpu.parallel import mesh as kmesh
+    kmesh.maybe_initialize_distributed()
     args = parse_args()
     num_classes = 10 if args.dataset == 'cifar10' else 100
     use_kfac = args.kfac_update_freq > 0

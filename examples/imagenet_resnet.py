@@ -85,6 +85,8 @@ def get_data(args):
 
 
 def main():
+    from kfac_pytorch_tpu.parallel import mesh as kmesh
+    kmesh.maybe_initialize_distributed()
     args = parse_args()
     os.makedirs(args.log_dir, exist_ok=True)
     logging.basicConfig(

@@ -115,6 +115,8 @@ def synthetic_translation(n, vocab, max_len, seed=0):
 
 
 def main():
+    from kfac_pytorch_tpu.parallel import mesh as kmesh
+    kmesh.maybe_initialize_distributed()
     args = parse_args()
     logging.basicConfig(level=logging.INFO, format='%(asctime)s %(message)s',
                         force=True)

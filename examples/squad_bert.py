@@ -102,6 +102,8 @@ def squad_f1_em(pred_spans, gold_spans, token_seqs):
 
 
 def main():
+    from kfac_pytorch_tpu.parallel import mesh as kmesh
+    kmesh.maybe_initialize_distributed()
     args = parse_args()
     logging.basicConfig(level=logging.INFO, format='%(asctime)s %(message)s',
                         force=True)

@@ -6,9 +6,26 @@ jax.sharding.Mesh over all devices (multi-host via jax.distributed); data
 parallelism is a mesh axis, not a process abstraction.
 """
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def maybe_initialize_distributed():
+    """Initialize jax.distributed for multi-host pods when the launcher
+    exported the coordination env (launch_tpu.sh) — the process-boundary
+    replacement for mpirun/hostfiles (reference: launch_horovod.sh:32).
+    No-op on single host."""
+    addr = os.environ.get('JAX_COORDINATOR_ADDRESS')
+    if not addr or not os.environ.get('KFAC_TPU_MULTIHOST'):
+        return False
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ['JAX_NUM_PROCESSES']),
+        process_id=int(os.environ['JAX_PROCESS_ID']))
+    return True
 
 
 def make_mesh(num_devices=None, axis_name='batch', devices=None):

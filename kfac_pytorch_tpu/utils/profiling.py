@@ -1,0 +1,77 @@
+"""Tracing and phase attribution.
+
+The reference's tracing story is manual wall-clock phase timers
+(IO/FW+BW/COMM/KFAC/UPDATE, examples/pytorch_cifar10_resnet.py:289-339)
+plus the --exclude-parts subtraction method (kfac_preconditioner_base.py:
+96-99, consumed by scripts/parse_logs.py:44-73). Under jit the phases fuse
+into one program, so the TPU equivalents are:
+
+- :func:`trace` — a jax.profiler context writing an XLA trace (Perfetto /
+  TensorBoard viewable) for true on-chip phase timing;
+- :func:`exclude_parts_breakdown` — the subtraction method automated:
+  time the jitted step once per ablation flag set and difference the
+  means (this is the reference's attribution method, and it works under
+  fusion because each ablation compiles to a smaller program).
+"""
+
+import contextlib
+import time
+
+import jax
+import numpy as np
+
+PHASES = ('ComputeFactor', 'CommunicateFactor', 'ComputeInverse',
+          'CommunicateInverse')
+
+
+@contextlib.contextmanager
+def trace(log_dir):
+    """jax.profiler trace context — the on-chip replacement for the manual
+    phase timers."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def time_steps(step_fn, state, batch, iters=30, warmup=5, **kw):
+    """Mean/std steady-state iteration time (the SPEED-mode measurement,
+    reference :333-344)."""
+    for _ in range(warmup):
+        state, m = step_fn(state, batch, **kw)
+    jax.block_until_ready(m)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state, m = step_fn(state, batch, **kw)
+        jax.block_until_ready(m)
+        times.append(time.perf_counter() - t0)
+    return float(np.mean(times)), float(np.std(times)), state
+
+
+def exclude_parts_breakdown(make_step, state_factory, batch, iters=20,
+                            **kw):
+    """Attribute per-phase cost by ablation subtraction.
+
+    ``make_step(exclude_parts) -> step_fn`` builds a step with the given
+    phases excluded; ``state_factory()`` returns a fresh train state.
+    Returns ``{phase: seconds}`` with 'Total' and the subtraction-derived
+    per-phase costs (cumulative ablation, reference parse_logs.py:44-73).
+    """
+    results = {}
+    excluded = []
+    prev = None
+    t_full, _, _ = time_steps(make_step(''), state_factory(), batch,
+                              iters=iters, **kw)
+    results['Total'] = t_full
+    prev = t_full
+    for phase in ('CommunicateInverse', 'ComputeInverse',
+                  'CommunicateFactor', 'ComputeFactor'):
+        excluded.append(phase)
+        t, _, _ = time_steps(make_step(','.join(excluded)), state_factory(),
+                             batch, iters=iters, **kw)
+        results[phase] = max(prev - t, 0.0)
+        prev = t
+    results['Rest'] = prev
+    return results

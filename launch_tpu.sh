@@ -1,0 +1,30 @@
+#!/bin/bash
+# TPU launcher — replaces the reference's mpirun/hostfile and ssh/torchrun
+# launchers (launch_horovod.sh:32, launch_torch.sh:26-45).
+#
+# On TPU there is ONE python process per host; intra-host chips are just
+# devices in the jax mesh, and multi-host pods coordinate through
+# jax.distributed.initialize (driven by TPU runtime env vars — no ssh
+# loops, no hostfiles). Single host:
+#
+#   bash launch_tpu.sh examples/cifar10_resnet.py --num-devices 8 ...
+#
+# Multi-host (run the same command on every worker of the pod slice, e.g.
+# via `gcloud compute tpus tpu-vm ssh --worker=all --command=...`):
+#
+#   JAX_COORDINATOR_ADDRESS=<worker0-ip>:8476 \
+#   JAX_NUM_PROCESSES=<n_hosts> JAX_PROCESS_ID=<this host> \
+#   bash launch_tpu.sh examples/imagenet_resnet.py ...
+#
+# kfac_pytorch_tpu initializes jax.distributed automatically when these
+# variables are present (see kfac_pytorch_tpu/parallel/mesh.py).
+
+set -e
+cd "$(dirname "$0")"
+script="$1"; shift
+
+if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
+  export KFAC_TPU_MULTIHOST=1
+fi
+
+exec python "$script" "$@"

@@ -1,0 +1,27 @@
+#!/bin/bash
+# CIFAR-10 driver — env-var-parameterized defaults, same knob surface as
+# the reference (train_cifar10.sh:4-27). kfac=0 => pure SGD baseline.
+
+dnn="${dnn:-resnet32}"
+batch_size="${batch_size:-128}"
+base_lr="${base_lr:-0.1}"
+epochs="${epochs:-100}"
+kfac="${kfac:-1}"                 # kfac_update_freq (0 disables)
+fac="${fac:-1}"                   # fac (cov) update freq
+kfac_name="${kfac_name:-eigen_dp}"
+stat_decay="${stat_decay:-0.95}"
+damping="${damping:-0.03}"
+kl_clip="${kl_clip:-0.001}"
+exclude_parts="${exclude_parts:-}"
+lr_decay="${lr_decay:-35 75 90}"
+nworkers="${nworkers:-1}"         # devices in the mesh
+data_dir="${data_dir:-}"
+
+params="--model $dnn --batch-size $batch_size --base-lr $base_lr \
+  --epochs $epochs --kfac-update-freq $kfac --kfac-cov-update-freq $fac \
+  --kfac-name $kfac_name --stat-decay $stat_decay --damping $damping \
+  --kl-clip $kl_clip --lr-decay $lr_decay --num-devices $nworkers"
+[ -n "$exclude_parts" ] && params="$params --exclude-parts $exclude_parts"
+[ -n "$data_dir" ] && params="$params --dir $data_dir"
+
+bash "$(dirname "$0")/launch_tpu.sh" examples/cifar10_resnet.py $params "$@"

@@ -1,0 +1,20 @@
+#!/bin/bash
+# CIFAR-100 driver (reference parity: train_cifar100.sh — VGG-16 default).
+
+dnn="${dnn:-vgg16}"
+batch_size="${batch_size:-128}"
+base_lr="${base_lr:-0.1}"
+epochs="${epochs:-100}"
+kfac="${kfac:-1}"
+fac="${fac:-1}"
+kfac_name="${kfac_name:-eigen_dp}"
+damping="${damping:-0.03}"
+nworkers="${nworkers:-1}"
+
+params="--dataset cifar100 --model $dnn --batch-size $batch_size \
+  --base-lr $base_lr --epochs $epochs --kfac-update-freq $kfac \
+  --kfac-cov-update-freq $fac --kfac-name $kfac_name --damping $damping \
+  --num-devices $nworkers"
+[ -n "$data_dir" ] && params="$params --dir $data_dir"
+
+bash "$(dirname "$0")/launch_tpu.sh" examples/cifar10_resnet.py $params "$@"

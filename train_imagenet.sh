@@ -1,0 +1,29 @@
+#!/bin/bash
+# ImageNet driver — reference parity (train_imagenet.sh:4-27): 55-epoch
+# K-FAC schedule replacing the 90-epoch SGD schedule.
+
+dnn="${dnn:-resnet50}"
+batch_size="${batch_size:-32}"
+base_lr="${base_lr:-0.0125}"
+epochs="${epochs:-55}"
+if [ "$epochs" = "90" ]; then
+  lr_decay="${lr_decay:-30 60 80}"
+else
+  lr_decay="${lr_decay:-25 35 40 45 50}"
+fi
+kfac="${kfac:-1}"
+fac="${fac:-1}"
+kfac_name="${kfac_name:-eigen_dp}"
+stat_decay="${stat_decay:-0.95}"
+damping="${damping:-0.002}"
+exclude_parts="${exclude_parts:-}"
+nworkers="${nworkers:-1}"
+
+params="--model $dnn --batch-size $batch_size --base-lr $base_lr \
+  --epochs $epochs --lr-decay $lr_decay --kfac-update-freq $kfac \
+  --kfac-cov-update-freq $fac --kfac-name $kfac_name \
+  --stat-decay $stat_decay --damping $damping --num-devices $nworkers"
+[ -n "$exclude_parts" ] && params="$params --exclude-parts $exclude_parts"
+[ -n "$train_dir" ] && params="$params --train-dir $train_dir"
+
+bash "$(dirname "$0")/launch_tpu.sh" examples/imagenet_resnet.py $params "$@"

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Multi-30k Transformer driver (reference parity: train_multi30k.sh).
+
+batch_size="${batch_size:-128}"
+epochs="${epochs:-100}"
+optimizer="${optimizer:-sgd}"
+base_lr="${base_lr:-0.1}"
+kfac="${kfac:-1}"
+fac="${fac:-1}"
+kfac_name="${kfac_name:-eigen_dp}"
+damping="${damping:-0.003}"
+nworkers="${nworkers:-1}"
+
+params="--batch-size $batch_size --epochs $epochs --optimizer $optimizer \
+  --base-lr $base_lr --kfac-update-freq $kfac --kfac-cov-update-freq $fac \
+  --kfac-name $kfac_name --damping $damping --num-devices $nworkers"
+[ -n "$data_dir" ] && params="$params --dir $data_dir"
+
+bash "$(dirname "$0")/launch_tpu.sh" examples/multi30k_transformer.py \
+  $params "$@"

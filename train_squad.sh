@@ -1,0 +1,20 @@
+#!/bin/bash
+# SQuAD BERT driver (reference parity: train_squad.sh).
+
+model_size="${model_size:-base}"
+batch_size="${batch_size:-4}"
+epochs="${epochs:-2}"
+base_lr="${base_lr:-0.04}"
+kfac="${kfac:-1}"
+fac="${fac:-1}"
+kfac_name="${kfac_name:-eigen_dp}"
+damping="${damping:-0.003}"
+nworkers="${nworkers:-1}"
+
+params="--model-size $model_size --batch-size $batch_size \
+  --epochs $epochs --base-lr $base_lr --kfac-update-freq $kfac \
+  --kfac-cov-update-freq $fac --kfac-name $kfac_name --damping $damping \
+  --num-devices $nworkers"
+[ -n "$train_file" ] && params="$params --train-file $train_file"
+
+bash "$(dirname "$0")/launch_tpu.sh" examples/squad_bert.py $params "$@"
