@@ -1,0 +1,29 @@
+#!/bin/bash
+# Hyper-parameter grid batcher — damping x kfac-update-freq sweep, the
+# reference's hyper-search driver (batch-hyper.sh:1-27: damping x freq grid
+# fanned out across nodes). On TPU the sweep runs sequentially per host (or
+# fan it out across pod slices by exporting a different grid slice per
+# invocation via GRID_OFFSET/GRID_STRIDE).
+#
+# Usage: [dnn=resnet110] [nworkers=4] bash batch-hyper.sh
+
+dnn="${dnn:-resnet110}"
+nworkers="${nworkers:-1}"
+epochs="${epochs:-60}"
+dampings="${dampings:-0.03 0.01 0.003 0.001}"
+freqs="${freqs:-1 5 10 50}"
+offset="${GRID_OFFSET:-0}"
+stride="${GRID_STRIDE:-1}"
+
+cd "$(dirname "$0")"
+i=0
+for damping in $dampings; do
+  for kfac in $freqs; do
+    if [ $(( i % stride )) -eq "$offset" ]; then
+      echo "=== grid[$i]: damping=$damping kfac_update_freq=$kfac ==="
+      dnn="$dnn" nworkers="$nworkers" epochs="$epochs" \
+        damping="$damping" kfac="$kfac" bash train_cifar10.sh "$@"
+    fi
+    i=$(( i + 1 ))
+  done
+done

@@ -23,8 +23,27 @@ set -e
 cd "$(dirname "$0")"
 script="$1"; shift
 
+# env defaults + optional mesh preset (pod=N -> configs/podN), the
+# reference's `source configs/envs.conf` + hostfile selection
+# (launch_horovod.sh:7,32).
+[ -f configs/envs.conf ] && . configs/envs.conf
+if [ -n "$pod" ]; then
+  if [ -f "configs/pod$pod" ]; then
+    set -a                 # export everything the preset defines
+    . "configs/pod$pod"
+    set +a
+    # append so the preset wins over any earlier --num-devices default
+    # from the train_*.sh param string (argparse last-occurrence-wins)
+    set -- "$@" --num-devices "$KFAC_NUM_DEVICES"
+  else
+    echo "launch_tpu.sh: no such mesh preset configs/pod$pod" >&2
+    exit 1
+  fi
+fi
+export JAX_COMPILATION_CACHE_DIR XLA_PYTHON_CLIENT_PREALLOCATE
+
 if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi
 
-exec python "$script" "$@"
+exec "${PY:-python}" "$script" "$@"

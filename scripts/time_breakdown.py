@@ -1,0 +1,126 @@
+"""Per-phase K-FAC step time breakdown via the exclude-parts subtraction method.
+
+Capability parity with the reference's breakdown analysis
+(reference: scripts/time_breakdown.py:1-83 — stacked phase times for SGD vs
+K-FAC; fed by --exclude-parts ablation runs, kfac_preconditioner_base.py:96-99).
+
+On TPU the step is one fused XLA program, so phases cannot be wall-clocked
+inside it; this script measures them the way the reference's method does —
+by differencing ablated variants (each `exclude_parts` setting compiles a
+program *without* that phase):
+
+  FactorComp   = t(full) - t(exclude ComputeFactor... everything downstream)
+  InverseComp  = ...
+
+Run it directly; it builds the CIFAR ResNet flagship config and prints the
+stacked breakdown. Use --model/--batch for other shapes.
+
+Usage: python scripts/time_breakdown.py [--model resnet32] [--batch 128]
+       [--variant eigen_dp] [--num-devices 1]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from scripts.utils import build_vision_model, force_platform
+force_platform()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import training
+
+# Cumulative ablations, innermost phase first: each setting removes one
+# more pipeline stage (reference exclude_parts grammar,
+# kfac_preconditioner_base.py:96-99).
+LADDER = [
+    ('full', ''),
+    ('-CommunicateInverse', 'CommunicateInverse'),
+    ('-ComputeInverse', 'CommunicateInverse,ComputeInverse'),
+    ('-CommunicateFactor',
+     'CommunicateInverse,ComputeInverse,CommunicateFactor'),
+    ('-ComputeFactor',
+     'CommunicateInverse,ComputeInverse,CommunicateFactor,ComputeFactor'),
+]
+
+
+def _time_step(step, state, batch, iters, **kw):
+    for _ in range(3):
+        state, m = step(state, batch, **kw)
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch, **kw)
+    jax.block_until_ready(m)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--model', default='resnet32')
+    ap.add_argument('--batch', type=int, default=128)
+    ap.add_argument('--variant', default='eigen_dp')
+    ap.add_argument('--num-devices', type=int, default=1)
+    ap.add_argument('--iters', type=int, default=10)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    model, img, ncls = build_vision_model(args.model)
+    batch = {'input': jnp.asarray(rng.randn(args.batch, img, img, 3),
+                                  jnp.float32),
+             'label': jnp.asarray(rng.randint(0, ncls, args.batch))}
+    tx = training.sgd(0.1, momentum=0.9, weight_decay=5e-4)
+
+    def ce(outputs, b):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, b['label']).mean()
+
+    times = {}
+    for label, excl in LADDER:
+        precond = kfac.KFAC(variant=args.variant, lr=0.1, damping=0.003,
+                            fac_update_freq=1, kfac_update_freq=1,
+                            num_devices=args.num_devices, axis_name=None,
+                            exclude_parts=excl)
+        state = training.init_train_state(model, tx, precond,
+                                          jax.random.PRNGKey(0),
+                                          batch['input'])
+        step = training.build_train_step(model, tx, precond, ce,
+                                         extra_mutable=('batch_stats',))
+        times[label] = _time_step(step, state, batch, args.iters,
+                                  lr=0.1, damping=0.003)
+
+    # SGD reference (no preconditioner at all)
+    state = training.init_train_state(model, tx, None, jax.random.PRNGKey(0),
+                                      batch['input'])
+    sgd = training.build_train_step(model, tx, None, ce,
+                                    extra_mutable=('batch_stats',))
+    times['sgd'] = _time_step(sgd, state, batch, args.iters)
+
+    ladder = [times[label] for label, _ in LADDER]
+    phases = {
+        'FF&BP+update (sgd)': times['sgd'],
+        'capture+glue': max(ladder[4] - times['sgd'], 0.0),
+        'ComputeFactor': max(ladder[3] - ladder[4], 0.0),
+        'CommunicateFactor': max(ladder[2] - ladder[3], 0.0),
+        'ComputeInverse': max(ladder[1] - ladder[2], 0.0),
+        'CommunicateInverse': max(ladder[0] - ladder[1], 0.0),
+    }
+    total = times['full']
+    print(f'\n{args.model} bs{args.batch} {args.variant} '
+          f'nd{args.num_devices} — iter {total * 1e3:.2f} ms '
+          f'(SGD {times["sgd"] * 1e3:.2f} ms, '
+          f'overhead {total / times["sgd"]:.2f}x)')
+    for name, t in phases.items():
+        bar = '#' * int(60 * t / total)
+        print(f'  {name:<20} {t * 1e3:>8.2f} ms  {bar}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,74 @@
+"""Shared helpers for the research/benchmark scripts.
+
+Capability parity with the reference's script helpers
+(reference: scripts/utils.py:1-112 — shared log-parsing/plot utilities for
+the offline analysis scripts). Here: platform forcing (the virtual-CPU-mesh
+escape hatch), timing, and linear cost-model fitting.
+"""
+
+import os
+import time
+
+
+def force_platform():
+    """Honor KFAC_PLATFORM / KFAC_HOST_DEVICES before any JAX client exists.
+
+    The driver environment pins ``JAX_PLATFORMS`` at interpreter start, so
+    scripts offer their own escape hatch to run distributed probes on a
+    virtual CPU mesh::
+
+        KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=8 python scripts/test_collectives.py
+
+    Must be called before any ``jax.devices()`` / computation.
+    """
+    plat = os.environ.get('KFAC_PLATFORM')
+    if not plat:
+        return
+    nd = int(os.environ.get('KFAC_HOST_DEVICES', '8'))
+    os.environ['XLA_FLAGS'] = (
+        os.environ.get('XLA_FLAGS', '')
+        + f' --xla_force_host_platform_device_count={nd}')
+    import jax
+    jax.config.update('jax_platforms', plat)
+
+
+# --model flag values (models/__init__.py registry) that are ImageNet-scale;
+# everything else in the zoo is CIFAR-scale (32x32, 10/100 classes).
+IMAGENET_MODELS = frozenset({
+    'resnet18', 'resnet34', 'resnet50', 'resnet101', 'resnet152',
+    'resnext50', 'resnext101', 'inceptionv4', 'inception-v4'})
+
+
+def build_vision_model(name, img=None, num_classes=None):
+    """Resolve a ``--model`` flag to (model, img_size, num_classes) through
+    the zoo registry (same name surface as the example entrypoints)."""
+    from kfac_pytorch_tpu import models
+    if name in IMAGENET_MODELS:
+        img = img or (299 if 'inception' in name else 224)
+        num_classes = num_classes or 1000
+    else:
+        img = img or 32
+        num_classes = num_classes or 10
+    return models.get_model(name, num_classes=num_classes), img, num_classes
+
+
+def timeit(fn, *args, warmup=2, iters=10):
+    """Mean wall-clock seconds per call, synchronized on device output."""
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def fit_linear(xs, ys):
+    """Least-squares fit of ``y = alpha + beta * x`` (the alpha-beta
+    latency/bandwidth model, reference scripts/comm_models.py:8-19)."""
+    import numpy as np
+    X = np.stack([np.ones(len(xs)), np.asarray(xs, float)], axis=1)
+    (alpha, beta), *_ = np.linalg.lstsq(X, np.asarray(ys), rcond=None)
+    return float(alpha), float(beta)
