@@ -1,0 +1,203 @@
+"""Long-context causal-LM trainer: sequence-parallel ring attention + K-FAC.
+
+Capability beyond the reference (SURVEY.md §5.7 — the reference has no
+context/sequence parallelism and tops out at 384 tokens): trains
+``models.TransformerLM`` with the *sequence* axis sharded over a mesh axis
+(ring attention or Ulysses all-to-all, ``parallel/ring_attention.py``) and
+an optional data axis — a ('data', 'seq') 2-D mesh. DP-KFAC factor
+statistics stay owner-local per shard exactly as in the reference's DP
+variants (kfac_preconditioner_inv_dp.py:75-90).
+
+Dataset: a plain-text corpus via ``--data`` or a synthetic Markov corpus
+so the entrypoint runs in a dataset-free container (same convention as
+examples/wikitext_rnn.py).
+
+Example (virtual mesh smoke):
+  KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=8 python examples/longcontext_lm.py \
+      --seq-len 512 --seq-devices 4 --data-devices 2 --epochs 1
+"""
+
+import argparse
+import logging
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from scripts.utils import force_platform
+force_platform()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import models, training
+from kfac_pytorch_tpu.utils import metrics
+
+
+def parse_args():
+    p = argparse.ArgumentParser(
+        description='Long-context TransformerLM + DP-KFAC (TPU)')
+    p.add_argument('--data', default=None)
+    p.add_argument('--seq-len', type=int, default=2048)
+    p.add_argument('--batch-size', type=int, default=4,
+                   help='global batch (sequences per step)')
+    p.add_argument('--epochs', type=int, default=3)
+    p.add_argument('--steps-per-epoch', type=int, default=100)
+    p.add_argument('--n-layer', type=int, default=4)
+    p.add_argument('--n-head', type=int, default=8)
+    p.add_argument('--d-model', type=int, default=256)
+    p.add_argument('--seq-impl', choices=['ring', 'ulysses'],
+                   default='ring')
+    p.add_argument('--seq-devices', type=int, default=1,
+                   help="size of the 'seq' mesh axis")
+    p.add_argument('--data-devices', type=int, default=1,
+                   help="size of the 'data' mesh axis")
+    p.add_argument('--base-lr', type=float, default=3e-2)
+    p.add_argument('--kfac-update-freq', type=int, default=10)
+    p.add_argument('--kfac-cov-update-freq', type=int, default=1)
+    p.add_argument('--kfac-name', default='eigen_dp')
+    p.add_argument('--damping', type=float, default=0.003)
+    p.add_argument('--stat-decay', type=float, default=0.95)
+    p.add_argument('--kl-clip', type=float, default=0.001)
+    p.add_argument('--vocab-limit', type=int, default=8192)
+    p.add_argument('--synthetic-vocab', type=int, default=512)
+    p.add_argument('--seed', type=int, default=42)
+    p.add_argument('--speed', action='store_true')
+    p.add_argument('--log-dir', default='./logs')
+    return p.parse_args()
+
+
+def load_corpus(args):
+    if args.data and os.path.exists(args.data):
+        with open(args.data) as f:
+            words = f.read().split()
+        from collections import Counter
+        vocab = {w: i for i, (w, _) in enumerate(
+            Counter(words).most_common(args.vocab_limit - 1))}
+        vocab['<unk>'] = len(vocab)
+        ids = np.asarray([vocab.get(w, vocab['<unk>']) for w in words],
+                         np.int32)
+        return ids, len(vocab)
+    rng = np.random.RandomState(args.seed)
+    V = args.synthetic_vocab
+    trans = rng.dirichlet(np.ones(V) * 0.05, size=V)
+    n = max(200000, args.batch_size * args.seq_len * 8)
+    ids = np.zeros(n, np.int32)
+    for i in range(1, n):
+        ids[i] = rng.choice(V, p=trans[ids[i - 1]])
+    return ids, V
+
+
+def sample_batches(ids, args, rng):
+    L = args.seq_len
+    for _ in range(args.steps_per_epoch):
+        starts = rng.randint(0, len(ids) - L - 1, args.batch_size)
+        toks = np.stack([ids[s:s + L] for s in starts])
+        labs = np.stack([ids[s + 1:s + L + 1] for s in starts])
+        yield {'input': jnp.asarray(toks), 'label': jnp.asarray(labs)}
+
+
+def main():
+    args = parse_args()
+    os.makedirs(args.log_dir, exist_ok=True)
+    name = (f'longctx_L{args.seq_len}_{args.kfac_name}'
+            f'_bs{args.batch_size}_sd{args.seq_devices}'
+            f'_dd{args.data_devices}')
+    logging.basicConfig(
+        level=logging.INFO, format='%(asctime)s %(message)s', force=True,
+        handlers=[logging.StreamHandler(),
+                  logging.FileHandler(
+                      os.path.join(args.log_dir, name + '.log'), mode='w')])
+    log = logging.getLogger()
+    log.info('args: %s', vars(args))
+
+    ids, vocab = load_corpus(args)
+    nd, ns = args.data_devices, args.seq_devices
+    ndev = nd * ns
+    devices = jax.devices()
+    assert len(devices) >= ndev, (len(devices), ndev)
+    assert args.seq_len % max(ns, 1) == 0
+    assert args.batch_size % max(nd, 1) == 0
+
+    seq_axis = 'seq' if ns > 1 else None
+    data_axis = 'data' if nd > 1 else None
+    model = models.transformer_lm(
+        vocab_size=vocab, n_layer=args.n_layer, n_head=args.n_head,
+        d_model=args.d_model, max_len=args.seq_len, seq_axis=seq_axis,
+        seq_impl=args.seq_impl)
+    twin = models.transformer_lm(
+        vocab_size=vocab, n_layer=args.n_layer, n_head=args.n_head,
+        d_model=args.d_model, max_len=args.seq_len, seq_axis=None)
+
+    # K-FAC distributes factor work over the flattened mesh when both
+    # axes exist; with one axis it uses that axis directly.
+    if ndev > 1:
+        mesh = Mesh(np.array(devices[:ndev]).reshape(nd, ns),
+                    ('data', 'seq'))
+        kfac_axis = tuple(a for a, n in (('data', nd), ('seq', ns))
+                          if n > 1)
+        kfac_axis = kfac_axis if len(kfac_axis) > 1 else kfac_axis[0]
+    else:
+        mesh, kfac_axis = None, None
+
+    precond = None
+    if args.kfac_update_freq > 0:
+        precond = kfac.KFAC(
+            variant=args.kfac_name, lr=args.base_lr, damping=args.damping,
+            fac_update_freq=args.kfac_cov_update_freq,
+            kfac_update_freq=args.kfac_update_freq,
+            factor_decay=args.stat_decay, kl_clip=args.kl_clip,
+            num_devices=ndev, axis_name=kfac_axis,
+            exclude_vocabulary_size=vocab)
+
+    tx = training.sgd(args.base_lr, momentum=0.9)
+    sample_local = jnp.zeros(
+        (max(args.batch_size // max(nd, 1), 1),
+         args.seq_len // max(ns, 1)), jnp.int32)
+    state = training.init_train_state(twin, tx, precond,
+                                      jax.random.PRNGKey(args.seed),
+                                      sample_local)
+
+    def ce(outputs, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, batch['label']).mean()
+
+    bspec = P(data_axis, seq_axis)
+    step = training.build_train_step(
+        model, tx, precond, ce, axis_name=kfac_axis, mesh=mesh,
+        batch_specs={'input': bspec, 'label': bspec})
+
+    rng = np.random.RandomState(args.seed)
+    for epoch in range(args.epochs):
+        t0 = time.perf_counter()
+        loss_m = metrics.Metric('loss')
+        iter_times = []
+        for i, batch in enumerate(sample_batches(ids, args, rng)):
+            ti = time.perf_counter()
+            state, m = step(state, batch, lr=args.base_lr,
+                            damping=args.damping)
+            if args.speed:
+                jax.block_until_ready(m)
+                iter_times.append(time.perf_counter() - ti)
+                if i >= 60:
+                    break
+            loss_m.update(float(m['loss']))
+        if args.speed:
+            it = np.mean(iter_times[5:]), np.std(iter_times[5:])
+            toks = args.batch_size * args.seq_len / it[0]
+            log.info('SPEED: iter time %.4f +- %.4f s (tokens/sec %.1f)',
+                     it[0], it[1], toks)
+            break
+        ppl = math.exp(min(loss_m.avg, 20))
+        log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)', epoch,
+                 ppl, ppl, time.perf_counter() - t0)
+
+
+if __name__ == '__main__':
+    main()

@@ -12,6 +12,7 @@ from kfac_pytorch_tpu.models.imagenet_resnet import (
     resnext50_32x4d, resnext101_32x8d)
 from kfac_pytorch_tpu.models.inception_v4 import inception_v4
 from kfac_pytorch_tpu.models.rnn import wikitext_lstm
+from kfac_pytorch_tpu.models.gpt import TransformerLM, transformer_lm
 
 
 def get_model(name, num_classes=10, **kw):

@@ -1,0 +1,111 @@
+"""Long-context decoder-only transformer LM — sequence parallelism native.
+
+The reference tops out at 384-token sequences and has no context
+parallelism (SURVEY.md §5.7). This model family makes long context a
+first-class capability of the framework: the *sequence* axis is sharded
+over a mesh axis (``seq_axis``) and every block computes exact causal
+attention via ring attention (K/V rotating over ICI,
+``parallel/ring_attention.py``) or Ulysses all-to-all, while the MLP and
+projection layers stay local to the sequence shard (they are pointwise in
+sequence). K-FAC capture works unchanged: the ``nn.Dense`` layers sow
+per-shard activations and tap output-gradients, and DP-KFAC's owner-local
+factor statistics (reference: kfac_preconditioner_inv_dp.py:75-90) apply
+per sequence shard exactly as they do per batch shard.
+
+Apply this model *inside* ``shard_map`` with tokens sharded
+``P('data', 'seq')``; with ``seq_axis=None`` it is a plain causal LM.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen
+
+from kfac_pytorch_tpu import nn as knn
+from kfac_pytorch_tpu.parallel.ring_attention import (
+    ring_attention, ulysses_attention)
+
+
+class CausalSelfAttention(linen.Module):
+    n_head: int
+    d_model: int
+    seq_axis: Optional[str] = None
+    seq_impl: str = 'ring'   # 'ring' | 'ulysses'
+    dropout: float = 0.0
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        B, L, _ = x.shape
+        h = self.n_head
+        d = self.d_model // h
+        qkv = knn.Dense(3 * self.d_model, use_bias=True, name='qkv')(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, L, h, d).transpose(0, 2, 1, 3)
+        k = k.reshape(B, L, h, d).transpose(0, 2, 1, 3)
+        v = v.reshape(B, L, h, d).transpose(0, 2, 1, 3)
+        attn = ring_attention if self.seq_impl == 'ring' \
+            else ulysses_attention
+        out = attn(q, k, v, self.seq_axis, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(B, L, self.d_model)
+        out = knn.Dense(self.d_model, use_bias=True, name='proj')(out)
+        return linen.Dropout(self.dropout, deterministic=not train)(out)
+
+
+class Block(linen.Module):
+    n_head: int
+    d_model: int
+    mlp_ratio: int = 4
+    seq_axis: Optional[str] = None
+    seq_impl: str = 'ring'
+    dropout: float = 0.0
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        x = x + CausalSelfAttention(
+            self.n_head, self.d_model, self.seq_axis, self.seq_impl,
+            self.dropout, name='attn')(
+                linen.LayerNorm(epsilon=1e-5, name='ln1')(x), train=train)
+        y = linen.LayerNorm(epsilon=1e-5, name='ln2')(x)
+        y = knn.Dense(self.mlp_ratio * self.d_model, name='fc1')(y)
+        y = linen.gelu(y)
+        y = knn.Dense(self.d_model, name='fc2')(y)
+        y = linen.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class TransformerLM(linen.Module):
+    """Decoder-only causal LM over a (possibly sequence-sharded) token
+    stream. ``__call__(tokens[B, L_local])`` returns logits
+    ``[B, L_local, vocab]``; global positions come from the shard index
+    when ``seq_axis`` is set."""
+    vocab_size: int
+    n_layer: int = 4
+    n_head: int = 8
+    d_model: int = 256
+    max_len: int = 65536
+    seq_axis: Optional[str] = None
+    seq_impl: str = 'ring'
+    dropout: float = 0.0
+
+    @linen.compact
+    def __call__(self, tokens, train=True):
+        B, L = tokens.shape
+        x = linen.Embed(self.vocab_size, self.d_model, name='wte')(tokens)
+        pos = jnp.arange(L)
+        if self.seq_axis is not None:
+            from kfac_pytorch_tpu.parallel import collectives
+            pos = pos + collectives.axis_index(self.seq_axis) * L
+        x = x + linen.Embed(self.max_len, self.d_model, name='wpe')(pos)
+        x = linen.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.n_layer):
+            x = Block(self.n_head, self.d_model, seq_axis=self.seq_axis,
+                      seq_impl=self.seq_impl, dropout=self.dropout,
+                      name=f'block{i}')(x, train=train)
+        x = linen.LayerNorm(epsilon=1e-5, name='ln_f')(x)
+        # pre-softmax projection: excluded from K-FAC by vocab size, the
+        # reference's tied-embedding exclusion (base.py:139-140)
+        return knn.Dense(self.vocab_size, use_bias=False, name='lm_head')(x)
+
+
+def transformer_lm(vocab_size=32000, **kw):
+    return TransformerLM(vocab_size=vocab_size, **kw)

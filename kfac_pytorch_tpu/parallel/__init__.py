@@ -22,10 +22,15 @@ from kfac_pytorch_tpu.parallel.mesh import (
     make_mesh,
     data_parallel_specs,
 )
+from kfac_pytorch_tpu.parallel.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     'round_robin_assign', 'balanced_assign', 'block_partition',
     'pmean', 'psum', 'all_gather_rows', 'average_grads', 'axis_index',
     'axis_size',
     'make_mesh', 'data_parallel_specs',
+    'ring_attention', 'ulysses_attention',
 ]

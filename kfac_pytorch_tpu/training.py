@@ -54,7 +54,7 @@ def sgd(lr_schedule, momentum=0.9, weight_decay=0.0, nesterov=False):
 
 def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      extra_mutable=(), sync_extra_vars=True, donate=True,
-                     dropout_seed=None):
+                     dropout_seed=None, batch_specs=None):
     """Build the per-iteration function family.
 
     Args:
@@ -67,6 +67,9 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
       extra_mutable: extra mutable collections (e.g. ('batch_stats',)).
       sync_extra_vars: pmean mutated collections across the axis so
         replicated state stays replicated (BN running stats).
+      batch_specs: shard_map PartitionSpec (or pytree of specs) for the
+        batch; default ``P(axis_name)`` (data-parallel on axis 0). Pass
+        e.g. ``P(None, 'seq')`` for sequence-parallel token streams.
 
     Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
     dispatches between up to four compiled variants using the
@@ -140,9 +143,10 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                   else P())
         sspecs = TrainState(step=P(), params=P(), opt_state=P(),
                             kfac_state=kspecs, extra_vars=P())
+        bspecs = P(axis_name) if batch_specs is None else batch_specs
         sharded = jax.shard_map(
             fn, mesh=mesh,
-            in_specs=(sspecs, P(axis_name), P()),
+            in_specs=(sspecs, bspecs, P()),
             out_specs=(sspecs, P()))
         return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 

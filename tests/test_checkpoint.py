@@ -1,0 +1,65 @@
+"""Checkpoint / resume tests (reference semantics: rank-0 save of
+{model, optimizer} (examples/utils.py:11-18), ImageNet auto-resume by
+scanning checkpoint-{epoch} downward (pytorch_imagenet_resnet.py:162-167,
+305-312); upgrade: K-FAC factor state round-trips too)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import models, training
+from kfac_pytorch_tpu.utils import checkpoint
+
+
+@pytest.fixture(scope='module')
+def trained_state():
+    model = models.get_model('resnet20')
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003)
+    tx = training.sgd(0.1, momentum=0.9)
+    x = jnp.ones((4, 16, 16, 3), jnp.float32)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0), x)
+
+    def ce(outputs, b):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, b['label']).mean()
+
+    step = training.build_train_step(model, tx, precond, ce,
+                                     extra_mutable=('batch_stats',))
+    batch = {'input': x, 'label': jnp.asarray([0, 1, 2, 3])}
+    state, _ = step(state, batch, lr=0.1, damping=0.003)
+    return state
+
+
+def test_save_restore_roundtrip(tmp_path, trained_state):
+    checkpoint.save_checkpoint(tmp_path, 3, trained_state)
+    target = jax.tree.map(np.zeros_like, trained_state)
+    restored = checkpoint.restore_checkpoint(tmp_path, 3, target)
+    for a, b in zip(jax.tree.leaves(trained_state),
+                    jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_without_kfac_state(tmp_path, trained_state):
+    # reference behavior: K-FAC state NOT checkpointed; factors rebuild
+    checkpoint.save_checkpoint(tmp_path, 1, trained_state,
+                               include_kfac=False)
+    target = jax.tree.map(np.zeros_like,
+                          trained_state.replace(kfac_state=None))
+    restored = checkpoint.restore_checkpoint(tmp_path, 1, target)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(restored.params)[0]),
+        np.asarray(jax.tree.leaves(trained_state.params)[0]))
+    assert restored.kfac_state is None
+
+
+def test_find_resume_epoch_scans_downward(tmp_path, trained_state):
+    assert checkpoint.find_resume_epoch(tmp_path, 10) is None
+    checkpoint.save_checkpoint(tmp_path, 2, trained_state)
+    checkpoint.save_checkpoint(tmp_path, 5, trained_state)
+    # scans from max_epoch downward and returns the newest present
+    assert checkpoint.find_resume_epoch(tmp_path, 10) == 5
+    assert checkpoint.find_resume_epoch(tmp_path, 4) == 2

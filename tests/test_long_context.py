@@ -1,0 +1,120 @@
+"""Long-context sequence-parallel training: the TransformerLM with ring
+attention sharded over a 'seq' mesh axis, end-to-end through the K-FAC
+train step. Capability beyond the reference (SURVEY.md §5.7 — absent
+there); correctness anchor: sequence-parallel logits/updates must match
+the single-device model with the same params."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import capture, models, training
+
+VOCAB, B, L, NDEV = 64, 4, 64, 8
+
+
+def _lm(seq_axis):
+    return models.transformer_lm(
+        vocab_size=VOCAB, n_layer=2, n_head=8, d_model=64, max_len=L,
+        seq_axis=seq_axis)
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    toks = rng.randint(0, VOCAB, (B, L))
+    return {'input': jnp.asarray(toks[:, :]),
+            'label': jnp.asarray(np.roll(toks, -1, axis=1))}
+
+
+def _ce(outputs, batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, batch['label']).mean()
+
+
+@pytest.fixture(scope='module')
+def mesh():
+    return Mesh(np.array(jax.devices()[:NDEV]), ('seq',))
+
+
+def test_seq_parallel_forward_matches_dense(mesh):
+    # init with the seq_axis=None twin (same param structure; ring needs
+    # the axis bound, so init/trace happen outside shard_map on the twin)
+    twin = _lm(None)
+    batch = _batch()
+    variables = capture.init(twin, jax.random.PRNGKey(0), batch['input'],
+                             train=False)
+    ref = twin.apply(variables, batch['input'], train=False)
+
+    sp = _lm('seq')
+    out = jax.jit(jax.shard_map(
+        lambda v, t: sp.apply(v, t, train=False),
+        mesh=mesh, in_specs=(P(), P(None, 'seq')),
+        out_specs=P(None, 'seq')))(variables, batch['input'])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_seq_parallel_kfac_training_step(mesh):
+    twin = _lm(None)
+    sp = _lm('seq')
+    batch = _batch(seed=1)
+    local_len = L // NDEV
+
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
+                        num_devices=NDEV, axis_name='seq',
+                        exclude_vocabulary_size=VOCAB)
+    tx = training.sgd(0.1, momentum=0.9)
+    # setup/init on the twin with a local-shard-shaped sample (layer dims
+    # are sequence-length independent)
+    state = training.init_train_state(
+        twin, tx, precond, jax.random.PRNGKey(0),
+        batch['input'][:, :local_len])
+
+    step = training.build_train_step(
+        sp, tx, precond, _ce, axis_name='seq', mesh=mesh,
+        batch_specs=P(None, 'seq'))
+
+    losses = []
+    for _ in range(6):
+        state, metrics = step(state, batch, lr=0.1, damping=0.003)
+        losses.append(float(metrics['loss']))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_seq_parallel_grads_match_dense(mesh):
+    """Param gradients from the sequence-sharded model == dense model."""
+    twin = _lm(None)
+    sp = _lm('seq')
+    batch = _batch(seed=2)
+    variables = capture.init(twin, jax.random.PRNGKey(1), batch['input'],
+                             train=False)
+
+    def dense_loss(params):
+        out = twin.apply({'params': params}, batch['input'], train=False)
+        return _ce(out, batch)
+
+    def sharded_loss_fn(params, toks, labels):
+        out = sp.apply({'params': params}, toks, train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            out, labels).mean()
+        return jax.lax.pmean(loss, 'seq')
+
+    def sp_grads(params, toks, labels):
+        # pmean'd loss: autodiff already yields the global-mean gradient
+        return jax.grad(sharded_loss_fn)(params, toks, labels)
+
+    g_dense = jax.grad(dense_loss)(variables['params'])
+    g_sp = jax.jit(jax.shard_map(
+        sp_grads, mesh=mesh,
+        in_specs=(P(), P(None, 'seq'), P(None, 'seq')),
+        out_specs=P()))(variables['params'], batch['input'],
+                        batch['label'])
+    flat_d, _ = jax.flatten_util.ravel_pytree(g_dense)
+    flat_s, _ = jax.flatten_util.ravel_pytree(g_sp)
+    np.testing.assert_allclose(np.asarray(flat_s), np.asarray(flat_d),
+                               atol=5e-4, rtol=5e-4)

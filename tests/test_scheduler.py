@@ -1,0 +1,62 @@
+"""KFACParamScheduler parity tests (reference semantics:
+kfac_preconditioner_base.py:233-301 — multiplicative decay of damping and
+update frequencies at listed epochs, with start-epoch fast-forward for
+checkpoint resume, pytorch_imagenet_resnet.py:281-287)."""
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import KFACParamScheduler
+
+
+def _precond(damping=0.03, fac=1, freq=10):
+    return kfac.KFAC(variant='eigen_dp', damping=damping,
+                     fac_update_freq=fac, kfac_update_freq=freq)
+
+
+def test_damping_decays_at_schedule_epochs():
+    p = _precond(damping=0.03)
+    s = KFACParamScheduler(p, damping_alpha=0.5, damping_schedule=[2, 4])
+    assert p.damping == 0.03
+    s.step(1)
+    assert p.damping == 0.03
+    s.step(2)
+    assert abs(p.damping - 0.015) < 1e-12
+    s.step(4)
+    assert abs(p.damping - 0.0075) < 1e-12
+    # moving past the last boundary does not decay again
+    s.step(9)
+    assert abs(p.damping - 0.0075) < 1e-12
+
+
+def test_update_freq_growth_and_floor():
+    p = _precond(fac=1, freq=10)
+    s = KFACParamScheduler(p, update_freq_alpha=10,
+                           update_freq_schedule=[3])
+    s.step(3)
+    assert p.fac_update_freq == 10
+    assert p.kfac_update_freq == 100
+    # shrinking alpha floors at 1
+    p2 = _precond(fac=1, freq=2)
+    s2 = KFACParamScheduler(p2, update_freq_alpha=0.1,
+                            update_freq_schedule=[0])
+    s2.step(0)
+    assert p2.fac_update_freq == 1
+    assert p2.kfac_update_freq == 1
+
+
+def test_start_epoch_fast_forward_matches_stepping():
+    a = _precond(damping=0.03)
+    KFACParamScheduler(a, damping_alpha=0.5, damping_schedule=[1, 2],
+                       start_epoch=5)
+    b = _precond(damping=0.03)
+    sb = KFACParamScheduler(b, damping_alpha=0.5, damping_schedule=[1, 2])
+    for e in range(1, 6):
+        sb.step(e)
+    assert abs(a.damping - b.damping) < 1e-12
+
+
+def test_step_without_arg_advances_by_one():
+    p = _precond(damping=0.04)
+    s = KFACParamScheduler(p, damping_alpha=0.5, damping_schedule=[1])
+    s.step()
+    assert s.epoch == 1
+    assert abs(p.damping - 0.02) < 1e-12

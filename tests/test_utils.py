@@ -1,0 +1,116 @@
+"""Harness-utility tests: LR schedules, losses, metrics, data pipeline
+(reference surfaces: examples/utils.py:6-121, transformer/Optim.py:40-63)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu import data
+from kfac_pytorch_tpu.utils import losses, lr, metrics
+
+
+# -- LR schedules -----------------------------------------------------------
+
+def test_warmup_multistep_shape():
+    sched = lr.warmup_multistep(0.1, steps_per_epoch=10, warmup_epochs=2,
+                                decay_epochs=[5, 8], scale=4.0)
+    # warmup starts near base_lr/scale and reaches base_lr*scale
+    assert float(sched(0)) < 0.11
+    np.testing.assert_allclose(float(sched(20)), 0.4, rtol=1e-6)
+    # decays by 0.1 at epochs 5 and 8
+    np.testing.assert_allclose(float(sched(51)), 0.04, rtol=1e-5)
+    np.testing.assert_allclose(float(sched(81)), 0.004, rtol=1e-5)
+
+
+def test_polynomial_decay_endpoints():
+    sched = lr.polynomial_decay(1.0, total_steps=100, power=2.0,
+                                warmup_steps=10)
+    np.testing.assert_allclose(float(sched(5)), 0.5, rtol=1e-6)  # warmup
+    np.testing.assert_allclose(float(sched(10)), 1.0, rtol=1e-6)
+    assert float(sched(100)) < 1e-6                              # decayed out
+
+
+def test_inverse_sqrt_peaks_at_warmup():
+    sched = lr.inverse_sqrt(d_model=512, warmup_steps=100)
+    vals = [float(sched(s)) for s in (1, 50, 100, 200, 1000)]
+    assert np.argmax(vals) == 2                    # max exactly at warmup
+    assert vals[-1] < vals[2]
+
+
+def test_lr_schedules_traceable_under_jit():
+    for sched in (lr.warmup_multistep(0.1, 10, 0, [5]),
+                  lr.polynomial_decay(0.1, 100),
+                  lr.inverse_sqrt(64)):
+        out = jax.jit(sched)(jnp.int32(7))
+        assert np.isfinite(float(out))
+
+
+# -- losses -----------------------------------------------------------------
+
+def test_label_smoothing_zero_equals_ce():
+    logits = jnp.asarray(np.random.RandomState(0).randn(8, 10), jnp.float32)
+    labels = jnp.arange(8) % 10
+    ls = losses.label_smoothing_cross_entropy(logits, labels, smoothing=0.0)
+    logp = jax.nn.log_softmax(logits)
+    ce = -logp[jnp.arange(8), labels].mean()
+    np.testing.assert_allclose(float(ls), float(ce), rtol=1e-6)
+
+
+def test_label_smoothing_penalizes_overconfidence():
+    confident = jnp.asarray([[20.0, -20.0]])
+    labels = jnp.asarray([0])
+    sm = losses.label_smoothing_cross_entropy(confident, labels,
+                                              smoothing=0.1)
+    hard = losses.label_smoothing_cross_entropy(confident, labels,
+                                                smoothing=0.0)
+    assert float(sm) > float(hard)
+
+
+def test_sample_pseudo_labels_follows_distribution():
+    logits = jnp.log(jnp.asarray([[0.99, 0.01]])).repeat(1000, axis=0)
+    labs = losses.sample_pseudo_labels(jax.random.PRNGKey(0), logits)
+    assert float((labs == 0).mean()) > 0.95
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_metric_weighted_average():
+    m = metrics.Metric('loss')
+    m.update(1.0, n=1)
+    m.update(3.0, n=3)
+    np.testing.assert_allclose(m.avg, 2.5)
+
+
+def test_accuracy_and_topk():
+    logits = jnp.asarray([[0.1, 0.9, 0.0], [0.8, 0.15, 0.1]])
+    labels = jnp.asarray([1, 2])
+    np.testing.assert_allclose(float(metrics.accuracy(logits, labels)), 0.5)
+    np.testing.assert_allclose(
+        float(metrics.topk_accuracy(logits, labels, k=2)), 0.5)
+    np.testing.assert_allclose(
+        float(metrics.topk_accuracy(logits, labels, k=3)), 1.0)
+
+
+# -- data pipeline ----------------------------------------------------------
+
+def test_synthetic_dataset_deterministic():
+    x1, y1 = data.synthetic_classification(16, (8, 8, 3), 10, seed=1)
+    x2, y2 = data.synthetic_classification(16, (8, 8, 3), 10, seed=1)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+    assert y1.min() >= 0 and y1.max() < 10
+
+
+def test_loader_shards_cover_dataset():
+    x, y = data.synthetic_classification(32, (4, 4, 3), 10, seed=0)
+    loader = data.Loader(x, y, batch_size=8, train=False)
+    batches = list(loader.epoch())
+    assert sum(b['input'].shape[0] for b in batches) == 32
+
+
+def test_augment_preserves_shape_and_range():
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 32, 32, 3).astype(np.float32)
+    out = data.augment_cifar(rng, x)
+    assert out.shape == x.shape
+    assert np.isfinite(out).all()
