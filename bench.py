@@ -94,8 +94,12 @@ def main():
     # breakdown setting) and at the deployed freq-10 amortization
     inv1_s = _measure_variant(model, tx, batch, 'inverse_dp', 1, 1, 20)
     inv10_s = _measure_variant(model, tx, batch, 'inverse_dp', 10, 10, 20)
-    # reference-default eigen_dp at deployed amortization
-    eig10_s = _measure_variant(model, tx, batch, 'eigen_dp', 10, 10, 10)
+    # reference-default eigen_dp at deployed amortization: opt-in — its
+    # eigh program is by far the slowest compile and the headline metric
+    # doesn't use it (BENCH_FULL=1 to include)
+    eig10_s = None
+    if os.environ.get('BENCH_FULL'):
+        eig10_s = _measure_variant(model, tx, batch, 'eigen_dp', 10, 10, 10)
 
     imgs_per_sec = BATCH / inv1_s
     result = {
@@ -108,7 +112,8 @@ def main():
             'sgd_iter_s': round(sgd_s, 4),
             'inverse_dp_iter_s_freq1': round(inv1_s, 4),
             'inverse_dp_iter_s_freq10': round(inv10_s, 4),
-            'eigen_dp_iter_s_freq10': round(eig10_s, 4),
+            'eigen_dp_iter_s_freq10': (round(eig10_s, 4)
+                                       if eig10_s is not None else None),
             'kfac_overhead_vs_sgd_freq1': round(inv1_s / sgd_s, 3),
             'kfac_overhead_vs_sgd_freq10': round(inv10_s / sgd_s, 3),
             'batch': BATCH, 'img': IMG, 'device': str(jax.devices()[0]),
