@@ -1,0 +1,211 @@
+"""Pallas TPU kernel for the attention hot op: fused streaming-softmax block.
+
+This is the compute core under both the single-device attention path and
+each ring-attention step (`parallel/ring_attention.py`): for one K/V block
+it produces the *unnormalized* online-softmax pieces
+
+    m  = rowmax(s)            (stop-gradient numerical shift)
+    l  = sum exp(s - m)
+    pv = exp(s - m) @ v       with  s = scale * q k^T + bias
+
+without ever materializing the [Lq, Lk] score matrix in HBM: the kernel
+tiles Lq over the grid, streams K/V tiles through VMEM, and keeps the
+(m, l, acc) recurrence in registers — the flash-attention forward, shaped
+for the MXU (all matmuls `preferred_element_type=f32`).
+
+The backward pass (custom VJP) recomputes scores blockwise in JAX from the
+saved (q, k, v, m, l): memory stays O(Lq * TK) and XLA fuses the chain;
+cotangents w.r.t. `m` are identically zero by construction (the consumers
+treat it as a constant shift — see ring_attention._block_attn).
+
+`block_impl` selection in ring_attention: 'xla' (plain jnp, default off
+TPU), 'pallas' (this kernel, default on TPU), 'pallas_interpret' (kernel
+under the Pallas interpreter — used by the CPU test suite).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+def _fwd_kernel(meta_ref, q_ref, k_ref, v_ref, mask_ref,
+                m_ref, l_ref, o_ref, *, scale, causal, tk, nk):
+    iq = pl.program_id(1)
+    tq, d = q_ref.shape[1], q_ref.shape[2]
+    q = q_ref[0]
+    q_start = meta_ref[0]
+    k_start = meta_ref[1]
+    qpos = (q_start + iq * tq
+            + lax.broadcasted_iota(jnp.int32, (tq, 1), 0))
+
+    def body(j, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(j * tk, tk), :]
+        vblk = v_ref[0, pl.ds(j * tk, tk), :]
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        kpos = (k_start + j * tk
+                + lax.broadcasted_iota(jnp.int32, (1, tk), 1))
+        # additive bias, NOT replacement: masked entries must keep their
+        # s-dependence so degenerate fully-masked rows behave identically
+        # to the XLA block path and to the recompute backward
+        if causal:
+            s = s + jnp.where(qpos >= kpos, 0.0, _NEG_INF)
+        mask = mask_ref[0, pl.ds(j * tk, tk)]
+        s = s + jnp.where(mask[None, :] > 0.5, 0.0, _NEG_INF)
+        m_j = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_j)
+        p = jnp.exp(s - m_new[:, None])
+        c = jnp.exp(m - m_new)
+        l = l * c + p.sum(axis=-1)
+        acc = acc * c[:, None] + jnp.dot(
+            p, vblk.astype(jnp.float32), preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((tq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((tq,), jnp.float32)
+    acc0 = jnp.zeros((tq, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    m_ref[0] = m
+    l_ref[0] = l
+    o_ref[0] = acc
+
+
+def _pallas_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
+    """q: [BH, Lq, D]; k/v: [BH, Lk, D]; kv_mask: [BH, Lk] f32.
+    Returns (m [BH, Lq], l [BH, Lq], pv [BH, Lq, D]) — padded inputs are
+    the caller's responsibility (pad keys masked, pad queries sliced)."""
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    tq = min(128, Lq)
+    tk = min(128, Lk)
+    meta = jnp.asarray(starts, jnp.int32)
+    grid = (BH, Lq // tq)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               tk=tk, nk=Lk // tk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tq, D), lambda bh, iq, meta: (bh, iq, 0)),
+            pl.BlockSpec((1, Lk, D), lambda bh, iq, meta: (bh, 0, 0)),
+            pl.BlockSpec((1, Lk, D), lambda bh, iq, meta: (bh, 0, 0)),
+            pl.BlockSpec((1, Lk), lambda bh, iq, meta: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tq), lambda bh, iq, meta: (bh, iq)),
+            pl.BlockSpec((1, tq), lambda bh, iq, meta: (bh, iq)),
+            pl.BlockSpec((1, tq, D), lambda bh, iq, meta: (bh, iq, 0)),
+        ],
+    )
+    # under shard_map the outputs vary over every axis the inputs do
+    vma = frozenset()
+    for x in (q, k, v):
+        vma = vma | getattr(jax.typeof(x), 'vma', frozenset())
+    out_shape = [
+        jax.ShapeDtypeStruct((BH, Lq), jnp.float32, vma=vma),
+        jax.ShapeDtypeStruct((BH, Lq), jnp.float32, vma=vma),
+        jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32, vma=vma),
+    ]
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=out_shape, interpret=interpret)(
+                              meta, q, k, v, kv_mask)
+
+
+def _bias(qpos, kpos, causal, kv_mask):
+    bias = jnp.zeros((), jnp.float32)
+    if causal:
+        bias = jnp.where(qpos[:, None] >= kpos[None, :], 0.0, _NEG_INF)
+    if kv_mask is not None:
+        pad = jnp.where(kv_mask > 0.5, 0.0, _NEG_INF)  # [BH, Lk]
+        bias = bias + pad[:, None, :]
+    return bias
+
+
+def _blockwise_bwd(q, k, v, kv_mask, m, dl, dpv, q_start, k_start,
+                   scale, causal, tk=128):
+    """Exact gradients of (l, pv) w.r.t. (q, k, v) with m treated as a
+    constant shift — recomputed blockwise over K tiles, O(Lq*TK) memory."""
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    tk = min(tk, Lk)
+    qpos = q_start + jnp.arange(Lq)
+    f32 = jnp.float32
+    qf, kf, vf = q.astype(f32), k.astype(f32), v.astype(f32)
+
+    def body(j, carry):
+        dq, dk, dv = carry
+        kblk = lax.dynamic_slice_in_dim(kf, j * tk, tk, axis=1)
+        vblk = lax.dynamic_slice_in_dim(vf, j * tk, tk, axis=1)
+        s = jnp.einsum('bqd,bkd->bqk', qf, kblk,
+                       preferred_element_type=f32) * scale
+        kpos = k_start + j * tk + jnp.arange(tk)
+        mblk = (None if kv_mask is None
+                else lax.dynamic_slice_in_dim(kv_mask, j * tk, tk, axis=1))
+        s = s + _bias(qpos, kpos, causal, mblk)
+        p = jnp.exp(s - m[..., None])                       # [BH, Lq, tk]
+        ds = p * (dl[..., None]
+                  + jnp.einsum('bqd,bkd->bqk', dpv, vblk,
+                               preferred_element_type=f32))
+        dq = dq + jnp.einsum('bqk,bkd->bqd', ds, kblk,
+                             preferred_element_type=f32) * scale
+        dk_j = jnp.einsum('bqk,bqd->bkd', ds, qf,
+                          preferred_element_type=f32) * scale
+        dv_j = jnp.einsum('bqk,bqd->bkd', p, dpv,
+                          preferred_element_type=f32)
+        dk = lax.dynamic_update_slice_in_dim(
+            dk, dk_j + lax.dynamic_slice_in_dim(dk, j * tk, tk, 1), j * tk,
+            axis=1)
+        dv = lax.dynamic_update_slice_in_dim(
+            dv, dv_j + lax.dynamic_slice_in_dim(dv, j * tk, tk, 1), j * tk,
+            axis=1)
+        return dq, dk, dv
+
+    dq0 = jnp.zeros_like(qf)
+    dk0 = jnp.zeros_like(kf)
+    dv0 = jnp.zeros_like(vf)
+    dq, dk, dv = lax.fori_loop(0, Lk // tk, body, (dq0, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_block_attn(q, k, v, kv_mask, starts, scale, causal,
+                     interpret=False):
+    """Fused (m, l, pv) for one attention block.
+
+    q: [BH, Lq, D]; k, v: [BH, Lk, D]; kv_mask: [BH, Lk] f32 (1=attend).
+    Lq and Lk must be multiples of 8 (pad + mask at the call site).
+    starts: int32 [2] = (q_start, k_start) global block offsets — may be
+    traced (ring callers pass per-device offsets; delivered to the kernel
+    via scalar prefetch).
+    """
+    m, l, pv = _pallas_fwd(q, k, v, kv_mask, starts, scale, causal,
+                           interpret)
+    return lax.stop_gradient(m), l, pv
+
+
+def _flash_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
+    m, l, pv = _pallas_fwd(q, k, v, kv_mask, starts, scale, causal,
+                           interpret)
+    m = lax.stop_gradient(m)
+    return (m, l, pv), (q, k, v, kv_mask, starts, m)
+
+
+def _flash_bwd(scale, causal, interpret, res, cts):
+    q, k, v, kv_mask, starts, m = res
+    _, dl, dpv = cts  # dm == 0: m is stop-gradiented at every consumer
+    dq, dk, dv = _blockwise_bwd(q, k, v, kv_mask, m, dl, dpv,
+                                starts[0], starts[1], scale, causal)
+    return dq, dk, dv, None, None
+
+
+flash_block_attn.defvjp(_flash_fwd, _flash_bwd)

@@ -32,6 +32,45 @@ from jax import lax
 _NEG_INF = -1e30
 
 
+def _default_block_impl():
+    """'pallas' on TPU, 'xla' elsewhere (interpret mode is for tests).
+    KFAC_ATTN_IMPL overrides ('xla' | 'pallas' | 'pallas_interpret')."""
+    import os
+    env = os.environ.get('KFAC_ATTN_IMPL')
+    if env:
+        return env
+    return 'pallas' if jax.default_backend() == 'tpu' else 'xla'
+
+
+def _block_attn_dispatch(q, k, v, q_start, k_start, causal, kv_mask,
+                         scale, block_impl):
+    """One streaming block through the selected implementation.
+
+    'xla': plain jnp ops (materializes the [Lq, Lk] block scores and lets
+    XLA fuse); 'pallas'/'pallas_interpret': the fused flash kernel
+    (ops/pallas_attention.py), which never materializes scores in HBM.
+    Both return identical (m, l, pv).
+    """
+    if block_impl == 'xla':
+        bias = _bias_for_block(q_start, k_start, q.shape[2], k.shape[2],
+                               causal, kv_mask)
+        return _block_attn(q, k, v, bias, scale)
+    from kfac_pytorch_tpu.ops.pallas_attention import flash_block_attn
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    fold = lambda x: x.reshape(B * H, *x.shape[2:])
+    maskf = (jnp.ones((B, Lk), jnp.float32) if kv_mask is None
+             else kv_mask.astype(jnp.float32))
+    maskf = jnp.repeat(maskf, H, axis=0)
+    starts = jnp.stack([jnp.asarray(q_start, jnp.int32),
+                        jnp.asarray(k_start, jnp.int32)])
+    m, l, pv = flash_block_attn(
+        fold(q), fold(k), fold(v), maskf, starts, scale, causal,
+        block_impl == 'pallas_interpret')
+    unfold = lambda x: x.reshape(B, H, *x.shape[1:])
+    return unfold(m), unfold(l), unfold(pv)
+
+
 def _block_attn(q, k, v, bias, scale):
     """One streaming block: scores, masked, unnormalized softmax pieces.
 
@@ -65,7 +104,7 @@ def _merge(o, l, m, pv_j, l_j, m_j):
 
 
 def ring_attention(q, k, v, axis_name, causal=False, kv_mask=None,
-                   scale=None):
+                   scale=None, block_impl=None):
     """Exact attention with the sequence axis sharded over ``axis_name``.
 
     Args:
@@ -85,9 +124,10 @@ def ring_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     Lk = k.shape[2]
     dtype = jnp.float32
 
+    block_impl = block_impl or _default_block_impl()
     if axis_name is None:
-        bias = _bias_for_block(0, 0, Lq, Lk, causal, kv_mask)
-        m, l, pv = _block_attn(q, k, v, bias, scale)
+        m, l, pv = _block_attn_dispatch(q, k, v, 0, 0, causal, kv_mask,
+                                        scale, block_impl)
         return (pv / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
     n = lax.axis_size(axis_name)
@@ -110,9 +150,9 @@ def ring_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     def body(t, carry):
         o, l, m, k_t, v_t, mask_t = carry
         src = (me - t) % n  # which global shard this K/V block came from
-        bias = _bias_for_block(me * Lq, src * Lk, Lq, Lk, causal,
-                               mask_t > 0.5)
-        m_j, l_j, pv_j = _block_attn(q, k_t, v_t, bias, scale)
+        m_j, l_j, pv_j = _block_attn_dispatch(
+            q, k_t, v_t, me * Lq, src * Lk, causal, mask_t > 0.5, scale,
+            block_impl)
         o, l, m = _merge(o, l, m, pv_j, l_j, m_j)
         k_t = lax.ppermute(k_t, axis_name, perm)
         v_t = lax.ppermute(v_t, axis_name, perm)
@@ -138,7 +178,7 @@ def _bias_for_block(q_start, k_start, Lq, Lk, causal, kv_mask):
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, kv_mask=None,
-                      scale=None):
+                      scale=None, block_impl=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Same contract as :func:`ring_attention` but requires ``H`` divisible
@@ -149,7 +189,8 @@ def ulysses_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     scale = scale or (q.shape[-1] ** -0.5)
     if axis_name is None:
         return ring_attention(q, k, v, None, causal=causal,
-                              kv_mask=kv_mask, scale=scale)
+                              kv_mask=kv_mask, scale=scale,
+                              block_impl=block_impl)
     n = lax.axis_size(axis_name)
     B, H, Lq, D = q.shape
     if H % n:
@@ -165,7 +206,8 @@ def ulysses_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     if kv_mask is not None:
         maskg = lax.all_gather(kv_mask.astype(jnp.float32), axis_name,
                                axis=1, tiled=True) > 0.5
-    bias = _bias_for_block(0, 0, qg.shape[2], kg.shape[2], causal, maskg)
-    m, l, pv = _block_attn(qg, kg, vg, bias, scale)
+    m, l, pv = _block_attn_dispatch(
+        qg, kg, vg, 0, 0, causal, maskg,
+        scale, block_impl or _default_block_impl())
     out = (pv / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
     return unswap(out)
