@@ -112,20 +112,35 @@ def augment_cifar(rng, x):
 
 
 class Loader:
-    """Persistent shuffling batch iterator (drop-last, reshuffle per epoch)."""
+    """Persistent shuffling batch iterator (drop-last, reshuffle per epoch).
 
-    def __init__(self, x, y, batch_size, train=True, augment=None, seed=0):
+    ``shard=(index, count)`` restricts iteration to this process's slice of
+    every epoch permutation — the multi-host DistributedSampler (reference:
+    examples/pytorch_cifar10_resnet.py:180-192): all processes draw the
+    same permutation (same seed) and take disjoint contiguous slices, so
+    ``batch_size`` here is the *per-process* batch. Defaults to
+    ``(jax.process_index(), jax.process_count())``.
+    """
+
+    def __init__(self, x, y, batch_size, train=True, augment=None, seed=0,
+                 shard=None):
         self.x, self.y = x, y
         self.batch_size = batch_size
         self.train = train
         self.augment = augment
         self.rng = np.random.RandomState(seed)
-        self.steps_per_epoch = len(x) // batch_size
+        if shard is None:
+            import jax
+            shard = (jax.process_index(), jax.process_count())
+        self.shard_index, self.shard_count = shard
+        self.steps_per_epoch = len(x) // (batch_size * self.shard_count)
 
     def epoch(self):
         idx = np.arange(len(self.x))
         if self.train:
             self.rng.shuffle(idx)
+        per = len(self.x) // self.shard_count
+        idx = idx[self.shard_index * per:(self.shard_index + 1) * per]
         for s in range(self.steps_per_epoch):
             sel = idx[s * self.batch_size:(s + 1) * self.batch_size]
             bx = _normalize(self.x[sel])

@@ -27,6 +27,20 @@ class Metric:
     def avg(self):
         return self.total / max(self.n, 1e-12)
 
+    def sync(self):
+        """Cross-process allreduce of (total, n) — the reference's
+        allreduce-averaged Metric semantics on a multi-host pod
+        (examples/utils.py:39-52). No-op on one process."""
+        import jax
+        if jax.process_count() == 1:
+            return self
+        from jax.experimental import multihost_utils
+        agg = multihost_utils.process_allgather(
+            np.asarray([self.total, self.n], np.float64))
+        self.total = float(agg[:, 0].sum())
+        self.n = float(agg[:, 1].sum())
+        return self
+
 
 def accuracy(outputs, labels):
     """Top-1 accuracy from logits (reference: examples/utils.py:6-9)."""

@@ -108,6 +108,30 @@ def test_loader_shards_cover_dataset():
     assert sum(b['input'].shape[0] for b in batches) == 32
 
 
+def test_loader_process_shards_are_disjoint_and_cover():
+    x, y = data.synthetic_classification(32, (2, 2, 3), 10, seed=0)
+    seen = []
+    for i in range(4):  # 4 simulated processes, same seed
+        loader = data.Loader(x, y, batch_size=4, train=True, seed=7,
+                             shard=(i, 4))
+        assert loader.steps_per_epoch == 2  # 32 / (4 * 4)
+        for b in loader.epoch():
+            seen.extend(np.asarray(b['label']).tolist())
+    assert len(seen) == 32  # disjoint shards, full coverage
+    ref = data.Loader(x, y, batch_size=16, train=True, seed=7,
+                      shard=(0, 1))
+    ref_labels = [l for b in ref.epoch()
+                  for l in np.asarray(b['label']).tolist()]
+    assert sorted(seen) == sorted(ref_labels)
+
+
+def test_metric_sync_single_process_noop():
+    m = metrics.Metric('loss')
+    m.update(2.0, n=4)
+    m.sync()
+    np.testing.assert_allclose(m.avg, 2.0)
+
+
 def test_augment_preserves_shape_and_range():
     rng = np.random.RandomState(0)
     x = rng.rand(4, 32, 32, 3).astype(np.float32)
