@@ -190,8 +190,9 @@ def main():
             val_loss.update(l, len(batch['label']))
             val_acc.update(a, len(batch['label']))
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)', epoch, train_loss.avg, val_loss.avg,
-                 val_acc.avg, time.time() - t0)
+                 '(%.1fs)', epoch, train_loss.sync().avg,
+                 val_loss.sync().avg, val_acc.sync().avg,
+                 time.time() - t0)
         if scheduler is not None:
             scheduler.step(epoch + 1)
         if args.checkpoint_dir:

@@ -210,7 +210,8 @@ def main():
             vl.update(l)
             va.update(a)
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)', epoch, tm.avg, vl.avg, va.avg, time.time() - t0)
+                 '(%.1fs)', epoch, tm.sync().avg, vl.sync().avg,
+                 va.sync().avg, time.time() - t0)
         if scheduler is not None:
             scheduler.step(epoch + 1)
         utils.save_checkpoint(args.checkpoint_format, epoch, state)
