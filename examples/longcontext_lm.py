@@ -87,11 +87,13 @@ def load_corpus(args):
     rng = np.random.RandomState(args.seed)
     V = args.synthetic_vocab
     trans = rng.dirichlet(np.ones(V) * 0.05, size=V)
+    cum = trans.cumsum(axis=1)
     n = max(200000, args.batch_size * args.seq_len * 8)
+    u = rng.rand(n)
     ids = np.zeros(n, np.int32)
-    for i in range(1, n):
-        ids[i] = rng.choice(V, p=trans[ids[i - 1]])
-    return ids, V
+    for i in range(1, n):  # inverse-CDF sampling: O(log V) per token
+        ids[i] = np.searchsorted(cum[ids[i - 1]], u[i])
+    return np.minimum(ids, V - 1), V
 
 
 def sample_batches(ids, args, rng):
@@ -118,6 +120,8 @@ def main():
     log.info('args: %s', vars(args))
 
     ids, vocab = load_corpus(args)
+    split = int(len(ids) * 0.9)
+    train_ids, val_ids = ids[:split], ids[split:]
     nd, ns = args.data_devices, args.seq_devices
     ndev = nd * ns
     devices = jax.devices()
@@ -173,12 +177,27 @@ def main():
         model, tx, precond, ce, axis_name=kfac_axis, mesh=mesh,
         batch_specs={'input': bspec, 'label': bspec})
 
+    def eval_loss_local(params, batch):
+        out = model.apply({'params': params}, batch['input'], train=False)
+        loss = ce(out, batch)
+        if kfac_axis is not None:
+            loss = jax.lax.pmean(loss, kfac_axis)
+        return loss
+
+    if mesh is not None:
+        eval_step = jax.jit(jax.shard_map(
+            eval_loss_local, mesh=mesh,
+            in_specs=(P(), {'input': bspec, 'label': bspec}),
+            out_specs=P()))
+    else:
+        eval_step = jax.jit(eval_loss_local)
+
     rng = np.random.RandomState(args.seed)
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
         loss_m = metrics.Metric('loss')
         iter_times = []
-        for i, batch in enumerate(sample_batches(ids, args, rng)):
+        for i, batch in enumerate(sample_batches(train_ids, args, rng)):
             ti = time.perf_counter()
             state, m = step(state, batch, lr=args.base_lr,
                             damping=args.damping)
@@ -194,9 +213,15 @@ def main():
             log.info('SPEED: iter time %.4f +- %.4f s (tokens/sec %.1f)',
                      it[0], it[1], toks)
             break
+        val_m = metrics.Metric('val_loss')
+        vrng = np.random.RandomState(args.seed + 1)
+        vargs = args
+        for vb in list(sample_batches(val_ids, vargs, vrng))[:10]:
+            val_m.update(float(eval_step(state.params, vb)))
         ppl = math.exp(min(loss_m.avg, 20))
+        vppl = math.exp(min(val_m.avg, 20))
         log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)', epoch,
-                 ppl, ppl, time.perf_counter() - t0)
+                 ppl, vppl, time.perf_counter() - t0)
 
 
 if __name__ == '__main__':

@@ -183,11 +183,15 @@ def flash_block_attn(q, k, v, kv_mask, starts, scale, causal,
     """Fused (m, l, pv) for one attention block.
 
     q: [BH, Lq, D]; k, v: [BH, Lk, D]; kv_mask: [BH, Lk] f32 (1=attend).
-    Lq and Lk must be multiples of 8 (pad + mask at the call site).
+    Lq and Lk must tile exactly: multiples of 8 when <= 128, multiples of
+    128 above (the ring dispatch pads + masks to this grid —
+    parallel/ring_attention.py _block_attn_dispatch).
     starts: int32 [2] = (q_start, k_start) global block offsets — may be
     traced (ring callers pass per-device offsets; delivered to the kernel
     via scalar prefetch).
     """
+    assert q.shape[1] % (8 if q.shape[1] <= 128 else 128) == 0, q.shape
+    assert k.shape[1] % (8 if k.shape[1] <= 128 else 128) == 0, k.shape
     m, l, pv = _pallas_fwd(q, k, v, kv_mask, starts, scale, causal,
                            interpret)
     return lax.stop_gradient(m), l, pv

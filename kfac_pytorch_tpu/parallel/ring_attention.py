@@ -58,16 +58,26 @@ def _block_attn_dispatch(q, k, v, q_start, k_start, causal, kv_mask,
     from kfac_pytorch_tpu.ops.pallas_attention import flash_block_attn
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
-    fold = lambda x: x.reshape(B * H, *x.shape[2:])
+    # pad sequence lengths up to the kernel's tile grid (<=128: multiple
+    # of 8; >128: multiple of 128). Padded keys are masked out (exact:
+    # their exp terms are 0); padded query rows are sliced off — and
+    # jnp.pad's VJP slices the cotangents back, so gradients stay exact.
+    pad_to = lambda n: -(-n // 8) * 8 if n <= 128 else -(-n // 128) * 128
+    Lqp, Lkp = pad_to(Lq), pad_to(Lk)
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, Lqp - Lq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, Lkp - Lk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, Lkp - Lk), (0, 0)))
     maskf = (jnp.ones((B, Lk), jnp.float32) if kv_mask is None
              else kv_mask.astype(jnp.float32))
+    maskf = jnp.pad(maskf, ((0, 0), (0, Lkp - Lk)))  # pad keys masked
+    fold = lambda x: x.reshape(B * H, *x.shape[2:])
     maskf = jnp.repeat(maskf, H, axis=0)
     starts = jnp.stack([jnp.asarray(q_start, jnp.int32),
                         jnp.asarray(k_start, jnp.int32)])
     m, l, pv = flash_block_attn(
-        fold(q), fold(k), fold(v), maskf, starts, scale, causal,
+        fold(qp), fold(kp), fold(vp), maskf, starts, scale, causal,
         block_impl == 'pallas_interpret')
-    unfold = lambda x: x.reshape(B, H, *x.shape[1:])
+    unfold = lambda x: x.reshape(B, H, *x.shape[1:])[:, :, :Lq]
     return unfold(m), unfold(l), unfold(pv)
 
 

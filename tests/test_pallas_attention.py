@@ -82,6 +82,32 @@ def test_kernel_gradients_match_xla_blocks():
                                    atol=5e-4, rtol=5e-4)
 
 
+def test_non_tile_multiple_length_values_and_grads():
+    """L=160 (>128, not a multiple of 128): the dispatch must pad to the
+    tile grid — regression for silent tail truncation."""
+    rng = np.random.RandomState(3)
+    B, H, L = 1, 2, 160
+    mk = lambda: jnp.asarray(rng.randn(B, H, L, D), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    def loss(impl, q, k, v):
+        out = ring_mod.ring_attention(q, k, v, axis_name=None, causal=True,
+                                      block_impl=impl)
+        return (out ** 2).sum(), out
+
+    (lp, out_p), gp = jax.value_and_grad(
+        functools.partial(loss, 'pallas_interpret'), argnums=(0, 1, 2),
+        has_aux=True)(q, k, v)
+    (lx, out_x), gx = jax.value_and_grad(
+        functools.partial(loss, 'xla'), argnums=(0, 1, 2),
+        has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
+                               atol=2e-5, rtol=2e-5)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
 def test_ring_with_pallas_blocks_matches_dense():
     devs = jax.devices()[:8]
     mesh = Mesh(np.array(devs), ('seq',))
