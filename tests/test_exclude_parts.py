@@ -1,0 +1,85 @@
+"""exclude_parts ablation plumbing (reference:
+kfac_preconditioner_base.py:96-99, 200-225 — each flag removes one
+pipeline stage; used for the phase-attribution subtraction method,
+scripts/time_breakdown.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import models, training
+
+
+def _run_steps(exclude_parts, n=2, variant='eigen_dp'):
+    model = models.get_model('resnet20')
+    precond = kfac.KFAC(variant=variant, lr=0.1, damping=0.003,
+                        exclude_parts=exclude_parts)
+    tx = training.sgd(0.1, momentum=0.9)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16, 16, 3),
+                    jnp.float32)
+    batch = {'input': x, 'label': jnp.asarray([0, 1, 2, 3])}
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0), x)
+
+    def ce(outputs, b):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, b['label']).mean()
+
+    step = training.build_train_step(model, tx, precond, ce,
+                                     extra_mutable=('batch_stats',))
+    for _ in range(n):
+        state, _ = step(state, batch, lr=0.1, damping=0.003)
+    return state
+
+
+def _factor_norm(state):
+    return float(sum(jnp.abs(f).sum()
+                     for f in jax.tree.leaves(state.kfac_state.factors)))
+
+
+def _decomp_norm(state):
+    return float(sum(jnp.abs(d).sum()
+                     for d in jax.tree.leaves(state.kfac_state.decomp)))
+
+
+def test_exclude_compute_factor_leaves_factors_untouched():
+    full = _run_steps('')
+    ablated = _run_steps('ComputeFactor')
+    init = _run_steps('ComputeFactor', n=0)  # state as initialized
+    assert abs(_factor_norm(full) - _factor_norm(init)) > 1e-3
+    # with the stage ablated the factor state never changes from init
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(x).ravel() for x in
+                        jax.tree.leaves(ablated.kfac_state.factors)]),
+        np.concatenate([np.asarray(x).ravel() for x in
+                        jax.tree.leaves(init.kfac_state.factors)]))
+
+
+def test_exclude_compute_inverse_skips_decomposition():
+    ablated = _run_steps('ComputeInverse')
+    assert _decomp_norm(ablated) == 0.0
+    # factors still accumulate (only the decomposition stage is ablated)
+    assert _factor_norm(ablated) > 0
+
+
+def test_exclude_communicate_inverse_disables_kl_clip_rescale():
+    # reference parity: the nu-rescale reads the gathered preds, so the
+    # comm ablation also skips the clip (inv.py:188-217 under ablation)
+    full = _run_steps('')
+    noclip = _run_steps('CommunicateInverse')
+    pf = jax.tree.leaves(full.params)[0]
+    pn = jax.tree.leaves(noclip.params)[0]
+    assert not np.allclose(np.asarray(pf), np.asarray(pn))
+
+
+def test_excluded_runs_remain_finite():
+    for parts in ('CommunicateFactor',
+                  'CommunicateInverse,ComputeInverse',
+                  'CommunicateInverse,ComputeInverse,CommunicateFactor,'
+                  'ComputeFactor'):
+        state = _run_steps(parts, n=1)
+        for leaf in jax.tree.leaves(state.params):
+            assert np.isfinite(np.asarray(leaf)).all(), parts
