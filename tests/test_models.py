@@ -45,6 +45,30 @@ def test_vgg16_builds():
     assert len(metas) == 14  # 13 convs + classifier
 
 
+def test_wide_resnet_and_resnext_forward():
+    import jax
+    import jax.numpy as jnp
+    from kfac_pytorch_tpu import capture, models
+    for name in ('wrn-28-10', 'resnext50'):
+        model = models.get_model(name, num_classes=10)
+        x = jnp.ones((2, 32, 32, 3), jnp.float32)
+        variables = capture.init(model, jax.random.PRNGKey(0), x,
+                                 train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 10), name
+
+
+def test_inception_v4_forward():
+    import jax
+    import jax.numpy as jnp
+    from kfac_pytorch_tpu import capture, models
+    model = models.get_model('inceptionv4', num_classes=7)
+    x = jnp.ones((1, 128, 128, 3), jnp.float32)
+    variables = capture.init(model, jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 7)
+
+
 def test_imagenet_resnet50_params():
     model = models.resnet50()
     x = jnp.ones((1, 64, 64, 3))
