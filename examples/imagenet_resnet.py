@@ -81,10 +81,10 @@ def get_data(args):
         y = np.load(os.path.join(args.train_dir, 'labels.npy'))
         return (x, y), (x[:1024], y[:1024])
     shape = (args.img_size, args.img_size, 3)
-    train = kdata.synthetic_classification(args.synthetic_size, shape, 1000,
-                                           seed=1)
-    val = kdata.synthetic_classification(256, shape, 1000, seed=2)
-    return train, val
+    # same draw + split: train/val must share the class means
+    x, y = kdata.synthetic_classification(args.synthetic_size + 256, shape,
+                                          1000, seed=1)
+    return (x[:-256], y[:-256]), (x[-256:], y[-256:])
 
 
 def main():

@@ -71,11 +71,13 @@ def get_cifar(data_dir=None, num_classes=10, synthetic_size=2048):
             return load_cifar10(data_dir)
         except (FileNotFoundError, OSError):
             pass
-    train = synthetic_classification(synthetic_size, (32, 32, 3),
-                                     num_classes, seed=1)
-    val = synthetic_classification(synthetic_size // 4, (32, 32, 3),
-                                   num_classes, seed=2)
-    return train, val
+    # one draw, one set of class means, then split — train and val must
+    # come from the SAME distribution or validation is unlearnable noise
+    n_val = synthetic_size // 4
+    x, y = synthetic_classification(synthetic_size + n_val, (32, 32, 3),
+                                    num_classes, seed=1)
+    return (x[:synthetic_size], y[:synthetic_size]), \
+        (x[synthetic_size:], y[synthetic_size:])
 
 
 # ---------------------------------------------------------------------------
