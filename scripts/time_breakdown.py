@@ -22,7 +22,6 @@ Usage: python scripts/time_breakdown.py [--model resnet32] [--batch 128]
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
@@ -36,30 +35,7 @@ import optax
 
 import kfac_pytorch_tpu as kfac
 from kfac_pytorch_tpu import training
-
-# Cumulative ablations, innermost phase first: each setting removes one
-# more pipeline stage (reference exclude_parts grammar,
-# kfac_preconditioner_base.py:96-99).
-LADDER = [
-    ('full', ''),
-    ('-CommunicateInverse', 'CommunicateInverse'),
-    ('-ComputeInverse', 'CommunicateInverse,ComputeInverse'),
-    ('-CommunicateFactor',
-     'CommunicateInverse,ComputeInverse,CommunicateFactor'),
-    ('-ComputeFactor',
-     'CommunicateInverse,ComputeInverse,CommunicateFactor,ComputeFactor'),
-]
-
-
-def _time_step(step, state, batch, iters, **kw):
-    for _ in range(3):
-        state, m = step(state, batch, **kw)
-    jax.block_until_ready(m)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, batch, **kw)
-    jax.block_until_ready(m)
-    return (time.perf_counter() - t0) / iters
+from kfac_pytorch_tpu.utils import profiling
 
 
 def main():
@@ -82,42 +58,47 @@ def main():
         return optax.softmax_cross_entropy_with_integer_labels(
             outputs, b['label']).mean()
 
-    times = {}
-    for label, excl in LADDER:
+    def make_step(exclude_parts):
         precond = kfac.KFAC(variant=args.variant, lr=0.1, damping=0.003,
                             fac_update_freq=1, kfac_update_freq=1,
                             num_devices=args.num_devices, axis_name=None,
-                            exclude_parts=excl)
+                            exclude_parts=exclude_parts)
         state = training.init_train_state(model, tx, precond,
                                           jax.random.PRNGKey(0),
                                           batch['input'])
         step = training.build_train_step(model, tx, precond, ce,
                                          extra_mutable=('batch_stats',))
-        times[label] = _time_step(step, state, batch, args.iters,
-                                  lr=0.1, damping=0.003)
+        return step, state
+
+    last = {}
+
+    def build(excl):
+        step, state = make_step(excl)
+        last['state'] = state  # fresh state matching this step's precond
+        return step
+
+    breakdown = profiling.exclude_parts_breakdown(
+        build, lambda: last['state'], batch, iters=args.iters,
+        lr=0.1, damping=0.003)
 
     # SGD reference (no preconditioner at all)
     state = training.init_train_state(model, tx, None, jax.random.PRNGKey(0),
                                       batch['input'])
     sgd = training.build_train_step(model, tx, None, ce,
                                     extra_mutable=('batch_stats',))
-    times['sgd'] = _time_step(sgd, state, batch, args.iters)
+    sgd_t, _, _ = profiling.time_steps(sgd, state, batch, iters=args.iters,
+                                       warmup=3)
 
-    ladder = [times[label] for label, _ in LADDER]
-    phases = {
-        'FF&BP+update (sgd)': times['sgd'],
-        'capture+glue': max(ladder[4] - times['sgd'], 0.0),
-        'ComputeFactor': max(ladder[3] - ladder[4], 0.0),
-        'CommunicateFactor': max(ladder[2] - ladder[3], 0.0),
-        'ComputeInverse': max(ladder[1] - ladder[2], 0.0),
-        'CommunicateInverse': max(ladder[0] - ladder[1], 0.0),
-    }
-    total = times['full']
+    total = breakdown['Total']
     print(f'\n{args.model} bs{args.batch} {args.variant} '
           f'nd{args.num_devices} — iter {total * 1e3:.2f} ms '
-          f'(SGD {times["sgd"] * 1e3:.2f} ms, '
-          f'overhead {total / times["sgd"]:.2f}x)')
-    for name, t in phases.items():
+          f'(SGD {sgd_t * 1e3:.2f} ms, overhead {total / sgd_t:.2f}x)')
+    order = ['ComputeFactor', 'CommunicateFactor', 'ComputeInverse',
+             'CommunicateInverse']
+    rows = ([('FF&BP+update (sgd)', sgd_t),
+             ('capture+glue', max(breakdown['Rest'] - sgd_t, 0.0))]
+            + [(p, breakdown[p]) for p in reversed(order)])
+    for name, t in rows:
         bar = '#' * int(60 * t / total)
         print(f'  {name:<20} {t * 1e3:>8.2f} ms  {bar}')
 
