@@ -1,0 +1,67 @@
+"""Factory-surface parity tests (reference: kfac/__init__.py:8-16,
+kfac/dp_kfac.py:4-39) and profiling helpers."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu.utils import profiling
+
+
+def test_get_kfac_module_binds_variant():
+    for name in kfac.KFAC_VARIANTS:
+        factory = kfac.get_kfac_module(name)
+        p = factory(lr=0.2, damping=0.01)
+        assert p.variant == name
+        assert p.lr == 0.2
+
+
+def test_get_kfac_module_unknown_raises():
+    with pytest.raises(KeyError):
+        kfac.get_kfac_module('nope')
+
+
+def test_dp_kfac_facade_selects_dp_variants():
+    assert kfac.DP_KFAC(inv_type='eigen').variant == 'eigen_dp'
+    assert kfac.DP_KFAC(inv_type='inverse').variant == 'inverse_dp'
+
+
+def test_variant_table_matches_reference_semantics():
+    # MPD variants allreduce factor stats; DP variants keep them local
+    assert kfac.KFAC(variant='inverse').stats_reduce == 'pmean'
+    assert kfac.KFAC(variant='eigen').stats_reduce == 'pmean'
+    assert kfac.KFAC(variant='inverse_dp').stats_reduce == 'local'
+    assert kfac.KFAC(variant='eigen_dp').stats_reduce == 'local'
+    # comm modes: eigen forces inverse comm (eigen.py:52); dp comm preds
+    assert kfac.KFAC(variant='eigen').comm_mode == 'inverse'
+    assert kfac.KFAC(variant='eigen_dp').comm_mode == 'pred'
+    assert kfac.KFAC(variant='inverse').comm_mode == 'pred'
+    assert kfac.KFAC(
+        variant='inverse', communicate_inverse_or_not=True
+    ).comm_mode == 'inverse'
+
+
+def test_time_steps_returns_steady_state_stats():
+    calls = []
+
+    def fake_step(state, batch, **kw):
+        calls.append(1)
+        return state, jnp.float32(0.0)
+
+    mean, std, state = profiling.time_steps(fake_step, 0, None, iters=4,
+                                            warmup=2)
+    assert len(calls) == 6
+    assert mean >= 0 and std >= 0
+
+
+def test_exclude_parts_breakdown_shape():
+    def make_step(excl):
+        def step(state, batch, **kw):
+            return state, jnp.float32(len(excl))
+        return step
+
+    out = profiling.exclude_parts_breakdown(make_step, lambda: 0, None,
+                                            iters=2)
+    assert set(out) == {'Total', 'Rest'} | set(profiling.PHASES)
+    assert all(v >= 0 for v in out.values())
