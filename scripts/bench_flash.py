@@ -1,0 +1,77 @@
+"""Single-chip attention kernel A/B: Pallas flash block vs plain XLA.
+
+Times one fwd+bwd causal attention call at growing sequence length with
+both block implementations (`parallel/ring_attention.py` dispatch). The
+XLA path materializes the [L, L] score block in HBM; the Pallas kernel
+streams K/V tiles through VMEM — the gap grows with L until the XLA path
+OOMs, which is the kernel's reason to exist.
+
+Usage: python scripts/bench_flash.py [--seq-lens 1024 4096 16384]
+       [--heads 8] [--d-head 64] [--batch 1]
+"""
+
+import argparse
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from scripts.utils import force_platform, timeit
+force_platform()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu.parallel.ring_attention import ring_attention
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--seq-lens', nargs='+', type=int,
+                    default=[1024, 4096, 8192, 16384])
+    ap.add_argument('--batch', type=int, default=1)
+    ap.add_argument('--heads', type=int, default=8)
+    ap.add_argument('--d-head', type=int, default=64)
+    ap.add_argument('--impls', nargs='+', default=None,
+                    help="default: xla + (pallas on tpu | "
+                         "pallas_interpret elsewhere)")
+    args = ap.parse_args()
+
+    on_tpu = jax.default_backend() == 'tpu'
+    impls = args.impls or ['xla', 'pallas' if on_tpu else
+                           'pallas_interpret']
+    print(f'device: {jax.devices()[0]}; B={args.batch} H={args.heads} '
+          f'D={args.d_head}; fwd+bwd causal attention')
+
+    for L in args.seq_lens:
+        rng = np.random.RandomState(0)
+        shape = (args.batch, args.heads, L, args.d_head)
+        q = jnp.asarray(rng.randn(*shape), jnp.float32)
+        k = jnp.asarray(rng.randn(*shape), jnp.float32)
+        v = jnp.asarray(rng.randn(*shape), jnp.float32)
+        outs = {}
+        for impl in impls:
+            def loss(q, k, v, impl=impl):
+                out = ring_attention(q, k, v, axis_name=None, causal=True,
+                                     block_impl=impl)
+                return (out.astype(jnp.float32) ** 2).sum()
+
+            fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+            try:
+                t = timeit(fn, q, k, v, warmup=1, iters=3)
+                outs[impl] = float(fn(q, k, v)[0])
+                print(f'  L={L:>7} {impl:>17}: {t * 1e3:>9.2f} ms '
+                      f'({args.batch * L / t / 1e3:>8.1f}K tok/s)')
+            except Exception as e:
+                print(f'  L={L:>7} {impl:>17}: failed '
+                      f'({type(e).__name__}: {str(e)[:80]})')
+        if len(outs) == 2:
+            vals = list(outs.values())
+            rel = abs(vals[0] - vals[1]) / max(abs(vals[0]), 1e-9)
+            print(f'  L={L:>7} loss agreement: rel diff {rel:.2e}')
+
+
+if __name__ == '__main__':
+    main()
