@@ -60,7 +60,7 @@ def parse_args():
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp')
     p.add_argument('--stat-decay', type=float, default=0.95)
-    p.add_argument('--damping', type=float, default=0.003)
+    p.add_argument('--damping', type=float, default=0.03)
     p.add_argument('--kl-clip', type=float, default=0.001)
     p.add_argument('--exclude-parts', default='')
     p.add_argument('--num-devices', type=int, default=1)
@@ -163,7 +163,9 @@ def main():
         tx = optax.chain(optax.scale_by_adam(b1=0.9, b2=0.98, eps=1e-9),
                          optax.scale_by_learning_rate(lr_fn))
     else:
-        lr_fn = utils.warmup_multistep(args.base_lr, 100, 5, args.lr_decay)
+        steps_per_epoch = max(len(train_src) // args.batch_size, 1)
+        lr_fn = utils.warmup_multistep(args.base_lr, steps_per_epoch, 5,
+                                       args.lr_decay)
         tx = training.sgd(lr_fn, momentum=0.9, weight_decay=5e-4)
 
     precond = None

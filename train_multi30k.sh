@@ -8,7 +8,7 @@ base_lr="${base_lr:-0.1}"
 kfac="${kfac:-1}"
 fac="${fac:-1}"
 kfac_name="${kfac_name:-eigen_dp}"
-damping="${damping:-0.003}"
+damping="${damping:-0.03}"
 nworkers="${nworkers:-1}"
 
 params="--batch-size $batch_size --epochs $epochs --optimizer $optimizer \
