@@ -1,0 +1,68 @@
+"""Update-frequency gating: the trainer must pick compiled step variants
+so factor/inverse state changes ONLY on schedule steps (reference
+steps-%-freq gating, kfac_preconditioner_base.py:198-213, with the hook
+cost gated out on non-update steps, :122-130)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import models, training
+
+
+def _setup(fac_freq, inv_freq):
+    model = models.get_model('resnet20')
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
+                        fac_update_freq=fac_freq,
+                        kfac_update_freq=inv_freq)
+    tx = training.sgd(0.1, momentum=0.9)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16, 16, 3),
+                    jnp.float32)
+    batch = {'input': x, 'label': jnp.asarray([0, 1, 2, 3])}
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0), x)
+
+    def ce(outputs, b):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, b['label']).mean()
+
+    step = training.build_train_step(model, tx, precond, ce,
+                                     extra_mutable=('batch_stats',),
+                                     donate=False)
+    return step, state, batch
+
+
+def _norms(state):
+    f = float(sum(jnp.abs(x).sum()
+                  for x in jax.tree.leaves(state.kfac_state.factors)))
+    d = float(sum(jnp.abs(x).sum()
+                  for x in jax.tree.leaves(state.kfac_state.decomp)))
+    return f, d
+
+
+def test_factor_and_inverse_update_only_on_schedule():
+    step, state, batch = _setup(fac_freq=2, inv_freq=4)
+    f_hist, d_hist = [], []
+    prev_f, prev_d = _norms(state)
+    for i in range(8):
+        state, _ = step(state, batch, lr=0.1, damping=0.003)
+        f, d = _norms(state)
+        f_hist.append(f != prev_f)
+        d_hist.append(d != prev_d)
+        prev_f, prev_d = f, d
+    # factors change on steps 0, 2, 4, 6 (0-indexed step counter)
+    assert f_hist == [True, False, True, False, True, False, True, False]
+    # decomposition changes on steps 0 and 4
+    assert d_hist == [True, False, False, False, True, False, False, False]
+
+
+def test_params_update_every_step_regardless():
+    step, state, batch = _setup(fac_freq=5, inv_freq=5)
+    prev = jax.tree.leaves(state.params)[0]
+    for _ in range(3):
+        state, _ = step(state, batch, lr=0.1, damping=0.003)
+        cur = jax.tree.leaves(state.params)[0]
+        assert not np.allclose(np.asarray(prev), np.asarray(cur))
+        prev = cur
