@@ -1,0 +1,158 @@
+"""Golden cross-implementation parity: run the ACTUAL reference
+implementation (torch CPU, /root/reference, read-only) and this framework
+on identical weights and data, and compare the preconditioned gradients.
+
+This is the strongest parity evidence available: not an oracle we wrote,
+but the reference's own numerics. Skipped when the reference checkout or
+torch is unavailable."""
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REF = '/root/reference'
+pytestmark = pytest.mark.skipif(not os.path.isdir(os.path.join(REF, 'kfac')),
+                                reason='reference checkout not available')
+
+B, DIN, DH, DOUT = 16, 4, 8, 3
+LR, DAMPING, KL_CLIP, DECAY = 0.1, 0.01, 0.001, 0.95
+
+
+@pytest.fixture(scope='module')
+def torch_side():
+    torch = pytest.importorskip('torch')
+    import torch.distributed as dist
+
+    if 'horovod' not in sys.modules:  # stub so kfac.backend imports
+        hvd = types.ModuleType('horovod.torch')
+        hvd.init = lambda *a, **k: None
+        sys.modules['horovod'] = types.ModuleType('horovod')
+        sys.modules['horovod.torch'] = hvd
+    sys.path.insert(0, REF)
+    os.environ.setdefault('MASTER_ADDR', '127.0.0.1')
+    os.environ.setdefault('MASTER_PORT', '29572')
+    if not dist.is_initialized():
+        dist.init_process_group('gloo', rank=0, world_size=1)
+    import kfac as ref_kfac
+    import kfac.backend as ref_backend
+    ref_backend.init('Torch')
+    return torch, ref_kfac
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(B, DIN).astype(np.float32),
+            rng.randint(0, DOUT, B),
+            rng.randn(DH, DIN).astype(np.float32) * 0.5,   # w1 [out, in]
+            rng.randn(DH).astype(np.float32) * 0.1,
+            rng.randn(DOUT, DH).astype(np.float32) * 0.5,  # w2 [out, in]
+            rng.randn(DOUT).astype(np.float32) * 0.1)
+
+
+def _reference_precond_grads(torch, ref_kfac, variant, steps=1):
+    x, y, w1, b1, w2, b2 = _data()
+    model = torch.nn.Sequential(torch.nn.Linear(DIN, DH), torch.nn.ReLU(),
+                                torch.nn.Linear(DH, DOUT))
+    with torch.no_grad():
+        model[0].weight.copy_(torch.from_numpy(w1))
+        model[0].bias.copy_(torch.from_numpy(b1))
+        model[2].weight.copy_(torch.from_numpy(w2))
+        model[2].bias.copy_(torch.from_numpy(b2))
+    pre = ref_kfac.get_kfac_module(variant)(
+        model, lr=LR, damping=DAMPING, fac_update_freq=1,
+        kfac_update_freq=1, kl_clip=KL_CLIP, factor_decay=DECAY)
+    for _ in range(steps):
+        model.zero_grad()
+        loss = torch.nn.functional.cross_entropy(
+            model(torch.from_numpy(x)), torch.from_numpy(y))
+        loss.backward()
+        pre.step()
+    return {
+        'w1': model[0].weight.grad.numpy().copy(),
+        'b1': model[0].bias.grad.numpy().copy(),
+        'w2': model[2].weight.grad.numpy().copy(),
+        'b2': model[2].bias.grad.numpy().copy(),
+    }
+
+
+def _ours_precond_grads(variant, steps=1):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import linen
+
+    import kfac_pytorch_tpu as kfac
+    from kfac_pytorch_tpu import capture
+    from kfac_pytorch_tpu import nn as knn
+
+    x, y, w1, b1, w2, b2 = _data()
+
+    class MLP(linen.Module):
+        @linen.compact
+        def __call__(self, x):
+            x = knn.Dense(DH, name='l1')(x)
+            x = linen.relu(x)
+            return knn.Dense(DOUT, name='l2')(x)
+
+    model = MLP()
+    variables = capture.init(model, jax.random.PRNGKey(0), jnp.asarray(x))
+    params = {'l1': {'kernel': jnp.asarray(w1.T), 'bias': jnp.asarray(b1)},
+              'l2': {'kernel': jnp.asarray(w2.T), 'bias': jnp.asarray(b2)}}
+
+    pre = kfac.get_kfac_module(variant)(
+        lr=LR, damping=DAMPING, fac_update_freq=1, kfac_update_freq=1,
+        kl_clip=KL_CLIP, factor_decay=DECAY)
+    metas = capture.collect_layer_meta(model, {'params': params},
+                                      jnp.asarray(x))
+    pre.setup(metas)
+    state = pre.init()
+
+    def loss_fn(outputs):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, jnp.asarray(y)).mean()
+
+    for _ in range(steps):
+        _, _, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+            model, loss_fn, {'params': params}, jnp.asarray(x))
+        new_grads, state = pre.step(state, grads, acts, gs)
+    return {
+        'w1': np.asarray(new_grads['l1']['kernel']).T,
+        'b1': np.asarray(new_grads['l1']['bias']),
+        'w2': np.asarray(new_grads['l2']['kernel']).T,
+        'b2': np.asarray(new_grads['l2']['bias']),
+    }
+
+
+# Multi-step parity holds for the eigen variants. The inverse variants
+# intentionally deviate after step 1: the reference's _add_value_to_diagonal
+# mutates damping into its STORED running-average factors in place
+# (inv.py:106-129), so damping compounds across inverse updates there;
+# this framework applies damping to a temporary (see engine.py module doc).
+@pytest.mark.parametrize('variant,steps', [
+    ('eigen_dp', 1), ('inverse_dp', 1), ('eigen', 1), ('inverse', 1),
+    ('eigen_dp', 3), ('eigen', 3),
+])
+def test_preconditioned_grads_match_reference(torch_side, variant, steps):
+    torch, ref_kfac = torch_side
+    ref = _reference_precond_grads(torch, ref_kfac, variant, steps)
+    ours = _ours_precond_grads(variant, steps)
+    for k in ref:
+        np.testing.assert_allclose(
+            ours[k], ref[k], atol=2e-4, rtol=2e-3,
+            err_msg=f'{variant} step{steps} param {k}')
+
+
+@pytest.mark.parametrize('variant', ['inverse_dp', 'inverse'])
+def test_inverse_multistep_deviation_is_bounded(torch_side, variant):
+    """The documented damping-accumulation deviation stays small (the
+    reference compounds +sqrt(damping)*pi onto its factors each update)."""
+    torch, ref_kfac = torch_side
+    ref = _reference_precond_grads(torch, ref_kfac, variant, 3)
+    ours = _ours_precond_grads(variant, 3)
+    for k in ref:
+        denom = np.abs(ref[k]).max()
+        rel = np.abs(ours[k] - ref[k]).max() / max(denom, 1e-9)
+        assert rel < 0.15, (variant, k, rel)
