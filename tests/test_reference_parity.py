@@ -145,6 +145,102 @@ def test_preconditioned_grads_match_reference(torch_side, variant, steps):
             err_msg=f'{variant} step{steps} param {k}')
 
 
+def _conv_data(seed=3):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(8, 3, 6, 6).astype(np.float32),      # NCHW for torch
+            rng.randint(0, DOUT, 8),
+            rng.randn(4, 3, 3, 3).astype(np.float32) * 0.4,  # [out,in,kh,kw]
+            rng.randn(4).astype(np.float32) * 0.1,
+            rng.randn(DOUT, 4 * 6 * 6).astype(np.float32) * 0.2,
+            rng.randn(DOUT).astype(np.float32) * 0.1)
+
+
+def _reference_conv_grads(torch, ref_kfac, variant):
+    x, y, wc, bc, wl, bl = _conv_data()
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 4, 3, stride=1, padding=1), torch.nn.ReLU(),
+        torch.nn.Flatten(), torch.nn.Linear(4 * 6 * 6, DOUT))
+    with torch.no_grad():
+        model[0].weight.copy_(torch.from_numpy(wc))
+        model[0].bias.copy_(torch.from_numpy(bc))
+        model[3].weight.copy_(torch.from_numpy(wl))
+        model[3].bias.copy_(torch.from_numpy(bl))
+    pre = ref_kfac.get_kfac_module(variant)(
+        model, lr=LR, damping=DAMPING, fac_update_freq=1,
+        kfac_update_freq=1, kl_clip=KL_CLIP, factor_decay=DECAY)
+    model.zero_grad()
+    loss = torch.nn.functional.cross_entropy(
+        model(torch.from_numpy(x)), torch.from_numpy(y))
+    loss.backward()
+    pre.step()
+    return {'conv_w': model[0].weight.grad.numpy().copy(),
+            'conv_b': model[0].bias.grad.numpy().copy(),
+            'fc_w': model[3].weight.grad.numpy().copy(),
+            'fc_b': model[3].bias.grad.numpy().copy()}
+
+
+def _ours_conv_grads(variant):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import linen
+
+    import kfac_pytorch_tpu as kfac
+    from kfac_pytorch_tpu import capture
+    from kfac_pytorch_tpu import nn as knn
+
+    x, y, wc, bc, wl, bl = _conv_data()
+    x_nhwc = np.transpose(x, (0, 2, 3, 1))
+
+    class CNN(linen.Module):
+        @linen.compact
+        def __call__(self, x):
+            x = knn.Conv(4, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)),
+                         name='c')(x)
+            x = linen.relu(x)
+            # match torch Flatten of NCHW: [N, C*H*W] with C outermost
+            x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+            return knn.Dense(DOUT, name='f')(x)
+
+    model = CNN()
+    params = {
+        'c': {'kernel': jnp.asarray(np.transpose(wc, (2, 3, 1, 0))),
+              'bias': jnp.asarray(bc)},
+        'f': {'kernel': jnp.asarray(wl.T), 'bias': jnp.asarray(bl)},
+    }
+    pre = kfac.get_kfac_module(variant)(
+        lr=LR, damping=DAMPING, fac_update_freq=1, kfac_update_freq=1,
+        kl_clip=KL_CLIP, factor_decay=DECAY)
+    metas = capture.collect_layer_meta(model, {'params': params},
+                                      jnp.asarray(x_nhwc))
+    pre.setup(metas)
+    state = pre.init()
+
+    def loss_fn(outputs):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, jnp.asarray(y)).mean()
+
+    _, _, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+        model, loss_fn, {'params': params}, jnp.asarray(x_nhwc))
+    new_grads, state = pre.step(state, grads, acts, gs)
+    return {'conv_w': np.transpose(np.asarray(new_grads['c']['kernel']),
+                                   (3, 2, 0, 1)),
+            'conv_b': np.asarray(new_grads['c']['bias']),
+            'fc_w': np.asarray(new_grads['f']['kernel']).T,
+            'fc_b': np.asarray(new_grads['f']['bias'])}
+
+
+@pytest.mark.parametrize('variant', ['eigen_dp', 'inverse_dp'])
+def test_conv_preconditioned_grads_match_reference(torch_side, variant):
+    torch, ref_kfac = torch_side
+    ref = _reference_conv_grads(torch, ref_kfac, variant)
+    ours = _ours_conv_grads(variant)
+    for k in ref:
+        np.testing.assert_allclose(
+            ours[k], ref[k], atol=5e-4, rtol=5e-3,
+            err_msg=f'{variant} param {k}')
+
+
 @pytest.mark.parametrize('variant', ['inverse_dp', 'inverse'])
 def test_inverse_multistep_deviation_is_bounded(torch_side, variant):
     """The documented damping-accumulation deviation stays small (the
