@@ -241,6 +241,38 @@ def test_conv_preconditioned_grads_match_reference(torch_side, variant):
             err_msg=f'{variant} param {k}')
 
 
+def test_param_scheduler_matches_reference(torch_side):
+    """KFACParamScheduler epoch-decay parity (reference base.py:233-301)."""
+    torch, ref_kfac = torch_side
+    from kfac_pytorch_tpu import KFACParamScheduler
+    import kfac_pytorch_tpu as kfac
+
+    model = torch.nn.Sequential(torch.nn.Linear(DIN, DOUT))
+    ref_pre = ref_kfac.get_kfac_module('eigen_dp')(
+        model, lr=LR, damping=0.03, fac_update_freq=2, kfac_update_freq=10)
+    ref_sched = ref_kfac.KFACParamScheduler(
+        ref_pre, damping_alpha=0.5, damping_schedule=[3, 6],
+        update_freq_alpha=10, update_freq_schedule=[4])
+
+    ours_pre = kfac.KFAC(variant='eigen_dp', lr=LR, damping=0.03,
+                         fac_update_freq=2, kfac_update_freq=10)
+    ours_sched = KFACParamScheduler(
+        ours_pre, damping_alpha=0.5, damping_schedule=[3, 6],
+        update_freq_alpha=10, update_freq_schedule=[4])
+
+    for epoch in range(1, 9):
+        ref_sched.step(epoch)
+        ours_sched.step(epoch)
+        # the reference publishes live values through param_groups, which
+        # the preconditioner reads back each step (base.py:188-193)
+        g = ref_pre.param_groups[0]
+        np.testing.assert_allclose(ours_pre.damping, g['damping'],
+                                   err_msg=f'epoch {epoch}')
+        assert ours_pre.fac_update_freq == int(g['fac_update_freq']), epoch
+        assert ours_pre.kfac_update_freq == int(g['kfac_update_freq']), \
+            epoch
+
+
 @pytest.mark.parametrize('variant', ['inverse_dp', 'inverse'])
 def test_inverse_multistep_deviation_is_bounded(torch_side, variant):
     """The documented damping-accumulation deviation stays small (the
