@@ -157,8 +157,12 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         if precond is None:
             uf = ui = False
         else:
-            uf = precond.should_update_factors(step)
-            ui = precond.should_update_inverse(step)
+            # hook_enabled=False freezes factor capture/updates (reference
+            # set_hook_enabled, kfac_preconditioner_base.py:117-130); the
+            # existing decomposition keeps preconditioning
+            enabled = getattr(precond, 'hook_enabled', True)
+            uf = enabled and precond.should_update_factors(step)
+            ui = enabled and precond.should_update_inverse(step)
         key = (uf, ui)
         if key not in variants:
             variants[key] = make_variant(uf, ui)
