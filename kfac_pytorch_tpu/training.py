@@ -76,7 +76,8 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     preconditioner's host-side update frequencies.
     """
 
-    def one_step(state, batch, hyper, update_factors, update_inverse):
+    def one_step(state, batch, hyper, update_factors, update_inverse,
+                 bypass_precond=False):
         x = batch['input']
         variables = {'params': state.params, **state.extra_vars}
         use_capture = precond is not None and update_factors
@@ -110,7 +111,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         loss = coll.pmean(loss, axis_name)
 
         kfac_state = state.kfac_state
-        if precond is not None:
+        if precond is not None and not bypass_precond:
             grads, kfac_state = precond.step(
                 kfac_state, grads, acts, gs, hyper=hyper,
                 update_factors=update_factors,
@@ -134,9 +135,10 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
 
     state_specs_cache = {}
 
-    def make_variant(update_factors, update_inverse):
+    def make_variant(update_factors, update_inverse, bypass_precond=False):
         fn = functools.partial(one_step, update_factors=update_factors,
-                               update_inverse=update_inverse)
+                               update_inverse=update_inverse,
+                               bypass_precond=bypass_precond)
         if axis_name is None:
             return jax.jit(fn, donate_argnums=(0,) if donate else ())
         kspecs = (precond.state_pspecs(axis_name) if precond is not None
@@ -151,19 +153,36 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
     variants = {}
+    seen_inverse = {}  # host-side: does a decomposition exist yet?
 
     def step_fn(state, batch, lr=None, damping=None):
         step = int(state.step)
+        if 'yes' not in seen_inverse:
+            # one-time: a restored checkpoint may already carry a
+            # decomposition (utils/checkpoint.py include_kfac=True)
+            seen_inverse['yes'] = bool(
+                state.kfac_state is not None
+                and any(bool(jnp.any(x != 0))
+                        for x in jax.tree.leaves(state.kfac_state.decomp)))
         if precond is None:
             uf = ui = False
         else:
             # hook_enabled=False freezes factor capture/updates (reference
             # set_hook_enabled, kfac_preconditioner_base.py:117-130); the
-            # existing decomposition keeps preconditioning
+            # existing decomposition keeps preconditioning. Before ANY
+            # decomposition exists, preconditioning would apply zeros —
+            # pass gradients through instead (the reference would have no
+            # factors to read at all in that state).
             enabled = getattr(precond, 'hook_enabled', True)
             uf = enabled and precond.should_update_factors(step)
             ui = enabled and precond.should_update_inverse(step)
+            seen_inverse['yes'] = seen_inverse['yes'] or ui
         key = (uf, ui)
+        if precond is not None and not seen_inverse['yes']:
+            key = (False, False, 'passthrough')
+            if key not in variants:
+                variants[key] = make_variant(False, False,
+                                             bypass_precond=True)
         if key not in variants:
             variants[key] = make_variant(uf, ui)
         hyper = KFACHyperParams(
