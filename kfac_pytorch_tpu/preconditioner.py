@@ -211,7 +211,7 @@ class KFAC:
     def step(self, state: KFACState, grads, acts=None, gs=None,
              hyper: Optional[KFACHyperParams] = None, *,
              update_factors: bool = True, update_inverse: bool = True,
-             axis_name: str = '__default__'):
+             factors_only: bool = False, axis_name: str = '__default__'):
         """One K-FAC step: (state, grads, captured stats) ->
         (preconditioned grads, new state).
 
@@ -246,6 +246,13 @@ class KFAC:
                 reduce = 'local'
             factors = engine.update_factors(
                 plan, factors, stats, self.factor_decay, reduce, axis_name)
+
+        if factors_only:
+            # accumulate statistics but leave gradients untouched — used
+            # before the first decomposition exists (an all-zero decomp
+            # would zero the gradients)
+            return grads, state.replace(step=state.step + 1,
+                                        factors=factors)
 
         if self.exclude_compute_inverse:
             # ablation: no decomposition -> grads pass through

@@ -77,7 +77,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     """
 
     def one_step(state, batch, hyper, update_factors, update_inverse,
-                 bypass_precond=False):
+                 factors_only=False):
         x = batch['input']
         variables = {'params': state.params, **state.extra_vars}
         use_capture = precond is not None and update_factors
@@ -111,11 +111,12 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         loss = coll.pmean(loss, axis_name)
 
         kfac_state = state.kfac_state
-        if precond is not None and not bypass_precond:
+        if precond is not None:
             grads, kfac_state = precond.step(
                 kfac_state, grads, acts, gs, hyper=hyper,
                 update_factors=update_factors,
-                update_inverse=update_inverse, axis_name=axis_name)
+                update_inverse=update_inverse, factors_only=factors_only,
+                axis_name=axis_name)
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -135,10 +136,10 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
 
     state_specs_cache = {}
 
-    def make_variant(update_factors, update_inverse, bypass_precond=False):
+    def make_variant(update_factors, update_inverse, factors_only=False):
         fn = functools.partial(one_step, update_factors=update_factors,
                                update_inverse=update_inverse,
-                               bypass_precond=bypass_precond)
+                               factors_only=factors_only)
         if axis_name is None:
             return jax.jit(fn, donate_argnums=(0,) if donate else ())
         kspecs = (precond.state_pspecs(axis_name) if precond is not None
@@ -170,19 +171,18 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             # hook_enabled=False freezes factor capture/updates (reference
             # set_hook_enabled, kfac_preconditioner_base.py:117-130); the
             # existing decomposition keeps preconditioning. Before ANY
-            # decomposition exists, preconditioning would apply zeros —
-            # pass gradients through instead (the reference would have no
-            # factors to read at all in that state).
+            # decomposition exists the gradients pass through unmodified
+            # while factor statistics still accumulate on schedule (the
+            # reference would have no factors to read at all here).
             enabled = getattr(precond, 'hook_enabled', True)
             uf = enabled and precond.should_update_factors(step)
             ui = enabled and precond.should_update_inverse(step)
             seen_inverse['yes'] = seen_inverse['yes'] or ui
         key = (uf, ui)
         if precond is not None and not seen_inverse['yes']:
-            key = (False, False, 'passthrough')
+            key = (uf, False, 'factors_only')
             if key not in variants:
-                variants[key] = make_variant(False, False,
-                                             bypass_precond=True)
+                variants[key] = make_variant(uf, False, factors_only=True)
         if key not in variants:
             variants[key] = make_variant(uf, ui)
         hyper = KFACHyperParams(

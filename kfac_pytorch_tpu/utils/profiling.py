@@ -50,27 +50,25 @@ def time_steps(step_fn, state, batch, iters=30, warmup=5, **kw):
     return float(np.mean(times)), float(np.std(times)), state
 
 
-def exclude_parts_breakdown(make_step, state_factory, batch, iters=20,
-                            **kw):
+def exclude_parts_breakdown(make_step, batch, iters=20, **kw):
     """Attribute per-phase cost by ablation subtraction.
 
-    ``make_step(exclude_parts) -> step_fn`` builds a step with the given
-    phases excluded; ``state_factory()`` returns a fresh train state.
+    ``make_step(exclude_parts) -> (step_fn, fresh_state)`` builds a step
+    with the given phases excluded plus a matching fresh train state.
     Returns ``{phase: seconds}`` with 'Total' and the subtraction-derived
     per-phase costs (cumulative ablation, reference parse_logs.py:44-73).
     """
     results = {}
     excluded = []
-    prev = None
-    t_full, _, _ = time_steps(make_step(''), state_factory(), batch,
-                              iters=iters, **kw)
+    step, state = make_step('')
+    t_full, _, _ = time_steps(step, state, batch, iters=iters, **kw)
     results['Total'] = t_full
     prev = t_full
     for phase in ('CommunicateInverse', 'ComputeInverse',
                   'CommunicateFactor', 'ComputeFactor'):
         excluded.append(phase)
-        t, _, _ = time_steps(make_step(','.join(excluded)), state_factory(),
-                             batch, iters=iters, **kw)
+        step, state = make_step(','.join(excluded))
+        t, _, _ = time_steps(step, state, batch, iters=iters, **kw)
         results[phase] = max(prev - t, 0.0)
         prev = t
     results['Rest'] = prev

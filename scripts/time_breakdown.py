@@ -70,16 +70,8 @@ def main():
                                          extra_mutable=('batch_stats',))
         return step, state
 
-    last = {}
-
-    def build(excl):
-        step, state = make_step(excl)
-        last['state'] = state  # fresh state matching this step's precond
-        return step
-
     breakdown = profiling.exclude_parts_breakdown(
-        build, lambda: last['state'], batch, iters=args.iters,
-        lr=0.1, damping=0.003)
+        make_step, batch, iters=args.iters, lr=0.1, damping=0.003)
 
     # SGD reference (no preconditioner at all)
     state = training.init_train_state(model, tx, None, jax.random.PRNGKey(0),

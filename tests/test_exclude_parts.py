@@ -11,29 +11,11 @@ import pytest
 
 import kfac_pytorch_tpu as kfac
 from kfac_pytorch_tpu import models, training
-
-
-class _TinyCNN:
-    """Small conv+dense model so each compiled variant is cheap."""
-
-    def __new__(cls):
-        import flax.linen as linen
-        from kfac_pytorch_tpu import nn as knn
-
-        class M(linen.Module):
-            @linen.compact
-            def __call__(self, x, train=True):
-                x = knn.Conv(8, (3, 3), name='c1')(x)
-                x = linen.relu(x)
-                x = knn.Conv(8, (3, 3), strides=(2, 2), name='c2')(x)
-                x = linen.relu(x)
-                x = x.reshape(x.shape[0], -1)
-                return knn.Dense(10, name='fc')(x)
-        return M()
+from tests.helpers import TinyCNN
 
 
 def _run_steps(exclude_parts, n=2, variant='eigen_dp'):
-    model = _TinyCNN()
+    model = TinyCNN()
     precond = kfac.KFAC(variant=variant, lr=0.1, damping=0.003,
                         exclude_parts=exclude_parts)
     tx = training.sgd(0.1, momentum=0.9)

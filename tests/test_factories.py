@@ -59,9 +59,8 @@ def test_exclude_parts_breakdown_shape():
     def make_step(excl):
         def step(state, batch, **kw):
             return state, jnp.float32(len(excl))
-        return step
+        return step, 0
 
-    out = profiling.exclude_parts_breakdown(make_step, lambda: 0, None,
-                                            iters=2)
+    out = profiling.exclude_parts_breakdown(make_step, None, iters=2)
     assert set(out) == {'Total', 'Rest'} | set(profiling.PHASES)
     assert all(v >= 0 for v in out.values())

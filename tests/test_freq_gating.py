@@ -10,29 +10,11 @@ import optax
 
 import kfac_pytorch_tpu as kfac
 from kfac_pytorch_tpu import models, training
-
-
-class _TinyCNN:
-    """Small conv+dense model so each compiled variant is cheap."""
-
-    def __new__(cls):
-        import flax.linen as linen
-        from kfac_pytorch_tpu import nn as knn
-
-        class M(linen.Module):
-            @linen.compact
-            def __call__(self, x, train=True):
-                x = knn.Conv(8, (3, 3), name='c1')(x)
-                x = linen.relu(x)
-                x = knn.Conv(8, (3, 3), strides=(2, 2), name='c2')(x)
-                x = linen.relu(x)
-                x = x.reshape(x.shape[0], -1)
-                return knn.Dense(10, name='fc')(x)
-        return M()
+from tests.helpers import TinyCNN
 
 
 def _setup(fac_freq, inv_freq):
-    model = _TinyCNN()
+    model = TinyCNN()
     precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
                         fac_update_freq=fac_freq,
                         kfac_update_freq=inv_freq)
@@ -88,18 +70,7 @@ def test_params_update_every_step_regardless():
 
 
 def test_hook_enabled_false_freezes_factor_state():
-    step, state, batch = _setup(fac_freq=1, inv_freq=1)
-    state, _ = step(state, batch, lr=0.1, damping=0.003)  # warm factors
-    f0, d0 = _norms(state)
-    # disable hooks: factor/decomp state must freeze, params keep moving
-    import kfac_pytorch_tpu  # noqa: F401
-    # rebuild a fresh setup to flip the flag cleanly
-    import jax, optax
-    import jax.numpy as jnp
-    import numpy as np
-    import kfac_pytorch_tpu as kfac
-    from kfac_pytorch_tpu import models, training
-    model = _TinyCNN()
+    model = TinyCNN()
     precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
                         fac_update_freq=1, kfac_update_freq=1,
                         hook_enabled=False)
