@@ -185,10 +185,13 @@ def main():
         return loss
 
     if mesh is not None:
+        from kfac_pytorch_tpu.parallel.ring_attention import (
+            interpreted_attention_active)
         eval_step = jax.jit(jax.shard_map(
             eval_loss_local, mesh=mesh,
             in_specs=(P(), {'input': bspec, 'label': bspec}),
-            out_specs=P()))
+            out_specs=P(),
+            check_vma=not interpreted_attention_active()))
     else:
         eval_step = jax.jit(eval_loss_local)
 

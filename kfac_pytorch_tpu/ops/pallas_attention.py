@@ -8,10 +8,11 @@ it produces the *unnormalized* online-softmax pieces
     l  = sum exp(s - m)
     pv = exp(s - m) @ v       with  s = scale * q k^T + bias
 
-without ever materializing the [Lq, Lk] score matrix in HBM: the kernel
-tiles Lq over the grid, streams K/V tiles through VMEM, and keeps the
-(m, l, acc) recurrence in registers — the flash-attention forward, shaped
-for the MXU (all matmuls `preferred_element_type=f32`).
+without ever materializing the [Lq, Lk] score matrix in HBM: Lq tiles ride
+the grid, K/V tiles ride the innermost grid dimension, and the (m, l, acc)
+online-softmax recurrence lives in VMEM scratch — the flash-attention
+forward, shaped for the MXU (all matmuls `preferred_element_type=f32`) and
+O(tile)-VMEM at any sequence length.
 
 The backward pass (custom VJP) recomputes scores blockwise in JAX from the
 saved (q, k, v, m, l): memory stays O(Lq * TK) and XLA fuses the chain;
@@ -39,20 +40,27 @@ def _round_up(x, m):
 
 
 def _fwd_kernel(meta_ref, q_ref, k_ref, v_ref, mask_ref,
-                m_ref, l_ref, o_ref, *, scale, causal, tk, nk):
+                m_ref, l_ref, o_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, nk):
     iq = pl.program_id(1)
-    tq, d = q_ref.shape[1], q_ref.shape[2]
-    q = q_ref[0]
+    j = pl.program_id(2)
+    tq = q_ref.shape[1]
+    tk = k_ref.shape[1]
     q_start = meta_ref[0]
     k_start = meta_ref[1]
-    qpos = (q_start + iq * tq
-            + lax.broadcasted_iota(jnp.int32, (tq, 1), 0))
 
-    def body(j, carry):
-        m, l, acc = carry
-        kblk = k_ref[0, pl.ds(j * tk, tk), :]
-        vblk = v_ref[0, pl.ds(j * tk, tk), :]
-        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        q = q_ref[0]
+        qpos = (q_start + iq * tq
+                + lax.broadcasted_iota(jnp.int32, (tq, 1), 0))
+        s = jnp.dot(q, k_ref[0].T,
+                    preferred_element_type=jnp.float32) * scale
         kpos = (k_start + j * tk
                 + lax.broadcasted_iota(jnp.int32, (1, tk), 1))
         # additive bias, NOT replacement: masked entries must keep their
@@ -60,24 +68,36 @@ def _fwd_kernel(meta_ref, q_ref, k_ref, v_ref, mask_ref,
         # to the XLA block path and to the recompute backward
         if causal:
             s = s + jnp.where(qpos >= kpos, 0.0, _NEG_INF)
-        mask = mask_ref[0, pl.ds(j * tk, tk)]
-        s = s + jnp.where(mask[None, :] > 0.5, 0.0, _NEG_INF)
-        m_j = jnp.max(s, axis=-1)
+        mask = mask_ref[0]                                 # [1, tk]
+        s = s + jnp.where(mask > 0.5, 0.0, _NEG_INF)
+        m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
+        m_j = jnp.max(s, axis=-1, keepdims=True)           # [tq, 1]
         m_new = jnp.maximum(m, m_j)
-        p = jnp.exp(s - m_new[:, None])
-        c = jnp.exp(m - m_new)
-        l = l * c + p.sum(axis=-1)
-        acc = acc * c[:, None] + jnp.dot(
-            p, vblk.astype(jnp.float32), preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        p = jnp.exp(s - m_new)
+        c = jnp.exp(m - m_new)                             # [tq, 1]
+        m_scr[...] = m_new
+        l_scr[...] = l * c + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc * c + jnp.dot(
+            p, v_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32)
 
-    m0 = jnp.full((tq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((tq,), jnp.float32)
-    acc0 = jnp.zeros((tq, d), jnp.float32)
-    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
-    m_ref[0] = m
-    l_ref[0] = l
-    o_ref[0] = acc
+    if causal:
+        # skip tiles entirely above the diagonal: every (q, k) pair there
+        # contributes exp(-inf)=0, so branching the body away is exact for
+        # the forward (l/pv untouched); the backward guards the one
+        # artifact (m never updated for a fully-skipped row) by clamping
+        # its recompute exponent — see _blockwise_bwd
+        last_q = q_start + (iq + 1) * tq - 1
+        first_k = k_start + j * tk
+        pl.when(last_q >= first_k)(_body)
+    else:
+        _body()
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        m_ref[0] = m_scr[...]
+        l_ref[0] = l_scr[...]
+        o_ref[0] = acc_scr[...]
 
 
 def _pallas_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
@@ -89,22 +109,57 @@ def _pallas_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
     tq = min(128, Lq)
     tk = min(128, Lk)
     meta = jnp.asarray(starts, jnp.int32)
-    grid = (BH, Lq // tq)
+    nk = Lk // tk
+    # K tiles ride the innermost grid dim with the (m, l, acc) recurrence
+    # in VMEM scratch — VMEM stays O(tile) at any Lk (a full-Lk K/V block
+    # double-buffers past the 16M scoped-vmem limit by Lk=8192)
+    grid = (BH, Lq // tq, nk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               tk=tk, nk=Lk // tk)
+                               nk=nk)
+    if causal and not interpret:
+        # clamp the K/V/mask tile index to the last tile the kernel will
+        # actually touch for this q tile: skipped iterations then repeat
+        # the previous block index, which elides the HBM->VMEM copy (the
+        # kernel's pl.when skips their compute; which block sits in VMEM
+        # is irrelevant there). Perf-only — skipped under the interpreter,
+        # whose start-index machinery rejects vma-carrying meta under
+        # shard_map (TPU lowering reads meta from SMEM instead)
+        def _last_tile(iq, meta):
+            # must stay in sync with the kernel's skip condition
+            # (last_q >= first_k): tile of the last k position any q row
+            # of tile iq may attend to
+            return jnp.maximum(
+                (meta[0] + (iq + 1) * tq - 1 - meta[1]) // tk, 0)
+
+        def kv_idx(bh, iq, j, meta):
+            return bh, jnp.minimum(j, _last_tile(iq, meta)), 0
+
+        def mask_idx(bh, iq, j, meta):
+            return bh, 0, jnp.minimum(j, _last_tile(iq, meta))
+    else:
+        kv_idx = lambda bh, iq, j, meta: (bh, j, 0)
+        mask_idx = lambda bh, iq, j, meta: (bh, 0, j)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, tq, D), lambda bh, iq, meta: (bh, iq, 0)),
-            pl.BlockSpec((1, Lk, D), lambda bh, iq, meta: (bh, 0, 0)),
-            pl.BlockSpec((1, Lk, D), lambda bh, iq, meta: (bh, 0, 0)),
-            pl.BlockSpec((1, Lk), lambda bh, iq, meta: (bh, 0)),
+            pl.BlockSpec((1, tq, D), lambda bh, iq, j, meta: (bh, iq, 0)),
+            pl.BlockSpec((1, tk, D), kv_idx),
+            pl.BlockSpec((1, tk, D), kv_idx),
+            # mask carries a singleton row so the block's trailing two dims
+            # (1, tk) satisfy the Mosaic constraint (last two block dims
+            # multiples of (8, 128) or full-size)
+            pl.BlockSpec((1, 1, tk), mask_idx),
         ],
         out_specs=[
-            pl.BlockSpec((1, tq), lambda bh, iq, meta: (bh, iq)),
-            pl.BlockSpec((1, tq), lambda bh, iq, meta: (bh, iq)),
-            pl.BlockSpec((1, tq, D), lambda bh, iq, meta: (bh, iq, 0)),
+            pl.BlockSpec((1, tq, 1), lambda bh, iq, j, meta: (bh, iq, 0)),
+            pl.BlockSpec((1, tq, 1), lambda bh, iq, j, meta: (bh, iq, 0)),
+            pl.BlockSpec((1, tq, D), lambda bh, iq, j, meta: (bh, iq, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tq, 1), jnp.float32),
+            pltpu.VMEM((tq, 1), jnp.float32),
+            pltpu.VMEM((tq, D), jnp.float32),
         ],
     )
     # under shard_map the outputs vary over every axis the inputs do
@@ -112,13 +167,21 @@ def _pallas_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
     for x in (q, k, v):
         vma = vma | getattr(jax.typeof(x), 'vma', frozenset())
     out_shape = [
-        jax.ShapeDtypeStruct((BH, Lq), jnp.float32, vma=vma),
-        jax.ShapeDtypeStruct((BH, Lq), jnp.float32, vma=vma),
+        jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32, vma=vma),
+        jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32, vma=vma),
         jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32, vma=vma),
     ]
-    return pl.pallas_call(kernel, grid_spec=grid_spec,
-                          out_shape=out_shape, interpret=interpret)(
-                              meta, q, k, v, kv_mask)
+    params = {}
+    if not interpret:
+        # the j grid dim carries the scratch recurrence → must stay serial
+        cp = getattr(pltpu, 'CompilerParams', None) or pltpu.TPUCompilerParams
+        params['compiler_params'] = cp(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary'))
+    m, l, pv = pl.pallas_call(kernel, grid_spec=grid_spec,
+                              out_shape=out_shape, interpret=interpret,
+                              **params)(
+                                  meta, q, k, v, kv_mask[:, None, :])
+    return m[..., 0], l[..., 0], pv
 
 
 def _bias(qpos, kpos, causal, kv_mask):
@@ -152,7 +215,13 @@ def _blockwise_bwd(q, k, v, kv_mask, m, dl, dpv, q_start, k_start,
         mblk = (None if kv_mask is None
                 else lax.dynamic_slice_in_dim(kv_mask, j * tk, tk, axis=1))
         s = s + _bias(qpos, kpos, causal, mblk)
-        p = jnp.exp(s - m[..., None])                       # [BH, Lq, tk]
+        # clamp at 0: exact for legitimate entries (m >= rowmax(s) by
+        # construction), and pins p <= 1 for rows whose every tile was
+        # causally skipped in the Pallas forward (m stays at the -1e30
+        # init there; in f32 the -1e30 bias absorbs s_raw so unclamped p
+        # already lands at exp(0)=1 with exactly-zero cotangents, but
+        # that relies on absorption — the clamp is dtype-independent)
+        p = jnp.exp(jnp.minimum(s - m[..., None], 0.0))     # [BH, Lq, tk]
         ds = p * (dl[..., None]
                   + jnp.einsum('bqd,bkd->bqk', dpv, vblk,
                                preferred_element_type=f32))
@@ -189,6 +258,13 @@ def flash_block_attn(q, k, v, kv_mask, starts, scale, causal,
     starts: int32 [2] = (q_start, k_start) global block offsets — may be
     traced (ring callers pass per-device offsets; delivered to the kernel
     via scalar prefetch).
+
+    Fully-skipped causal tiles leave a q row's stats at their init values
+    (m = -1e30 exactly, l = 0, pv = 0) rather than the XLA block path's
+    finite-garbage (rowmax - 1e30, l >= 1) — both combine to a zero
+    contribution downstream, and the backward clamps its recompute
+    exponent so the -1e30 shift cannot overflow (test:
+    test_ring_gradients_finite_with_fully_future_blocks).
     """
     assert q.shape[1] % (8 if q.shape[1] <= 128 else 128) == 0, q.shape
     assert k.shape[1] % (8 if k.shape[1] <= 128 else 128) == 0, k.shape

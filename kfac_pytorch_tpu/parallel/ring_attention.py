@@ -42,6 +42,18 @@ def _default_block_impl():
     return 'pallas' if jax.default_backend() == 'tpu' else 'xla'
 
 
+def interpreted_attention_active():
+    """True when attention blocks resolve to the Pallas interpreter.
+
+    The interpreter's block-index machinery cannot evaluate the kernel's
+    scalar-prefetch meta once shard_map's varying-manual-axes checker has
+    tagged it (per-device ring offsets vary over the seq axis), so any
+    shard_map enclosing interpreted attention must pass check_vma=False
+    — training.build_train_step consults this. TPU lowering is unaffected
+    (meta rides SMEM)."""
+    return _default_block_impl() == 'pallas_interpret'
+
+
 def _block_attn_dispatch(q, k, v, q_start, k_start, causal, kv_mask,
                          scale, block_impl):
     """One streaming block through the selected implementation.

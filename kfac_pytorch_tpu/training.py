@@ -54,7 +54,7 @@ def sgd(lr_schedule, momentum=0.9, weight_decay=0.0, nesterov=False):
 
 def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      extra_mutable=(), sync_extra_vars=True, donate=True,
-                     dropout_seed=None, batch_specs=None):
+                     dropout_seed=None, batch_specs=None, check_vma=None):
     """Build the per-iteration function family.
 
     Args:
@@ -70,6 +70,12 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
       batch_specs: shard_map PartitionSpec (or pytree of specs) for the
         batch; default ``P(axis_name)`` (data-parallel on axis 0). Pass
         e.g. ``P(None, 'seq')`` for sequence-parallel token streams.
+      check_vma: shard_map varying-manual-axes checking. Default (None)
+        enables it except when the environment routes attention through
+        the Pallas interpreter (test-only; its block-index machinery
+        rejects vma-tagged scalar-prefetch args). Pass an explicit bool
+        when selecting ``block_impl='pallas_interpret'`` per-call instead
+        of via KFAC_ATTN_IMPL.
 
     Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
     dispatches between up to four compiled variants using the
@@ -147,10 +153,14 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         sspecs = TrainState(step=P(), params=P(), opt_state=P(),
                             kfac_state=kspecs, extra_vars=P())
         bspecs = P(axis_name) if batch_specs is None else batch_specs
+        from .parallel.ring_attention import interpreted_attention_active
+        vma = (not interpreted_attention_active() if check_vma is None
+               else check_vma)
         sharded = jax.shard_map(
             fn, mesh=mesh,
             in_specs=(sspecs, bspecs, P()),
-            out_specs=(sspecs, P()))
+            out_specs=(sspecs, P()),
+            check_vma=vma)
         return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
     variants = {}
