@@ -56,7 +56,10 @@ def main():
     print(f'device: {jax.devices()[0]}')
     for prec in ['default', 'tensorfloat32', 'highest']:
         with jax.default_matmul_precision(prec):
-            eigh_j = jax.jit(lambda x: ops.sym_eig(x))
+            # pin the baseline to XLA QDWH so an exported
+            # KFAC_EIGH_IMPL=jacobi can't make the A/B compare
+            # jacobi against itself
+            eigh_j = jax.jit(lambda x: ops.sym_eig(x, impl='xla'))
             inv_j = jax.jit(lambda x: ops.psd_inverse(x))
             for d in args.dims:
                 x = spd(rng, args.batch, d)
@@ -64,6 +67,20 @@ def main():
                 ti = timeit(inv_j, x)
                 print(f'prec={prec:14s} dim={d:5d} batch={args.batch} '
                       f'eigh={te * 1e3:9.1f} ms  chol_inv={ti * 1e3:8.1f} ms')
+
+    # batched matmul-form Jacobi vs XLA QDWH eigh (the K-FAC bucket
+    # regime: decompose a whole stacked bucket in one call)
+    jac = jax.jit(lambda x: ops.jacobi_eigh(x))
+    for d in args.dims:
+        if d > 1024:
+            continue  # n^4 matmul form cedes large dims to QDWH
+        x = spd(rng, args.batch, d)
+        tj = timeit(jac, x)
+        w, _ = jac(x)
+        werr = float(jnp.max(jnp.abs(
+            w - jnp.asarray(np.linalg.eigvalsh(np.asarray(x))))))
+        print(f'jacobi_eigh     dim={d:5d} batch={args.batch} '
+              f'{tj * 1e3:9.1f} ms  (max |dw| {werr:.2e})')
 
     # factor GEMM (the ComputeA hot op) at conv-layer shapes
     gemm = jax.jit(lambda a: ops.compute_a_conv(a, (3, 3), (1, 1), (1, 1),
