@@ -56,6 +56,10 @@ def parse_args():
     # K-FAC (reference: pytorch_cifar10_resnet.py:75-95)
     p.add_argument('--kfac-update-freq', type=int, default=10,
                    help='0 disables K-FAC (pure SGD)')
+    p.add_argument('--kfac-basis-update-freq', type=int, default=0,
+                   help='full eigendecomposition cadence; intermediate '
+                        'inverse updates refresh eigenvalues in the '
+                        'retained basis (0 = always full)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp',
                    choices=list(kfac.KFAC_VARIANTS))
@@ -120,6 +124,7 @@ def main():
             lr=args.base_lr, damping=args.damping,
             fac_update_freq=args.kfac_cov_update_freq,
             kfac_update_freq=args.kfac_update_freq,
+            basis_update_freq=(args.kfac_basis_update_freq or None),
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_parts=args.exclude_parts,
             num_devices=args.num_devices,

@@ -51,6 +51,10 @@ def parse_args():
     p.add_argument('--img-size', type=int, default=224)
     # K-FAC (reference defaults: train_imagenet.sh)
     p.add_argument('--kfac-update-freq', type=int, default=1)
+    p.add_argument('--kfac-basis-update-freq', type=int, default=0,
+                   help='full eigendecomposition cadence; intermediate '
+                        'inverse updates refresh eigenvalues in the '
+                        'retained basis (0 = always full)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp')
     p.add_argument('--stat-decay', type=float, default=0.95)
@@ -126,6 +130,7 @@ def main():
             lr=args.base_lr, damping=args.damping,
             fac_update_freq=args.kfac_cov_update_freq,
             kfac_update_freq=args.kfac_update_freq,
+            basis_update_freq=(args.kfac_basis_update_freq or None),
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_parts=args.exclude_parts,
             num_devices=args.num_devices,

@@ -60,6 +60,10 @@ def parse_args():
                    help="size of the 'data' mesh axis")
     p.add_argument('--base-lr', type=float, default=3e-2)
     p.add_argument('--kfac-update-freq', type=int, default=10)
+    p.add_argument('--kfac-basis-update-freq', type=int, default=0,
+                   help='full eigendecomposition cadence; intermediate '
+                        'inverse updates refresh eigenvalues in the '
+                        'retained basis (0 = always full)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp')
     p.add_argument('--damping', type=float, default=0.003)
@@ -156,6 +160,7 @@ def main():
             variant=args.kfac_name, lr=args.base_lr, damping=args.damping,
             fac_update_freq=args.kfac_cov_update_freq,
             kfac_update_freq=args.kfac_update_freq,
+            basis_update_freq=(args.kfac_basis_update_freq or None),
             factor_decay=args.stat_decay, kl_clip=args.kl_clip,
             num_devices=ndev, axis_name=kfac_axis,
             exclude_vocabulary_size=vocab)

@@ -47,6 +47,10 @@ def parse_args():
     p.add_argument('--base-lr', type=float, default=0.04)
     p.add_argument('--warmup-frac', type=float, default=0.1)
     p.add_argument('--kfac-update-freq', type=int, default=10)
+    p.add_argument('--kfac-basis-update-freq', type=int, default=0,
+                   help='full eigendecomposition cadence; intermediate '
+                        'inverse updates refresh eigenvalues in the '
+                        'retained basis (0 = always full)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp')
     p.add_argument('--stat-decay', type=float, default=0.95)
@@ -136,6 +140,7 @@ def main():
             lr=args.base_lr, damping=args.damping,
             fac_update_freq=args.kfac_cov_update_freq,
             kfac_update_freq=args.kfac_update_freq,
+            basis_update_freq=(args.kfac_basis_update_freq or None),
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_vocabulary_size=cfg.vocab_size,
             num_devices=args.num_devices,

@@ -213,6 +213,47 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
     return {'invs': invs}
 
 
+def refresh_decomposition(plan, factors_local, decomp_prev, eps, axis_name,
+                          comm_mode, communicate=True):
+    """Cheap eigen refresh: new eigenvalues in the RETAINED eigenbasis.
+
+    E-KFAC-style amortization (George et al. 2018 re-estimate scalings in
+    a fixed Kronecker eigenbasis): between full eigendecompositions the
+    basis Q drifts slowly, so ``d <- clamp(diag(Q^T F Q))`` re-fits the
+    spectrum to the current running-average factors with two batched
+    matmuls per bucket instead of an eigh. In comm_mode='inverse' only the
+    eigenvalue VECTORS are re-gathered (the replicated basis stays put),
+    shrinking the inverse-comm volume from O(d^2) to O(d) per factor.
+
+    ``decomp_prev`` is the state's decomposition (local rows in 'pred'
+    mode, gathered/replicated in 'inverse' mode); returns a decomposition
+    in the same layout.
+    """
+    evals, evecs_local = {}, {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        q = decomp_prev['evecs'][key]
+        if comm_mode == 'inverse':
+            # replicated (gathered) basis -> this device's rows
+            per_dev = plan.buckets[bdim].per_dev
+            idx = coll.axis_index(axis_name)
+            q = lax.dynamic_slice_in_dim(q, idx * per_dev, per_dev, axis=0)
+        evecs_local[key] = q
+        f = factors_local[key]
+        fq = jnp.einsum('mjk,mki->mji', f, q, precision=_PRED_PRECISION)
+        d = jnp.sum(q * fq, axis=1)
+        evals[key] = ops.clamp_eigvals(d, eps)
+    if comm_mode == 'inverse':
+        if communicate:
+            evals = {k: coll.all_gather_rows(v, axis_name)
+                     for k, v in evals.items()}
+        else:
+            evals = gather_decomposition(plan, evals, axis_name,
+                                         communicate=False)
+        return {'evals': evals, 'evecs': decomp_prev['evecs']}
+    return {'evals': evals, 'evecs': evecs_local}
+
+
 def gather_decomposition(plan, decomp_local, axis_name, communicate=True):
     """All-gather decomposition rows to every device (comm_inverse mode).
 

@@ -83,6 +83,12 @@ class KFAC:
       distribute_layer_factors: eigen variant — put A and G of one layer on
         different devices when the mesh outnumbers layers (eigen.py:66-71);
         default auto.
+      basis_update_freq: eigh variants only (beyond reference) — full
+        eigendecomposition every this-many steps; intermediate
+        ``kfac_update_freq`` hits re-fit only the eigenvalues in the
+        retained eigenbasis (E-KFAC-style amortization, two matmuls per
+        bucket instead of an eigh). None (default) = every inverse update
+        is a full decomposition, the reference cadence.
     """
 
     def __init__(self, variant='eigen_dp', lr=0.1, damping=0.001,
@@ -91,7 +97,8 @@ class KFAC:
                  factor_decay=0.95, exclude_vocabulary_size=None,
                  hook_enabled=True, exclude_parts='', batch_averaged=True,
                  num_devices=1, axis_name=None, assignment='round_robin',
-                 distribute_layer_factors=None, bucket_fn=None, eps=1e-10):
+                 distribute_layer_factors=None, bucket_fn=None, eps=1e-10,
+                 basis_update_freq=None):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -118,6 +125,9 @@ class KFAC:
         self.distribute_layer_factors = distribute_layer_factors
         self.bucket_fn = bucket_fn or default_bucket_fn
         self.eps = eps
+        if basis_update_freq is not None and self.method != 'eigh':
+            raise ValueError('basis_update_freq applies to eigh variants')
+        self.basis_update_freq = basis_update_freq
         # exclude_parts ablation flags (kfac_preconditioner_base.py:96-99)
         self.exclude_communicate_inverse = 'CommunicateInverse' in exclude_parts
         self.exclude_compute_inverse = 'ComputeInverse' in exclude_parts
@@ -206,12 +216,28 @@ class KFAC:
     def should_update_inverse(self, step: int) -> bool:
         return step % self.kfac_update_freq == 0
 
+    def should_update_basis(self, step: int,
+                            last_full_step: Optional[int] = None) -> bool:
+        """Full eigendecomposition vs eigenvalue-only refresh at an
+        inverse-update step (meaningful only when basis_update_freq is
+        set and should_update_inverse(step) holds).
+
+        Staleness-based (steps since the last full decomposition), not
+        step-modulo: a modulo rule would alias against kfac_update_freq
+        (full eigh only at the lcm of the two) and silently starve the
+        basis when KFACParamScheduler rescales kfac_update_freq.
+        """
+        if self.basis_update_freq is None or last_full_step is None:
+            return True
+        return step - last_full_step >= self.basis_update_freq
+
     # -- the step ---------------------------------------------------------
 
     def step(self, state: KFACState, grads, acts=None, gs=None,
              hyper: Optional[KFACHyperParams] = None, *,
              update_factors: bool = True, update_inverse: bool = True,
-             factors_only: bool = False, axis_name: str = '__default__'):
+             update_basis: bool = True, factors_only: bool = False,
+             axis_name: str = '__default__'):
         """One K-FAC step: (state, grads, captured stats) ->
         (preconditioned grads, new state).
 
@@ -260,14 +286,21 @@ class KFAC:
             return grads, state.replace(step=state.step + 1, factors=factors)
 
         if update_inverse:
-            decomp_local = engine.compute_decomposition(
-                plan, factors, damping, self.method, self.eps, axis_name)
-            if self.comm_mode == 'inverse':
-                decomp = engine.gather_decomposition(
-                    plan, decomp_local, axis_name,
+            if self.method == 'eigh' and not update_basis:
+                # eigenvalue-only refresh in the retained eigenbasis
+                decomp = engine.refresh_decomposition(
+                    plan, factors, decomp, self.eps, axis_name,
+                    self.comm_mode,
                     communicate=not self.exclude_communicate_inverse)
             else:
-                decomp = decomp_local
+                decomp_local = engine.compute_decomposition(
+                    plan, factors, damping, self.method, self.eps, axis_name)
+                if self.comm_mode == 'inverse':
+                    decomp = engine.gather_decomposition(
+                        plan, decomp_local, axis_name,
+                        communicate=not self.exclude_communicate_inverse)
+                else:
+                    decomp = decomp_local
 
         grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
         if self.comm_mode == 'inverse':

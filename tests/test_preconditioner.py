@@ -133,6 +133,62 @@ def test_stale_decomposition_reuse():
                                    np.asarray(s2.factors[k]), atol=0)
 
 
+@pytest.mark.parametrize('variant', ['eigen_dp', 'eigen'])
+def test_basis_refresh_exact_with_unchanged_factors(variant):
+    """With factors unchanged, the eigenvalue-only refresh
+    (update_basis=False) reproduces the full eigendecomposition's
+    preconditioning exactly: diag(Q^T F Q) = d when Q is F's eigenbasis."""
+    precond, state, grads, acts, gs, metas = _setup(
+        variant, basis_update_freq=100)
+    g_full, s1 = precond.step(state, grads, acts, gs)
+    # refresh in the retained basis (factors frozen -> same spectrum)
+    g_ref, s2 = precond.step(s1, grads, update_factors=False,
+                             update_inverse=True, update_basis=False)
+    for name in metas:
+        np.testing.assert_allclose(np.asarray(g_full[name]['kernel']),
+                                   np.asarray(g_ref[name]['kernel']),
+                                   rtol=1e-4, atol=1e-5)
+    for k in s1.decomp['evals']:
+        np.testing.assert_allclose(np.asarray(s1.decomp['evals'][k]),
+                                   np.asarray(s2.decomp['evals'][k]),
+                                   rtol=1e-4, atol=1e-5)
+        # basis retained bit-for-bit
+        np.testing.assert_allclose(np.asarray(s1.decomp['evecs'][k]),
+                                   np.asarray(s2.decomp['evecs'][k]), atol=0)
+
+
+def test_basis_refresh_tracks_factor_change():
+    """After a factor update, the refresh re-fits eigenvalues to the NEW
+    factors in the old basis: evals must move toward diag(Q^T F' Q)."""
+    precond, state, grads, acts, gs, metas = _setup(
+        'eigen_dp', basis_update_freq=100)
+    _, s1 = precond.step(state, grads, acts, gs)
+    # second factor update drifts the running averages, then refresh
+    _, s2 = precond.step(s1, grads, acts, gs, update_basis=False)
+    for k in s1.decomp['evals']:
+        q = np.asarray(s1.decomp['evecs'][k])
+        f = np.asarray(s2.factors[k])
+        want = np.einsum('mji,mjk,mki->mi', q, f, q)
+        want = want * (want > precond.eps)
+        np.testing.assert_allclose(np.asarray(s2.decomp['evals'][k]), want,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_basis_update_freq_gating_and_validation():
+    precond, *_ = _setup('eigen_dp', basis_update_freq=30,
+                         kfac_update_freq=10)
+    # staleness-based: no full decomposition yet -> always full; then
+    # full again once 30 steps have passed since the last one —
+    # independent of kfac_update_freq (no lcm aliasing)
+    assert precond.should_update_basis(0, None)
+    assert not precond.should_update_basis(10, 0)
+    assert not precond.should_update_basis(20, 0)
+    assert precond.should_update_basis(30, 0)
+    assert precond.should_update_basis(55, 25)
+    with pytest.raises(ValueError):
+        _setup('inverse_dp', basis_update_freq=10)
+
+
 def test_no_kl_clip_and_plain_passthrough():
     precond, state, grads, acts, gs, metas = _setup('eigen_dp', kl_clip=None)
     new_grads, _ = precond.step(state, grads, acts, gs)
