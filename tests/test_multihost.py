@@ -95,14 +95,17 @@ def test_two_process_distributed_kfac_training():
             try:
                 outs.append(p.communicate(timeout=450)[0])
             except subprocess.TimeoutExpired:
-                # show whatever the peers printed — the stuck worker is
-                # usually blocked on a failed peer's init barrier
-                partial = [o for o in outs]
+                # kill everyone, then read ALL outputs — the stuck worker
+                # is usually blocked on a failed peer's init barrier, so
+                # the root cause lives in the peer's stdout
                 for q in procs:
-                    q.kill()
-                partial.append(p.communicate()[0])
+                    if q.poll() is None:
+                        q.kill()
+                everything = list(outs)
+                for q in procs[len(outs):]:
+                    everything.append(q.communicate()[0])
                 raise AssertionError(
-                    f'worker timed out; outputs so far: {partial}')
+                    f'worker timed out; all outputs: {everything}')
     finally:
         for p in procs:
             if p.poll() is None:

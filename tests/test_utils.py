@@ -101,6 +101,41 @@ def test_synthetic_dataset_deterministic():
     assert y1.min() >= 0 and y1.max() < 10
 
 
+def test_load_cifar10_standard_pickle_format(tmp_path):
+    """Format-compatibility regression: load_cifar10 must read the exact
+    cifar-10-batches-py layout torchvision writes (CHW uint8 rows,
+    bytes-keyed dicts, 5 train batches + test_batch) and produce NHWC
+    uint8 that the Loader then normalizes."""
+    import pickle
+    base = tmp_path / 'cifar-10-batches-py'
+    base.mkdir()
+
+    def blob(n, seed):
+        r = np.random.RandomState(seed)
+        return {b'data': r.randint(0, 256, (n, 3072), dtype=np.uint8),
+                b'labels': r.randint(0, 10, n).tolist()}
+
+    for i in range(1, 6):
+        with open(base / f'data_batch_{i}', 'wb') as f:
+            pickle.dump(blob(20, i), f)
+    with open(base / 'test_batch', 'wb') as f:
+        pickle.dump(blob(12, 9), f)
+
+    (xtr, ytr), (xte, yte) = data.load_cifar10(str(tmp_path))
+    assert xtr.shape == (100, 32, 32, 3) and xtr.dtype == np.uint8
+    assert xte.shape == (12, 32, 32, 3) and ytr.shape == (100,)
+    # CHW->HWC transpose correctness: channel 0 of image 0 must equal the
+    # first 1024 bytes of its row
+    with open(base / 'data_batch_1', 'rb') as f:
+        raw = pickle.load(f, encoding='bytes')[b'data'][0]
+    np.testing.assert_array_equal(xtr[0, :, :, 0].ravel(), raw[:1024])
+    # Loader normalizes uint8 inputs to float32 CIFAR statistics
+    loader = data.Loader(xtr, ytr, batch_size=10, train=False)
+    b = next(loader.epoch())
+    assert b['input'].dtype == np.float32
+    assert abs(float(b['input'].mean())) < 1.0  # roughly standardized
+
+
 def test_loader_shards_cover_dataset():
     x, y = data.synthetic_classification(32, (4, 4, 3), 10, seed=0)
     loader = data.Loader(x, y, batch_size=8, train=False)
