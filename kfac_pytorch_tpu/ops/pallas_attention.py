@@ -39,6 +39,20 @@ def _round_up(x, m):
     return -(-x // m) * m
 
 
+def _diag_k_tile(iq, meta, tq, tk):
+    """Last k-tile index at/below the causal diagonal for q tile ``iq``
+    (meta = [q_start, k_start]). Must stay in sync with the kernels' skip
+    condition ``last_q >= first_k`` — single home for the index-map
+    copy-elision clamps."""
+    return jnp.maximum((meta[0] + (iq + 1) * tq - 1 - meta[1]) // tk, 0)
+
+
+def _diag_q_tile(j, meta, tq, tk, nq):
+    """First q-tile index at/below the causal diagonal for k tile ``j``
+    (dual of :func:`_diag_k_tile` for the transposed dk/dv grid)."""
+    return jnp.clip((meta[1] + j * tk - meta[0]) // tq, 0, nq - 1)
+
+
 def _fwd_kernel(meta_ref, q_ref, k_ref, v_ref, mask_ref,
                 m_ref, l_ref, o_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, nk):
@@ -124,18 +138,11 @@ def _pallas_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
         # is irrelevant there). Perf-only — skipped under the interpreter,
         # whose start-index machinery rejects vma-carrying meta under
         # shard_map (TPU lowering reads meta from SMEM instead)
-        def _last_tile(iq, meta):
-            # must stay in sync with the kernel's skip condition
-            # (last_q >= first_k): tile of the last k position any q row
-            # of tile iq may attend to
-            return jnp.maximum(
-                (meta[0] + (iq + 1) * tq - 1 - meta[1]) // tk, 0)
-
         def kv_idx(bh, iq, j, meta):
-            return bh, jnp.minimum(j, _last_tile(iq, meta)), 0
+            return bh, jnp.minimum(j, _diag_k_tile(iq, meta, tq, tk)), 0
 
         def mask_idx(bh, iq, j, meta):
-            return bh, 0, jnp.minimum(j, _last_tile(iq, meta))
+            return bh, 0, jnp.minimum(j, _diag_k_tile(iq, meta, tq, tk))
     else:
         kv_idx = lambda bh, iq, j, meta: (bh, j, 0)
         mask_idx = lambda bh, iq, j, meta: (bh, 0, j)
@@ -182,6 +189,206 @@ def _pallas_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
                               **params)(
                                   meta, q, k, v, kv_mask[:, None, :])
     return m[..., 0], l[..., 0], pv
+
+
+def _tile_p_ds(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, dpv_ref,
+               iq, j, q_start, k_start, scale, causal):
+    """Shared backward tile recompute: (p, ds, q, kblk, dpv) for the
+    (iq, j) tile. The bias is additive and the exponent clamp matches
+    _blockwise_bwd (exact for valid rows; guards the fully-skipped-row
+    m sentinel) — this is the single home of that convention for both
+    backward kernels."""
+    tq = q_ref.shape[1]
+    tk = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)
+    kblk = k_ref[0].astype(jnp.float32)
+    vblk = v_ref[0].astype(jnp.float32)
+    s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+    qpos = (q_start + iq * tq
+            + lax.broadcasted_iota(jnp.int32, (tq, 1), 0))
+    kpos = (k_start + j * tk
+            + lax.broadcasted_iota(jnp.int32, (1, tk), 1))
+    if causal:
+        s = s + jnp.where(qpos >= kpos, 0.0, _NEG_INF)
+    s = s + jnp.where(mask_ref[0] > 0.5, 0.0, _NEG_INF)
+    p = jnp.exp(jnp.minimum(s - m_ref[0], 0.0))             # [tq, tk]
+    dpv = dpv_ref[0].astype(jnp.float32)
+    ds = p * (dl_ref[0] + jnp.dot(
+        dpv, vblk.T, preferred_element_type=jnp.float32))
+    return p, ds, q, kblk, dpv
+
+
+def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref,
+                   dpv_ref, dq_ref, dq_scr, *, scale, causal, nk):
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    tq = q_ref.shape[1]
+    tk = k_ref.shape[1]
+    q_start = meta_ref[0]
+    k_start = meta_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _body():
+        _, ds, _, kblk, _ = _tile_p_ds(
+            q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, dpv_ref,
+            iq, j, q_start, k_start, scale, causal)
+        dq_scr[...] += jnp.dot(
+            ds, kblk, preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        last_q = q_start + (iq + 1) * tq - 1
+        first_k = k_start + j * tk
+        pl.when(last_q >= first_k)(_body)
+    else:
+        _body()
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref,
+                    dpv_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, nq):
+    j = pl.program_id(1)       # k tile (outer)
+    iq = pl.program_id(2)      # q tile (inner, serial)
+    tq = q_ref.shape[1]
+    tk = k_ref.shape[1]
+    q_start = meta_ref[0]
+    k_start = meta_ref[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _body():
+        p, ds, q, _, dpv = _tile_p_ds(
+            q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, dpv_ref,
+            iq, j, q_start, k_start, scale, causal)
+        dk_scr[...] += jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32) * scale
+        dv_scr[...] += jnp.dot(
+            p.T, dpv, preferred_element_type=jnp.float32)
+
+    if causal:
+        last_q = q_start + (iq + 1) * tq - 1
+        first_k = k_start + j * tk
+        pl.when(last_q >= first_k)(_body)
+    else:
+        _body()
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _pallas_bwd(q, k, v, kv_mask, m, dl, dpv, starts, scale, causal,
+                interpret):
+    """Fused flash backward: dq pass (K tiles innermost) + dk/dv pass
+    (Q tiles innermost), each with its accumulator in VMEM scratch —
+    O(tile) VMEM at any length, same math as :func:`_blockwise_bwd`."""
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    tq = min(128, Lq)
+    tk = min(128, Lk)
+    nq, nk = Lq // tq, Lk // tk
+    meta = jnp.asarray(starts, jnp.int32)
+    mask3 = kv_mask[:, None, :]
+    m3 = m[..., None]
+    dl3 = dl[..., None]
+    vma = frozenset()
+    for x in (q, k, v, dl, dpv):
+        vma = vma | getattr(jax.typeof(x), 'vma', frozenset())
+    params = {}
+    if not interpret:
+        cp = getattr(pltpu, 'CompilerParams', None) or pltpu.TPUCompilerParams
+        params['compiler_params'] = cp(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary'))
+
+    if causal and not interpret:
+        # copy-elision clamps, mirroring _pallas_fwd: skipped iterations
+        # repeat a neighbouring tile index so the HBM->VMEM copy is
+        # elided (perf-only; the kernels' pl.when skips their compute).
+        # dq pass (inner dim = k tiles): clamp j from above to the last
+        # tile at/below the diagonal for this q tile.
+        def kv_inner_idx(bh, a, b, meta):
+            return bh, jnp.minimum(b, _diag_k_tile(a, meta, tq, tk)), 0
+
+        def mask_inner_idx(bh, a, b, meta):
+            return bh, 0, jnp.minimum(b, _diag_k_tile(a, meta, tq, tk))
+
+        # dk/dv pass (inner dim = q tiles): clamp iq from below to the
+        # first q tile at/below the diagonal for this k tile.
+        def q_inner_idx(bh, a, b, meta):
+            return bh, jnp.maximum(b, _diag_q_tile(a, meta, tq, tk, nq)), 0
+
+        qvec_inner_idx = q_inner_idx
+    else:
+        kv_inner_idx = lambda bh, a, b, meta: (bh, b, 0)
+        mask_inner_idx = lambda bh, a, b, meta: (bh, 0, b)
+        q_inner_idx = lambda bh, a, b, meta: (bh, b, 0)
+        qvec_inner_idx = q_inner_idx
+
+    q_by_iq = pl.BlockSpec((1, tq, D), lambda bh, a, b, meta: (bh, a, 0))
+    kv_by_j_inner = pl.BlockSpec((1, tk, D), kv_inner_idx)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nq, nk),
+            in_specs=[
+                q_by_iq,
+                kv_by_j_inner,
+                kv_by_j_inner,
+                pl.BlockSpec((1, 1, tk), mask_inner_idx),
+                pl.BlockSpec((1, tq, 1), lambda bh, a, b, meta: (bh, a, 0)),
+                pl.BlockSpec((1, tq, 1), lambda bh, a, b, meta: (bh, a, 0)),
+                pl.BlockSpec((1, tq, D), lambda bh, a, b, meta: (bh, a, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, tq, D),
+                                   lambda bh, a, b, meta: (bh, a, 0)),
+            scratch_shapes=[pltpu.VMEM((tq, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((BH, Lq, D), q.dtype, vma=vma),
+        interpret=interpret, **params)(
+            meta, q, k, v, mask3, m3, dl3, dpv)
+
+    # second pass: grid transposed — k tiles outer, q tiles inner/serial
+    q_by_iq_inner = pl.BlockSpec((1, tq, D), q_inner_idx)
+    kv_by_j = pl.BlockSpec((1, tk, D), lambda bh, a, b, meta: (bh, a, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          nq=nq),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nk, nq),
+            in_specs=[
+                q_by_iq_inner,
+                kv_by_j,
+                kv_by_j,
+                pl.BlockSpec((1, 1, tk), lambda bh, a, b, meta: (bh, 0, a)),
+                pl.BlockSpec((1, tq, 1), qvec_inner_idx),
+                pl.BlockSpec((1, tq, 1), qvec_inner_idx),
+                pl.BlockSpec((1, tq, D), qvec_inner_idx),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, tk, D), lambda bh, a, b, meta: (bh, a, 0)),
+                pl.BlockSpec((1, tk, D), lambda bh, a, b, meta: (bh, a, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((tk, D), jnp.float32),
+                            pltpu.VMEM((tk, D), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((BH, Lk, D), k.dtype, vma=vma),
+                   jax.ShapeDtypeStruct((BH, Lk, D), v.dtype, vma=vma)],
+        interpret=interpret, **params)(
+            meta, q, k, v, mask3, m3, dl3, dpv)
+    return dq, dk, dv
 
 
 def _bias(qpos, kpos, causal, kv_mask):
@@ -281,10 +488,25 @@ def _flash_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
 
 
 def _flash_bwd(scale, causal, interpret, res, cts):
+    import os
     q, k, v, kv_mask, starts, m = res
     _, dl, dpv = cts  # dm == 0: m is stop-gradiented at every consumer
-    dq, dk, dv = _blockwise_bwd(q, k, v, kv_mask, m, dl, dpv,
-                                starts[0], starts[1], scale, causal)
+    # default: the fused Pallas backward (this VJP only runs on the
+    # pallas block path); KFAC_ATTN_BWD_IMPL=recompute selects the JAX
+    # blockwise recompute. TRACE-TIME knob: it is read when the backward
+    # is first traced and baked into the jit cache — set it before the
+    # first compile; flipping it mid-process does not retrace already-
+    # jitted functions (same semantics as KFAC_ATTN_IMPL/KFAC_EIGH_IMPL).
+    impl = os.environ.get('KFAC_ATTN_BWD_IMPL', 'pallas')
+    if impl not in ('pallas', 'recompute'):
+        raise ValueError(f'KFAC_ATTN_BWD_IMPL={impl!r}: expected '
+                         "'pallas' or 'recompute'")
+    if impl == 'recompute':
+        dq, dk, dv = _blockwise_bwd(q, k, v, kv_mask, m, dl, dpv,
+                                    starts[0], starts[1], scale, causal)
+    else:
+        dq, dk, dv = _pallas_bwd(q, k, v, kv_mask, m, dl, dpv, starts,
+                                 scale, causal, interpret)
     return dq, dk, dv, None, None
 
 
