@@ -10,6 +10,8 @@ from kfac_pytorch_tpu.models.cifar_wide_resnet import wrn_28_10
 from kfac_pytorch_tpu.models.imagenet_resnet import (
     resnet18, resnet34, resnet50, resnet101, resnet152,
     resnext50_32x4d, resnext101_32x8d)
+from kfac_pytorch_tpu.models.densenet import (
+    densenet121, densenet169, densenet201)
 from kfac_pytorch_tpu.models.inception_v4 import inception_v4
 from kfac_pytorch_tpu.models.rnn import wikitext_lstm
 from kfac_pytorch_tpu.models.gpt import TransformerLM, transformer_lm
@@ -27,6 +29,8 @@ def get_model(name, num_classes=10, **kw):
         'resnet101': resnet101, 'resnet152': resnet152,
         'resnext50': resnext50_32x4d, 'resnext101': resnext101_32x8d,
         'inceptionv4': inception_v4, 'inception-v4': inception_v4,
+        'densenet121': densenet121, 'densenet169': densenet169,
+        'densenet201': densenet201,
     }
     if name not in registry:
         raise KeyError(f'unknown model {name!r}')

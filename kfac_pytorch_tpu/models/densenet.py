@@ -1,0 +1,96 @@
+"""ImageNet DenseNet-BC (121/169/201) in Flax/NHWC with KFAC layers.
+
+Same family the reference trains through torchvision (densenet121 at
+examples/pytorch_imagenet_resnet.py:247-248; the densenet201 64-GPU
+efficiency preset at batch.sh:29): BN-ReLU-Conv pre-activation ordering,
+bottleneck width 4k, compression 0.5 transitions, growth rate 32.
+Every conv is a ``knn.Conv`` so K-FAC captures its factors exactly as it
+does for the ResNet zoo.
+"""
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import nn as knn
+
+_kaiming = linen.initializers.kaiming_normal()
+
+
+def _norm(train, dtype, name):
+    return linen.BatchNorm(use_running_average=not train, momentum=0.9,
+                           epsilon=1e-5, dtype=dtype, name=name)
+
+
+class DenseLayer(linen.Module):
+    growth_rate: int
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        out = linen.relu(_norm(train, self.dtype, 'bn1')(x))
+        out = knn.Conv(4 * self.growth_rate, (1, 1), padding=(0, 0),
+                       use_bias=False, kernel_init=_kaiming,
+                       dtype=self.dtype, name='conv1')(out)
+        out = linen.relu(_norm(train, self.dtype, 'bn2')(out))
+        out = knn.Conv(self.growth_rate, (3, 3), padding=(1, 1),
+                       use_bias=False, kernel_init=_kaiming,
+                       dtype=self.dtype, name='conv2')(out)
+        return jnp.concatenate([x, out], axis=-1)
+
+
+class Transition(linen.Module):
+    out_features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        x = linen.relu(_norm(train, self.dtype, 'bn')(x))
+        x = knn.Conv(self.out_features, (1, 1), padding=(0, 0),
+                     use_bias=False, kernel_init=_kaiming, dtype=self.dtype,
+                     name='conv')(x)
+        return linen.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet(linen.Module):
+    block_config: tuple = (6, 12, 24, 16)
+    growth_rate: int = 32
+    num_init_features: int = 64
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        x = knn.Conv(self.num_init_features, (7, 7), strides=(2, 2),
+                     padding=(3, 3), use_bias=False, kernel_init=_kaiming,
+                     dtype=self.dtype, name='conv0')(x)
+        x = linen.relu(_norm(train, self.dtype, 'bn0')(x))
+        x = linen.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1),
+                                                               (1, 1)))
+        features = self.num_init_features
+        for i, n_layers in enumerate(self.block_config):
+            for j in range(n_layers):
+                x = DenseLayer(self.growth_rate, dtype=self.dtype,
+                               name=f'block{i}_layer{j}')(x, train=train)
+            features += n_layers * self.growth_rate
+            if i != len(self.block_config) - 1:
+                features //= 2  # BC compression 0.5
+                x = Transition(features, dtype=self.dtype,
+                               name=f'trans{i}')(x, train=train)
+        x = linen.relu(_norm(train, self.dtype, 'bn_final')(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return knn.Dense(self.num_classes, dtype=self.dtype, name='fc')(x)
+
+
+def densenet121(num_classes=1000, **kw):
+    return DenseNet(block_config=(6, 12, 24, 16), num_classes=num_classes,
+                    **kw)
+
+
+def densenet169(num_classes=1000, **kw):
+    return DenseNet(block_config=(6, 12, 32, 32), num_classes=num_classes,
+                    **kw)
+
+
+def densenet201(num_classes=1000, **kw):
+    return DenseNet(block_config=(6, 12, 48, 32), num_classes=num_classes,
+                    **kw)

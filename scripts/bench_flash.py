@@ -36,9 +36,17 @@ def main():
     ap.add_argument('--impls', nargs='+', default=None,
                     help="default: xla + (pallas on tpu | "
                          "pallas_interpret elsewhere)")
+    ap.add_argument('--bwd-impls', nargs='+', default=None,
+                    choices=['pallas', 'recompute'],
+                    help='A/B the pallas-path backward: each entry times '
+                         'the pallas block impl with this backward '
+                         '(KFAC_ATTN_BWD_IMPL is set before tracing)')
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == 'tpu'
+    if args.impls and args.bwd_impls:
+        raise SystemExit('--impls and --bwd-impls are mutually exclusive '
+                         '(bwd mode pins the pallas forward)')
     impls = args.impls or ['xla', 'pallas' if on_tpu else
                            'pallas_interpret']
     print(f'device: {jax.devices()[0]}; B={args.batch} H={args.heads} '
@@ -51,7 +59,14 @@ def main():
         k = jnp.asarray(rng.randn(*shape), jnp.float32)
         v = jnp.asarray(rng.randn(*shape), jnp.float32)
         outs = {}
-        for impl in impls:
+        pallas_impl = 'pallas' if on_tpu else 'pallas_interpret'
+        runs = ([(i, None) for i in impls] if not args.bwd_impls else
+                [(pallas_impl, b) for b in args.bwd_impls])
+        for impl, bwd in runs:
+            if bwd is not None:
+                os.environ['KFAC_ATTN_BWD_IMPL'] = bwd
+            tag = impl if bwd is None else f'{impl}/bwd={bwd}'
+
             def loss(q, k, v, impl=impl):
                 out = ring_attention(q, k, v, axis_name=None, causal=True,
                                      block_impl=impl)
@@ -59,17 +74,23 @@ def main():
 
             fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
             try:
-                outs[impl] = float(fn(q, k, v)[0])  # warms the jit cache
+                val, grads = fn(q, k, v)  # warms the jit cache
+                # agreement basis: fwd loss in impl mode; in bwd mode the
+                # forwards are identical by construction, so compare the
+                # gradients (what actually differs between backends)
+                outs[tag] = (float(val) if bwd is None else
+                             float(sum(jnp.linalg.norm(g) for g in grads)))
                 t = timeit(fn, q, k, v, warmup=1, iters=3)
-                print(f'  L={L:>7} {impl:>17}: {t * 1e3:>9.2f} ms '
+                print(f'  L={L:>7} {tag:>22}: {t * 1e3:>9.2f} ms '
                       f'({args.batch * L / t / 1e3:>8.1f}K tok/s)')
             except Exception as e:
-                print(f'  L={L:>7} {impl:>17}: failed '
+                print(f'  L={L:>7} {tag:>22}: failed '
                       f'({type(e).__name__}: {str(e)[:80]})')
         if len(outs) == 2:
             vals = list(outs.values())
             rel = abs(vals[0] - vals[1]) / max(abs(vals[0]), 1e-9)
-            print(f'  L={L:>7} loss agreement: rel diff {rel:.2e}')
+            what = 'grad-norm' if args.bwd_impls else 'loss'
+            print(f'  L={L:>7} {what} agreement: rel diff {rel:.2e}')
 
 
 if __name__ == '__main__':

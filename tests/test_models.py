@@ -69,6 +69,29 @@ def test_inception_v4_forward():
     assert out.shape == (1, 7)
 
 
+def test_densenet121_params_and_forward():
+    """torchvision densenet121: 7,978,856 params; every conv must be a
+    K-FAC capture layer (120 convs + fc)."""
+    model = models.get_model('densenet121', num_classes=1000)
+    x = jnp.ones((1, 64, 64, 3))
+    variables = capture.init(model, jax.random.PRNGKey(0), x, train=False)
+    n = _count(variables['params'])
+    assert abs(n - 7_978_856) / 7_978_856 < 0.01, n
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+    metas = capture.collect_layer_meta(model, variables, x, train=False)
+    assert len(metas) == 121, len(metas)  # 120 convs + fc
+
+
+def test_densenet201_layer_count():
+    model = models.get_model('densenet201', num_classes=10)
+    x = jnp.ones((1, 32, 32, 3))
+    variables = capture.init(model, jax.random.PRNGKey(0), x, train=False)
+    metas = capture.collect_layer_meta(model, variables, x, train=False)
+    # 2*(6+12+48+32) block convs + stem + 3 transitions + fc = 201 heads
+    assert len(metas) == 2 * 98 + 1 + 3 + 1, len(metas)
+
+
 def test_imagenet_resnet50_params():
     model = models.resnet50()
     x = jnp.ones((1, 64, 64, 3))
