@@ -164,8 +164,24 @@ def _local_table(arr, axis_name):
     return jnp.take(jnp.asarray(arr), coll.axis_index(axis_name), axis=0)
 
 
+def local_evecs(plan, decomp, axis_name, comm_mode):
+    """This device's eigenbasis rows from a stored decomposition (local
+    already in 'pred' mode; sliced out of the gathered/replicated layout
+    in 'inverse' mode)."""
+    out = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        q = decomp['evecs'][key]
+        if comm_mode == 'inverse':
+            per_dev = plan.buckets[bdim].per_dev
+            idx = coll.axis_index(axis_name)
+            q = lax.dynamic_slice_in_dim(q, idx * per_dev, per_dev, axis=0)
+        out[key] = q
+    return out
+
+
 def compute_decomposition(plan, factors_local, damping, method, eps,
-                          axis_name):
+                          axis_name, basis_local=None, warm_sweeps=None):
     """Batched eigh or pi-damped Cholesky inverse of the local factor rows.
 
     eigh parity: eigen.py:98-119 / eigen_dp.py:62-75 (eigenvalue clamp
@@ -173,12 +189,20 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
     ``pi = sqrt((trA/dimA)/(trG/dimG))`` scaled damping; both factor sides
     reduce to ``sqrt(damping * own_trace_avg / mate_trace_avg)`` on their
     diagonal, so one uniform expression covers A and G slots.
+
+    basis_local: previous local eigenbasis rows (``local_evecs``) to
+    warm-start the Jacobi eigh — only consulted on the eigh path and only
+    effective when KFAC_EIGH_IMPL resolves to jacobi. ``warm_sweeps``
+    overrides the warm-start sweep count (None = kernel default).
     """
     if method == 'eigh':
         evals, evecs = {}, {}
         for bdim in plan.bucket_dims:
             key = _key(bdim)
-            d, q = ops.sym_eig(factors_local[key])
+            basis = None if basis_local is None else basis_local[key]
+            d, q = ops.sym_eig(factors_local[key], basis=basis,
+                               sweeps=warm_sweeps if basis is not None
+                               else None)
             evals[key] = ops.clamp_eigvals(d, eps)
             evecs[key] = q
         return {'evals': evals, 'evecs': evecs}
@@ -229,16 +253,11 @@ def refresh_decomposition(plan, factors_local, decomp_prev, eps, axis_name,
     mode, gathered/replicated in 'inverse' mode); returns a decomposition
     in the same layout.
     """
-    evals, evecs_local = {}, {}
+    evals = {}
+    evecs_local = local_evecs(plan, decomp_prev, axis_name, comm_mode)
     for bdim in plan.bucket_dims:
         key = _key(bdim)
-        q = decomp_prev['evecs'][key]
-        if comm_mode == 'inverse':
-            # replicated (gathered) basis -> this device's rows
-            per_dev = plan.buckets[bdim].per_dev
-            idx = coll.axis_index(axis_name)
-            q = lax.dynamic_slice_in_dim(q, idx * per_dev, per_dev, axis=0)
-        evecs_local[key] = q
+        q = evecs_local[key]
         f = factors_local[key]
         fq = jnp.einsum('mjk,mki->mji', f, q, precision=_PRED_PRECISION)
         d = jnp.sum(q * fq, axis=1)

@@ -89,6 +89,11 @@ class KFAC:
         retained eigenbasis (E-KFAC-style amortization, two matmuls per
         bucket instead of an eigh). None (default) = every inverse update
         is a full decomposition, the reference cadence.
+      warm_start_basis: eigh variants only (beyond reference) — full
+        decompositions after the first re-diagonalize in the previous
+        eigenbasis (rotate, few Jacobi sweeps, rotate back). Effective
+        when KFAC_EIGH_IMPL resolves to jacobi; composes with
+        basis_update_freq.
     """
 
     def __init__(self, variant='eigen_dp', lr=0.1, damping=0.001,
@@ -98,7 +103,8 @@ class KFAC:
                  hook_enabled=True, exclude_parts='', batch_averaged=True,
                  num_devices=1, axis_name=None, assignment='round_robin',
                  distribute_layer_factors=None, bucket_fn=None, eps=1e-10,
-                 basis_update_freq=None):
+                 basis_update_freq=None, warm_start_basis=False,
+                 warm_sweeps=None):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -128,6 +134,22 @@ class KFAC:
         if basis_update_freq is not None and self.method != 'eigh':
             raise ValueError('basis_update_freq applies to eigh variants')
         self.basis_update_freq = basis_update_freq
+        if warm_start_basis and self.method != 'eigh':
+            raise ValueError('warm_start_basis applies to eigh variants')
+        if warm_start_basis:
+            import os
+            import warnings
+            if os.environ.get('KFAC_EIGH_IMPL', 'xla') == 'xla':
+                warnings.warn(
+                    'warm_start_basis has no effect on the XLA eigh path '
+                    "(QDWH cannot warm-start) — set KFAC_EIGH_IMPL="
+                    "'jacobi' or 'auto' to use it", stacklevel=2)
+        self.warm_start_basis = warm_start_basis
+        # warm-start sweep count: the default (5) is calibrated for the
+        # stat_decay=0.95 / freq<=10 drift regime; raise it for long
+        # inverse intervals or aggressive decay, where the stored basis
+        # rotates further between full decompositions
+        self.warm_sweeps = warm_sweeps
         # exclude_parts ablation flags (kfac_preconditioner_base.py:96-99)
         self.exclude_communicate_inverse = 'CommunicateInverse' in exclude_parts
         self.exclude_compute_inverse = 'ComputeInverse' in exclude_parts
@@ -236,7 +258,8 @@ class KFAC:
     def step(self, state: KFACState, grads, acts=None, gs=None,
              hyper: Optional[KFACHyperParams] = None, *,
              update_factors: bool = True, update_inverse: bool = True,
-             update_basis: bool = True, factors_only: bool = False,
+             update_basis: bool = True, warm_basis: bool = False,
+             factors_only: bool = False,
              axis_name: str = '__default__'):
         """One K-FAC step: (state, grads, captured stats) ->
         (preconditioned grads, new state).
@@ -293,8 +316,18 @@ class KFAC:
                     self.comm_mode,
                     communicate=not self.exclude_communicate_inverse)
             else:
+                basis_local = None
+                if (self.method == 'eigh' and self.warm_start_basis
+                        and warm_basis):
+                    # warm_basis is STATIC, set by the trainer only after
+                    # a full decomposition exists (a zero basis would
+                    # silently corrupt the rotated problem)
+                    basis_local = engine.local_evecs(
+                        plan, decomp, axis_name, self.comm_mode)
                 decomp_local = engine.compute_decomposition(
-                    plan, factors, damping, self.method, self.eps, axis_name)
+                    plan, factors, damping, self.method, self.eps,
+                    axis_name, basis_local=basis_local,
+                    warm_sweeps=self.warm_sweeps)
                 if self.comm_mode == 'inverse':
                     decomp = engine.gather_decomposition(
                         plan, decomp_local, axis_name,

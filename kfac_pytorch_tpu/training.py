@@ -83,7 +83,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     """
 
     def one_step(state, batch, hyper, update_factors, update_inverse,
-                 update_basis=True, factors_only=False):
+                 update_basis=True, warm_basis=False, factors_only=False):
         x = batch['input']
         variables = {'params': state.params, **state.extra_vars}
         use_capture = precond is not None and update_factors
@@ -122,7 +122,8 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                 kfac_state, grads, acts, gs, hyper=hyper,
                 update_factors=update_factors,
                 update_inverse=update_inverse, update_basis=update_basis,
-                factors_only=factors_only, axis_name=axis_name)
+                warm_basis=warm_basis, factors_only=factors_only,
+                axis_name=axis_name)
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -143,10 +144,11 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     state_specs_cache = {}
 
     def make_variant(update_factors, update_inverse, update_basis=True,
-                     factors_only=False):
+                     warm_basis=False, factors_only=False):
         fn = functools.partial(one_step, update_factors=update_factors,
                                update_inverse=update_inverse,
                                update_basis=update_basis,
+                               warm_basis=warm_basis,
                                factors_only=factors_only)
         if axis_name is None:
             return jax.jit(fn, donate_argnums=(0,) if donate else ())
@@ -179,7 +181,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                         for x in jax.tree.leaves(state.kfac_state.decomp)))
         if precond is None:
             uf = ui = False
-            ub = True
+            ub, warm = True, False
         else:
             # hook_enabled=False freezes factor capture/updates (reference
             # set_hook_enabled, kfac_preconditioner_base.py:117-130); the
@@ -196,18 +198,24 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             ub = (not seen_inverse['yes']
                   or precond.should_update_basis(
                       step, seen_inverse.get('last_full')))
+            # warm-start only once a prior full decomposition exists in
+            # this run's state (the basis must be orthogonal, not zeros)
+            warm = (getattr(precond, 'warm_start_basis', False)
+                    and 'last_full' in seen_inverse)
             seen_inverse['yes'] = seen_inverse['yes'] or ui
             if ui and ub:
                 seen_inverse['last_full'] = step
             if not ui:
-                ub = True  # unused without an inverse update — one variant
-        key = (uf, ui, ub)
+                ub, warm = True, False  # unused without an inverse update
+            if not ub:
+                warm = False            # refresh path has no eigh to warm
+        key = (uf, ui, ub, warm)
         if precond is not None and not seen_inverse['yes']:
             key = (uf, False, 'factors_only')
             if key not in variants:
                 variants[key] = make_variant(uf, False, factors_only=True)
         if key not in variants:
-            variants[key] = make_variant(uf, ui, ub)
+            variants[key] = make_variant(uf, ui, ub, warm)
         hyper = KFACHyperParams(
             lr=jnp.float32(lr if lr is not None
                            else getattr(precond, 'lr', 0.0)),

@@ -76,10 +76,10 @@ def main():
             try:
                 val, grads = fn(q, k, v)  # warms the jit cache
                 # agreement basis: fwd loss in impl mode; in bwd mode the
-                # forwards are identical by construction, so compare the
-                # gradients (what actually differs between backends)
+                # forwards are identical by construction, so keep the
+                # gradient tensors themselves for per-tensor comparison
                 outs[tag] = (float(val) if bwd is None else
-                             float(sum(jnp.linalg.norm(g) for g in grads)))
+                             [np.asarray(g) for g in grads])
                 t = timeit(fn, q, k, v, warmup=1, iters=3)
                 print(f'  L={L:>7} {tag:>22}: {t * 1e3:>9.2f} ms '
                       f'({args.batch * L / t / 1e3:>8.1f}K tok/s)')
@@ -87,10 +87,16 @@ def main():
                 print(f'  L={L:>7} {tag:>22}: failed '
                       f'({type(e).__name__}: {str(e)[:80]})')
         if len(outs) == 2:
-            vals = list(outs.values())
-            rel = abs(vals[0] - vals[1]) / max(abs(vals[0]), 1e-9)
-            what = 'grad-norm' if args.bwd_impls else 'loss'
-            print(f'  L={L:>7} {what} agreement: rel diff {rel:.2e}')
+            a, b = list(outs.values())
+            if args.bwd_impls:
+                rels = [float(np.linalg.norm(ga - gb)
+                              / max(np.linalg.norm(gb), 1e-9))
+                        for ga, gb in zip(a, b)]
+                print(f'  L={L:>7} grad agreement (dq/dk/dv rel): '
+                      + ' '.join(f'{r:.2e}' for r in rels))
+            else:
+                rel = abs(a - b) / max(abs(a), 1e-9)
+                print(f'  L={L:>7} loss agreement: rel diff {rel:.2e}')
 
 
 if __name__ == '__main__':

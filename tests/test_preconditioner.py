@@ -174,6 +174,36 @@ def test_basis_refresh_tracks_factor_change():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_warm_start_basis_matches_cold_eigh(monkeypatch):
+    """With the jacobi eigh and unchanged factors, a warm-started full
+    decomposition (rotate into the stored basis, few sweeps, rotate back)
+    must reproduce the cold decomposition's preconditioning."""
+    monkeypatch.setenv('KFAC_EIGH_IMPL', 'jacobi')
+    precond, state, grads, acts, gs, metas = _setup(
+        'eigen_dp', warm_start_basis=True)
+    g_cold, s1 = precond.step(state, grads, acts, gs)
+    g_warm, s2 = precond.step(s1, grads, update_factors=False,
+                              update_inverse=True, update_basis=True,
+                              warm_basis=True)
+    for name in metas:
+        np.testing.assert_allclose(np.asarray(g_cold[name]['kernel']),
+                                   np.asarray(g_warm[name]['kernel']),
+                                   rtol=1e-3, atol=1e-4)
+    for k in s1.decomp['evals']:
+        np.testing.assert_allclose(np.asarray(s1.decomp['evals'][k]),
+                                   np.asarray(s2.decomp['evals'][k]),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_warm_start_validation(monkeypatch):
+    with pytest.raises(ValueError):
+        _setup('inverse_dp', warm_start_basis=True)
+    # opting in while the eigh impl is XLA (which cannot warm-start) warns
+    monkeypatch.delenv('KFAC_EIGH_IMPL', raising=False)
+    with pytest.warns(UserWarning, match='warm_start_basis'):
+        _setup('eigen_dp', warm_start_basis=True)
+
+
 def test_basis_update_freq_gating_and_validation():
     precond, *_ = _setup('eigen_dp', basis_update_freq=30,
                          kfac_update_freq=10)
