@@ -114,13 +114,16 @@ def jacobi_eigh(x, sweeps=None, basis=None):
     Returns (eigvals, eigvecs) sorted ascending, matching eigh.
     """
     if basis is not None:
+        # same precision rule as the cold path: f64 inputs stay f64
+        cd = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+        basis_c = basis.astype(cd)
         rot = jnp.matmul(
-            jnp.swapaxes(basis, -1, -2),
-            jnp.matmul(x.astype(jnp.float32), basis, precision='highest'),
+            jnp.swapaxes(basis_c, -1, -2),
+            jnp.matmul(x.astype(cd), basis_c, precision='highest'),
             precision='highest')
         rot = 0.5 * (rot + jnp.swapaxes(rot, -1, -2))
         w, vr = jacobi_eigh(rot, sweeps=5 if sweeps is None else sweeps)
-        v = jnp.matmul(basis, vr.astype(basis.dtype), precision='highest')
+        v = jnp.matmul(basis_c, vr.astype(cd), precision='highest')
         return w.astype(x.dtype), v.astype(x.dtype)
     single = x.ndim == 2
     if single:

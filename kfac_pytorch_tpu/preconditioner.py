@@ -150,6 +150,11 @@ class KFAC:
         # inverse intervals or aggressive decay, where the stored basis
         # rotates further between full decompositions
         self.warm_sweeps = warm_sweeps
+        # every warm full compounds ~1e-7 orthogonality error into the
+        # chained basis Q <- Q @ V'; a periodic cold full resets it.
+        # 50 keeps the accumulated error ~5e-6 — far below the f32
+        # decomposition noise floor
+        self.cold_restart_every = 50
         # exclude_parts ablation flags (kfac_preconditioner_base.py:96-99)
         self.exclude_communicate_inverse = 'CommunicateInverse' in exclude_parts
         self.exclude_compute_inverse = 'ComputeInverse' in exclude_parts

@@ -52,6 +52,22 @@ def sgd(lr_schedule, momentum=0.9, weight_decay=0.0, nesterov=False):
     return optax.chain(*parts)
 
 
+def _warm_basis_gate(precond, seen, step, ui, ub):
+    """Host-side warm/cold decision for a full decomposition, mutating
+    the run's ``seen`` record: warm only once a prior full exists (the
+    stored basis must be orthogonal, not zeros), and every
+    ``cold_restart_every``-th full goes cold to reset the orthogonality
+    error the chained basis ``Q <- Q @ V'`` accumulates."""
+    streak = seen.get('warm_streak', 0)
+    warm = (getattr(precond, 'warm_start_basis', False)
+            and 'last_full' in seen
+            and streak < getattr(precond, 'cold_restart_every', 50))
+    if ui and ub:
+        seen['last_full'] = step
+        seen['warm_streak'] = streak + 1 if warm else 0
+    return warm
+
+
 def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      extra_mutable=(), sync_extra_vars=True, donate=True,
                      dropout_seed=None, batch_specs=None, check_vma=None):
@@ -198,13 +214,8 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             ub = (not seen_inverse['yes']
                   or precond.should_update_basis(
                       step, seen_inverse.get('last_full')))
-            # warm-start only once a prior full decomposition exists in
-            # this run's state (the basis must be orthogonal, not zeros)
-            warm = (getattr(precond, 'warm_start_basis', False)
-                    and 'last_full' in seen_inverse)
+            warm = _warm_basis_gate(precond, seen_inverse, step, ui, ub)
             seen_inverse['yes'] = seen_inverse['yes'] or ui
-            if ui and ub:
-                seen_inverse['last_full'] = step
             if not ui:
                 ub, warm = True, False  # unused without an inverse update
             if not ub:

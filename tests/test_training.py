@@ -141,6 +141,25 @@ def test_warm_start_training_tracks_cold(monkeypatch):
     assert abs(warm[-1] - cold[-1]) < 0.25 * abs(cold[0] - cold[-1]) + 1e-3
 
 
+def test_warm_streak_cold_restart_gating():
+    """Host gating (_warm_basis_gate): the first full is cold, subsequent
+    fulls warm, and every cold_restart_every-th full goes cold again to
+    reset the chained basis' accumulated orthogonality error. Non-inverse
+    steps must not advance the streak."""
+    seen = {'yes': False}
+    precond_like = type('P', (), {'warm_start_basis': True,
+                                  'cold_restart_every': 3})()
+    gate = lambda s, ui=True, ub=True: training._warm_basis_gate(
+        precond_like, seen, s, ui, ub)
+    decisions = [gate(s) for s in range(6)]
+    # cold, then 3 warm, then forced cold, then warm again
+    assert decisions == [False, True, True, True, False, True], decisions
+    # a step without an inverse update leaves the record untouched
+    before = dict(seen)
+    gate(6, ui=False)
+    assert seen == before
+
+
 def test_sharded_training_runs_and_matches_replicated_params():
     """Full train step under shard_map on 4 devices: runs, loss finite,
     params stay replicated (vma-checked by construction)."""

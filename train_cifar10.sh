@@ -9,6 +9,7 @@ epochs="${epochs:-100}"
 kfac="${kfac:-1}"                 # kfac_update_freq (0 disables)
 fac="${fac:-1}"                   # fac (cov) update freq
 kfac_name="${kfac_name:-eigen_dp}"
+basis_freq="${basis_freq:-0}"        # full-eigh cadence (0 = every inverse update)
 stat_decay="${stat_decay:-0.95}"
 damping="${damping:-0.03}"
 kl_clip="${kl_clip:-0.001}"
@@ -19,7 +20,7 @@ data_dir="${data_dir:-}"
 
 params="--model $dnn --batch-size $batch_size --base-lr $base_lr \
   --epochs $epochs --kfac-update-freq $kfac --kfac-cov-update-freq $fac \
-  --kfac-name $kfac_name --stat-decay $stat_decay --damping $damping \
+  --kfac-name $kfac_name --kfac-basis-update-freq $basis_freq --stat-decay $stat_decay --damping $damping \
   --kl-clip $kl_clip --lr-decay $lr_decay --num-devices $nworkers"
 [ -n "$exclude_parts" ] && params="$params --exclude-parts $exclude_parts"
 [ -n "$data_dir" ] && params="$params --dir $data_dir"

@@ -14,6 +14,7 @@ fi
 kfac="${kfac:-1}"
 fac="${fac:-1}"
 kfac_name="${kfac_name:-eigen_dp}"
+basis_freq="${basis_freq:-0}"        # full-eigh cadence (0 = every inverse update)
 stat_decay="${stat_decay:-0.95}"
 damping="${damping:-0.002}"
 exclude_parts="${exclude_parts:-}"
@@ -21,7 +22,7 @@ nworkers="${nworkers:-1}"
 
 params="--model $dnn --batch-size $batch_size --base-lr $base_lr \
   --epochs $epochs --lr-decay $lr_decay --kfac-update-freq $kfac \
-  --kfac-cov-update-freq $fac --kfac-name $kfac_name \
+  --kfac-cov-update-freq $fac --kfac-name $kfac_name --kfac-basis-update-freq $basis_freq \
   --stat-decay $stat_decay --damping $damping --num-devices $nworkers"
 [ -n "$exclude_parts" ] && params="$params --exclude-parts $exclude_parts"
 [ -n "$train_dir" ] && params="$params --train-dir $train_dir"

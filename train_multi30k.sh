@@ -8,12 +8,13 @@ base_lr="${base_lr:-0.1}"
 kfac="${kfac:-1}"
 fac="${fac:-1}"
 kfac_name="${kfac_name:-eigen_dp}"
+basis_freq="${basis_freq:-0}"        # full-eigh cadence (0 = every inverse update)
 damping="${damping:-0.03}"
 nworkers="${nworkers:-1}"
 
 params="--batch-size $batch_size --epochs $epochs --optimizer $optimizer \
   --base-lr $base_lr --kfac-update-freq $kfac --kfac-cov-update-freq $fac \
-  --kfac-name $kfac_name --damping $damping --num-devices $nworkers"
+  --kfac-name $kfac_name --kfac-basis-update-freq $basis_freq --damping $damping --num-devices $nworkers"
 [ -n "$data_dir" ] && params="$params --dir $data_dir"
 
 bash "$(dirname "$0")/launch_tpu.sh" examples/multi30k_transformer.py \

@@ -8,12 +8,13 @@ base_lr="${base_lr:-0.04}"
 kfac="${kfac:-1}"
 fac="${fac:-1}"
 kfac_name="${kfac_name:-eigen_dp}"
+basis_freq="${basis_freq:-0}"        # full-eigh cadence (0 = every inverse update)
 damping="${damping:-0.003}"
 nworkers="${nworkers:-1}"
 
 params="--model-size $model_size --batch-size $batch_size \
   --epochs $epochs --base-lr $base_lr --kfac-update-freq $kfac \
-  --kfac-cov-update-freq $fac --kfac-name $kfac_name --damping $damping \
+  --kfac-cov-update-freq $fac --kfac-name $kfac_name --kfac-basis-update-freq $basis_freq --damping $damping \
   --num-devices $nworkers"
 [ -n "$train_file" ] && params="$params --train-file $train_file"
 
