@@ -16,6 +16,9 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
+from scripts.utils import force_platform
+force_platform()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -69,18 +72,28 @@ def main():
                       f'eigh={te * 1e3:9.1f} ms  chol_inv={ti * 1e3:8.1f} ms')
 
     # batched matmul-form Jacobi vs XLA QDWH eigh (the K-FAC bucket
-    # regime: decompose a whole stacked bucket in one call)
+    # regime: decompose a whole stacked bucket in one call), cold and
+    # warm-started (re-diagonalize a drifted matrix in the prior basis)
     jac = jax.jit(lambda x: ops.jacobi_eigh(x))
+    jac_warm = jax.jit(lambda x, b: ops.jacobi_eigh(x, basis=b))
     for d in args.dims:
         if d > 1024:
             continue  # n^4 matmul form cedes large dims to QDWH
         x = spd(rng, args.batch, d)
         tj = timeit(jac, x)
-        w, _ = jac(x)
+        w, q = jac(x)
         werr = float(jnp.max(jnp.abs(
             w - jnp.asarray(np.linalg.eigvalsh(np.asarray(x))))))
-        print(f'jacobi_eigh     dim={d:5d} batch={args.batch} '
+        print(f'jacobi_eigh      dim={d:5d} batch={args.batch} '
               f'{tj * 1e3:9.1f} ms  (max |dw| {werr:.2e})')
+        drift = spd(rng, args.batch, d)
+        xp = 0.6 * x + 0.4 * jnp.asarray(drift) / d
+        tw = timeit(jac_warm, xp, q)
+        ww, _ = jac_warm(xp, q)
+        werr = float(jnp.max(jnp.abs(
+            ww - jnp.asarray(np.linalg.eigvalsh(np.asarray(xp))))))
+        print(f'jacobi_eigh WARM dim={d:5d} batch={args.batch} '
+              f'{tw * 1e3:9.1f} ms  (max |dw| {werr:.2e})')
 
     # factor GEMM (the ComputeA hot op) at conv-layer shapes
     gemm = jax.jit(lambda a: ops.compute_a_conv(a, (3, 3), (1, 1), (1, 1),
