@@ -64,6 +64,9 @@ def parse_args():
                    help='full eigendecomposition cadence; intermediate '
                         'inverse updates refresh eigenvalues in the '
                         'retained basis (0 = always full)')
+    p.add_argument('--kfac-warm-start', action='store_true',
+                   help='warm-start full eigendecompositions in the '
+                        'previous eigenbasis (jacobi eigh only)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp')
     p.add_argument('--damping', type=float, default=0.003)
@@ -161,6 +164,7 @@ def main():
             fac_update_freq=args.kfac_cov_update_freq,
             kfac_update_freq=args.kfac_update_freq,
             basis_update_freq=(args.kfac_basis_update_freq or None),
+            warm_start_basis=args.kfac_warm_start,
             factor_decay=args.stat_decay, kl_clip=args.kl_clip,
             num_devices=ndev, axis_name=kfac_axis,
             exclude_vocabulary_size=vocab)

@@ -61,6 +61,9 @@ def parse_args():
                    help='full eigendecomposition cadence; intermediate '
                         'inverse updates refresh eigenvalues in the '
                         'retained basis (0 = always full)')
+    p.add_argument('--kfac-warm-start', action='store_true',
+                   help='warm-start full eigendecompositions in the '
+                        'previous eigenbasis (jacobi eigh only)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp')
     p.add_argument('--stat-decay', type=float, default=0.95)
@@ -179,6 +182,7 @@ def main():
             fac_update_freq=args.kfac_cov_update_freq,
             kfac_update_freq=args.kfac_update_freq,
             basis_update_freq=(args.kfac_basis_update_freq or None),
+            warm_start_basis=args.kfac_warm_start,
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_vocabulary_size=n_trg_vocab,  # tied pre-softmax (:297)
             exclude_parts=args.exclude_parts,
