@@ -142,6 +142,35 @@ def test_ring_gradients_finite_with_fully_future_blocks():
                                    atol=1e-3, rtol=1e-3)
 
 
+def test_diag_tile_clamps_identity_on_needed_iterations():
+    """The causal copy-elision clamps only run on real TPU (the
+    interpreter can't evaluate vma-tagged meta), so pin their math here:
+    for every grid iteration whose tile the kernel actually computes
+    (last_q >= first_k), the clamped K-tile index must equal j and the
+    clamped q-tile index must equal iq — a wrong clamp would feed the
+    kernel the wrong tile with no test to catch it."""
+    from kfac_pytorch_tpu.ops.pallas_attention import (_diag_k_tile,
+                                                       _diag_q_tile)
+    for q_start, k_start, tq, tk, nq, nk in [
+            (0, 0, 8, 8, 4, 4), (0, 0, 128, 128, 3, 3),
+            (64, 32, 16, 8, 5, 7), (256, 0, 128, 128, 2, 4),
+            (0, 256, 8, 16, 6, 3), (96, 96, 32, 32, 4, 4)]:
+        meta = jnp.asarray([q_start, k_start], jnp.int32)
+        for iq in range(nq):
+            for j in range(nk):
+                last_q = q_start + (iq + 1) * tq - 1
+                first_k = k_start + j * tk
+                needed = last_q >= first_k
+                kj = int(jnp.minimum(j, _diag_k_tile(iq, meta, tq, tk)))
+                qi = int(jnp.maximum(
+                    iq, _diag_q_tile(j, meta, tq, tk, nq)))
+                if needed:
+                    assert kj == j, (q_start, k_start, tq, tk, iq, j, kj)
+                    assert qi == iq, (q_start, k_start, tq, tk, iq, j, qi)
+                # skipped iterations may point anywhere in range
+                assert 0 <= kj < nk and 0 <= qi < nq
+
+
 def test_pallas_bwd_matches_recompute_bwd(monkeypatch):
     """The fused Pallas backward and the JAX blockwise-recompute backward
     are two implementations of the same VJP — gradients must match to
