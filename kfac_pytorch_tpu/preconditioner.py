@@ -94,6 +94,11 @@ class KFAC:
         eigenbasis (rotate, few Jacobi sweeps, rotate back). Effective
         when KFAC_EIGH_IMPL resolves to jacobi; composes with
         basis_update_freq.
+      cold_restart_every: with warm_start_basis, force a cold (from
+        scratch) full decomposition after this many consecutive warm
+        ones — the chained basis Q <- Q @ V' accumulates ~1e-7
+        orthogonality error per warm full, and the periodic cold full
+        resets it. Must be a positive int.
     """
 
     def __init__(self, variant='eigen_dp', lr=0.1, damping=0.001,
@@ -104,7 +109,7 @@ class KFAC:
                  num_devices=1, axis_name=None, assignment='round_robin',
                  distribute_layer_factors=None, bucket_fn=None, eps=1e-10,
                  basis_update_freq=None, warm_start_basis=False,
-                 warm_sweeps=None):
+                 warm_sweeps=None, cold_restart_every=50):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -152,9 +157,13 @@ class KFAC:
         self.warm_sweeps = warm_sweeps
         # every warm full compounds ~1e-7 orthogonality error into the
         # chained basis Q <- Q @ V'; a periodic cold full resets it.
-        # 50 keeps the accumulated error ~5e-6 — far below the f32
-        # decomposition noise floor
-        self.cold_restart_every = 50
+        # The default (50) keeps the accumulated error ~5e-6 — far below
+        # the f32 decomposition noise floor
+        if not (isinstance(cold_restart_every, int)
+                and cold_restart_every > 0):
+            raise ValueError('cold_restart_every must be a positive int '
+                             f'(got {cold_restart_every!r})')
+        self.cold_restart_every = cold_restart_every
         # exclude_parts ablation flags (kfac_preconditioner_base.py:96-99)
         self.exclude_communicate_inverse = 'CommunicateInverse' in exclude_parts
         self.exclude_compute_inverse = 'ComputeInverse' in exclude_parts
