@@ -70,6 +70,19 @@ def sym_eig(x, impl=None, basis=None, sweeps=None):
 
 
 @functools.lru_cache(maxsize=None)
+def _tournament_perms(n):
+    """Per-round permutations putting each round's pairs adjacent
+    ([p0, q0, p1, q1, ...]) plus their inverses — the gather tables for
+    the 'paired' rotation form. Static numpy."""
+    pairs = _tournament_pairs(n)                  # [n-1, n/2, 2]
+    perms = pairs.reshape(n - 1, n)
+    invs = np.empty_like(perms)
+    rows = np.arange(n - 1)[:, None]
+    invs[rows, perms] = np.arange(n)[None, :]
+    return perms, invs
+
+
+@functools.lru_cache(maxsize=None)
 def _tournament_pairs(n):
     """Round-robin schedule: n-1 rounds of n/2 disjoint (p, q) pairs
     covering every index pair exactly once (circle method). Static numpy
@@ -85,7 +98,19 @@ def _tournament_pairs(n):
     return np.asarray(rounds, np.int32)  # [n-1, n/2, 2]
 
 
-def jacobi_eigh(x, sweeps=None, basis=None):
+def _givens_cs(app, aqq, apq, tiny):
+    """Stable Givens (c, s) zeroing the symmetric 2x2 off-diagonal:
+    tau = (aqq-app)/(2 apq), t the smaller root."""
+    apq_safe = jnp.where(jnp.abs(apq) < tiny, 1.0, apq)
+    tau = (aqq - app) / (2.0 * apq_safe)
+    sgn = jnp.where(tau >= 0, 1.0, -1.0)
+    t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+    t = jnp.where(jnp.abs(apq) < tiny, 0.0, t)
+    c = 1.0 / jnp.sqrt(1.0 + t * t)
+    return c, t * c
+
+
+def jacobi_eigh(x, sweeps=None, basis=None, rotate=None):
     """Batched symmetric eigendecomposition by cyclic Jacobi sweeps with
     matmul-applied rotations — the MXU-shaped alternative to XLA's QDWH
     eigh for the K-FAC factor regime (stacked buckets of dim <= ~1024).
@@ -111,8 +136,17 @@ def jacobi_eigh(x, sweeps=None, basis=None):
     pass an ORTHOGONAL basis (cold zero-initialized state would silently
     corrupt results; the preconditioner gates warm starts on a
     decomposition existing).
+    rotate: how a round applies its n/2 disjoint rotations. 'dense'
+    packs them into one [n, n] J and does three n^3 matmuls (MXU-bound,
+    the default). 'paired' permutes each round's pairs adjacent and
+    applies the 2x2 rotations elementwise on the paired rows/columns —
+    O(n^2) work per round (factor-n fewer flops, but gather/VPU-bound);
+    identical results. None reads KFAC_JACOBI_ROT (default 'dense').
     Returns (eigvals, eigvecs) sorted ascending, matching eigh.
     """
+    rotate = rotate or os.environ.get('KFAC_JACOBI_ROT', 'dense')
+    if rotate not in ('dense', 'paired'):
+        raise ValueError(f'rotate={rotate!r}: expected dense|paired')
     if basis is not None:
         # same precision rule as the cold path: f64 inputs stay f64
         cd = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
@@ -122,7 +156,8 @@ def jacobi_eigh(x, sweeps=None, basis=None):
             jnp.matmul(x.astype(cd), basis_c, precision='highest'),
             precision='highest')
         rot = 0.5 * (rot + jnp.swapaxes(rot, -1, -2))
-        w, vr = jacobi_eigh(rot, sweeps=5 if sweeps is None else sweeps)
+        w, vr = jacobi_eigh(rot, sweeps=5 if sweeps is None else sweeps,
+                            rotate=rotate)
         v = jnp.matmul(basis_c, vr.astype(cd), precision='highest')
         return w.astype(x.dtype), v.astype(x.dtype)
     single = x.ndim == 2
@@ -138,7 +173,6 @@ def jacobi_eigh(x, sweeps=None, basis=None):
         n = n + 1
     if sweeps is None:
         sweeps = 10 if n <= 512 else 12
-    pairs = jnp.asarray(_tournament_pairs(n))       # [n-1, n/2, 2]
     dtype = x.dtype
     # sweep in f32 for low/mixed-precision inputs, but keep f64 inputs in
     # f64 — downcasting would silently cap an x64 caller at f32 accuracy
@@ -151,7 +185,14 @@ def jacobi_eigh(x, sweeps=None, basis=None):
     v0 = a0 * 0.0 + eye
     tiny = jnp.asarray(1e-30, cdtype)
 
-    def round_step(r, carry):
+    if rotate == 'dense':
+        pairs = jnp.asarray(_tournament_pairs(n))   # [n-1, n/2, 2]
+    else:
+        perms_np, invs_np = _tournament_perms(n)
+        perms = jnp.asarray(perms_np)
+        invs = jnp.asarray(invs_np)
+
+    def dense_round(r, carry):
         a, v = carry
         pq = pairs[r % (n - 1)]
         p, q = pq[:, 0], pq[:, 1]                   # [n/2] each
@@ -160,14 +201,7 @@ def jacobi_eigh(x, sweeps=None, basis=None):
         apq = jnp.take_along_axis(rows_p, q[None, :, None], -1)[..., 0]
         rows_q = jnp.take(a, q, axis=-2)
         aqq = jnp.take_along_axis(rows_q, q[None, :, None], -1)[..., 0]
-        # stable Givens: tau = (aqq-app)/(2 apq), t the smaller root
-        apq_safe = jnp.where(jnp.abs(apq) < tiny, 1.0, apq)
-        tau = (aqq - app) / (2.0 * apq_safe)
-        sgn = jnp.where(tau >= 0, 1.0, -1.0)
-        t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
-        t = jnp.where(jnp.abs(apq) < tiny, 0.0, t)
-        c = 1.0 / jnp.sqrt(1.0 + t * t)             # [L, n/2]
-        s = t * c
+        c, s = _givens_cs(app, aqq, apq, tiny)      # [L, n/2]
         batch = a.shape[0]
         j = jnp.broadcast_to(eye, a.shape)
         bidx = jnp.arange(batch)[:, None]
@@ -185,6 +219,38 @@ def jacobi_eigh(x, sweeps=None, basis=None):
         a = 0.5 * (a + jnp.swapaxes(a, -1, -2))
         return a, v
 
+    def paired_round(r, carry):
+        # permute this round's pairs adjacent, rotate the 2x2 blocks
+        # elementwise (O(n^2) per round vs the dense form's n^3 matmuls),
+        # permute back
+        a, v = carry
+        idx = r % (n - 1)
+        perm, inv = perms[idx], invs[idx]
+        ap = jnp.take(jnp.take(a, perm, axis=-2), perm, axis=-1)
+        d = jnp.diagonal(ap, axis1=-2, axis2=-1)    # [L, n]
+        app, aqq = d[..., 0::2], d[..., 1::2]       # [L, n/2]
+        apq = jnp.diagonal(ap[..., 0::2, 1::2], axis1=-2, axis2=-1)
+        c, s = _givens_cs(app, aqq, apq, tiny)      # [L, n/2]
+        cr = c[..., None]
+        sr = s[..., None]
+
+        def rot_rows(m):                            # J^T on the left:
+            mr = m.reshape(m.shape[:-2] + (n // 2, 2, n))
+            r0, r1 = mr[..., 0, :], mr[..., 1, :]
+            out = jnp.stack([cr * r0 - sr * r1, sr * r0 + cr * r1],
+                            axis=-2)
+            return out.reshape(m.shape)
+
+        ap = rot_rows(ap)
+        ap = jnp.swapaxes(rot_rows(jnp.swapaxes(ap, -1, -2)), -1, -2)
+        a = jnp.take(jnp.take(ap, inv, axis=-2), inv, axis=-1)
+        vp = jnp.take(v, perm, axis=-1)             # V J: columns rotate
+        vp = jnp.swapaxes(rot_rows(jnp.swapaxes(vp, -1, -2)), -1, -2)
+        v = jnp.take(vp, inv, axis=-1)
+        a = 0.5 * (a + jnp.swapaxes(a, -1, -2))
+        return a, v
+
+    round_step = dense_round if rotate == 'dense' else paired_round
     a, v = lax.fori_loop(0, sweeps * (n - 1), round_step, (a0, v0))
     w = jnp.diagonal(a, axis1=-2, axis2=-1)
     if odd:

@@ -48,6 +48,25 @@ def test_jacobi_eigh_matches_numpy():
         np.testing.assert_allclose(rec, x, atol=1e-4 * scale, rtol=1e-4)
 
 
+def test_jacobi_paired_rotation_matches_dense():
+    """'paired' (permute pairs adjacent, rotate 2x2 blocks elementwise)
+    and 'dense' (packed-J matmuls) are two evaluations of the same
+    rotation sequence — results must agree to rounding noise."""
+    rng = np.random.RandomState(7)
+    for shape in [(2, 16, 16), (1, 30, 30), (21, 21)]:
+        x = _spd(rng, *shape) / shape[-1]
+        wd, vd = ops.jacobi_eigh(jnp.asarray(x), rotate='dense')
+        wp, vp = ops.jacobi_eigh(jnp.asarray(x), rotate='paired')
+        np.testing.assert_allclose(np.asarray(wd), np.asarray(wp),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.abs(np.asarray(vd)),
+                                   np.abs(np.asarray(vp)),
+                                   rtol=1e-3, atol=1e-3)
+    import pytest
+    with pytest.raises(ValueError):
+        ops.jacobi_eigh(jnp.eye(4), rotate='nope')
+
+
 def test_sym_eig_jacobi_impl_dispatch():
     rng = np.random.RandomState(4)
     x = _spd(rng, 2, 12, 12)
