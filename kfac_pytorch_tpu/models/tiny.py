@@ -1,0 +1,29 @@
+"""Tiny conv net for tests and the driver's multi-chip dry run.
+
+Not part of the reference zoo — a minimal K-FAC-preconditionable model so
+every compiled step variant stays cheap. Shared by tests/helpers.py and
+__graft_entry__.dryrun_multichip so the two cannot drift.
+"""
+
+import flax.linen as linen
+
+from kfac_pytorch_tpu import nn as knn
+
+
+class TinyCNN(linen.Module):
+    """Two K-FAC convs + dense head; optional BatchNorm so the dry run also
+    exercises the cross-replica batch_stats sync path."""
+
+    batch_norm: bool = False
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        x = knn.Conv(8, (3, 3), name='c1')(x)
+        if self.batch_norm:
+            x = linen.BatchNorm(use_running_average=not train,
+                                momentum=0.9, name='bn1')(x)
+        x = linen.relu(x)
+        x = knn.Conv(8, (3, 3), strides=(2, 2), name='c2')(x)
+        x = linen.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        return knn.Dense(10, name='fc')(x)

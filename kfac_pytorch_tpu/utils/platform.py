@@ -1,0 +1,50 @@
+"""Virtual host-platform forcing, shared by bench.py, scripts/, tests, and
+the driver dry run.
+
+The deployment environment pins ``JAX_PLATFORMS`` at interpreter start
+(sitecustomize), so the env var cannot be used to escape to a virtual CPU
+mesh — the platform must go through ``jax.config`` before any backend
+initializes, and the device count through ``XLA_FLAGS`` (read lazily at
+client init) or ``jax_num_cpu_devices``.
+"""
+
+import os
+import re
+
+
+def force_host_platform(platform=None, n_devices=None):
+    """Force ``platform`` with ``n_devices`` virtual host devices.
+
+    Must be called before any backend initializes (any ``jax.devices()`` or
+    computation). Returns True when ``jax.devices()`` now satisfies the
+    request; False means a backend was already initialized incompatibly —
+    JAX cannot re-platform or grow the device count post-init, so the
+    caller must re-exec in a fresh process. When neither argument is given
+    this is a no-op returning True (backend stays lazy).
+    """
+    import jax
+
+    if n_devices is not None:
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' in flags:
+            flags = re.sub(
+                r'--xla_force_host_platform_device_count=\d+',
+                f'--xla_force_host_platform_device_count={n_devices}', flags)
+        else:
+            flags += f' --xla_force_host_platform_device_count={n_devices}'
+        os.environ['XLA_FLAGS'] = flags
+    if platform:
+        jax.config.update('jax_platforms', platform)
+        if platform == 'cpu' and n_devices is not None:
+            try:
+                jax.config.update('jax_num_cpu_devices', n_devices)
+            except RuntimeError:
+                pass  # already initialized; XLA_FLAGS may still have taken
+    if not platform:
+        return True  # nothing to verify without forcing a platform init
+    devices = jax.devices()
+    ok = all(d.platform == platform
+             for d in devices[:n_devices or len(devices)])
+    if n_devices is not None:
+        ok = ok and len(devices) >= n_devices
+    return ok

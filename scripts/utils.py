@@ -24,12 +24,8 @@ def force_platform():
     plat = os.environ.get('KFAC_PLATFORM')
     if not plat:
         return
-    nd = int(os.environ.get('KFAC_HOST_DEVICES', '8'))
-    os.environ['XLA_FLAGS'] = (
-        os.environ.get('XLA_FLAGS', '')
-        + f' --xla_force_host_platform_device_count={nd}')
-    import jax
-    jax.config.update('jax_platforms', plat)
+    from kfac_pytorch_tpu.utils.platform import force_host_platform
+    force_host_platform(plat, int(os.environ.get('KFAC_HOST_DEVICES', '8')))
 
 
 # --model flag values (models/__init__.py registry) that are ImageNet-scale;

@@ -1,18 +1,3 @@
 """Shared test helpers."""
 
-import flax.linen as linen
-
-from kfac_pytorch_tpu import nn as knn
-
-
-class TinyCNN(linen.Module):
-    """Small conv+dense model so each compiled step variant is cheap."""
-
-    @linen.compact
-    def __call__(self, x, train=True):
-        x = knn.Conv(8, (3, 3), name='c1')(x)
-        x = linen.relu(x)
-        x = knn.Conv(8, (3, 3), strides=(2, 2), name='c2')(x)
-        x = linen.relu(x)
-        x = x.reshape(x.shape[0], -1)
-        return knn.Dense(10, name='fc')(x)
+from kfac_pytorch_tpu.models.tiny import TinyCNN  # noqa: F401 (re-export)
