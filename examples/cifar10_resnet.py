@@ -64,6 +64,11 @@ def parse_args():
                    help='warm-start full eigendecompositions in the '
                         'previous eigenbasis (jacobi eigh only)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
+    p.add_argument('--kfac-type', '--fisher-type', default='Femp',
+                   choices=['Femp', 'F1mc'],
+                   help='Fisher estimator: empirical-gradient (Femp) or '
+                        '1-sample MC with model-sampled pseudo labels '
+                        '(F1mc; reference pytorch_cifar10_resnet.py:74-75)')
     p.add_argument('--kfac-name', default='eigen_dp',
                    choices=list(kfac.KFAC_VARIANTS))
     p.add_argument('--stat-decay', type=float, default=0.95)
@@ -155,7 +160,9 @@ def main():
                                       jax.random.PRNGKey(args.seed), sample)
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
-                                     extra_mutable=('batch_stats',))
+                                     extra_mutable=('batch_stats',),
+                                     fisher_type=args.kfac_type,
+                                     fisher_seed=args.seed)
 
     @jax.jit
     def eval_step(params, extra_vars, batch):

@@ -70,7 +70,9 @@ def _warm_basis_gate(precond, seen, step, ui, ub):
 
 def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      extra_mutable=(), sync_extra_vars=True, donate=True,
-                     dropout_seed=None, batch_specs=None, check_vma=None):
+                     dropout_seed=None, batch_specs=None, check_vma=None,
+                     fisher_type='Femp', fisher_loss_fn=None,
+                     fisher_sample_fn=None, fisher_seed=0):
     """Build the per-iteration function family.
 
     Args:
@@ -92,11 +94,41 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         rejects vma-tagged scalar-prefetch args). Pass an explicit bool
         when selecting ``block_impl='pallas_interpret'`` per-call instead
         of via KFAC_ATTN_IMPL.
+      fisher_type: 'Femp' (default) estimates the Fisher from the
+        empirical-gradient backward; 'F1mc' is the true-Fisher 1-sample MC
+        estimator — on factor-update steps a second capture backward runs
+        against labels sampled from the model's own predictive
+        distribution, and its (a, g) feed the factors while the parameter
+        update still uses the real-loss gradients. The reference declares
+        this choice (examples/utils.py:82-90 generate_pseudo_labels) but
+        never wires it into a trainer; here it is first-class. Both
+        backwards live in one compiled program (XLA CSEs the shared
+        forward), so the extra cost lands only on fac_update_freq steps.
+      fisher_loss_fn: F1mc sampling loss ``(outputs, pseudo_labels) ->
+        scalar`` (local mean). Default: softmax cross-entropy over the
+        last axis, which covers classifiers and LM token heads.
+      fisher_sample_fn: F1mc label sampler ``(rng, outputs) ->
+        pseudo_labels``; must draw from the predictive distribution
+        implied by ``fisher_loss_fn`` (override BOTH together — e.g. a
+        Gaussian head needs a Gaussian sampler, not the default
+        categorical). Default: ``utils.losses.sample_pseudo_labels``.
+      fisher_seed: base seed for the pseudo-label sampler (folded with the
+        step counter and, under data parallelism, the device index).
 
     Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
     dispatches between up to four compiled variants using the
     preconditioner's host-side update frequencies.
     """
+    if fisher_type not in ('Femp', 'F1mc'):
+        raise ValueError(f'fisher_type must be Femp or F1mc, '
+                         f'got {fisher_type!r}')
+    if fisher_loss_fn is None:
+        def fisher_loss_fn(outputs, pseudo_labels):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                outputs, pseudo_labels).mean()
+    if fisher_sample_fn is None:
+        from kfac_pytorch_tpu.utils.losses import sample_pseudo_labels
+        fisher_sample_fn = sample_pseudo_labels
 
     def one_step(state, batch, hyper, update_factors, update_inverse,
                  update_basis=True, warm_basis=False, factors_only=False):
@@ -117,6 +149,21 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             loss, out, grads, acts, gs, mutated = \
                 capture.value_and_grad_with_capture(
                     model, lambda o: loss_fn(o, batch), variables, x,
+                    mutable=extra_mutable, axis_name=axis_name, rngs=rngs)
+            if fisher_type == 'F1mc':
+                # true-Fisher MC estimate: re-capture (a, g) from a backward
+                # against labels sampled from the model's own distribution;
+                # the parameter update keeps the real-loss grads above.
+                # 0xF15C domain tag keeps this stream distinct from the
+                # dropout stream even when dropout_seed == fisher_seed.
+                key = jax.random.fold_in(jax.random.PRNGKey(fisher_seed),
+                                         0xF15C)
+                key = jax.random.fold_in(key, state.step)
+                if axis_name is not None:
+                    key = jax.random.fold_in(key, coll.axis_index(axis_name))
+                pseudo = fisher_sample_fn(key, jax.lax.stop_gradient(out))
+                _, _, _, acts, gs, _ = capture.value_and_grad_with_capture(
+                    model, lambda o: fisher_loss_fn(o, pseudo), variables, x,
                     mutable=extra_mutable, axis_name=axis_name, rngs=rngs)
         else:
             def plain_loss(params):

@@ -179,3 +179,57 @@ def test_sharded_training_runs_and_matches_replicated_params():
     assert np.isfinite(float(m['loss']))
     state, m2 = step(state, batch, lr=0.1, damping=0.003)
     assert np.isfinite(float(m2['loss']))
+
+
+def _one_f1mc_step(model, batch, fisher_type, seed=0):
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
+                        fac_update_freq=1, kfac_update_freq=1,
+                        num_devices=1, axis_name=None)
+    tx = training.sgd(0.1, momentum=0.9)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0), batch['input'])
+    step = training.build_train_step(model, tx, precond, _ce,
+                                     fisher_type=fisher_type,
+                                     fisher_seed=seed)
+    state, m = step(state, batch, lr=0.1, damping=0.003)
+    assert np.isfinite(float(m['loss']))
+    return state, precond
+
+
+def test_f1mc_changes_g_factors_only():
+    """F1mc's pseudo-label backward must change the G factors (different
+    cotangents) but not the A factors (same forward activations), and the
+    sampler must be seed-reproducible (reference capability surface:
+    examples/utils.py:82-90 + pytorch_cifar10_resnet.py:74-75)."""
+    model = TinyCNN()
+    batch = _batch()
+    s_emp, precond = _one_f1mc_step(model, batch, 'Femp')
+    s_mc, _ = _one_f1mc_step(model, batch, 'F1mc')
+    s_mc_same, _ = _one_f1mc_step(model, batch, 'F1mc')
+    s_mc_other, _ = _one_f1mc_step(model, batch, 'F1mc', seed=123)
+
+    g_diff = 0
+    for ba, ra, bg, rg, _owner in precond.plan.layer_rows:
+        a_emp = np.asarray(s_emp.kfac_state.factors[str(ba)][ra])
+        a_mc = np.asarray(s_mc.kfac_state.factors[str(ba)][ra])
+        np.testing.assert_allclose(a_emp, a_mc, atol=1e-5)
+        g_emp = np.asarray(s_emp.kfac_state.factors[str(bg)][rg])
+        g_mc = np.asarray(s_mc.kfac_state.factors[str(bg)][rg])
+        g_diff += int(not np.allclose(g_emp, g_mc, atol=1e-6))
+    assert g_diff > 0, 'F1mc produced identical G factors to Femp'
+
+    # identical seed -> identical factors; different seed -> different Gs
+    for k in s_mc.kfac_state.factors:
+        np.testing.assert_array_equal(
+            np.asarray(s_mc.kfac_state.factors[k]),
+            np.asarray(s_mc_same.kfac_state.factors[k]))
+    assert any(
+        not np.allclose(np.asarray(s_mc.kfac_state.factors[str(bg)][rg]),
+                        np.asarray(s_mc_other.kfac_state.factors[str(bg)][rg]),
+                        atol=1e-6)
+        for _, _, bg, rg, _ in precond.plan.layer_rows)
+
+    # the parameter update itself must differ (factors feed the precond)
+    diff = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                        s_emp.params, s_mc.params)
+    assert max(jax.tree.leaves(diff)) > 0
