@@ -88,6 +88,8 @@ def parse_args():
     p.add_argument('--speed', action='store_true',
                    help='SPEED mode: time ~60 iterations and exit')
     p.add_argument('--log-dir', default='./logs')
+    p.add_argument('--tb-dir', default=None,
+                   help='write TensorBoard scalar summaries here (rank 0)')
     p.add_argument('--checkpoint-dir', default=None)
     return p.parse_args()
 
@@ -189,6 +191,10 @@ def main():
                  args.batch_size / np.mean(times))
         return
 
+    tb = None
+    if args.tb_dir and jax.process_index() == 0:
+        from kfac_pytorch_tpu.utils.summary import SummaryWriter
+        tb = SummaryWriter(args.tb_dir)
     for epoch in range(args.epochs):
         train_loss = utils.Metric('train_loss')
         t0 = time.time()
@@ -205,10 +211,18 @@ def main():
             l, a = eval_step(state.params, state.extra_vars, batch)
             val_loss.update(l, len(batch['label']))
             val_acc.update(a, len(batch['label']))
+        # sync() is a cross-process collective — call it on ALL ranks here
+        # and reuse the values in the rank-0-only tb block below
+        tl, vl_avg, va_avg = (train_loss.sync().avg, val_loss.sync().avg,
+                              val_acc.sync().avg)
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)', epoch, train_loss.sync().avg,
-                 val_loss.sync().avg, val_acc.sync().avg,
-                 time.time() - t0)
+                 '(%.1fs)', epoch, tl, vl_avg, va_avg, time.time() - t0)
+        if tb is not None:
+            tb.add_scalar('train/loss', tl, epoch)
+            tb.add_scalar('train/lr', float(lr_fn(int(state.step))), epoch)
+            tb.add_scalar('val/loss', vl_avg, epoch)
+            tb.add_scalar('val/accuracy', va_avg, epoch)
+            tb.flush()
         if scheduler is not None:
             scheduler.step(epoch + 1)
         if args.checkpoint_dir:

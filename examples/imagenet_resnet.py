@@ -75,6 +75,10 @@ def parse_args():
     p.add_argument('--speed', action='store_true')
     p.add_argument('--bf16', action='store_true', default=True)
     p.add_argument('--log-dir', default='./logs')
+    p.add_argument('--tb-dir', default=None,
+                   help='write TensorBoard scalar summaries here (rank 0; '
+                        'reference pytorch_imagenet_resnet.py:169-178, '
+                        '405-408 — gated there, first-class here)')
     p.add_argument('--checkpoint-format', default='./checkpoints')
     p.add_argument('--synthetic-size', type=int, default=1024)
     return p.parse_args()
@@ -201,6 +205,10 @@ def main():
                  args.batch_size / np.mean(times))
         return
 
+    tb = None
+    if args.tb_dir and jax.process_index() == 0:
+        from kfac_pytorch_tpu.utils.summary import SummaryWriter
+        tb = SummaryWriter(args.tb_dir)
     for epoch in range(start_epoch, args.epochs):
         t0 = time.time()
         tm = utils.Metric('train_loss')
@@ -218,9 +226,17 @@ def main():
             l, a = eval_step(state.params, state.extra_vars, b)
             vl.update(l)
             va.update(a)
+        # sync() is a cross-process collective — call it on ALL ranks here
+        # and reuse the values in the rank-0-only tb block below
+        tl, vl_avg, va_avg = (tm.sync().avg, vl.sync().avg, va.sync().avg)
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)', epoch, tm.sync().avg, vl.sync().avg,
-                 va.sync().avg, time.time() - t0)
+                 '(%.1fs)', epoch, tl, vl_avg, va_avg, time.time() - t0)
+        if tb is not None:
+            tb.add_scalar('train/loss', tl, epoch)
+            tb.add_scalar('train/lr', float(lr_fn(int(state.step))), epoch)
+            tb.add_scalar('val/loss', vl_avg, epoch)
+            tb.add_scalar('val/accuracy', va_avg, epoch)
+            tb.flush()
         if scheduler is not None:
             scheduler.step(epoch + 1)
         utils.save_checkpoint(args.checkpoint_format, epoch, state)

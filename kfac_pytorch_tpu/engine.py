@@ -167,7 +167,13 @@ def _local_table(arr, axis_name):
 def local_evecs(plan, decomp, axis_name, comm_mode):
     """This device's eigenbasis rows from a stored decomposition (local
     already in 'pred' mode; sliced out of the gathered/replicated layout
-    in 'inverse' mode)."""
+    in 'inverse' mode).
+
+    Never-decomposed (all-zero) rows come back as the identity, so a warm
+    request against a fresh state degrades to a cold Jacobi instead of
+    rotating into a zero 'basis' and corrupting the decomposition — a
+    guard for direct ``KFAC.step(warm_basis=True)`` callers that bypass
+    the trainer-side seen-inverse gate."""
     out = {}
     for bdim in plan.bucket_dims:
         key = _key(bdim)
@@ -176,7 +182,8 @@ def local_evecs(plan, decomp, axis_name, comm_mode):
             per_dev = plan.buckets[bdim].per_dev
             idx = coll.axis_index(axis_name)
             q = lax.dynamic_slice_in_dim(q, idx * per_dev, per_dev, axis=0)
-        out[key] = q
+        valid = jnp.any(q != 0, axis=(-2, -1), keepdims=True)
+        out[key] = jnp.where(valid, q, jnp.eye(q.shape[-1], dtype=q.dtype))
     return out
 
 

@@ -94,6 +94,13 @@ class KFAC:
         eigenbasis (rotate, few Jacobi sweeps, rotate back). Effective
         when KFAC_EIGH_IMPL resolves to jacobi; composes with
         basis_update_freq.
+      warm_sweeps: Jacobi sweep count for warm-started full
+        decompositions (None = the jacobi kernel's warm default, 5 —
+        calibrated for the stat_decay=0.95 / <=10-step full-interval
+        drift regime). Raise it for longer intervals between fulls
+        (large basis_update_freq / kfac_update_freq) or faster factor
+        decay: the stored basis rotates further between fulls and five
+        sweeps can under-converge.
       cold_restart_every: with warm_start_basis, force a cold (from
         scratch) full decomposition after this many consecutive warm
         ones — the chained basis Q <- Q @ V' accumulates ~1e-7
@@ -150,10 +157,16 @@ class KFAC:
                     "(QDWH cannot warm-start) — set KFAC_EIGH_IMPL="
                     "'jacobi' or 'auto' to use it", stacklevel=2)
         self.warm_start_basis = warm_start_basis
-        # warm-start sweep count: the default (5) is calibrated for the
-        # stat_decay=0.95 / freq<=10 drift regime; raise it for long
-        # inverse intervals or aggressive decay, where the stored basis
-        # rotates further between full decompositions
+        if warm_start_basis and warm_sweeps is None:
+            interval = basis_update_freq or kfac_update_freq
+            if interval > 10:
+                import warnings
+                warnings.warn(
+                    f'warm_start_basis with a {interval}-step interval '
+                    'between full decompositions: the default warm_sweeps '
+                    '(5) is calibrated for <=10-step basis drift — pass '
+                    'warm_sweeps>=8 if eigen accuracy degrades',
+                    stacklevel=2)
         self.warm_sweeps = warm_sweeps
         # every warm full compounds ~1e-7 orthogonality error into the
         # chained basis Q <- Q @ V'; a periodic cold full resets it.

@@ -279,7 +279,23 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                            else getattr(precond, 'lr', 0.0)),
             damping=jnp.float32(damping if damping is not None
                                 else getattr(precond, 'damping', 0.0)))
-        return variants[key](state, batch, hyper)
+        try:
+            return variants[key](state, batch, hyper)
+        except Exception as e:
+            # per-call block_impl='pallas_interpret' cannot be seen by the
+            # check_vma auto-detection (it only reads KFAC_ATTN_IMPL), and
+            # the resulting shard_map trace error is cryptic — point at
+            # the escape hatch
+            msg = str(e)
+            if check_vma is None and ('vma' in msg or 'Varying' in msg
+                                      or 'varying' in msg):
+                raise type(e)(
+                    msg + '\n[kfac_pytorch_tpu] If this model routes '
+                    'attention through the Pallas interpreter per-call '
+                    "(block_impl='pallas_interpret') rather than via "
+                    'KFAC_ATTN_IMPL, pass check_vma=False to '
+                    'build_train_step.') from e
+            raise
 
     return step_fn
 

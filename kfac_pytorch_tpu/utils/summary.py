@@ -1,0 +1,109 @@
+"""Dependency-free TensorBoard scalar export.
+
+The reference optionally wires torch's SummaryWriter (and in fact ships
+with it disabled: examples/pytorch_imagenet_resnet.py:169-178 sets
+``log_writer = None``); here scalar export is first-class and native —
+event files are written directly in the TFRecord + Event-proto wire
+format (hand-encoded; no torch/tensorboard import in the hot path), so
+the framework needs no logging dependency and the files load in stock
+TensorBoard.
+
+Wire format notes (both are stable public formats):
+  record  = len(u64 LE) | masked_crc32c(len) | payload | masked_crc32c(payload)
+  Event   = 1: wall_time (double) | 2: step (varint int64)
+          | 3: file_version (string, first record only) | 5: Summary
+  Summary = 1: repeated Value;  Value = 1: tag (string) | 2: simple_value
+"""
+
+import os
+import socket
+import struct
+import time
+
+
+def _crc32c_table():
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_TABLE = _crc32c_table()
+
+
+def _crc32c(data):
+    c = 0xFFFFFFFF
+    for b in data:
+        c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    c = _crc32c(data)
+    return ((((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _field(num, wire, payload):
+    return _varint((num << 3) | wire) + payload
+
+
+def _len_delim(num, payload):
+    return _field(num, 2, _varint(len(payload)) + payload)
+
+
+def _event(wall_time, step=None, file_version=None, tag=None, value=None):
+    msg = _field(1, 1, struct.pack('<d', wall_time))
+    if step is not None:
+        msg += _field(2, 0, _varint(step))
+    if file_version is not None:
+        msg += _len_delim(3, file_version.encode())
+    if tag is not None:
+        val = _len_delim(1, tag.encode()) + _field(
+            2, 5, struct.pack('<f', float(value)))
+        msg += _len_delim(5, _len_delim(1, val))
+    return msg
+
+
+class SummaryWriter:
+    """Minimal scalar-only TensorBoard writer.
+
+    Usage mirrors the torch API surface the reference gates on
+    (add_scalar/flush/close); construct on rank 0 only, like the
+    reference's first-worker gating."""
+
+    def __init__(self, log_dir):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = (f'events.out.tfevents.{int(time.time())}.'
+                 f'{socket.gethostname()}.{os.getpid()}')
+        self._f = open(os.path.join(log_dir, fname), 'wb')
+        self._write(_event(time.time(), file_version='brain.Event:2'))
+
+    def _write(self, payload):
+        header = struct.pack('<Q', len(payload))
+        self._f.write(header + struct.pack('<I', _masked_crc(header))
+                      + payload + struct.pack('<I', _masked_crc(payload)))
+
+    def add_scalar(self, tag, value, step):
+        self._write(_event(time.time(), step=int(step), tag=tag,
+                           value=value))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()

@@ -245,3 +245,20 @@ def test_param_scheduler():
     assert precond.fac_update_freq == 4 and precond.kfac_update_freq == 20
     assert precond.should_update_factors(8)
     assert not precond.should_update_factors(9)
+
+
+def test_warm_basis_on_fresh_state_degrades_to_cold(monkeypatch):
+    """Direct API call step(warm_basis=True) on a never-decomposed state:
+    the zero stored 'basis' must be treated as identity (cold Jacobi), not
+    rotated into (ADVICE r1: trainer-side gating was the only safety)."""
+    monkeypatch.setenv('KFAC_EIGH_IMPL', 'jacobi')
+    precond, state, grads, acts, gs, metas = _setup(
+        'eigen_dp', warm_start_basis=True)
+    g_cold, _ = precond.step(state, grads, acts, gs)
+    g_warm, s_warm = precond.step(state, grads, acts, gs, warm_basis=True)
+    for name in metas:
+        np.testing.assert_allclose(np.asarray(g_cold[name]['kernel']),
+                                   np.asarray(g_warm[name]['kernel']),
+                                   rtol=1e-3, atol=1e-4)
+    for k in s_warm.decomp['evals']:
+        assert np.all(np.isfinite(np.asarray(s_warm.decomp['evals'][k])))

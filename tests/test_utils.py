@@ -4,6 +4,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kfac_pytorch_tpu import data
 from kfac_pytorch_tpu.utils import losses, lr, metrics
@@ -173,3 +174,22 @@ def test_augment_preserves_shape_and_range():
     out = data.augment_cifar(rng, x)
     assert out.shape == x.shape
     assert np.isfinite(out).all()
+
+
+def test_summary_writer_tensorboard_roundtrip(tmp_path):
+    """Native event files must load in stock TensorBoard (scalars arrive
+    as migrated tensor values)."""
+    pytest.importorskip('tensorboard')
+    from kfac_pytorch_tpu.utils.summary import SummaryWriter
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalar('train/loss', 2.5, 0)
+    w.add_scalar('val/accuracy', 0.875, 7)
+    w.close()
+    from tensorboard.backend.event_processing import event_file_loader
+    import glob as _glob
+    f = _glob.glob(str(tmp_path) + '/events.out.tfevents.*')[0]
+    got = []
+    for e in event_file_loader.EventFileLoader(f).Load():
+        for v in e.summary.value:
+            got.append((e.step, v.tag, float(v.tensor.float_val[0])))
+    assert got == [(0, 'train/loss', 2.5), (7, 'val/accuracy', 0.875)], got
