@@ -191,17 +191,16 @@ def main():
                  args.batch_size / np.mean(times))
         return
 
-    tb = None
-    if args.tb_dir and jax.process_index() == 0:
-        from kfac_pytorch_tpu.utils.summary import SummaryWriter
-        tb = SummaryWriter(args.tb_dir)
+    from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer
+    tb = maybe_writer(args.tb_dir)
+    lr_now = args.base_lr
     for epoch in range(args.epochs):
         train_loss = utils.Metric('train_loss')
         t0 = time.time()
         for batch in train_loader.epoch():
             batch = {k: jnp.asarray(v) for k, v in batch.items()}
-            s = int(state.step)
-            state, m = step(state, batch, lr=lr_fn(s),
+            lr_now = float(lr_fn(int(state.step)))
+            state, m = step(state, batch, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             train_loss.update(m['loss'], len(batch['label']))
         val_loss = utils.Metric('val_loss')
@@ -217,12 +216,7 @@ def main():
                               val_acc.sync().avg)
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
                  '(%.1fs)', epoch, tl, vl_avg, va_avg, time.time() - t0)
-        if tb is not None:
-            tb.add_scalar('train/loss', tl, epoch)
-            tb.add_scalar('train/lr', float(lr_fn(int(state.step))), epoch)
-            tb.add_scalar('val/loss', vl_avg, epoch)
-            tb.add_scalar('val/accuracy', va_avg, epoch)
-            tb.flush()
+        log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)
         if args.checkpoint_dir:

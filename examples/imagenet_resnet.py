@@ -205,18 +205,17 @@ def main():
                  args.batch_size / np.mean(times))
         return
 
-    tb = None
-    if args.tb_dir and jax.process_index() == 0:
-        from kfac_pytorch_tpu.utils.summary import SummaryWriter
-        tb = SummaryWriter(args.tb_dir)
+    from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer
+    tb = maybe_writer(args.tb_dir)
+    lr_now = args.base_lr
     for epoch in range(start_epoch, args.epochs):
         t0 = time.time()
         tm = utils.Metric('train_loss')
         for batch in train_loader.epoch():
             b = {'input': jnp.asarray(batch['input'], dtype),
                  'label': jnp.asarray(batch['label'])}
-            s = int(state.step)
-            state, m = step(state, b, lr=lr_fn(s),
+            lr_now = float(lr_fn(int(state.step)))
+            state, m = step(state, b, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             tm.update(m['loss'])
         vl, va = utils.Metric('vl'), utils.Metric('va')
@@ -231,12 +230,7 @@ def main():
         tl, vl_avg, va_avg = (tm.sync().avg, vl.sync().avg, va.sync().avg)
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
                  '(%.1fs)', epoch, tl, vl_avg, va_avg, time.time() - t0)
-        if tb is not None:
-            tb.add_scalar('train/loss', tl, epoch)
-            tb.add_scalar('train/lr', float(lr_fn(int(state.step))), epoch)
-            tb.add_scalar('val/loss', vl_avg, epoch)
-            tb.add_scalar('val/accuracy', va_avg, epoch)
-            tb.flush()
+        log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)
         utils.save_checkpoint(args.checkpoint_format, epoch, state)

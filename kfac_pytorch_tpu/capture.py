@@ -104,10 +104,34 @@ def collect_layer_meta(model, variables, *args, exclude_vocabulary_size=None,
             variables)
     metas = dict(layers)
     if exclude_vocabulary_size is not None:
-        metas = {k: m for k, m in metas.items()
-                 if not (m.kind == 'dense'
-                         and m.out_dim == exclude_vocabulary_size)}
+        metas = filter_vocab_head(metas, exclude_vocabulary_size)
     return metas
+
+
+def filter_vocab_head(metas, vocab_size):
+    """Drop the pre-softmax head: the FINAL captured layer, iff it is a
+    dense with ``out_dim == vocab_size``. The reference
+    (kfac_preconditioner_base.py:139-140) matches by dim at any position;
+    that blunt match silently drops interior layers that merely share the
+    dim — e.g. a KFACLSTMCell's 4H gate projections when vocab ==
+    4*hidden — so here only the last-called layer is excluded and other
+    matches are kept with a warning."""
+    names = list(metas)
+    drop = set()
+    if names:
+        last = metas[names[-1]]
+        if last.kind == 'dense' and last.out_dim == vocab_size:
+            drop.add(names[-1])
+    interior = [k for k in names if k not in drop
+                and metas[k].kind == 'dense'
+                and metas[k].out_dim == vocab_size]
+    if interior:
+        import warnings
+        warnings.warn(
+            f'layers {interior} match exclude_vocabulary_size={vocab_size} '
+            'but are not the trailing pre-softmax head — keeping them '
+            'preconditioned', stacklevel=2)
+    return {k: m for k, m in metas.items() if k not in drop}
 
 
 # ---------------------------------------------------------------------------

@@ -4,16 +4,48 @@ Parity with the reference zoo's RNN LM (examples/wikitext_models.py:1-72:
 embedding, n-layer LSTM, dropout, tied-or-untied decoder). The reference
 marks this workload "does not work with K-FAC yet"
 (examples/pytorch_wikitext_rnn.py:6) — recurrent layers are not
-K-FAC-supported there either (hooks attach to Linear only). Here the
-decoder is a KFAC Dense layer, excluded by vocab size at setup, matching
-that behavior; the LSTM runs via lax.scan (compiler-friendly recurrence).
+K-FAC-supported there either (hooks attach to Linear only).
+
+Here K-FAC on the LSTM's internal matmuls IS supported (beyond
+reference): ``kfac_lstm=True`` swaps in :class:`KFACLSTMCell`, whose
+input and recurrent projections are KFAC Dense layers scanned with
+per-timestep capture — ``nn.scan`` stacks the zero taps and sown inputs
+along the time axis, so the backward yields the true per-timestep
+``dL/d(preactivation)`` through the full recurrence, and the factor math
+treats time like any other leading batch axis (exactly the transformer
+convention). Default is the plain fused cell (reference parity).
 """
 
 import flax.linen as linen
 import jax
 import jax.numpy as jnp
 
+from kfac_pytorch_tpu import capture
 from kfac_pytorch_tpu import nn as knn
+
+
+class KFACLSTMCell(linen.Module):
+    """LSTM cell whose gate projections are K-FAC-captured Dense layers.
+
+    ``gates = ih(x_t) + hh(h_{t-1})`` with ``ih`` carrying the bias —
+    same parameterization (and parameter count) as the standard fused
+    cell, but each projection is a capture-aware matmul, so scanning the
+    cell produces factor statistics for W_ih ([E(+1) x 4H]) and W_hh
+    ([H x 4H]).
+    """
+
+    features: int
+
+    @linen.compact
+    def __call__(self, carry, x_t):
+        c, h = carry
+        gates = (knn.Dense(4 * self.features, name='ih')(x_t)
+                 + knn.Dense(4 * self.features, use_bias=False,
+                             name='hh')(h))
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = linen.sigmoid(f) * c + linen.sigmoid(i) * jnp.tanh(g)
+        h = linen.sigmoid(o) * jnp.tanh(c)
+        return (c, h), h
 
 
 class LSTMLanguageModel(linen.Module):
@@ -23,6 +55,7 @@ class LSTMLanguageModel(linen.Module):
     num_layers: int = 2
     dropout: float = 0.5
     tie_weights: bool = False
+    kfac_lstm: bool = False   # capture the recurrent matmuls (beyond ref)
 
     @linen.compact
     def __call__(self, tokens, train=True):
@@ -31,16 +64,27 @@ class LSTMLanguageModel(linen.Module):
         x = emb(tokens)
         x = linen.Dropout(self.dropout, deterministic=not train)(x)
         for i in range(self.num_layers):
-            cell = linen.OptimizedLSTMCell(self.hidden_dim,
-                                           name=f'lstm_{i}')
             B = x.shape[0]
-            carry = cell.initialize_carry(
-                jax.random.PRNGKey(0), (B, x.shape[-1]))
-            scanner = linen.scan(
-                type(cell), variable_broadcast='params',
-                split_rngs={'params': False}, in_axes=1, out_axes=1)
-            carry, x = scanner(self.hidden_dim, name=f'lstm_scan_{i}')(
-                carry, x)
+            if self.kfac_lstm:
+                carry = (jnp.zeros((B, self.hidden_dim), x.dtype),
+                         jnp.zeros((B, self.hidden_dim), x.dtype))
+                # taps/acts get a leading time axis: per-timestep capture
+                scanner = linen.scan(
+                    KFACLSTMCell, variable_broadcast='params',
+                    variable_axes={capture.TAPS: 0, capture.ACTS: 0},
+                    split_rngs={'params': False}, in_axes=1, out_axes=1)
+                carry, x = scanner(self.hidden_dim,
+                                   name=f'lstm_scan_{i}')(carry, x)
+            else:
+                cell = linen.OptimizedLSTMCell(self.hidden_dim,
+                                               name=f'lstm_{i}')
+                carry = cell.initialize_carry(
+                    jax.random.PRNGKey(0), (B, x.shape[-1]))
+                scanner = linen.scan(
+                    type(cell), variable_broadcast='params',
+                    split_rngs={'params': False}, in_axes=1, out_axes=1)
+                carry, x = scanner(self.hidden_dim, name=f'lstm_scan_{i}')(
+                    carry, x)
             x = linen.Dropout(self.dropout, deterministic=not train)(x)
         if self.tie_weights:
             logits = x @ emb.embedding.T

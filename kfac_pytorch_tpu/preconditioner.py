@@ -194,9 +194,8 @@ class KFAC:
         exclusion is applied here if not already filtered.
         """
         if self.exclude_vocabulary_size is not None:
-            metas = {k: m for k, m in metas.items()
-                     if not (m.kind == 'dense'
-                             and m.out_dim == self.exclude_vocabulary_size)}
+            from kfac_pytorch_tpu.capture import filter_vocab_head
+            metas = filter_vocab_head(metas, self.exclude_vocabulary_size)
         distribute = self.distribute_layer_factors
         if self.variant == 'eigen' and distribute is None:
             # reference auto rule: factor-wise split iff world > #layers

@@ -289,7 +289,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             msg = str(e)
             if check_vma is None and ('vma' in msg or 'Varying' in msg
                                       or 'varying' in msg):
-                raise type(e)(
+                raise RuntimeError(
                     msg + '\n[kfac_pytorch_tpu] If this model routes '
                     'attention through the Pallas interpreter per-call '
                     "(block_impl='pallas_interpret') rather than via "
@@ -303,13 +303,18 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
 def init_train_state(model, tx, precond, rng, sample_input):
     """Initialize params, optimizer and K-FAC state (plus discovery of the
     capture layer metadata if the preconditioner isn't set up yet)."""
-    variables = capture.init(model, rng, sample_input)
+    # provide a dropout stream too: models that train with dropout (LSTM,
+    # transformer) request it at init since their __call__ defaults to
+    # train=True
+    rngs = {'params': rng, 'dropout': jax.random.fold_in(rng, 1)}
+    variables = capture.init(model, rngs, sample_input)
     params = variables.pop('params')
     kfac_state = None
     if precond is not None:
         if precond.plan is None:
             metas = capture.collect_layer_meta(
-                model, {'params': params, **variables}, sample_input)
+                model, {'params': params, **variables}, sample_input,
+                rngs={'dropout': jax.random.fold_in(rng, 2)})
             precond.setup(metas)
         kfac_state = precond.init()
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,

@@ -107,3 +107,25 @@ class SummaryWriter:
 
     def close(self):
         self._f.close()
+
+
+def maybe_writer(tb_dir):
+    """Rank-0-gated writer (the reference's first-worker gating)."""
+    import jax
+    if tb_dir and jax.process_index() == 0:
+        return SummaryWriter(tb_dir)
+    return None
+
+
+def log_epoch_scalars(tb, epoch, train_loss, lr, val_loss, val_acc):
+    """The trainers' shared per-epoch scalar set. ``tb`` may be None.
+    Callers must pass already-synced metric values — Metric.sync() is a
+    cross-process collective and must run on every rank, never inside a
+    rank-0-only branch."""
+    if tb is None:
+        return
+    tb.add_scalar('train/loss', train_loss, epoch)
+    tb.add_scalar('train/lr', lr, epoch)
+    tb.add_scalar('val/loss', val_loss, epoch)
+    tb.add_scalar('val/accuracy', val_acc, epoch)
+    tb.flush()

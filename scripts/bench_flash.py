@@ -102,7 +102,9 @@ def main():
                 print(f'  L={L:>7} {tag:>22}: {t * 1e3:>9.2f} ms '
                       f'({args.batch * L / t / 1e3:>8.1f}K tok/s)')
             except Exception as e:
-                if bwd is not None and run_idx == 0:
+                if bwd is not None and run_idx == 0 and tag not in outs:
+                    # only when the baseline GRADS were never stored — a
+                    # later timeit failure still leaves a usable baseline
                     baseline_missing = True
                 print(f'  L={L:>7} {tag:>22}: failed '
                       f'({type(e).__name__}: {str(e)[:80]})')

@@ -118,3 +118,24 @@ def test_conv_capture_g_shape_and_value():
         model, loss_fn, variables, x)
     assert gs['c1']['g'].shape == (2, 4, 4, 4)  # NHWC output grad
     assert acts['c1']['a'].shape == (2, 8, 8, 3)
+
+
+def test_vocab_exclusion_only_trailing_head():
+    """vocab == 4*hidden collision: the KFACLSTMCell gate projections must
+    stay preconditioned; only the trailing pre-softmax decoder is dropped
+    (with a warning about the interior dim match)."""
+    import warnings
+
+    from kfac_pytorch_tpu.models.rnn import wikitext_lstm
+
+    m = wikitext_lstm(64, embed_dim=16, hidden_dim=16, num_layers=1,
+                      dropout=0.0, kfac_lstm=True)
+    toks = jnp.zeros((2, 4), jnp.int32)
+    variables = capture.init(m, jax.random.PRNGKey(0), toks, train=False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        metas = capture.collect_layer_meta(m, variables, toks, train=False,
+                                           exclude_vocabulary_size=64)
+    assert set(metas) == {'lstm_scan_0/ih', 'lstm_scan_0/hh'}, metas
+    assert any('not the trailing pre-softmax head' in str(x.message)
+               for x in w)
