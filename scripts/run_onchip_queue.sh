@@ -1,0 +1,54 @@
+#!/bin/bash
+# One-shot on-chip validation queue (NOTES.md round-2): run the moment the
+# TPU tunnel is up. Sequential (ONE chip job at a time — concurrent jobs
+# deadlock on the single chip), timeout-wrapped (jax.devices() hangs when
+# the tunnel drops), everything logged under logs/onchip/.
+#
+# Usage: bash scripts/run_onchip_queue.sh  (repo root; takes hours — nohup it)
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p logs/onchip
+TS=$(date +%m%d_%H%M)
+L="logs/onchip/queue_${TS}"
+
+run() {  # run <tag> <timeout_s> <cmd...>
+  local tag=$1 to=$2; shift 2
+  echo "=== [$tag] $(date +%H:%M:%S) timeout=${to}s: $*" | tee -a "$L.summary"
+  timeout "$to" "$@" > "$L.$tag.log" 2>&1
+  local rc=$?
+  echo "=== [$tag] rc=$rc $(date +%H:%M:%S)" | tee -a "$L.summary"
+  tail -5 "$L.$tag.log" >> "$L.summary"
+  return $rc
+}
+
+# 0. probe — abort early if the tunnel is down
+run probe 120 python -c "import jax; print(jax.devices())" || {
+  echo "tunnel down — aborting queue" | tee -a "$L.summary"; exit 1; }
+
+# 1. flash fwd+bwd sweep incl. 16k/32k (pallas bwd is the default here)
+run flash_sweep 3600 python scripts/bench_flash.py \
+    --seq-lens 1024 8192 16384 32768
+
+# 2. backward A/B: fused pallas bwd vs blockwise recompute
+run flash_bwd_ab 3600 python scripts/bench_flash.py \
+    --seq-lens 8192 32768 --bwd-impls pallas recompute
+
+# 3. eigh impl + matmul-precision A/B at ResNet-50 bucket dims (cold+warm
+#    jacobi vs QDWH) — decides the KFAC_EIGH_IMPL default
+run bench_ops 3600 python scripts/bench_ops.py
+
+# 4. headline bench (fresh compiles can take 30-45 min on a cold cache)
+run bench_headline 5400 python bench.py
+
+# 5. full bench: + eigen_dp stock (XLA eigh)
+run bench_full_xla 5400 env BENCH_FULL=1 python bench.py
+
+# 6. full bench: eigen_dp with the batched-Jacobi eigh
+run bench_full_jacobi 5400 env BENCH_FULL=1 KFAC_EIGH_IMPL=jacobi python bench.py
+
+# 7. experimental paired-rotation jacobi (drop the knob if it loses on MXU)
+run bench_full_paired 5400 env BENCH_FULL=1 KFAC_EIGH_IMPL=jacobi \
+    KFAC_JACOBI_ROT=paired python bench.py
+
+echo "QUEUE COMPLETE $(date)" | tee -a "$L.summary"
