@@ -77,6 +77,8 @@ def parse_args():
     p.add_argument('--seed', type=int, default=42)
     p.add_argument('--speed', action='store_true')
     p.add_argument('--log-dir', default='./logs')
+    p.add_argument('--tb-dir', default=None,
+                   help='TensorBoard scalar summaries (rank 0)')
     return p.parse_args()
 
 
@@ -205,6 +207,8 @@ def main():
         eval_step = jax.jit(eval_loss_local)
 
     rng = np.random.RandomState(args.seed)
+    from kfac_pytorch_tpu.utils.summary import maybe_writer
+    tb = maybe_writer(args.tb_dir)
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
         loss_m = metrics.Metric('loss')
@@ -234,6 +238,10 @@ def main():
         vppl = math.exp(min(val_m.avg, 20))
         log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)', epoch,
                  ppl, vppl, time.perf_counter() - t0)
+        if tb is not None:
+            tb.add_scalar('train/ppl', ppl, epoch)
+            tb.add_scalar('val/ppl', vppl, epoch)
+            tb.flush()
 
 
 if __name__ == '__main__':

@@ -77,6 +77,8 @@ def parse_args():
                    help='>0 uses beam search for BLEU eval')
     p.add_argument('--synthetic-vocab', type=int, default=64)
     p.add_argument('--synthetic-size', type=int, default=2048)
+    p.add_argument('--tb-dir', default=None,
+                   help='TensorBoard scalar summaries (rank 0)')
     return p.parse_args()
 
 
@@ -255,6 +257,8 @@ def main():
             m.update(metrics['loss'])
         return state, m.avg
 
+    from kfac_pytorch_tpu.utils.summary import maybe_writer
+    tb = maybe_writer(args.tb_dir)
     for epoch in range(args.epochs):
         t0 = time.time()
         state, train_loss = run_epoch(state, epoch)
@@ -274,6 +278,10 @@ def main():
         score = translator.bleu(hyps, refs)
         log.info('epoch %d: train_loss %.4f BLEU %.2f (%.1fs)',
                  epoch, train_loss, score, time.time() - t0)
+        if tb is not None:
+            tb.add_scalar('train/loss', train_loss, epoch)
+            tb.add_scalar('val/BLEU', score, epoch)
+            tb.flush()
 
 
 if __name__ == '__main__':

@@ -62,6 +62,8 @@ def parse_args():
     p.add_argument('--num-devices', type=int, default=1)
     p.add_argument('--seed', type=int, default=42)
     p.add_argument('--synthetic-size', type=int, default=1024)
+    p.add_argument('--tb-dir', default=None,
+                   help='TensorBoard scalar summaries (rank 0)')
     return p.parse_args()
 
 
@@ -190,6 +192,8 @@ def main():
         return jnp.argmax(s, -1), jnp.argmax(e, -1)
 
     rs = np.random.RandomState(args.seed)
+    from kfac_pytorch_tpu.utils.summary import maybe_writer
+    tb = maybe_writer(args.tb_dir)
     for epoch in range(args.epochs):
         t0 = time.time()
         m = utils.Metric('loss')
@@ -211,6 +215,11 @@ def main():
                              list(zip(vstarts, vends)), vids)
         log.info('epoch %d: loss %.4f F1 %.2f EM %.2f (%.1fs)',
                  epoch, m.avg, f1, em, time.time() - t0)
+        if tb is not None:
+            tb.add_scalar('train/loss', m.avg, epoch)
+            tb.add_scalar('val/F1', f1, epoch)
+            tb.add_scalar('val/EM', em, epoch)
+            tb.flush()
 
 
 if __name__ == '__main__':

@@ -59,6 +59,8 @@ def parse_args():
     p.add_argument('--seed', type=int, default=42)
     p.add_argument('--synthetic-vocab', type=int, default=256)
     p.add_argument('--synthetic-tokens', type=int, default=100000)
+    p.add_argument('--tb-dir', default=None,
+                   help='TensorBoard scalar summaries (rank 0)')
     return p.parse_args()
 
 
@@ -135,6 +137,8 @@ def main():
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
 
+    from kfac_pytorch_tpu.utils.summary import maybe_writer
+    tb = maybe_writer(args.tb_dir)
     n_steps = (train_data.shape[1] - 1) // args.bptt
     for epoch in range(args.epochs):
         t0 = time.time()
@@ -157,6 +161,10 @@ def main():
         log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)', epoch,
                  math.exp(min(m.avg, 20)), math.exp(min(vm.avg, 20)),
                  time.time() - t0)
+        if tb is not None:
+            tb.add_scalar('train/ppl', math.exp(min(m.avg, 20)), epoch)
+            tb.add_scalar('val/ppl', math.exp(min(vm.avg, 20)), epoch)
+            tb.flush()
 
 
 if __name__ == '__main__':
