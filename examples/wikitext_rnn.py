@@ -158,12 +158,13 @@ def main():
             x = jnp.asarray(val_data[:, s:s + args.bptt])
             y = jnp.asarray(val_data[:, s + 1:s + args.bptt + 1])
             vm.update(eval_step(state.params, x, y))
+        ppl = math.exp(min(m.avg, 20))
+        vppl = math.exp(min(vm.avg, 20))
         log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)', epoch,
-                 math.exp(min(m.avg, 20)), math.exp(min(vm.avg, 20)),
-                 time.time() - t0)
+                 ppl, vppl, time.time() - t0)
         if tb is not None:
-            tb.add_scalar('train/ppl', math.exp(min(m.avg, 20)), epoch)
-            tb.add_scalar('val/ppl', math.exp(min(vm.avg, 20)), epoch)
+            tb.add_scalar('train/ppl', ppl, epoch)
+            tb.add_scalar('val/ppl', vppl, epoch)
             tb.flush()
 
 

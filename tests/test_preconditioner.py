@@ -262,3 +262,22 @@ def test_warm_basis_on_fresh_state_degrades_to_cold(monkeypatch):
                                    rtol=1e-3, atol=1e-4)
     for k in s_warm.decomp['evals']:
         assert np.all(np.isfinite(np.asarray(s_warm.decomp['evals'][k])))
+
+
+def test_warm_start_long_interval_warns(monkeypatch):
+    """ADVICE r1: warm_start_basis with a long full-decomposition interval
+    and default warm_sweeps must emit the calibration warning."""
+    import warnings as _w
+
+    monkeypatch.setenv('KFAC_EIGH_IMPL', 'jacobi')
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter('always')
+        kfac.KFAC(variant='eigen_dp', warm_start_basis=True,
+                  basis_update_freq=25, num_devices=1, axis_name=None)
+    assert any('warm_sweeps' in str(x.message) for x in rec)
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter('always')
+        kfac.KFAC(variant='eigen_dp', warm_start_basis=True,
+                  basis_update_freq=25, warm_sweeps=8,
+                  num_devices=1, axis_name=None)
+    assert not any('warm_sweeps' in str(x.message) for x in rec)
