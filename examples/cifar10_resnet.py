@@ -193,19 +193,42 @@ def main():
 
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer
     tb = maybe_writer(args.tb_dir)
+    guard = utils.PreemptionGuard()
     lr_now = args.base_lr
     for epoch in range(args.epochs):
         train_loss = utils.Metric('train_loss')
         t0 = time.time()
         for batch in train_loader.epoch():
+            if guard.should_stop(int(state.step)):
+                break
             batch = {k: jnp.asarray(v) for k, v in batch.items()}
             lr_now = float(lr_fn(int(state.step)))
             state, m = step(state, batch, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             train_loss.update(m['loss'], len(batch['label']))
+        if guard.should_stop():
+            # preemption grace window: save the live state and exit clean.
+            # The epoch is incomplete — tag the checkpoint with the LAST
+            # completed epoch so a resume replays the interrupted one
+            # (at-least-once; the step counter keeps the lr schedule exact).
+            tag = max(epoch - 1, 0)
+            if args.checkpoint_dir:
+                utils.save_checkpoint(args.checkpoint_dir, tag, state)
+                log.info('preempted in epoch %d (step %d): state saved as '
+                         'checkpoint-%d, exiting', epoch, int(state.step),
+                         tag)
+            else:
+                log.info('preempted in epoch %d (step %d): no '
+                         '--checkpoint-dir configured, state lost', epoch,
+                         int(state.step))
+            return
         val_loss = utils.Metric('val_loss')
         val_acc = utils.Metric('val_acc')
         for batch in val_loader.epoch():
+            if guard.triggered:
+                # local break only — every rank still reaches the metric
+                # sync below, so no collective is stranded
+                break
             batch = {k: jnp.asarray(v) for k, v in batch.items()}
             l, a = eval_step(state.params, state.extra_vars, batch)
             val_loss.update(l, len(batch['label']))
@@ -221,6 +244,11 @@ def main():
             scheduler.step(epoch + 1)
         if args.checkpoint_dir:
             utils.save_checkpoint(args.checkpoint_dir, epoch, state)
+        if guard.should_stop():
+            # preempted during validation: the train epoch completed, so
+            # the checkpoint above (if configured) is the resume point
+            log.info('preempted after epoch %d: exiting', epoch)
+            return
 
 
 if __name__ == '__main__':

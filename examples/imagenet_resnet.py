@@ -207,19 +207,37 @@ def main():
 
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer
     tb = maybe_writer(args.tb_dir)
+    guard = utils.PreemptionGuard()
     lr_now = args.base_lr
     for epoch in range(start_epoch, args.epochs):
         t0 = time.time()
         tm = utils.Metric('train_loss')
         for batch in train_loader.epoch():
+            if guard.should_stop(int(state.step)):
+                break
             b = {'input': jnp.asarray(batch['input'], dtype),
                  'label': jnp.asarray(batch['label'])}
             lr_now = float(lr_fn(int(state.step)))
             state, m = step(state, b, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             tm.update(m['loss'])
+        if guard.should_stop():
+            # preemption grace window: save the live state and exit clean.
+            # Tag with the LAST completed epoch: auto-resume then replays
+            # the interrupted epoch instead of skipping its tail and
+            # advancing the KFAC scheduler early (at-least-once; the step
+            # counter keeps the lr schedule exact).
+            tag = max(epoch - 1, 0)
+            utils.save_checkpoint(args.checkpoint_format, tag, state)
+            log.info('preempted in epoch %d (step %d): state saved as '
+                     'checkpoint-%d, exiting', epoch, int(state.step), tag)
+            return
         vl, va = utils.Metric('vl'), utils.Metric('va')
         for batch in val_loader.epoch():
+            if guard.triggered:
+                # local break only — every rank still reaches the metric
+                # sync below, so no collective is stranded
+                break
             b = {'input': jnp.asarray(batch['input']),
                  'label': jnp.asarray(batch['label'])}
             l, a = eval_step(state.params, state.extra_vars, b)
@@ -234,6 +252,11 @@ def main():
         if scheduler is not None:
             scheduler.step(epoch + 1)
         utils.save_checkpoint(args.checkpoint_format, epoch, state)
+        if guard.should_stop():
+            # preempted during validation: the train epoch completed, so
+            # the normal checkpoint-{epoch} above is the resume point
+            log.info('preempted after epoch %d: exiting', epoch)
+            return
 
 
 if __name__ == '__main__':

@@ -6,7 +6,8 @@ from kfac_pytorch_tpu.utils.lr import (
 from kfac_pytorch_tpu.utils.losses import (
     label_smoothing_cross_entropy, sample_pseudo_labels)
 from kfac_pytorch_tpu.utils.checkpoint import (
-    save_checkpoint, restore_checkpoint, find_resume_epoch)
+    save_checkpoint, restore_checkpoint, find_resume_epoch,
+    PreemptionGuard)
 from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
@@ -14,5 +15,5 @@ __all__ = [
     'Metric', 'accuracy', 'warmup_multistep', 'polynomial_decay',
     'inverse_sqrt', 'label_smoothing_cross_entropy', 'sample_pseudo_labels',
     'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',
-    'trace', 'time_steps', 'exclude_parts_breakdown',
+    'PreemptionGuard', 'trace', 'time_steps', 'exclude_parts_breakdown',
 ]

@@ -20,3 +20,9 @@ import jax  # noqa: E402
 jax.config.update('jax_platforms', 'cpu')
 # fp32 matmuls in tests: exact math, not MXU bf16 passthrough.
 jax.config.update('jax_default_matmul_precision', 'highest')
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'slow: multi-minute end-to-end drills (subprocess '
+        "trainers etc.); deselect with -m 'not slow'")

@@ -63,3 +63,66 @@ def test_find_resume_epoch_scans_downward(tmp_path, trained_state):
     # scans from max_epoch downward and returns the newest present
     assert checkpoint.find_resume_epoch(tmp_path, 10) == 5
     assert checkpoint.find_resume_epoch(tmp_path, 4) == 2
+
+
+@pytest.mark.slow
+def test_preemption_guard_saves_and_exits(tmp_path):
+    """SIGTERM drill (beyond-reference §5.3): the trainer saves the live
+    TrainState inside the grace window, exits cleanly, and the
+    checkpoint restores."""
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    env = dict(os.environ, KFAC_PLATFORM='cpu', KFAC_HOST_DEVICES='1')
+    logf = tmp_path / 'out.log'
+    with open(logf, 'w') as f:
+        proc = subprocess.Popen(
+            [sys.executable, 'examples/cifar10_resnet.py', '--model',
+             'resnet20', '--epochs', '50', '--batch-size', '16',
+             '--kfac-update-freq', '5', '--kfac-cov-update-freq', '5',
+             '--num-devices', '1',
+             '--checkpoint-dir', str(tmp_path / 'ckpt')],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env, stdout=f, stderr=subprocess.STDOUT)
+        try:
+            deadline = _time.time() + 420
+            while _time.time() < deadline:
+                if 'epoch 0:' in logf.read_text():
+                    break
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        'trainer died early:\n' + logf.read_text()[-2000:])
+                _time.sleep(2)
+            else:
+                raise AssertionError('epoch 0 never appeared:\n'
+                                     + logf.read_text()[-2000:])
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=180)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+    out = logf.read_text()
+    assert rc == 0, (rc, out[-2000:])
+    assert ('preempted in epoch' in out          # mid-train-loop save path
+            or 'preempted after epoch' in out), out[-2000:]  # post-val path
+    epochs = [int(m) for m in re.findall(r'checkpoint-(\d+)',
+                                         ' '.join(os.listdir(tmp_path / 'ckpt')))]
+    assert epochs, os.listdir(tmp_path / 'ckpt')
+    # the saved checkpoint restores into a fresh state skeleton
+    model = models.resnet20()
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
+                        fac_update_freq=5, kfac_update_freq=5,
+                        num_devices=1, axis_name=None)
+    # the trainer passes an lr *schedule* into sgd — match its opt_state
+    # tree structure, not just its shapes
+    tx = training.sgd(lambda s: 0.1, momentum=0.9, weight_decay=5e-4)
+    skel = training.init_train_state(model, tx, precond,
+                                     jax.random.PRNGKey(0),
+                                     jnp.zeros((16, 32, 32, 3)))
+    restored = checkpoint.restore_checkpoint(str(tmp_path / 'ckpt'),
+                                             max(epochs), skel)
+    assert int(restored.step) > 0
