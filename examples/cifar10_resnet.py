@@ -243,12 +243,16 @@ def main():
         if scheduler is not None:
             scheduler.step(epoch + 1)
         if args.checkpoint_dir:
-            utils.save_checkpoint(args.checkpoint_dir, epoch, state)
+            # async: the write hides behind the next epoch's compute
+            utils.save_checkpoint(args.checkpoint_dir, epoch, state,
+                                  block=False)
         if guard.should_stop():
             # preempted during validation: the train epoch completed, so
             # the checkpoint above (if configured) is the resume point
+            utils.wait_for_checkpoints()
             log.info('preempted after epoch %d: exiting', epoch)
             return
+    utils.wait_for_checkpoints()
 
 
 if __name__ == '__main__':

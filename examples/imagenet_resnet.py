@@ -251,12 +251,16 @@ def main():
         log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)
-        utils.save_checkpoint(args.checkpoint_format, epoch, state)
+        # async: the write hides behind the next epoch's compute
+        utils.save_checkpoint(args.checkpoint_format, epoch, state,
+                              block=False)
         if guard.should_stop():
             # preempted during validation: the train epoch completed, so
             # the normal checkpoint-{epoch} above is the resume point
+            utils.wait_for_checkpoints()
             log.info('preempted after epoch %d: exiting', epoch)
             return
+    utils.wait_for_checkpoints()
 
 
 if __name__ == '__main__':

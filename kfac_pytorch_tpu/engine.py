@@ -146,7 +146,11 @@ def update_factors(plan, factors_local, stats_stacked, factor_decay,
         b = plan.buckets[bdim]
         stats = stats_stacked[key]
         if stats_reduce == 'pmean':
-            stats = coll.pmean(stats, axis_name)
+            # only the reduce is CommunicateFactor — the EMA below is
+            # compute, so xprof attribution matches time_breakdown.py's
+            # exclude-parts subtraction
+            with jax.named_scope('kfac.CommunicateFactor'):
+                stats = coll.pmean(stats, axis_name)
         idx = coll.axis_index(axis_name)
         local = lax.dynamic_slice_in_dim(stats, idx * b.per_dev, b.per_dev,
                                          axis=0)

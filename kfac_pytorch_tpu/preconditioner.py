@@ -313,14 +313,21 @@ class KFAC:
         decomp = state.decomp
 
         if update_factors and not self.exclude_compute_factor:
-            a_list, g_list = engine.compute_layer_stats(
-                plan, acts, gs, self.batch_averaged)
-            stats = engine.stack_stats(plan, a_list, g_list)
+            # named scopes mirror the reference's phase taxonomy
+            # (exclude_parts names) so xprof traces attribute time the
+            # same way scripts/time_breakdown.py does
+            with jax.named_scope('kfac.ComputeFactor'):
+                a_list, g_list = engine.compute_layer_stats(
+                    plan, acts, gs, self.batch_averaged)
+                stats = engine.stack_stats(plan, a_list, g_list)
             reduce = self.stats_reduce
             if self.exclude_communicate_factor:
                 reduce = 'local'
-            factors = engine.update_factors(
-                plan, factors, stats, self.factor_decay, reduce, axis_name)
+            with jax.named_scope('kfac.UpdateFactors'):
+                # the pmean inside carries its own CommunicateFactor scope
+                factors = engine.update_factors(
+                    plan, factors, stats, self.factor_decay, reduce,
+                    axis_name)
 
         if factors_only:
             # accumulate statistics but leave gradients untouched — used
@@ -337,10 +344,11 @@ class KFAC:
         if update_inverse:
             if self.method == 'eigh' and not update_basis:
                 # eigenvalue-only refresh in the retained eigenbasis
-                decomp = engine.refresh_decomposition(
-                    plan, factors, decomp, self.eps, axis_name,
-                    self.comm_mode,
-                    communicate=not self.exclude_communicate_inverse)
+                with jax.named_scope('kfac.ComputeInverse.refresh'):
+                    decomp = engine.refresh_decomposition(
+                        plan, factors, decomp, self.eps, axis_name,
+                        self.comm_mode,
+                        communicate=not self.exclude_communicate_inverse)
             else:
                 basis_local = None
                 if (self.method == 'eigh' and self.warm_start_basis
@@ -350,25 +358,28 @@ class KFAC:
                     # silently corrupt the rotated problem)
                     basis_local = engine.local_evecs(
                         plan, decomp, axis_name, self.comm_mode)
-                decomp_local = engine.compute_decomposition(
-                    plan, factors, damping, self.method, self.eps,
-                    axis_name, basis_local=basis_local,
-                    warm_sweeps=self.warm_sweeps)
+                with jax.named_scope('kfac.ComputeInverse'):
+                    decomp_local = engine.compute_decomposition(
+                        plan, factors, damping, self.method, self.eps,
+                        axis_name, basis_local=basis_local,
+                        warm_sweeps=self.warm_sweeps)
                 if self.comm_mode == 'inverse':
-                    decomp = engine.gather_decomposition(
-                        plan, decomp_local, axis_name,
-                        communicate=not self.exclude_communicate_inverse)
+                    with jax.named_scope('kfac.CommunicateInverse'):
+                        decomp = engine.gather_decomposition(
+                            plan, decomp_local, axis_name,
+                            communicate=not self.exclude_communicate_inverse)
                 else:
                     decomp = decomp_local
 
         grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
-        if self.comm_mode == 'inverse':
-            preds = engine.compute_pred_replicated(
-                plan, decomp, grad_mats, damping, self.method)
-        else:
-            preds = engine.compute_pred_local(
-                plan, decomp, grad_mats, damping, self.method, axis_name,
-                communicate=not self.exclude_communicate_inverse)
+        with jax.named_scope('kfac.Precondition'):
+            if self.comm_mode == 'inverse':
+                preds = engine.compute_pred_replicated(
+                    plan, decomp, grad_mats, damping, self.method)
+            else:
+                preds = engine.compute_pred_local(
+                    plan, decomp, grad_mats, damping, self.method, axis_name,
+                    communicate=not self.exclude_communicate_inverse)
 
         new_grads = engine.preconditioned_grads(
             plan, grads, grad_mats, preds, lr, self.kl_clip,

@@ -7,7 +7,7 @@ from kfac_pytorch_tpu.utils.losses import (
     label_smoothing_cross_entropy, sample_pseudo_labels)
 from kfac_pytorch_tpu.utils.checkpoint import (
     save_checkpoint, restore_checkpoint, find_resume_epoch,
-    PreemptionGuard)
+    PreemptionGuard, wait_for_checkpoints)
 from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
@@ -15,5 +15,6 @@ __all__ = [
     'Metric', 'accuracy', 'warmup_multistep', 'polynomial_decay',
     'inverse_sqrt', 'label_smoothing_cross_entropy', 'sample_pseudo_labels',
     'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',
-    'PreemptionGuard', 'trace', 'time_steps', 'exclude_parts_breakdown',
+    'PreemptionGuard', 'wait_for_checkpoints',
+    'trace', 'time_steps', 'exclude_parts_breakdown',
 ]

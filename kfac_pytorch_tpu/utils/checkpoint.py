@@ -27,24 +27,63 @@ def _ckpt_dir(base, epoch):
     return os.path.join(os.path.abspath(base), f'checkpoint-{epoch}')
 
 
-def save_checkpoint(base_dir, epoch, state, include_kfac=True):
+_ASYNC_CKPTR = None  # lazily-created persistent checkpointer (async saves)
+
+
+def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True):
     """Write one checkpoint; only process 0 writes (rank-0 semantics,
-    examples/utils.py:11-18)."""
-    if jax.process_index() != 0:
-        return
+    examples/utils.py:11-18).
+
+    ``block=False`` returns as soon as the on-device state is snapshotted
+    and lets orbax write to disk in the background — the save hides
+    behind the next epoch's compute (beyond reference, which blocks on
+    torch.save). Call :func:`wait_for_checkpoints` before process exit
+    (and before acting on a just-saved preemption checkpoint).
+
+    Multi-process note: on the orbax path EVERY process must call this —
+    orbax's save opens with a global process barrier and coordinates who
+    writes what (single-file rank-0 output is an orbax detail, not an
+    early-return here; an early return would strand the other ranks in
+    the barrier). The pickle fallback is genuinely rank-0-only.
+    """
     payload = state
     if not include_kfac:
         payload = state.replace(kfac_state=None)
-    os.makedirs(base_dir, exist_ok=True)
     path = _ckpt_dir(base_dir, epoch)
     if _HAS_ORBAX:
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(path, payload, force=True)
-        ckptr.wait_until_finished()
+        if jax.process_index() == 0:
+            os.makedirs(base_dir, exist_ok=True)
+        global _ASYNC_CKPTR
+        if _ASYNC_CKPTR is None:
+            _ASYNC_CKPTR = ocp.StandardCheckpointer()
+        else:
+            # surface a PREVIOUS async save's failure here, attributed to
+            # this call site's logs, rather than letting it abort an
+            # unrelated later save (e.g. the preemption grace-window one)
+            try:
+                _ASYNC_CKPTR.wait_until_finished()
+            except Exception:  # noqa: BLE001 — log and keep checkpointing
+                import logging
+                logging.getLogger(__name__).exception(
+                    'a previous async checkpoint save failed; attempting '
+                    'this save anyway')
+                _ASYNC_CKPTR = ocp.StandardCheckpointer()
+        _ASYNC_CKPTR.save(path, payload, force=True)
+        if block:
+            _ASYNC_CKPTR.wait_until_finished()
     else:  # pragma: no cover
+        if jax.process_index() != 0:
+            return
+        os.makedirs(base_dir, exist_ok=True)
         import pickle
         with open(path + '.pkl', 'wb') as f:
             pickle.dump(jax.tree.map(np.asarray, payload), f)
+
+
+def wait_for_checkpoints():
+    """Block until all in-flight async saves are durable on disk."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
 
 
 def find_resume_epoch(base_dir, max_epoch):

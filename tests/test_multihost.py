@@ -65,6 +65,16 @@ for i in range(4):
     state, m = step(state, batch, lr=0.1, damping=0.003)
     ls.append(float(np.asarray(m['loss'].addressable_data(0))))
 assert ls[-1] < ls[0], ls
+ckdir = os.environ.get('KFAC_TEST_CKPT_DIR')
+if ckdir:
+    # every process calls save/restore: orbax coordinates through global
+    # barriers (rank-0-only calls would hang the other ranks)
+    from kfac_pytorch_tpu import utils as kutils
+    kutils.save_checkpoint(ckdir, 0, state)
+    kutils.wait_for_checkpoints()
+    restored = kutils.restore_checkpoint(ckdir, 0, state)
+    assert int(np.asarray(restored.step.addressable_data(0))) == 4
+    print('CKPT OK', flush=True)
 print(f'LOSSES {ls[0]:.6f} {ls[-1]:.6f}', flush=True)
 '''
 
@@ -75,14 +85,15 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_kfac_training():
+def test_two_process_distributed_kfac_training(tmp_path):
     # subprocess.communicate(timeout=...) below bounds the test's runtime
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = _WORKER % {'repo': repo}
     base = {k: v for k, v in os.environ.items()
             if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
     base.update(JAX_COORDINATOR_ADDRESS=f'127.0.0.1:{_free_port()}',
-                KFAC_TPU_MULTIHOST='1', JAX_NUM_PROCESSES='2')
+                KFAC_TPU_MULTIHOST='1', JAX_NUM_PROCESSES='2',
+                KFAC_TEST_CKPT_DIR=str(tmp_path / 'ckpt'))
     procs = []
     try:
         for pid in range(2):
@@ -116,3 +127,5 @@ def test_two_process_distributed_kfac_training():
     lines = [[l for l in o.splitlines() if l.startswith('LOSSES')][-1]
              for o in outs]
     assert lines[0] == lines[1], lines
+    # the all-ranks checkpoint round-trip completed on every process
+    assert all('CKPT OK' in o for o in outs), [o[-800:] for o in outs]
