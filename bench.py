@@ -27,7 +27,6 @@ the exclude-parts per-phase breakdown (scripts/time_breakdown.py parity).
 import json
 import os
 import sys
-import threading
 import time
 import traceback
 
@@ -62,6 +61,7 @@ MODEL = os.environ.get('BENCH_MODEL', 'resnet50')
 ITERS = int(os.environ.get('BENCH_ITERS', 20))
 WARMUP = 3
 BASELINE_KFAC_ITER_S = 0.487  # scripts/time_breakdown.py:26 (1 GPU, bs 32)
+METRIC = 'resnet50_imagenet_dpkfac_imgs_per_sec_per_chip'
 
 # Public per-chip peak dense bf16 FLOP/s by device kind (scaling-book /
 # cloud TPU docs figures); None-able — unknown kinds just skip MFU.
@@ -76,33 +76,6 @@ def _peak_flops(device):
         if key in kind:
             return peak
     return None
-
-
-def _probe_backend(timeout_s=180, retries=3):
-    """Initialize the backend under a watchdog: jax.devices() HANGS (not
-    errors) when the chip tunnel is down, so probe it on a daemon thread
-    and keep re-joining — init is a process singleton, so later joins
-    simply extend the wait window in case the tunnel comes back."""
-    result = {}
-
-    def probe():
-        try:
-            result['devices'] = jax.devices()
-        except Exception as e:  # noqa: BLE001 — report any init failure
-            result['error'] = repr(e)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    for attempt in range(retries):
-        t.join(timeout_s)
-        if 'devices' in result:
-            return result['devices']
-        if 'error' in result:
-            raise RuntimeError(f'backend init failed: {result["error"]}')
-        print(f'backend probe attempt {attempt + 1}/{retries}: no response '
-              f'in {timeout_s}s (tunnel down?)', file=sys.stderr, flush=True)
-    raise RuntimeError(
-        f'backend unavailable: jax.devices() hung for {retries * timeout_s}s')
 
 
 def _model_flops_per_iter(model, batch):
@@ -249,7 +222,7 @@ def _run(devices):
 
     imgs_per_sec = BATCH / inv1_s
     result = {
-        'metric': 'resnet50_imagenet_dpkfac_imgs_per_sec_per_chip',
+        'metric': METRIC,
         'value': round(imgs_per_sec, 2),
         'unit': 'imgs/s',
         'vs_baseline': round(imgs_per_sec / (BATCH / BASELINE_KFAC_ITER_S),
@@ -283,15 +256,22 @@ def _run(devices):
 
 
 def main():
+    from kfac_pytorch_tpu.utils.platform import probe_backend
+
+    def on_wait(attempt):
+        print(f'backend probe attempt {attempt + 1}: no response '
+              '(tunnel down?)', file=sys.stderr, flush=True)
+
     try:
-        devices = _probe_backend(
+        devices = probe_backend(
             timeout_s=int(os.environ.get('KFAC_BENCH_PROBE_TIMEOUT', 180)),
-            retries=int(os.environ.get('KFAC_BENCH_PROBE_RETRIES', 3)))
+            retries=int(os.environ.get('KFAC_BENCH_PROBE_RETRIES', 3)),
+            on_wait=on_wait)
         result = _run(devices)
     except BaseException as e:  # noqa: BLE001 — the JSON line must go out
         traceback.print_exc(file=sys.stderr)
         print(json.dumps({
-            'metric': 'resnet50_imagenet_dpkfac_imgs_per_sec_per_chip',
+            'metric': METRIC,
             'value': None, 'unit': 'imgs/s', 'vs_baseline': None,
             'error': f'{type(e).__name__}: {e}',
         }), flush=True)

@@ -31,8 +31,8 @@ _ASYNC_CKPTR = None  # lazily-created persistent checkpointer (async saves)
 
 
 def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True):
-    """Write one checkpoint; only process 0 writes (rank-0 semantics,
-    examples/utils.py:11-18).
+    """Write one checkpoint (one copy on disk — the reference's rank-0
+    torch.save semantics, examples/utils.py:11-18).
 
     ``block=False`` returns as soon as the on-device state is snapshotted
     and lets orbax write to disk in the background — the save hides

@@ -12,6 +12,42 @@ import os
 import re
 
 
+def probe_backend(timeout_s=180, retries=1, on_wait=None):
+    """Initialize the backend under a watchdog thread.
+
+    ``jax.devices()`` HANGS (not errors) when the chip tunnel is down, so
+    probe it on a daemon thread and re-join up to ``retries`` times —
+    backend init is a process singleton, so later joins simply extend the
+    wait window in case the tunnel comes back. ``on_wait(attempt)`` is
+    called after each unanswered window. Raises RuntimeError when the
+    backend never answers (or its init raised)."""
+    import threading
+
+    import jax
+
+    result = {}
+
+    def probe():
+        try:
+            result['devices'] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — report any init failure
+            result['error'] = repr(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    for attempt in range(retries):
+        t.join(timeout_s)
+        if 'devices' in result:
+            return result['devices']
+        if 'error' in result:
+            raise RuntimeError(f'backend init failed: {result["error"]}')
+        if on_wait is not None:
+            on_wait(attempt)
+    raise RuntimeError(
+        f'backend unavailable: jax.devices() hung for '
+        f'{retries * timeout_s}s (tunnel down?)')
+
+
 def force_host_platform(platform=None, n_devices=None):
     """Force ``platform`` with ``n_devices`` virtual host devices.
 
@@ -23,6 +59,20 @@ def force_host_platform(platform=None, n_devices=None):
     this is a no-op returning True (backend stays lazy).
     """
     import jax
+
+    # If another thread is wedged inside a hung backend init (a watchdog
+    # probe of an unreachable accelerator), jax.config.update below would
+    # block on the same init lock forever — detect it and bail to the
+    # caller's fresh-process fallback instead.
+    try:
+        from jax._src import xla_bridge as _xb
+        lock = getattr(_xb, '_backend_lock', None)
+        if lock is not None:
+            if not lock.acquire(timeout=10):
+                return False
+            lock.release()
+    except ImportError:  # private module moved — skip the fast-fail check
+        pass
 
     if n_devices is not None:
         flags = os.environ.get('XLA_FLAGS', '')
@@ -42,7 +92,16 @@ def force_host_platform(platform=None, n_devices=None):
                 pass  # already initialized; XLA_FLAGS may still have taken
     if not platform:
         return True  # nothing to verify without forcing a platform init
-    devices = jax.devices()
+    try:
+        # watchdog, not a bare jax.devices(): if another thread is already
+        # wedged inside a hung backend init (e.g. a probe of an
+        # unreachable accelerator), this would block on the init lock
+        # forever — time out and let the caller re-exec fresh instead
+        devices = probe_backend(timeout_s=60)
+    except RuntimeError as e:
+        if 'init failed' in str(e):
+            raise  # a genuine init error: surface it (re-exec can't help)
+        return False  # hang: wedged init in this process only
     ok = all(d.platform == platform
              for d in devices[:n_devices or len(devices)])
     if n_devices is not None:
