@@ -91,6 +91,9 @@ def parse_args():
     p.add_argument('--tb-dir', default=None,
                    help='write TensorBoard scalar summaries here (rank 0)')
     p.add_argument('--checkpoint-dir', default=None)
+    p.add_argument('--keep-checkpoints', type=int, default=0,
+                   help='retain only the N newest checkpoints '
+                        '(0 = keep all, reference behavior)')
     return p.parse_args()
 
 
@@ -246,6 +249,10 @@ def main():
             # async: the write hides behind the next epoch's compute
             utils.save_checkpoint(args.checkpoint_dir, epoch, state,
                                   block=False)
+            if args.keep_checkpoints:
+                # the PREVIOUS save is durable (save waits on it first)
+                utils.prune_checkpoints(args.checkpoint_dir,
+                                        args.keep_checkpoints)
         if guard.should_stop():
             # preempted during validation: the train epoch completed, so
             # the checkpoint above (if configured) is the resume point
@@ -253,6 +260,8 @@ def main():
             log.info('preempted after epoch %d: exiting', epoch)
             return
     utils.wait_for_checkpoints()
+    if args.checkpoint_dir and args.keep_checkpoints:
+        utils.prune_checkpoints(args.checkpoint_dir, args.keep_checkpoints)
 
 
 if __name__ == '__main__':

@@ -80,6 +80,9 @@ def parse_args():
                         'reference pytorch_imagenet_resnet.py:169-178, '
                         '405-408 — gated there, first-class here)')
     p.add_argument('--checkpoint-format', default='./checkpoints')
+    p.add_argument('--keep-checkpoints', type=int, default=0,
+                   help='retain only the N newest checkpoints '
+                        '(0 = keep all, reference behavior)')
     p.add_argument('--synthetic-size', type=int, default=1024)
     return p.parse_args()
 
@@ -254,6 +257,11 @@ def main():
         # async: the write hides behind the next epoch's compute
         utils.save_checkpoint(args.checkpoint_format, epoch, state,
                               block=False)
+        if args.keep_checkpoints:
+            # the PREVIOUS save is durable (save waits on it), so pruning
+            # can never touch an in-flight write
+            utils.prune_checkpoints(args.checkpoint_format,
+                                    args.keep_checkpoints)
         if guard.should_stop():
             # preempted during validation: the train epoch completed, so
             # the normal checkpoint-{epoch} above is the resume point
@@ -261,6 +269,9 @@ def main():
             log.info('preempted after epoch %d: exiting', epoch)
             return
     utils.wait_for_checkpoints()
+    if args.keep_checkpoints:
+        utils.prune_checkpoints(args.checkpoint_format,
+                                args.keep_checkpoints)
 
 
 if __name__ == '__main__':

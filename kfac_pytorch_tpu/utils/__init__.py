@@ -7,7 +7,7 @@ from kfac_pytorch_tpu.utils.losses import (
     label_smoothing_cross_entropy, sample_pseudo_labels)
 from kfac_pytorch_tpu.utils.checkpoint import (
     save_checkpoint, restore_checkpoint, find_resume_epoch,
-    PreemptionGuard, wait_for_checkpoints)
+    PreemptionGuard, wait_for_checkpoints, prune_checkpoints)
 from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
@@ -15,6 +15,6 @@ __all__ = [
     'Metric', 'accuracy', 'warmup_multistep', 'polynomial_decay',
     'inverse_sqrt', 'label_smoothing_cross_entropy', 'sample_pseudo_labels',
     'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',
-    'PreemptionGuard', 'wait_for_checkpoints',
+    'PreemptionGuard', 'wait_for_checkpoints', 'prune_checkpoints',
     'trace', 'time_steps', 'exclude_parts_breakdown',
 ]

@@ -86,6 +86,36 @@ def wait_for_checkpoints():
         _ASYNC_CKPTR.wait_until_finished()
 
 
+def prune_checkpoints(base_dir, keep):
+    """Keep only the ``keep`` newest distinct checkpoint epochs (orbax
+    CheckpointManager-style retention; the reference keeps every epoch).
+    Rank-0 only — pure filesystem, no barrier.
+
+    Safe to call right after an async ``save_checkpoint(block=False)``
+    because of two invariants this function RELIES on: (a) save_checkpoint
+    waits for the previous async save before issuing a new one, so every
+    finalized ``checkpoint-{e}`` name here is durable, and (b) the
+    in-flight orbax write lives under a ``.orbax-checkpoint-tmp`` suffix
+    the pattern below cannot match. If either invariant changes, call
+    :func:`wait_for_checkpoints` first."""
+    if keep is None or keep <= 0 or jax.process_index() != 0:
+        return
+    pat = re.compile(r'^checkpoint-(\d+)(\.pkl)?$')
+    by_epoch = {}
+    for name in (os.listdir(base_dir) if os.path.isdir(base_dir) else ()):
+        m = pat.match(name)
+        if m:
+            by_epoch.setdefault(int(m.group(1)), []).append(name)
+    for epoch in sorted(by_epoch)[:-keep]:
+        for name in by_epoch[epoch]:
+            target = os.path.join(base_dir, name)
+            if os.path.isdir(target):
+                import shutil
+                shutil.rmtree(target, ignore_errors=True)
+            else:
+                os.remove(target)
+
+
 def find_resume_epoch(base_dir, max_epoch):
     """Scan checkpoint-{epoch} downward from max_epoch (reference:
     pytorch_imagenet_resnet.py:162-167). Returns the epoch or None."""

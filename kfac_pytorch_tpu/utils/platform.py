@@ -12,6 +12,14 @@ import os
 import re
 
 
+class BackendHang(RuntimeError):
+    """Backend init never answered (tunnel down / wedged init lock)."""
+
+
+class BackendInitError(RuntimeError):
+    """Backend init ran and raised — re-probing or re-exec cannot help."""
+
+
 def probe_backend(timeout_s=180, retries=1, on_wait=None):
     """Initialize the backend under a watchdog thread.
 
@@ -19,8 +27,9 @@ def probe_backend(timeout_s=180, retries=1, on_wait=None):
     probe it on a daemon thread and re-join up to ``retries`` times —
     backend init is a process singleton, so later joins simply extend the
     wait window in case the tunnel comes back. ``on_wait(attempt)`` is
-    called after each unanswered window. Raises RuntimeError when the
-    backend never answers (or its init raised)."""
+    called after each unanswered window. Raises :class:`BackendHang` when
+    the backend never answers, :class:`BackendInitError` when its init
+    raised."""
     import threading
 
     import jax
@@ -40,10 +49,10 @@ def probe_backend(timeout_s=180, retries=1, on_wait=None):
         if 'devices' in result:
             return result['devices']
         if 'error' in result:
-            raise RuntimeError(f'backend init failed: {result["error"]}')
+            raise BackendInitError(f'backend init failed: {result["error"]}')
         if on_wait is not None:
             on_wait(attempt)
-    raise RuntimeError(
+    raise BackendHang(
         f'backend unavailable: jax.devices() hung for '
         f'{retries * timeout_s}s (tunnel down?)')
 
@@ -98,10 +107,8 @@ def force_host_platform(platform=None, n_devices=None):
         # unreachable accelerator), this would block on the init lock
         # forever — time out and let the caller re-exec fresh instead
         devices = probe_backend(timeout_s=60)
-    except RuntimeError as e:
-        if 'init failed' in str(e):
-            raise  # a genuine init error: surface it (re-exec can't help)
-        return False  # hang: wedged init in this process only
+    except BackendHang:
+        return False  # wedged init in this process only; re-exec helps
     ok = all(d.platform == platform
              for d in devices[:n_devices or len(devices)])
     if n_devices is not None:

@@ -126,3 +126,27 @@ def test_preemption_guard_saves_and_exits(tmp_path):
     restored = checkpoint.restore_checkpoint(str(tmp_path / 'ckpt'),
                                              max(epochs), skel)
     assert int(restored.step) > 0
+
+
+def test_prune_checkpoints(tmp_path):
+    """Retention keeps the N newest epochs, ignores orbax tmp dirs and
+    foreign names, and is a no-op with keep=0/None."""
+    import os
+
+    from kfac_pytorch_tpu.utils.checkpoint import prune_checkpoints
+    for e in (0, 1, 2, 10):
+        os.makedirs(tmp_path / f'checkpoint-{e}')
+    (tmp_path / 'checkpoint-11.orbax-checkpoint-tmp').mkdir()
+    (tmp_path / 'other-file').write_text('x')
+    prune_checkpoints(str(tmp_path), None)
+    prune_checkpoints(str(tmp_path), 0)
+    assert sorted(os.listdir(tmp_path)) == [
+        'checkpoint-0', 'checkpoint-1', 'checkpoint-10', 'checkpoint-11'
+        '.orbax-checkpoint-tmp', 'checkpoint-2', 'other-file']
+    prune_checkpoints(str(tmp_path), 2)
+    assert sorted(p for p in os.listdir(tmp_path)
+                  if p.startswith('checkpoint-') and '.' not in p) == [
+        'checkpoint-10', 'checkpoint-2']
+    # tmp dir and foreign file untouched
+    assert (tmp_path / 'checkpoint-11.orbax-checkpoint-tmp').exists()
+    assert (tmp_path / 'other-file').exists()
