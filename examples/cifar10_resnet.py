@@ -104,16 +104,18 @@ def main():
     num_classes = 10 if args.dataset == 'cifar10' else 100
     use_kfac = args.kfac_update_freq > 0
 
-    os.makedirs(args.log_dir, exist_ok=True)
-    logfile = os.path.join(
-        args.log_dir,
-        f'{args.dataset}_{args.model}_kfac{args.kfac_update_freq}_'
-        f'{args.kfac_name}_bs{args.batch_size}_nd{args.num_devices}.log')
-    logging.basicConfig(
-        level=logging.INFO, format='%(asctime)s %(message)s',
-        handlers=[logging.StreamHandler(), logging.FileHandler(logfile)],
-        force=True)
-    log = logging.getLogger()
+    from kfac_pytorch_tpu.utils.runlog import setup_run_logging
+    # non-default estimator/amortization knobs go into the filename too,
+    # or distinct configs are indistinguishable by name; the timestamp
+    # suffix gives each run its own file (no ambiguous appends)
+    log, _ = setup_run_logging(
+        args.log_dir, args.dataset, args.model,
+        f'kfac{args.kfac_update_freq}', args.kfac_name,
+        args.kfac_type if args.kfac_type != 'Femp' else None,
+        f'basis{args.kfac_basis_update_freq}'
+        if args.kfac_basis_update_freq else None,
+        'warm' if args.kfac_warm_start else None,
+        f'bs{args.batch_size}', f'nd{args.num_devices}')
     log.info('args: %s', vars(args))
 
     (train_x, train_y), (val_x, val_y) = kdata.get_cifar(

@@ -105,15 +105,14 @@ def main():
     from kfac_pytorch_tpu.parallel import mesh as kmesh
     kmesh.maybe_initialize_distributed()
     args = parse_args()
-    os.makedirs(args.log_dir, exist_ok=True)
-    logging.basicConfig(
-        level=logging.INFO, format='%(asctime)s %(message)s', force=True,
-        handlers=[logging.StreamHandler(),
-                  logging.FileHandler(os.path.join(
-                      args.log_dir,
-                      f'imagenet_{args.model}_{args.kfac_name}_'
-                      f'nd{args.num_devices}.log'))])
-    log = logging.getLogger()
+    from kfac_pytorch_tpu.utils.runlog import setup_run_logging
+    log, _ = setup_run_logging(
+        args.log_dir, 'imagenet', args.model,
+        f'kfac{args.kfac_update_freq}', args.kfac_name,
+        f'basis{args.kfac_basis_update_freq}'
+        if getattr(args, 'kfac_basis_update_freq', 0) else None,
+        'warm' if getattr(args, 'kfac_warm_start', False) else None,
+        f'bs{args.batch_size}', f'nd{args.num_devices}')
     log.info('args: %s', vars(args))
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32

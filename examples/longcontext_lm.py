@@ -117,15 +117,11 @@ def sample_batches(ids, args, rng):
 def main():
     args = parse_args()
     os.makedirs(args.log_dir, exist_ok=True)
-    name = (f'longctx_L{args.seq_len}_{args.kfac_name}'
-            f'_bs{args.batch_size}_sd{args.seq_devices}'
-            f'_dd{args.data_devices}')
-    logging.basicConfig(
-        level=logging.INFO, format='%(asctime)s %(message)s', force=True,
-        handlers=[logging.StreamHandler(),
-                  logging.FileHandler(
-                      os.path.join(args.log_dir, name + '.log'), mode='w')])
-    log = logging.getLogger()
+    from kfac_pytorch_tpu.utils.runlog import setup_run_logging
+    log, _ = setup_run_logging(
+        args.log_dir, f'longctx_L{args.seq_len}', args.kfac_name,
+        f'bs{args.batch_size}', f'sd{args.seq_devices}',
+        f'dd{args.data_devices}')
     log.info('args: %s', vars(args))
 
     ids, vocab = load_corpus(args)

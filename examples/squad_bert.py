@@ -117,9 +117,11 @@ def main():
     from kfac_pytorch_tpu.parallel import mesh as kmesh
     kmesh.maybe_initialize_distributed()
     args = parse_args()
-    logging.basicConfig(level=logging.INFO, format='%(asctime)s %(message)s',
-                        force=True)
-    log = logging.getLogger()
+    from kfac_pytorch_tpu.utils.runlog import setup_run_logging
+    log, _ = setup_run_logging(
+        './logs', 'squad', args.model_size,
+        f'kfac{args.kfac_update_freq}', args.kfac_name,
+        f'bs{args.batch_size}', f'nd{args.num_devices}')
     log.info('args: %s', vars(args))
 
     cfg_fn = {'tiny': bert.BertConfig.tiny, 'base': bert.BertConfig.base,

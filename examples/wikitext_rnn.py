@@ -92,9 +92,11 @@ def batchify(ids, batch_size):
 
 def main():
     args = parse_args()
-    logging.basicConfig(level=logging.INFO, format='%(asctime)s %(message)s',
-                        force=True)
-    log = logging.getLogger()
+    from kfac_pytorch_tpu.utils.runlog import setup_run_logging
+    log, _ = setup_run_logging(
+        './logs', 'wikitext', f'kfac{args.kfac_update_freq}',
+        args.kfac_name if args.kfac_update_freq else 'sgd',
+        f'bs{args.batch_size}')
     log.info('args: %s', vars(args))
 
     ids, vocab_size = load_corpus(args)

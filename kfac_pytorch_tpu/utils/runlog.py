@@ -1,0 +1,32 @@
+"""Per-run logging setup shared by the example trainers.
+
+Reference convention: config-encoded log filenames (the reference bakes
+model / kfac freq / world size / batch into its logfile names,
+examples/pytorch_cifar10_resnet.py:318). Here each RUN additionally gets
+its own file — the config-encoded stem plus a start-time suffix, opened
+fresh ('w') — so reruns and A/B legs of the same config never append
+into one ambiguous stream (``scripts/parse_logs.py`` treats each file as
+one run and keys its tables off the filename).
+"""
+
+import logging
+import os
+import time
+
+
+def setup_run_logging(log_dir, *parts, unique=True):
+    """``basicConfig`` with stream + per-run file handler.
+
+    ``parts`` are joined with '_' (None/empty dropped). Returns
+    ``(logger, logfile_path)``.
+    """
+    os.makedirs(log_dir, exist_ok=True)
+    stem = '_'.join(str(p) for p in parts if p not in (None, ''))
+    if unique:
+        stem += time.strftime('_%m%dT%H%M%S')
+    path = os.path.join(log_dir, stem + '.log')
+    logging.basicConfig(
+        level=logging.INFO, format='%(asctime)s %(message)s', force=True,
+        handlers=[logging.StreamHandler(),
+                  logging.FileHandler(path, mode='w')])
+    return logging.getLogger(), path

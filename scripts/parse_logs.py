@@ -4,8 +4,10 @@ Capability parity with the reference's log aggregation
 (reference: scripts/parse_logs.py:1-79 + scripts/reader.py — extract
 iteration times / imgs-per-sec / val accuracy from training logs, including
 the --exclude-parts subtraction method for phase attribution). Operates on
-the log files the example trainers write (examples/*.py, filenames encode
-the config: ``{dataset}_{model}_kfac{freq}_{variant}_bs{b}_nd{n}.log``).
+the log files the example trainers write (one file per RUN via
+utils/runlog.py: a config-encoded stem — e.g.
+``{dataset}_{model}_kfac{freq}_{variant}[_{F1mc}][_basisN][_warm]_bs{b}_
+nd{n}`` — plus a start-time suffix).
 
 Usage:
   python scripts/parse_logs.py logs/*.log            # summary table
