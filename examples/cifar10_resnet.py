@@ -16,7 +16,6 @@ Multi-device mesh:
 """
 
 import argparse
-import logging
 import os
 import sys
 import time

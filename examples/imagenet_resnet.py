@@ -12,7 +12,6 @@ deterministic synthetic ImageNet-shaped data.
 """
 
 import argparse
-import logging
 import os
 import sys
 import time
@@ -110,8 +109,8 @@ def main():
         args.log_dir, 'imagenet', args.model,
         f'kfac{args.kfac_update_freq}', args.kfac_name,
         f'basis{args.kfac_basis_update_freq}'
-        if getattr(args, 'kfac_basis_update_freq', 0) else None,
-        'warm' if getattr(args, 'kfac_warm_start', False) else None,
+        if args.kfac_basis_update_freq else None,
+        'warm' if args.kfac_warm_start else None,
         f'bs{args.batch_size}', f'nd{args.num_devices}')
     log.info('args: %s', vars(args))
 

@@ -18,7 +18,6 @@ Example (virtual mesh smoke):
 """
 
 import argparse
-import logging
 import math
 import os
 import sys
@@ -116,7 +115,6 @@ def sample_batches(ids, args, rng):
 
 def main():
     args = parse_args()
-    os.makedirs(args.log_dir, exist_ok=True)
     from kfac_pytorch_tpu.utils.runlog import setup_run_logging
     log, _ = setup_run_logging(
         args.log_dir, f'longctx_L{args.seq_len}', args.kfac_name,

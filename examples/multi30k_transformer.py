@@ -14,7 +14,6 @@ container.
 """
 
 import argparse
-import logging
 import os
 import sys
 import time
@@ -77,6 +76,8 @@ def parse_args():
                    help='>0 uses beam search for BLEU eval')
     p.add_argument('--synthetic-vocab', type=int, default=64)
     p.add_argument('--synthetic-size', type=int, default=2048)
+    p.add_argument('--log-dir', default='./logs',
+                   help='per-run log files land here')
     p.add_argument('--tb-dir', default=None,
                    help='TensorBoard scalar summaries (rank 0)')
     return p.parse_args()
@@ -132,7 +133,7 @@ def main():
     args = parse_args()
     from kfac_pytorch_tpu.utils.runlog import setup_run_logging
     log, _ = setup_run_logging(
-        './logs', 'multi30k', args.optimizer,
+        args.log_dir, 'multi30k', args.optimizer,
         f'kfac{args.kfac_update_freq}', args.kfac_name,
         f'bs{args.batch_size}', f'nd{args.num_devices}')
     log.info('args: %s', vars(args))

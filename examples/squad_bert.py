@@ -13,7 +13,6 @@ span) that a small model learns from scratch.
 import argparse
 import collections
 import json
-import logging
 import os
 import sys
 import time
@@ -62,6 +61,8 @@ def parse_args():
     p.add_argument('--num-devices', type=int, default=1)
     p.add_argument('--seed', type=int, default=42)
     p.add_argument('--synthetic-size', type=int, default=1024)
+    p.add_argument('--log-dir', default='./logs',
+                   help='per-run log files land here')
     p.add_argument('--tb-dir', default=None,
                    help='TensorBoard scalar summaries (rank 0)')
     return p.parse_args()
@@ -119,7 +120,7 @@ def main():
     args = parse_args()
     from kfac_pytorch_tpu.utils.runlog import setup_run_logging
     log, _ = setup_run_logging(
-        './logs', 'squad', args.model_size,
+        args.log_dir, 'squad', args.model_size,
         f'kfac{args.kfac_update_freq}', args.kfac_name,
         f'bs{args.batch_size}', f'nd{args.num_devices}')
     log.info('args: %s', vars(args))

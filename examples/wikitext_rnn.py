@@ -15,7 +15,6 @@ corpus so the entrypoint runs in a dataset-free container.
 """
 
 import argparse
-import logging
 import math
 import os
 import sys
@@ -59,6 +58,8 @@ def parse_args():
     p.add_argument('--seed', type=int, default=42)
     p.add_argument('--synthetic-vocab', type=int, default=256)
     p.add_argument('--synthetic-tokens', type=int, default=100000)
+    p.add_argument('--log-dir', default='./logs',
+                   help='per-run log files land here')
     p.add_argument('--tb-dir', default=None,
                    help='TensorBoard scalar summaries (rank 0)')
     return p.parse_args()
@@ -94,7 +95,7 @@ def main():
     args = parse_args()
     from kfac_pytorch_tpu.utils.runlog import setup_run_logging
     log, _ = setup_run_logging(
-        './logs', 'wikitext', f'kfac{args.kfac_update_freq}',
+        args.log_dir, 'wikitext', f'kfac{args.kfac_update_freq}',
         args.kfac_name if args.kfac_update_freq else 'sgd',
         f'bs{args.batch_size}')
     log.info('args: %s', vars(args))

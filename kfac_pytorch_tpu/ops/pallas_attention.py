@@ -487,20 +487,39 @@ def _flash_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
     return (m, l, pv), (q, k, v, kv_mask, starts, m)
 
 
+#: 'auto' backward crossover: measured on a real v5e chip (2026-07-31,
+#: B=1 H=8 D=64 causal, logs/onchip/queue_0731_0346.flash_bwd_ab.log) the
+#: blockwise recompute wins below this key length (8k: 45 ms vs 62 ms
+#: fused) and the fused Pallas backward wins 15x above it (32k: 0.66 s vs
+#: 9.9 s — the recompute's full-array dk/dv tile updates are O(Lk^2) HBM
+#: traffic). Lk is a static shape, so the choice is made at trace time.
+AUTO_BWD_PALLAS_MIN_LK = 32768
+
+
+def _bwd_impl_for(impl: str, lk: int) -> str:
+    """Resolve the backward implementation name; 'auto' picks by the
+    (static) key length of this block."""
+    if impl not in ('auto', 'pallas', 'recompute'):
+        raise ValueError(f'KFAC_ATTN_BWD_IMPL={impl!r}: expected '
+                         "'auto', 'pallas' or 'recompute'")
+    if impl == 'auto':
+        return 'pallas' if lk >= AUTO_BWD_PALLAS_MIN_LK else 'recompute'
+    return impl
+
+
 def _flash_bwd(scale, causal, interpret, res, cts):
     import os
     q, k, v, kv_mask, starts, m = res
     _, dl, dpv = cts  # dm == 0: m is stop-gradiented at every consumer
-    # default: the fused Pallas backward (this VJP only runs on the
-    # pallas block path); KFAC_ATTN_BWD_IMPL=recompute selects the JAX
-    # blockwise recompute. TRACE-TIME knob: it is read when the backward
-    # is first traced and baked into the jit cache — set it before the
-    # first compile; flipping it mid-process does not retrace already-
-    # jitted functions (same semantics as KFAC_ATTN_IMPL/KFAC_EIGH_IMPL).
-    impl = os.environ.get('KFAC_ATTN_BWD_IMPL', 'pallas')
-    if impl not in ('pallas', 'recompute'):
-        raise ValueError(f'KFAC_ATTN_BWD_IMPL={impl!r}: expected '
-                         "'pallas' or 'recompute'")
+    # default 'auto': per-block-length choice between the fused Pallas
+    # backward and the JAX blockwise recompute (this VJP only runs on the
+    # pallas block path) — see _bwd_impl_for. TRACE-TIME knob: it is read
+    # when the backward is first traced and baked into the jit cache —
+    # set it before the first compile; flipping it mid-process does not
+    # retrace already-jitted functions (same semantics as
+    # KFAC_ATTN_IMPL/KFAC_EIGH_IMPL).
+    impl = _bwd_impl_for(os.environ.get('KFAC_ATTN_BWD_IMPL', 'auto'),
+                         k.shape[1])
     if impl == 'recompute':
         dq, dk, dv = _blockwise_bwd(q, k, v, kv_mask, m, dl, dpv,
                                     starts[0], starts[1], scale, causal)

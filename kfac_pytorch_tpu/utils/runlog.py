@@ -14,19 +14,33 @@ import os
 import time
 
 
-def setup_run_logging(log_dir, *parts, unique=True):
+def setup_run_logging(log_dir, *parts, unique=True, process_id=None):
     """``basicConfig`` with stream + per-run file handler.
 
     ``parts`` are joined with '_' (None/empty dropped). Returns
-    ``(logger, logfile_path)``.
+    ``(logger, logfile_path)`` — the path is None on non-zero processes.
+
+    Multi-process runs write the file from process 0 only (reference
+    rank-0 logging convention, examples/pytorch_cifar10_resnet.py:145):
+    on a shared filesystem the per-second timestamp suffix is identical
+    across ranks, so peer FileHandlers opened with mode='w' would
+    truncate each other. ``process_id`` defaults to the launcher-exported
+    JAX_PROCESS_ID (launch_tpu.sh) — read from the environment rather
+    than jax.process_index() so logging setup never triggers backend
+    initialization.
     """
-    os.makedirs(log_dir, exist_ok=True)
-    stem = '_'.join(str(p) for p in parts if p not in (None, ''))
-    if unique:
-        stem += time.strftime('_%m%dT%H%M%S')
-    path = os.path.join(log_dir, stem + '.log')
+    if process_id is None:
+        process_id = int(os.environ.get('JAX_PROCESS_ID', '0'))
+    handlers = [logging.StreamHandler()]
+    path = None
+    if process_id == 0:
+        os.makedirs(log_dir, exist_ok=True)
+        stem = '_'.join(str(p) for p in parts if p not in (None, ''))
+        if unique:
+            stem += time.strftime('_%m%dT%H%M%S')
+        path = os.path.join(log_dir, stem + '.log')
+        handlers.append(logging.FileHandler(path, mode='w'))
     logging.basicConfig(
         level=logging.INFO, format='%(asctime)s %(message)s', force=True,
-        handlers=[logging.StreamHandler(),
-                  logging.FileHandler(path, mode='w')])
+        handlers=handlers)
     return logging.getLogger(), path

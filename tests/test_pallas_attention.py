@@ -192,6 +192,24 @@ def test_pallas_bwd_matches_recompute_bwd(monkeypatch):
                                    atol=2e-4, rtol=2e-4)
 
 
+def test_bwd_impl_auto_policy():
+    """'auto' resolves by static block key length: blockwise recompute
+    below the measured v5e crossover, fused Pallas backward at/above it
+    (logs/onchip/queue_0731_0346.flash_bwd_ab.log: 8k recompute 45 ms vs
+    fused 62 ms; 32k fused 0.66 s vs recompute 9.9 s)."""
+    from kfac_pytorch_tpu.ops.pallas_attention import (
+        AUTO_BWD_PALLAS_MIN_LK, _bwd_impl_for)
+    assert _bwd_impl_for('auto', 1024) == 'recompute'
+    assert _bwd_impl_for('auto', AUTO_BWD_PALLAS_MIN_LK - 128) == 'recompute'
+    assert _bwd_impl_for('auto', AUTO_BWD_PALLAS_MIN_LK) == 'pallas'
+    assert _bwd_impl_for('auto', 2 * AUTO_BWD_PALLAS_MIN_LK) == 'pallas'
+    # explicit choices pass through untouched; junk is rejected
+    assert _bwd_impl_for('pallas', 8) == 'pallas'
+    assert _bwd_impl_for('recompute', 1 << 20) == 'recompute'
+    with pytest.raises(ValueError):
+        _bwd_impl_for('fused', 1024)
+
+
 def test_ring_with_pallas_blocks_matches_dense():
     devs = jax.devices()[:8]
     mesh = Mesh(np.array(devs), ('seq',))

@@ -1,6 +1,8 @@
 """Harness-utility tests: LR schedules, losses, metrics, data pipeline
 (reference surfaces: examples/utils.py:6-121, transformer/Optim.py:40-63)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -193,3 +195,32 @@ def test_summary_writer_tensorboard_roundtrip(tmp_path):
         for v in e.summary.value:
             got.append((e.step, v.tag, float(v.tensor.float_val[0])))
     assert got == [(0, 'train/loss', 2.5), (7, 'val/accuracy', 0.875)], got
+
+
+def test_setup_run_logging_rank0_only_file(tmp_path, monkeypatch):
+    """Process 0 gets the per-run file; peer processes stream only — on a
+    shared filesystem their identical timestamp suffix would otherwise
+    truncate each other's file (mode='w')."""
+    import logging as _logging
+    from kfac_pytorch_tpu.utils.runlog import setup_run_logging
+
+    log, path = setup_run_logging(str(tmp_path), 'run', 'a', None, 'bs8',
+                                  process_id=0)
+    log.info('hello from rank 0')
+    assert path is not None and path.endswith('.log')
+    for h in _logging.getLogger().handlers:
+        h.flush()
+    assert 'hello from rank 0' in open(path).read()
+    assert 'run_a_bs8' in os.path.basename(path)  # None part dropped
+
+    _, peer_path = setup_run_logging(str(tmp_path), 'run', 'a', 'bs8',
+                                     process_id=1)
+    assert peer_path is None
+    assert not any(isinstance(h, _logging.FileHandler)
+                   for h in _logging.getLogger().handlers)
+
+    # launcher-exported rank is picked up from the environment
+    monkeypatch.setenv('JAX_PROCESS_ID', '3')
+    _, env_path = setup_run_logging(str(tmp_path), 'run', 'b')
+    assert env_path is None
+    _logging.basicConfig(force=True)  # restore for later tests
