@@ -102,13 +102,18 @@ def _ce(outputs, batch):
 
 
 def _time_steps(step, state, batch, iters, warmup=WARMUP, **kw):
+    # host_fence, not block_until_ready: the latter does not fence
+    # execution on the tunneled TPU platform (scripts/check_eigh_onchip.py);
+    # each step consumes the previous step's state, so fencing the final
+    # metrics fences the whole chain exactly
+    from kfac_pytorch_tpu.utils.profiling import host_fence
     for _ in range(warmup):
         state, m = step(state, batch, **kw)
-    jax.block_until_ready(m)
+    host_fence(m)
     t0 = time.perf_counter()
     for _ in range(iters):
         state, m = step(state, batch, **kw)
-    jax.block_until_ready(m)
+    host_fence(m)
     return (time.perf_counter() - t0) / iters, state
 
 

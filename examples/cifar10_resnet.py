@@ -180,19 +180,15 @@ def main():
         return loss, acc
 
     if args.speed:
+        from kfac_pytorch_tpu.utils import profiling
         batch = next(train_loader.epoch())
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
-        times = []
-        for i in range(SPEED_ITERS + 5):
-            t0 = time.perf_counter()
-            state, m = step(state, batch, lr=lr_fn(i),
-                            damping=precond.damping if precond else 0.0)
-            jax.block_until_ready(m['loss'])
-            if i >= 5:
-                times.append(time.perf_counter() - t0)
+        mean, std, state = profiling.time_steps(
+            step, state, batch, iters=SPEED_ITERS, warmup=5,
+            kw_fn=lambda i: dict(lr=lr_fn(i)),
+            damping=precond.damping if precond else 0.0)
         log.info('SPEED: iter time %.4f +- %.4f s (imgs/sec %.1f)',
-                 np.mean(times), np.std(times),
-                 args.batch_size / np.mean(times))
+                 mean, std, args.batch_size / mean)
         return
 
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer

@@ -190,20 +190,16 @@ def main():
         return loss, utils.accuracy(out, batch['label'])
 
     if args.speed:
+        from kfac_pytorch_tpu.utils import profiling
         batch = next(train_loader.epoch())
         batch = {'input': jnp.asarray(batch['input'], dtype),
                  'label': jnp.asarray(batch['label'])}
-        times = []
-        for i in range(65):
-            t0 = time.perf_counter()
-            state, m = step(state, batch, lr=lr_fn(i),
-                            damping=precond.damping if precond else 0.0)
-            jax.block_until_ready(m['loss'])
-            if i >= 5:
-                times.append(time.perf_counter() - t0)
+        mean, std, state = profiling.time_steps(
+            step, state, batch, iters=60, warmup=5,
+            kw_fn=lambda i: dict(lr=lr_fn(i)),
+            damping=precond.damping if precond else 0.0)
         log.info('SPEED: iter %.4f +- %.4f s (%.1f imgs/s)',
-                 np.mean(times), np.std(times),
-                 args.batch_size / np.mean(times))
+                 mean, std, args.batch_size / mean)
         return
 
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer

@@ -207,16 +207,21 @@ def main():
         t0 = time.perf_counter()
         loss_m = metrics.Metric('loss')
         iter_times = []
+        rtt = 0.0
         for i, batch in enumerate(sample_batches(train_ids, args, rng)):
             ti = time.perf_counter()
             state, m = step(state, batch, lr=args.base_lr,
                             damping=args.damping)
+            # float() pulls the loss to the host — the real execution
+            # fence (block_until_ready does not fence on the tunnel)
+            loss_m.update(float(m['loss']))
             if args.speed:
-                jax.block_until_ready(m)
-                iter_times.append(time.perf_counter() - ti)
+                if i == 4:  # measure idle round-trip once, post-fence
+                    from kfac_pytorch_tpu.utils import profiling
+                    rtt = profiling.fence_rtt(m)
+                iter_times.append(max(time.perf_counter() - ti - rtt, 0.0))
                 if i >= 60:
                     break
-            loss_m.update(float(m['loss']))
         if args.speed:
             it = np.mean(iter_times[5:]), np.std(iter_times[5:])
             toks = args.batch_size * args.seq_len / it[0]

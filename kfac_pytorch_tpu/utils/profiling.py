@@ -35,18 +35,59 @@ def trace(log_dir):
         jax.profiler.stop_trace()
 
 
-def time_steps(step_fn, state, batch, iters=30, warmup=5, **kw):
+def host_fence(out):
+    """Force completion of every execution dispatched so far by pulling a
+    tiny piece of ``out`` to the host — THE execution fence for this
+    framework's timing code.
+
+    ``jax.block_until_ready`` does not fence execution on the tunneled
+    TPU platform (measured 2026-07-31, scripts/check_eigh_onchip.py: a
+    multi-second eigh 'blocked' in 0.15 ms while a forced transfer took
+    the full compute time). A host transfer cannot complete before the
+    producing computation has run, and a single TPU core executes
+    programs in submission order, so fetching from the LAST dispatched
+    program's output fences all of them. Only a scalar-sized slice
+    travels, keeping wire time out of the measurement."""
+    leaves = [x for x in jax.tree.leaves(out) if hasattr(x, 'shape')]
+    if not leaves:
+        return jax.block_until_ready(out)
+    x = leaves[-1]
+    np.asarray(x[(slice(0, 1),) * getattr(x, 'ndim', 0)])
+
+
+def fence_rtt(out, samples=3):
+    """Measure the pure host<->device round-trip cost of :func:`host_fence`
+    when nothing is pending (call right after a fence) — subtract it from
+    per-iteration timings so tunnel latency doesn't masquerade as step
+    time."""
+    t0 = time.perf_counter()
+    for _ in range(samples):
+        host_fence(out)
+    return (time.perf_counter() - t0) / samples
+
+
+def time_steps(step_fn, state, batch, iters=30, warmup=5, kw_fn=None, **kw):
     """Mean/std steady-state iteration time (the SPEED-mode measurement,
-    reference :333-344)."""
-    for _ in range(warmup):
-        state, m = step_fn(state, batch, **kw)
-    jax.block_until_ready(m)
+    reference :333-344). Fences each iteration via :func:`host_fence` and
+    subtracts the measured idle round-trip so per-iter times reflect
+    device execution, not tunnel latency.
+
+    kw_fn: optional ``kw_fn(i) -> dict`` of per-iteration step kwargs
+    (e.g. a stepped LR schedule); merged over ``**kw``.
+    """
+    def kwargs(i):
+        return {**kw, **(kw_fn(i) if kw_fn else {})}
+
+    for i in range(warmup):
+        state, m = step_fn(state, batch, **kwargs(i))
+    host_fence(m)
+    rtt = fence_rtt(m)
     times = []
-    for _ in range(iters):
+    for i in range(iters):
         t0 = time.perf_counter()
-        state, m = step_fn(state, batch, **kw)
-        jax.block_until_ready(m)
-        times.append(time.perf_counter() - t0)
+        state, m = step_fn(state, batch, **kwargs(warmup + i))
+        host_fence(m)
+        times.append(max(time.perf_counter() - t0 - rtt, 0.0))
     return float(np.mean(times)), float(np.std(times)), state
 
 

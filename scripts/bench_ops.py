@@ -16,7 +16,7 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
-from scripts.utils import force_platform
+from scripts.utils import force_platform, timeit
 force_platform()
 
 import jax
@@ -31,21 +31,19 @@ RESNET50_A_DIMS = [147, 64, 256, 576, 512, 1024, 1152, 2048, 2304, 4608,
 RESNET50_G_DIMS = [64, 128, 256, 512, 1024, 2048, 1000]
 
 
-def timeit(fn, *args, warmup=2, iters=5):
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
-
-
 def spd(rng, batch, dim):
     a = rng.randn(batch, dim, dim).astype(np.float32) / np.sqrt(dim)
     x = a @ a.transpose(0, 2, 1) + np.eye(dim, dtype=np.float32)
     return jnp.asarray(x)
+
+
+def jitter(x):
+    """``vary`` hook for timeit: a per-iteration diagonal shift keeps the
+    inputs distinct (same spectrum structure) so remote execution caches
+    cannot serve repeats — see scripts/utils.timeit."""
+    d = x.shape[-1]
+    eye = jnp.eye(d, dtype=x.dtype) * 1e-4
+    return lambda i: (x + (i + 1) * eye,)
 
 
 def main():
@@ -66,8 +64,8 @@ def main():
             inv_j = jax.jit(lambda x: ops.psd_inverse(x))
             for d in args.dims:
                 x = spd(rng, args.batch, d)
-                te = timeit(eigh_j, x)
-                ti = timeit(inv_j, x)
+                te = timeit(eigh_j, x, warmup=1, iters=3, vary=jitter(x))
+                ti = timeit(inv_j, x, warmup=1, iters=3, vary=jitter(x))
                 print(f'prec={prec:14s} dim={d:5d} batch={args.batch} '
                       f'eigh={te * 1e3:9.1f} ms  chol_inv={ti * 1e3:8.1f} ms')
 
@@ -80,7 +78,7 @@ def main():
         if d > 1024:
             continue  # n^4 matmul form cedes large dims to QDWH
         x = spd(rng, args.batch, d)
-        tj = timeit(jac, x)
+        tj = timeit(jac, x, warmup=1, iters=3, vary=jitter(x))
         w, q = jac(x)
         werr = float(jnp.max(jnp.abs(
             w - jnp.asarray(np.linalg.eigvalsh(np.asarray(x))))))
@@ -88,7 +86,9 @@ def main():
               f'{tj * 1e3:9.1f} ms  (max |dw| {werr:.2e})')
         drift = spd(rng, args.batch, d)
         xp = 0.6 * x + 0.4 * jnp.asarray(drift) / d
-        tw = timeit(jac_warm, xp, q)
+        jw = jitter(xp)
+        tw = timeit(jac_warm, xp, q, warmup=1, iters=3,
+            vary=lambda i: (*jw(i), q))
         ww, _ = jac_warm(xp, q)
         werr = float(jnp.max(jnp.abs(
             ww - jnp.asarray(np.linalg.eigvalsh(np.asarray(xp))))))
@@ -100,7 +100,8 @@ def main():
                                                 False))
     for c, hw in [(64, 56), (256, 28), (512, 14)]:
         a = jnp.asarray(rng.randn(32, hw, hw, c).astype(np.float32))
-        t = timeit(gemm, a)
+        t = timeit(gemm, a, warmup=1, iters=3,
+           vary=lambda i: (a + 1e-3 * i,))
         print(f'compute_a_conv c={c:4d} hw={hw:3d} bs=32: {t * 1e3:8.1f} ms')
 
 

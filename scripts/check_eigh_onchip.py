@@ -1,14 +1,25 @@
 """On-chip eigh sanity probe: is the timing real, and is the answer right?
 
-scripts/bench_ops.py measured batch-4 dim-4608 XLA eigh at ~0.1 ms on the
-tunnel chip (logs/onchip/queue_0731_0346.bench_ops.log) — physically
-impossible (one 4608^3 matmul alone is ~1 ms at v5e peak), so either
-``jax.block_until_ready`` is not actually fencing execution on this
-platform, or eigh is converging to garbage instantly. This probe decides
+scripts/bench_ops.py originally measured batch-4 dim-4608 XLA eigh at
+~0.1 ms on the tunnel chip (logs/onchip/queue_0731_0346.bench_ops.log) —
+physically impossible (one 4608^3 matmul alone is ~1 ms at v5e peak), so
+either ``jax.block_until_ready`` was not fencing execution on this
+platform, or eigh was converging to garbage instantly. This probe decides
 which: it times the same op three ways (block_until_ready; a forced
 device->host transfer, which cannot complete before the computation; and
-a scalar reduction of the outputs) and checks the decomposition itself
-(reconstruction ``Q diag(w) Q^T ~= X``, orthogonality ``Q^T Q ~= I``).
+a host fetch of an on-device scalar reduction) and checks the
+decomposition itself (reconstruction ``Q diag(w) Q^T ~= X``,
+orthogonality ``Q^T Q ~= I``). First run's verdict (2026-07-31,
+logs/onchip/manual_seq.log): decomposition CORRECT, block_until_ready
+fence BROKEN (0.15 ms vs multi-second real compute) — which is why all
+framework timing now goes through ``utils.profiling.host_fence``.
+
+Methodology notes baked in from review: each timing iteration gets a
+distinct input (diagonal jitter) so remote execution caches cannot serve
+repeats; the wire-only baseline transfers N distinct precomputed arrays
+(np.asarray caches the host value per array, so re-pulling one array is
+free after the first fetch); the reduction is fetched to host, not
+block_until_ready'd.
 
 Usage: python scripts/check_eigh_onchip.py [--dim 2304] [--batch 4]
 """
@@ -36,59 +47,61 @@ def main():
     p.add_argument('--batch', type=int, default=4)
     p.add_argument('--iters', type=int, default=3)
     args = p.parse_args()
-    d, b = args.dim, args.batch
+    d, b, iters = args.dim, args.batch, args.iters
 
     rng = np.random.RandomState(0)
     a = rng.randn(b, d, d).astype(np.float32) / np.sqrt(d)
     x = jnp.asarray(a @ a.transpose(0, 2, 1) + np.eye(d, dtype=np.float32))
+    eye = jnp.eye(d, dtype=x.dtype) * 1e-4
+    xs = [x + (i + 1) * eye for i in range(iters)]  # distinct per iter
     print(f'device: {jax.devices()[0]}  x: {x.shape} {x.dtype}')
 
     eigh_j = jax.jit(lambda x: ops.sym_eig(x, impl='xla'))
     w, q = jax.block_until_ready(eigh_j(x))  # compile + settle
 
-    # 1) the bench_ops timing recipe
+    # 1) the (broken-on-tunnel) block_until_ready recipe
     t0 = time.perf_counter()
-    for _ in range(args.iters):
-        out = eigh_j(x)
+    for xi in xs:
+        out = eigh_j(xi)
     jax.block_until_ready(out)
-    t_block = (time.perf_counter() - t0) / args.iters
+    t_block = (time.perf_counter() - t0) / iters
 
     # 2) force a full device->host copy of the eigenvectors each iter
     t0 = time.perf_counter()
-    for _ in range(args.iters):
-        w2, q2 = eigh_j(x)
+    for xi in xs:
+        _, q2 = eigh_j(xi)
         _ = np.asarray(q2)
-    t_xfer = (time.perf_counter() - t0) / args.iters
+    t_xfer = (time.perf_counter() - t0) / iters
 
-    # 3) reduce to one scalar on device, pull only that
-    red = jax.jit(lambda x: jax.tree.map(jnp.sum, eigh_j(x)))
-    jax.block_until_ready(red(x))
+    # 3) reduce to one scalar on device, pull only that (host fetch — the
+    #    very fence this probe justifies; NOT block_until_ready)
+    red = jax.jit(lambda x: sum(jnp.sum(o) for o in eigh_j(x)))
+    float(np.asarray(red(x)))  # compile + settle
     t0 = time.perf_counter()
-    for _ in range(args.iters):
-        s = red(x)
-    jax.block_until_ready(s)
-    t_reduce = (time.perf_counter() - t0) / args.iters
+    for xi in xs:
+        s = float(np.asarray(red(xi)))
+    t_reduce = (time.perf_counter() - t0) / iters
 
-    # transfer-only baseline: pulling an already-computed same-shape array
-    # costs the same copy; subtract it so the plausibility verdict sees
-    # compute time, not wire time
-    q_done = jax.block_until_ready(eigh_j(x))[1]
+    # transfer-only baseline: N distinct, already-computed same-shape
+    # arrays (re-pulling one array is free after its first fetch)
+    qs_done = [jax.block_until_ready(eigh_j(xi))[1] for xi in xs]
+    time.sleep(1.0)  # let any straggling execution drain
     t0 = time.perf_counter()
-    for _ in range(args.iters):
-        _ = np.asarray(q_done)
-    t_wire = (time.perf_counter() - t0) / args.iters
+    for qd in qs_done:
+        _ = np.asarray(qd)
+    t_wire = (time.perf_counter() - t0) / iters
 
     print(f'timing: block_until_ready {t_block * 1e3:9.2f} ms | '
           f'+host transfer {t_xfer * 1e3:9.2f} ms '
           f'(wire-only {t_wire * 1e3:9.2f} ms) | '
-          f'scalar reduce {t_reduce * 1e3:9.2f} ms')
+          f'scalar-fetch reduce {t_reduce * 1e3:9.2f} ms')
 
     wn, qn = np.asarray(w), np.asarray(q)
     xn = np.asarray(x)
     recon = qn @ (wn[..., None] * np.swapaxes(qn, -1, -2))
     rec_err = np.max(np.abs(recon - xn)) / np.max(np.abs(xn))
-    eye = np.eye(d, dtype=np.float32)
-    orth_err = max(np.max(np.abs(qi.T @ qi - eye)) for qi in qn)
+    eye_n = np.eye(d, dtype=np.float32)
+    orth_err = max(np.max(np.abs(qi.T @ qi - eye_n)) for qi in qn)
     w_ref = np.linalg.eigvalsh(xn[0])
     w_err = np.max(np.abs(np.sort(wn[0]) - w_ref)) / np.max(np.abs(w_ref))
     print(f'accuracy: recon {rec_err:.2e}  orth {orth_err:.2e}  '
@@ -102,8 +115,9 @@ def main():
           f'compute {compute_ms:.2f} ms -> timings '
           + ('PLAUSIBLE' if compute_ms > floor_ms else 'IMPLAUSIBLE'))
     print('VERDICT:', 'correct decomposition' if ok_acc
-          else 'WRONG RESULTS — do not trust this eigh', '| slowest timing',
-          f'{max(t_block, t_xfer, t_reduce) * 1e3:.2f} ms')
+          else 'WRONG RESULTS — do not trust this eigh', '| compute',
+          f'~{compute_ms:.2f} ms | block_until_ready fence '
+          + ('OK' if t_block >= 0.5 * t_reduce else 'BROKEN'))
 
 
 if __name__ == '__main__':

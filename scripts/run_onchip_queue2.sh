@@ -1,0 +1,71 @@
+#!/bin/bash
+# Second on-chip batch (round-2 session 4), rebuilt after the fencing
+# discovery: jax.block_until_ready does NOT fence execution on the tunnel
+# platform (scripts/check_eigh_onchip.py), so every measurement here uses
+# the fixed harness (host-fetch fence + per-iteration input jitter).
+# Sequential, timeout-wrapped, logs under logs/onchip/.
+#
+# Dropped from the original plan: BENCH_FULL KFAC_EIGH_IMPL=jacobi legs —
+# the real-fenced probe shows batched Jacobi loses to XLA QDWH per matrix
+# at 512 (>=1.6x) and catastrophically at 1024 (~79 s/call), so running a
+# full ResNet-50 eigen_dp bench through it would burn hours measuring a
+# known loser. The 'paired' rotation form gets one cheap bench_ops probe
+# instead (gather-free — the one variant that might map to the MXU).
+#
+# Usage: nohup bash scripts/run_onchip_queue2.sh &
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p logs/onchip
+TS=$(date +%m%d_%H%M)
+L="logs/onchip/queue2_${TS}"
+
+run() {  # run <tag> <timeout_s> <cmd...>
+  local tag=$1 to=$2; shift 2
+  echo "=== [$tag] $(date +%H:%M:%S) timeout=${to}s: $*" | tee -a "$L.summary"
+  timeout "$to" "$@" > "$L.$tag.log" 2>&1
+  local rc=$?
+  echo "=== [$tag] rc=$rc $(date +%H:%M:%S)" | tee -a "$L.summary"
+  tail -5 "$L.$tag.log" >> "$L.summary"
+  return $rc
+}
+
+run probe 120 python -c "import jax; print(jax.devices())" || {
+  echo "tunnel down — aborting queue2" | tee -a "$L.summary"; exit 1; }
+
+# 1. real-fenced op A/B: XLA eigh vs chol_inv vs (<=1024) jacobi, three
+#    matmul precisions — decides KFAC_EIGH_IMPL auto + eigh precision
+run bench_ops 5400 python scripts/bench_ops.py
+
+# 2. the gather-free paired-rotation jacobi: keep or delete the knob
+run bench_ops_paired 3600 env KFAC_JACOBI_ROT=paired \
+    python scripts/bench_ops.py --dims 512 1024
+
+# 3. flash A/B re-run under the fixed harness (confirm the auto-bwd
+#    crossover measured with the old fence)
+run flash_ab 3600 python scripts/bench_flash.py \
+    --seq-lens 8192 32768 --bwd-impls pallas recompute
+
+# 4. headline bench with the real fence — the official-number candidate
+run bench_headline 5400 python bench.py
+
+# 5. full bench: + eigen_dp stock and basis-amortized legs (XLA eigh)
+run bench_full 7200 env BENCH_FULL=1 python bench.py
+
+# 6. per-phase breakdown on the flagship config (5 extra programs)
+run bench_breakdown 7200 env BENCH_BREAKDOWN=1 python bench.py
+
+# 7. real-data convergence ON CHIP: digits-CIFAR, unmodified reference
+#    recipe (ResNet-32, bs128, damping 0.03), K-FAC leg + SGD leg
+[ -d /tmp/digits_cifar ] || run mkdata 300 python scripts/make_digits_cifar.py
+run digits_kfac 7200 env data_dir=/tmp/digits_cifar nworkers=1 kfac=1 \
+    epochs=100 bash train_cifar10.sh
+run digits_sgd 7200 env data_dir=/tmp/digits_cifar nworkers=1 kfac=0 \
+    epochs=100 bash train_cifar10.sh
+
+# 8. retry the XLA blockwise attention path at 32k (was an HTTP 500 from
+#    the remote-compile service — flaky-or-real check)
+run flash_32k_xla 1800 python scripts/bench_flash.py --seq-lens 32768 \
+    --impls xla
+
+echo "QUEUE2 COMPLETE $(date)" | tee -a "$L.summary"

@@ -48,16 +48,25 @@ def build_vision_model(name, img=None, num_classes=None):
     return models.get_model(name, num_classes=num_classes), img, num_classes
 
 
-def timeit(fn, *args, warmup=2, iters=10):
-    """Mean wall-clock seconds per call, synchronized on device output."""
-    import jax
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
+def timeit(fn, *args, warmup=2, iters=10, vary=None):
+    """Mean wall-clock seconds per call, synchronized by a host fetch of
+    the last output (``kfac_pytorch_tpu.utils.profiling.host_fence`` —
+    ``jax.block_until_ready`` does not fence execution on the tunneled
+    TPU platform).
+
+    vary: optional ``vary(i) -> args`` callable producing per-iteration
+    inputs — repeated identical (program, inputs) executions can be
+    served from caches on remote platforms, so A/B microbenches should
+    pass distinct inputs per iteration.
+    """
+    from kfac_pytorch_tpu.utils.profiling import host_fence
+    for i in range(warmup):
+        out = fn(*(vary(i) if vary else args))
+    host_fence(out)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
+    for i in range(iters):
+        out = fn(*(vary(warmup + i) if vary else args))
+    host_fence(out)
     return (time.perf_counter() - t0) / iters
 
 
