@@ -202,9 +202,11 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
     diagonal, so one uniform expression covers A and G slots.
 
     basis_local: previous local eigenbasis rows (``local_evecs``) to
-    warm-start the Jacobi eigh — only consulted on the eigh path and only
-    effective when KFAC_EIGH_IMPL resolves to jacobi. ``warm_sweeps``
-    overrides the warm-start sweep count (None = kernel default).
+    warm-start the decomposition — only consulted on the eigh path and
+    only effective when KFAC_EIGH_IMPL resolves to 'jacobi' (rotated
+    sweeps) or 'subspace'/'auto' (perturbative tracking,
+    ops.subspace_eigh). ``warm_sweeps`` overrides the warm iteration
+    count (None = kernel default).
     """
     if method == 'eigh':
         evals, evecs = {}, {}

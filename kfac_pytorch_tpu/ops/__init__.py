@@ -12,6 +12,7 @@ from kfac_pytorch_tpu.ops.linalg import (
     psd_inverse,
     sym_eig,
     jacobi_eigh,
+    subspace_eigh,
     clamp_eigvals,
     add_scaled_identity,
     masked_trace,
@@ -21,6 +22,7 @@ from kfac_pytorch_tpu.ops.linalg import (
 __all__ = [
     'extract_patches', 'compute_a_dense', 'compute_a_conv',
     'compute_g_dense', 'compute_g_conv', 'update_running_avg',
-    'psd_inverse', 'sym_eig', 'jacobi_eigh', 'clamp_eigvals', 'add_scaled_identity',
+    'psd_inverse', 'sym_eig', 'jacobi_eigh', 'subspace_eigh',
+    'clamp_eigvals', 'add_scaled_identity',
     'masked_trace', 'identity_pad',
 ]

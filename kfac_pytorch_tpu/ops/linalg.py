@@ -48,25 +48,106 @@ def sym_eig(x, impl=None, basis=None, sweeps=None):
     instead of as a cuSOLVER host call.
 
     basis: optional previous eigenbasis (same shape as ``x``) to
-    warm-start the Jacobi path — see :func:`jacobi_eigh`. The caller must
-    guarantee it is orthogonal (e.g. a prior decomposition's
-    eigenvectors); it is ignored by the XLA path.
+    warm-start the Jacobi or subspace path. The caller must guarantee it
+    is orthogonal (e.g. a prior decomposition's eigenvectors); it is
+    ignored by the XLA path.
 
     impl: 'xla' (jnp.linalg.eigh — QDWH on TPU), 'jacobi' (the batched
-    matmul-form Jacobi sweep kernel below, built for the K-FAC bucket
-    regime: many small/medium factors decomposed together), 'auto'
-    (jacobi for bucket dims <= 1024, whose n^4 matmul form is the
-    MXU-friendly trade; QDWH's O(n^3) wins above), or None to read
+    matmul-form Jacobi sweep kernel below), 'subspace' (warm-only
+    orthogonal-iteration tracking — :func:`subspace_eigh`; falls back to
+    XLA when no basis exists yet), 'auto', or None to read
     KFAC_EIGH_IMPL from the environment (default 'xla').
+
+    'auto' resolves to 'subspace': real-chip measurements (2026-07-31,
+    logs/onchip/, NOTES.md fencing entry) show XLA QDWH eigh is
+    iteration-bound (seconds at K-FAC bucket dims: [4,2304] ~ 9.8 s) and
+    the gather-bound matmul-form Jacobi loses to it from 512 dims up
+    (~79 s/call at [4,1024]); the subspace tracker is the only
+    MXU-shaped form — cold decompositions still pay one QDWH, warm fulls
+    are ~6 batched matmuls + a Cholesky.
     """
     impl = impl or os.environ.get('KFAC_EIGH_IMPL', 'xla')
     if impl == 'auto':
-        impl = 'jacobi' if x.shape[-1] <= 1024 else 'xla'
+        impl = 'subspace'
     if impl == 'jacobi':
         return jacobi_eigh(x, sweeps=sweeps, basis=basis)
-    # QDWH has no warm-start notion; basis/sweeps are ignored on XLA
+    if impl == 'subspace' and basis is not None:
+        return subspace_eigh(x, basis, steps=sweeps)
+    # QDWH: no warm-start notion ('subspace' with no basis lands here too)
     eigvals, eigvecs = jnp.linalg.eigh(x)
     return eigvals, eigvecs
+
+
+def _chol_qr(z, jitter=1e-6):
+    """Batched CholeskyQR: orthonormalize the columns of ``z`` with one
+    Gram matmul, one small Cholesky and one triangular solve — all
+    MXU-shaped. A relative diagonal jitter keeps the Gram factor positive
+    definite when ``z`` is ill-conditioned (the caller runs two passes,
+    which restores orthogonality to working precision — CholeskyQR2)."""
+    g = jnp.einsum('...ji,...jk->...ik', z, z,
+                   precision=lax.Precision.HIGHEST)
+    d = jnp.diagonal(g, axis1=-2, axis2=-1)
+    scale = jnp.mean(d, axis=-1, keepdims=True)[..., None]
+    eye = jnp.eye(z.shape[-1], dtype=z.dtype)
+    r = jnp.linalg.cholesky(g + jitter * scale * eye)
+    # q = z @ r^{-T}: columns of z against the lower Cholesky factor
+    return lax.linalg.triangular_solve(r, z, left_side=False, lower=True,
+                                       transpose_a=True)
+
+
+def subspace_eigh(x, basis, steps=None, tau=0.01, clip=0.5):
+    """Warm eigendecomposition by perturbative basis tracking: start from
+    the previous eigenbasis instead of re-solving from scratch.
+
+    The running-average K-FAC factors rotate slowly between
+    decompositions (factor_decay ~= 0.95), so ``B = Q^T X Q`` is nearly
+    diagonal in the stored basis. Each step applies the first-order
+    eigenvector correction of perturbation theory — the skew-symmetric
+    rotation ``K_ij = B_ij / (d_j - d_i)`` — and re-orthonormalizes with
+    CholeskyQR2, which drives the off-diagonal mass down quadratically
+    per step for separated eigenvalues. Near-degenerate pairs get their
+    rotation Tikhonov-suppressed (``denom / (denom^2 + (tau*spread)^2)``):
+    mixing inside an eigenvalue cluster is harmless, because any
+    orthogonal basis of the cluster's invariant subspace yields the same
+    preconditioner ``Q f(d) Q^T`` and the Rayleigh eigenvalues
+    ``diag(Q^T X Q)`` stay correct. ``clip`` bounds individual rotation
+    angles so a far-drifted basis degrades gracefully toward more steps
+    rather than overshooting.
+
+    Everything is batched matmuls plus one [n, n] Cholesky per step —
+    the MXU-shaped replacement for QDWH/Jacobi in the warm path
+    (KFAC_EIGH_IMPL=subspace|auto + warm_start_basis / basis_update_freq):
+    real-chip QDWH at K-FAC bucket dims costs seconds
+    (logs/onchip/manual_seq.log) while this costs ~6 matmuls.
+
+    Returns unsorted ``(eigvals, eigvecs)`` like :func:`jacobi_eigh`.
+    """
+    steps = 2 if steps is None else max(int(steps), 1)
+    n = x.shape[-1]
+    eye = jnp.eye(n, dtype=x.dtype)
+    q = basis.astype(x.dtype)
+    mm = functools.partial(jnp.einsum, precision=lax.Precision.HIGHEST)
+    for _ in range(steps):
+        xq = mm('...ij,...jk->...ik', x, q)
+        b = mm('...ji,...jk->...ik', q, xq)
+        d = jnp.diagonal(b, axis1=-2, axis2=-1)
+        # floor the spread at eps-relative scale: a constant-diagonal slot
+        # (e.g. an all-padding identity block) has spread 0, and a tiny
+        # (subnormal) floor would underflow in (tau*spread)**2 and make
+        # reg = 0/0 — with the eps floor, reg = 0 there and k stays 0
+        eps_floor = jnp.finfo(x.dtype).eps * (1.0 + jnp.max(jnp.abs(d),
+                                                            axis=-1))
+        spread = jnp.maximum(jnp.max(d, axis=-1) - jnp.min(d, axis=-1),
+                             eps_floor)[..., None, None]
+        denom = d[..., None, :] - d[..., :, None]        # d_j - d_i
+        reg = denom / (denom * denom + (tau * spread) ** 2)
+        k = jnp.clip((b - d[..., :, None] * eye) * reg, -clip, clip)
+        k = k * (1 - eye)                                # zero diagonal
+        q = _chol_qr(q + mm('...ij,...jk->...ik', q, k))
+        q = _chol_qr(q)                                  # CholeskyQR2
+    xq = mm('...ij,...jk->...ik', x, q)
+    w = jnp.sum(q * xq, axis=-2)
+    return w, q
 
 
 @functools.lru_cache(maxsize=None)

@@ -90,17 +90,20 @@ class KFAC:
         bucket instead of an eigh). None (default) = every inverse update
         is a full decomposition, the reference cadence.
       warm_start_basis: eigh variants only (beyond reference) — full
-        decompositions after the first re-diagonalize in the previous
-        eigenbasis (rotate, few Jacobi sweeps, rotate back). Effective
-        when KFAC_EIGH_IMPL resolves to jacobi; composes with
-        basis_update_freq.
-      warm_sweeps: Jacobi sweep count for warm-started full
-        decompositions (None = the jacobi kernel's warm default, 5 —
+        decompositions after the first start from the previous
+        eigenbasis. Effective when KFAC_EIGH_IMPL resolves to 'jacobi'
+        (rotate, few Jacobi sweeps, rotate back) or 'subspace'/'auto'
+        (orthogonal-iteration tracking, ops.subspace_eigh — the
+        MXU-shaped warm kernel, chosen by real-chip measurement);
+        composes with basis_update_freq.
+      warm_sweeps: iteration count for warm-started full decompositions:
+        Jacobi sweeps (None = the kernel's warm default, 5) or subspace
+        tracking steps (None = 2). Both defaults are
         calibrated for the stat_decay=0.95 / <=10-step full-interval
-        drift regime). Raise it for longer intervals between fulls
-        (large basis_update_freq / kfac_update_freq) or faster factor
-        decay: the stored basis rotates further between fulls and five
-        sweeps can under-converge.
+        drift regime — raise for longer intervals between fulls (large
+        basis_update_freq / kfac_update_freq) or faster factor decay:
+        the stored basis rotates further between fulls and the default
+        can under-converge.
       cold_restart_every: with warm_start_basis, force a cold (from
         scratch) full decomposition after this many consecutive warm
         ones — the chained basis Q <- Q @ V' accumulates ~1e-7
@@ -155,7 +158,8 @@ class KFAC:
                 warnings.warn(
                     'warm_start_basis has no effect on the XLA eigh path '
                     "(QDWH cannot warm-start) — set KFAC_EIGH_IMPL="
-                    "'jacobi' or 'auto' to use it", stacklevel=2)
+                    "'subspace' (or 'auto'/'jacobi') to use it",
+                    stacklevel=2)
         self.warm_start_basis = warm_start_basis
         if warm_start_basis and warm_sweeps is None:
             interval = basis_update_freq or kfac_update_freq

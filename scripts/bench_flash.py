@@ -98,7 +98,10 @@ def main():
                           + ' '.join(f'{r:.2e}' for r in rels))
                     outs.clear()
                 del grads
-                t = timeit(fn, q, k, v, warmup=1, iters=3)
+                # vary q per iteration: identical (program, input)
+                # repeats can be served from remote execution caches
+                t = timeit(fn, q, k, v, warmup=1, iters=3,
+                           vary=lambda i: (q * (1 + 1e-4 * i), k, v))
                 print(f'  L={L:>7} {tag:>22}: {t * 1e3:>9.2f} ms '
                       f'({args.batch * L / t / 1e3:>8.1f}K tok/s)')
             except Exception as e:

@@ -85,7 +85,9 @@ def main():
                 sh = NamedSharding(mesh, spec)
                 qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
             try:
-                t = timeit(g, qs, ks, vs, warmup=1, iters=3)
+                t = timeit(g, qs, ks, vs, warmup=1, iters=3,
+                           vary=lambda i: (qs * (1 + 1e-4 * i),
+                                           ks, vs))
             except Exception as e:  # OOM for dense at long L
                 print(f'  L={L:>7} {name:>8}: failed ({type(e).__name__})')
                 continue

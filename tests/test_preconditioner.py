@@ -281,3 +281,25 @@ def test_warm_start_long_interval_warns(monkeypatch):
                   basis_update_freq=25, warm_sweeps=8,
                   num_devices=1, axis_name=None)
     assert not any('warm_sweeps' in str(x.message) for x in rec)
+
+
+def test_warm_start_subspace_matches_cold_eigh(monkeypatch):
+    """With the subspace tracker and unchanged factors, a warm full
+    decomposition must reproduce the cold one exactly-to-noise: the
+    stored basis already diagonalizes the factors, so the perturbative
+    rotation K vanishes and only CholeskyQR2 noise remains."""
+    monkeypatch.setenv('KFAC_EIGH_IMPL', 'subspace')
+    precond, state, grads, acts, gs, metas = _setup(
+        'eigen_dp', warm_start_basis=True)
+    g_cold, s1 = precond.step(state, grads, acts, gs)
+    g_warm, s2 = precond.step(s1, grads, update_factors=False,
+                              update_inverse=True, update_basis=True,
+                              warm_basis=True)
+    for name in metas:
+        np.testing.assert_allclose(np.asarray(g_cold[name]['kernel']),
+                                   np.asarray(g_warm[name]['kernel']),
+                                   rtol=1e-3, atol=1e-4)
+    for k in s1.decomp['evals']:
+        np.testing.assert_allclose(np.asarray(s1.decomp['evals'][k]),
+                                   np.asarray(s2.decomp['evals'][k]),
+                                   rtol=1e-3, atol=1e-4)
