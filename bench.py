@@ -118,22 +118,37 @@ def _time_steps(step, state, batch, iters, warmup=WARMUP, **kw):
 
 
 def _measure_variant(model, tx, batch, variant, fac, kfac_freq, iters,
-                     basis_freq=None, warm_start=False):
-    # the amortized path dispatches a distinct compiled program (the
-    # eigenvalue-refresh variant) first at step kfac_freq — warm past it
-    # so its XLA compile cannot land inside the timed window
-    warmup = WARMUP if basis_freq is None else kfac_freq + 2
-    precond = kfac.KFAC(variant=variant, lr=0.0125, damping=0.002,
-                        fac_update_freq=fac, kfac_update_freq=kfac_freq,
-                        num_devices=1, axis_name=None,
-                        assignment='balanced', basis_update_freq=basis_freq,
-                        warm_start_basis=warm_start)
-    state = training.init_train_state(model, tx, precond,
-                                      jax.random.PRNGKey(0), batch['input'])
-    step = training.build_train_step(model, tx, precond, _ce,
-                                     extra_mutable=('batch_stats',))
-    s, _ = _time_steps(step, state, batch, iters, warmup=warmup,
-                       lr=0.0125, damping=0.002)
+                     basis_freq=None, warm_start=False, eigh_impl=None):
+    # the amortized/warm paths dispatch distinct compiled programs (the
+    # eigenvalue-refresh / warm-full variants) first at step kfac_freq —
+    # warm past it so their XLA compiles cannot land inside the timed
+    # window (with warm_start, the steady state measured IS warm fulls)
+    warmup = (WARMUP if basis_freq is None and not warm_start
+              else kfac_freq + 2)
+    prior_impl = os.environ.get('KFAC_EIGH_IMPL')
+    if eigh_impl is not None:
+        # trace-time knob: set before the step variants are first traced
+        os.environ['KFAC_EIGH_IMPL'] = eigh_impl
+    try:
+        precond = kfac.KFAC(variant=variant, lr=0.0125, damping=0.002,
+                            fac_update_freq=fac, kfac_update_freq=kfac_freq,
+                            num_devices=1, axis_name=None,
+                            assignment='balanced',
+                            basis_update_freq=basis_freq,
+                            warm_start_basis=warm_start)
+        state = training.init_train_state(model, tx, precond,
+                                          jax.random.PRNGKey(0),
+                                          batch['input'])
+        step = training.build_train_step(model, tx, precond, _ce,
+                                         extra_mutable=('batch_stats',))
+        s, _ = _time_steps(step, state, batch, iters, warmup=warmup,
+                           lr=0.0125, damping=0.002)
+    finally:
+        if eigh_impl is not None:
+            if prior_impl is None:
+                os.environ.pop('KFAC_EIGH_IMPL', None)
+            else:
+                os.environ['KFAC_EIGH_IMPL'] = prior_impl
     return s
 
 
@@ -201,7 +216,7 @@ def _run(devices):
     # reference-default eigen_dp at deployed amortization: opt-in — its
     # eigh program is by far the slowest compile and the headline metric
     # doesn't use it (BENCH_FULL=1 to include)
-    eig10_s = eig_amort_s = None
+    eig10_s = eig_amort_s = eig_warm_s = None
     if os.environ.get('BENCH_FULL'):
         eig10_s = _optional(lambda: _measure_variant(
             model, tx, batch, 'eigen_dp', 10, 10, min(ITERS, 10)))
@@ -210,12 +225,20 @@ def _run(devices):
         # contains refreshes only — which IS the steady state at this
         # cadence (fulls are 1 in 10 inverse updates); warm-started fulls
         # never land in a 10-iter window, so warm_start is deliberately
-        # NOT part of this measurement (the kwarg exists for a future
-        # full-in-window config). Combine with KFAC_EIGH_IMPL=jacobi|auto
-        # to switch the eigh kernel of the fulls outside the window.
+        # NOT part of this measurement. Combine with KFAC_EIGH_IMPL to
+        # switch the eigh kernel of the fulls outside the window.
         eig_amort_s = _optional(lambda: _measure_variant(
             model, tx, batch, 'eigen_dp', 10, 10, min(ITERS, 10),
             basis_freq=100))
+        # + warm subspace tracking: every freq-10 inverse update is a
+        # FULL decomposition, but warm — perturbative tracking steps in
+        # the stored basis (ops.subspace_eigh) instead of QDWH. The timed
+        # window contains one warm full, so this measures the real
+        # steady-state of the reference cadence with the MXU-shaped
+        # kernel (the candidate fix for eigen_dp's TPU gap).
+        eig_warm_s = _optional(lambda: _measure_variant(
+            model, tx, batch, 'eigen_dp', 10, 10, min(ITERS, 10),
+            warm_start=True, eigh_impl='subspace'))
 
     flops_iter = _optional(lambda: _model_flops_per_iter(model, batch))
     peak = _peak_flops(devices[0])
@@ -241,7 +264,11 @@ def _run(devices):
                                        if eig10_s is not None else None),
             'eigen_dp_iter_s_freq10_basis100': (
                 round(eig_amort_s, 4) if eig_amort_s is not None else None),
-            # the eigen measurements' semantics depend on the eigh kernel
+            'eigen_dp_iter_s_freq10_warm_subspace': (
+                round(eig_warm_s, 4) if eig_warm_s is not None else None),
+            # kernel for the eig10/basis100 legs (the env knob at their
+            # trace time); the warm_subspace leg always pins 'subspace',
+            # as its key name says
             'eigh_impl': os.environ.get('KFAC_EIGH_IMPL', 'xla'),
             'kfac_overhead_vs_sgd_freq1': round(inv1_s / sgd_s, 3),
             'kfac_overhead_vs_sgd_freq10': (round(inv10_s / sgd_s, 3)
