@@ -62,6 +62,11 @@ run digits_kfac 7200 env data_dir=/tmp/digits_cifar nworkers=1 kfac=1 \
     epochs=100 bash train_cifar10.sh
 run digits_sgd 7200 env data_dir=/tmp/digits_cifar nworkers=1 kfac=0 \
     epochs=100 bash train_cifar10.sh
+#    + the warm-subspace kernel on the same recipe: convergence evidence
+#    for ops.subspace_eigh on real data (vs the stock-XLA kfac leg above)
+run digits_kfac_subspace 7200 env data_dir=/tmp/digits_cifar nworkers=1 \
+    kfac=1 epochs=100 KFAC_EIGH_IMPL=subspace bash train_cifar10.sh \
+    --kfac-warm-start
 
 # 8. retry the XLA blockwise attention path at 32k (was an HTTP 500 from
 #    the remote-compile service — flaky-or-real check)

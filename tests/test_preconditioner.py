@@ -283,14 +283,19 @@ def test_warm_start_long_interval_warns(monkeypatch):
     assert not any('warm_sweeps' in str(x.message) for x in rec)
 
 
-def test_warm_start_subspace_matches_cold_eigh(monkeypatch):
+@pytest.mark.parametrize('variant', ['eigen_dp', 'eigen'])
+def test_warm_start_subspace_matches_cold_eigh(monkeypatch, variant):
     """With the subspace tracker and unchanged factors, a warm full
     decomposition must reproduce the cold one exactly-to-noise: the
     stored basis already diagonalizes the factors, so the perturbative
-    rotation K vanishes and only CholeskyQR2 noise remains."""
+    rotation K vanishes and only CholeskyQR2 noise remains. 'eigen'
+    additionally routes through the comm_inverse gathered layout
+    (local_evecs re-slices the stored rows — at this test's
+    num_devices=1 the slice offset is degenerate; the multi-device mesh
+    path is covered by the training-level warm tracking test)."""
     monkeypatch.setenv('KFAC_EIGH_IMPL', 'subspace')
     precond, state, grads, acts, gs, metas = _setup(
-        'eigen_dp', warm_start_basis=True)
+        variant, warm_start_basis=True)
     g_cold, s1 = precond.step(state, grads, acts, gs)
     g_warm, s2 = precond.step(s1, grads, update_factors=False,
                               update_inverse=True, update_basis=True,
@@ -303,3 +308,4 @@ def test_warm_start_subspace_matches_cold_eigh(monkeypatch):
         np.testing.assert_allclose(np.asarray(s1.decomp['evals'][k]),
                                    np.asarray(s2.decomp['evals'][k]),
                                    rtol=1e-3, atol=1e-4)
+
