@@ -18,7 +18,9 @@ persistent-worker MultiEpochsDataLoader (examples/pytorch_cifar10_resnet.py:
 
 import os
 import pickle
+import queue
 import tarfile
+import threading
 
 import numpy as np
 
@@ -113,6 +115,58 @@ def augment_cifar(rng, x):
     return out
 
 
+def prefetch(gen, depth=2):
+    """Run a batch generator in a background thread, ``depth`` items ahead
+    — host batch assembly (gather + normalize + augmentation) overlaps
+    device execution instead of serializing with it. This is the
+    persistent-worker MultiEpochsDataLoader capability (reference:
+    examples/utils.py:93-121, num_workers>0) delivered the single-process
+    TPU way: one producer thread and a bounded queue, no worker
+    processes to fork or keep alive. Exceptions in the producer re-raise
+    at the consuming site; the yielded sequence is identical to ``gen``.
+    """
+    if depth <= 0:
+        yield from gen
+        return
+    q = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def put(msg):
+        # stop-aware put: an abandoned consumer (early break / generator
+        # close) would otherwise leave this thread blocked in q.put
+        # forever, pinning the queue's batches and the source generator
+        while not stop.is_set():
+            try:
+                q.put(msg, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in gen:
+                if not put(('item', item)):
+                    gen.close()
+                    return
+            put(('end', None))
+        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+            put(('exc', e))
+
+    t = threading.Thread(target=worker, daemon=True, name='kfac-prefetch')
+    t.start()
+    try:
+        while True:
+            kind, payload = q.get()
+            if kind == 'end':
+                break
+            if kind == 'exc':
+                raise payload
+            yield payload
+    finally:
+        stop.set()
+
+
 class Loader:
     """Persistent shuffling batch iterator (drop-last, reshuffle per epoch).
 
@@ -137,15 +191,25 @@ class Loader:
         self.shard_index, self.shard_count = shard
         self.steps_per_epoch = len(x) // (batch_size * self.shard_count)
 
-    def epoch(self):
+    def epoch(self, prefetch_depth=2):
+        """One epoch of batches, assembled ``prefetch_depth`` ahead on a
+        background thread (:func:`prefetch`; 0 = synchronous). The batch
+        sequence is identical at any depth: each epoch draws a child RNG
+        from the persistent stream exactly once up front, so how far the
+        producer has run ahead (or where the consumer abandoned the
+        epoch) cannot perturb later epochs' randomness."""
+        epoch_rng = np.random.RandomState(self.rng.randint(1 << 31))
+        return prefetch(self._epoch_sync(epoch_rng), depth=prefetch_depth)
+
+    def _epoch_sync(self, rng):
         idx = np.arange(len(self.x))
         if self.train:
-            self.rng.shuffle(idx)
+            rng.shuffle(idx)
         per = len(self.x) // self.shard_count
         idx = idx[self.shard_index * per:(self.shard_index + 1) * per]
         for s in range(self.steps_per_epoch):
             sel = idx[s * self.batch_size:(s + 1) * self.batch_size]
             bx = _normalize(self.x[sel])
             if self.train and self.augment is not None:
-                bx = self.augment(self.rng, bx)
+                bx = self.augment(rng, bx)
             yield {'input': bx, 'label': self.y[sel]}

@@ -224,3 +224,44 @@ def test_setup_run_logging_rank0_only_file(tmp_path, monkeypatch):
     _, env_path = setup_run_logging(str(tmp_path), 'run', 'b')
     assert env_path is None
     _logging.basicConfig(force=True)  # restore for later tests
+
+
+def test_loader_prefetch_identical_and_propagates():
+    """Prefetched epochs must yield byte-identical batch sequences to the
+    synchronous path (the producer just runs ahead), and producer
+    exceptions must surface at the consuming site."""
+    x = (np.arange(64 * 8 * 8 * 3) % 255).reshape(64, 8, 8, 3) \
+        .astype(np.uint8)
+    y = np.arange(64) % 10
+
+    a = data.Loader(x, y, 16, train=True, seed=3, shard=(0, 1))
+    b = data.Loader(x, y, 16, train=True, seed=3, shard=(0, 1))
+    for ba, bb in zip(a.epoch(prefetch_depth=0), b.epoch(prefetch_depth=2)):
+        np.testing.assert_array_equal(ba['input'], bb['input'])
+        np.testing.assert_array_equal(ba['label'], bb['label'])
+
+    def boom():
+        yield {'input': 1}
+        raise RuntimeError('producer failed')
+
+    it = data.prefetch(boom(), depth=2)
+    assert next(it) == {'input': 1}
+    with pytest.raises(RuntimeError, match='producer failed'):
+        next(it)
+
+    # abandoning mid-epoch must not perturb later epochs (per-epoch child
+    # RNG) and must release the producer thread (stop-aware puts)
+    import threading as _threading
+    c = data.Loader(x, y, 16, train=True, seed=3, shard=(0, 1))
+    d = data.Loader(x, y, 16, train=True, seed=3, shard=(0, 1))
+    next(c.epoch(prefetch_depth=2))  # abandon after one batch
+    for _ in d.epoch(prefetch_depth=0):
+        pass
+    for bc, bd in zip(c.epoch(prefetch_depth=2), d.epoch(prefetch_depth=0)):
+        np.testing.assert_array_equal(bc['input'], bd['input'])
+    import gc, time as _time
+    gc.collect()  # drop the abandoned generator -> its finally fires
+    _time.sleep(0.5)
+    leaked = [t for t in _threading.enumerate()
+              if t.daemon and 'prefetch' in repr(t.name).lower()]
+    assert not leaked, leaked
