@@ -124,6 +124,12 @@ def prefetch(gen, depth=2):
     TPU way: one producer thread and a bounded queue, no worker
     processes to fork or keep alive. Exceptions in the producer re-raise
     at the consuming site; the yielded sequence is identical to ``gen``.
+
+    Abandoning the iterator releases the producer thread when the
+    generator finalizes (promptly under CPython refcounting). If the
+    iterator may be pinned past its useful life — e.g. a stored
+    exception traceback holding the consuming frame — call ``.close()``
+    on it (or wrap in ``contextlib.closing``) for deterministic release.
     """
     if depth <= 0:
         yield from gen

@@ -1,5 +1,6 @@
 """Batched symmetric linalg + exactness of identity padding."""
 
+import jax
 import numpy as np
 import jax.numpy as jnp
 
@@ -222,3 +223,29 @@ def test_subspace_eigh_constant_diagonal_slot_no_nan():
     qtq = np.swapaxes(np.asarray(q), -1, -2) @ np.asarray(q)
     np.testing.assert_allclose(qtq, np.broadcast_to(np.eye(8), qtq.shape),
                                atol=1e-4)
+
+
+def test_subspace_eigh_chained_tracking_no_accumulation():
+    """50 chained warm fulls over a running-average factor stream (the
+    cold_restart_every window at stat_decay=0.95): the damped-inverse
+    operator error vs exact eigh must stay small THROUGHOUT — tracking
+    error must not accumulate across the chain."""
+    rng = np.random.RandomState(0)
+    n, B, lam = 48, 24, 0.03
+
+    A = np.eye(n, dtype=np.float32)
+    q = jnp.asarray(np.eye(n, dtype=np.float32))
+    track = jax.jit(lambda a, b: ops.subspace_eigh(a, b))
+    errs = []
+    for _ in range(50):
+        a = rng.randn(B, n).astype(np.float32)
+        A = 0.95 * A + 0.05 * (a.T @ a) / B
+        w_ex, q_ex = np.linalg.eigh(A)
+        wj, q = track(jnp.asarray(A), q)
+        w, qn = np.asarray(wj), np.asarray(q)
+        op = qn @ (qn.T / (np.maximum(w, 0) + lam)[:, None])
+        ex = q_ex @ (q_ex.T / (np.maximum(w_ex, 0) + lam)[:, None])
+        errs.append(np.abs(op - ex).max() / np.abs(ex).max())
+    assert max(errs) < 0.06, (max(errs), errs[-5:])
+    # no upward trend: the last 10 no worse than the first 10's envelope
+    assert max(errs[-10:]) < max(errs[:10]) + 0.02, errs
