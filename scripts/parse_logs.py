@@ -19,8 +19,11 @@ import os
 import re
 import sys
 
+# covers all trainer SPEED formats: cifar 'iter time X +- Y s (imgs/sec Z)',
+# imagenet 'iter X +- Y s (Z imgs/s)', longcontext '... (tokens/sec Z)'
 SPEED_RE = re.compile(
-    r'SPEED: iter time ([\d.]+) \+- ([\d.]+) s \(imgs/sec ([\d.]+)\)')
+    r'SPEED: iter(?: time)? ([\d.]+) \+- ([\d.]+) s '
+    r'\((?:imgs/sec ([\d.]+)|([\d.]+) imgs/s|tokens/sec ([\d.]+))\)')
 # One regex per trainer epoch-line format (examples/*.py); each yields
 # (epoch, headline_metric, seconds) with higher_is_better per metric.
 EPOCH_RES = [
@@ -54,7 +57,10 @@ def parse(path):
                 out['args'] = m.group(1)
             m = SPEED_RE.search(line)
             if m:
-                out['speed'] = tuple(float(x) for x in m.groups())
+                g = m.groups()
+                rate = next(x for x in g[2:] if x is not None)
+                unit = 'tok/s' if g[4] is not None else 'imgs/s'
+                out['speed'] = (float(g[0]), float(g[1]), float(rate), unit)
             for rex, name, extract, higher in EPOCH_RES:
                 m = rex.search(line)
                 if m:
@@ -75,8 +81,9 @@ def main():
     for path in args.logs:
         r = parse(path)
         if r['speed']:
-            it, std, ips = r['speed']
-            print(f'{r["file"]}: iter {it:.4f}+-{std:.4f}s  {ips:.1f} imgs/s')
+            it, std, ips, unit = r['speed']
+            print(f'{r["file"]}: iter {it:.4f}+-{std:.4f}s  '
+                  f'{ips:.1f} {unit}')
         if r['epochs']:
             pick = max if r['higher_better'] else min
             best = pick(r['epochs'], key=lambda e: e[1])

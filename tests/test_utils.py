@@ -265,3 +265,29 @@ def test_loader_prefetch_identical_and_propagates():
     leaked = [t for t in _threading.enumerate()
               if t.daemon and 'prefetch' in repr(t.name).lower()]
     assert not leaked, leaked
+
+
+def test_parse_logs_all_speed_formats(tmp_path):
+    """scripts/parse_logs.py must recognize every trainer's SPEED line
+    (cifar 'iter time .. imgs/sec', imagenet 'iter .. imgs/s',
+    longcontext 'iter time .. tokens/sec') and the epoch metric lines."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+    from scripts import parse_logs
+
+    cases = {
+        'cifar.log': ('x SPEED: iter time 0.4489 +- 0.0841 s '
+                      '(imgs/sec 17.8)', (0.4489, 0.0841, 17.8, 'imgs/s')),
+        'imagenet.log': ('x SPEED: iter 0.9580 +- 0.0751 s (8.4 imgs/s)',
+                         (0.958, 0.0751, 8.4, 'imgs/s')),
+        'longctx.log': ('x SPEED: iter time 0.0129 +- 0.0004 s '
+                        '(tokens/sec 39791.8)',
+                        (0.0129, 0.0004, 39791.8, 'tok/s')),
+    }
+    for name, (line, want) in cases.items():
+        p = tmp_path / name
+        p.write_text(line + '\n2026-01-01 epoch 0: train_loss 1.0 '
+                     'val_loss 1.0 val_acc 0.5 (10.0s)\n')
+        r = parse_logs.parse(str(p))
+        assert r['speed'] == want, (name, r['speed'])
+        assert r['epochs'], name
