@@ -55,8 +55,11 @@ def parse_args():
                         'inverse updates refresh eigenvalues in the '
                         'retained basis (0 = always full)')
     p.add_argument('--kfac-warm-start', action='store_true',
-                   help='warm-start full eigendecompositions in the '
-                        'previous eigenbasis (jacobi eigh only)')
+                   help='warm-start decompositions from the stored one: '
+                        'eigen variants track the previous eigenbasis '
+                        '(KFAC_EIGH_IMPL=subspace|auto|jacobi), Cholesky '
+                        'variants Newton-Schulz-iterate the previous '
+                        'inverse')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp')
     p.add_argument('--stat-decay', type=float, default=0.95)

@@ -191,8 +191,35 @@ def local_evecs(plan, decomp, axis_name, comm_mode):
     return out
 
 
+def local_invs(plan, decomp, axis_name, comm_mode):
+    """This device's stored inverse rows (the Newton-Schulz warm seed).
+    Unlike :func:`local_evecs`, never-computed (all-zero) slots stay zero
+    — a zero seed has residual ``||I|| = 1`` and fails the NS acceptance
+    gate, forcing the Cholesky fallback (an identity 'seed' could make
+    NS diverge instead when ``||I - A|| > 1``)."""
+    out = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        x = decomp['invs'][key]
+        if comm_mode == 'inverse':
+            per_dev = plan.buckets[bdim].per_dev
+            idx = coll.axis_index(axis_name)
+            x = lax.dynamic_slice_in_dim(x, idx * per_dev, per_dev, axis=0)
+        out[key] = x
+    return out
+
+
+#: NS acceptance threshold on the returned inverse's residual
+#: ``max |I - A X|`` (measured AFTER the final iteration, i.e. the bound
+#: on the accepted result itself): healthy tracking sits at f32 noise —
+#: a result that still carries >5% residual means the seed was too stale,
+#: and the batched Cholesky recomputes the bucket from scratch.
+NS_ACCEPT_RESID = 0.05
+
+
 def compute_decomposition(plan, factors_local, damping, method, eps,
-                          axis_name, basis_local=None, warm_sweeps=None):
+                          axis_name, basis_local=None, warm_sweeps=None,
+                          invs_prev_local=None):
     """Batched eigh or pi-damped Cholesky inverse of the local factor rows.
 
     eigh parity: eigen.py:98-119 / eigen_dp.py:62-75 (eigenvalue clamp
@@ -207,6 +234,14 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
     sweeps) or 'subspace'/'auto' (perturbative tracking,
     ops.subspace_eigh). ``warm_sweeps`` overrides the warm iteration
     count (None = kernel default).
+
+    invs_prev_local: previous local inverse rows (``local_invs``) to
+    warm-start the Cholesky path by Newton-Schulz iteration
+    (ops.newton_schulz_inverse) — per bucket, the NS result is accepted
+    only when its residual ``max |I - A X|`` clears NS_ACCEPT_RESID
+    (zero/stale seeds fail and fall back to the batched Cholesky inside
+    ``lax.cond``, so the fallback costs nothing when tracking is
+    healthy). ``warm_sweeps`` overrides the NS iteration count.
     """
     if method == 'eigh':
         evals, evecs = {}, {}
@@ -246,7 +281,16 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
         mate_avg = jnp.take(flat_avg, _local_table(b.mate_flat, axis_name))
         damp_vec = jnp.sqrt(damping * own_avg / mate_avg)
         damped = ops.add_scaled_identity(factors_local[key], damp_vec)
-        invs[key] = ops.psd_inverse(damped)
+        if invs_prev_local is None:
+            invs[key] = ops.psd_inverse(damped)
+        else:
+            ns, resid = ops.newton_schulz_inverse(
+                damped, invs_prev_local[key],
+                iters=2 if warm_sweeps is None else max(int(warm_sweeps),
+                                                        1))
+            invs[key] = lax.cond(jnp.max(resid) < NS_ACCEPT_RESID,
+                                 lambda ns=ns: ns,
+                                 lambda d=damped: ops.psd_inverse(d))
     return {'invs': invs}
 
 

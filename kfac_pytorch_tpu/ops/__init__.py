@@ -13,6 +13,7 @@ from kfac_pytorch_tpu.ops.linalg import (
     sym_eig,
     jacobi_eigh,
     subspace_eigh,
+    newton_schulz_inverse,
     clamp_eigvals,
     add_scaled_identity,
     masked_trace,
@@ -23,6 +24,7 @@ __all__ = [
     'extract_patches', 'compute_a_dense', 'compute_a_conv',
     'compute_g_dense', 'compute_g_conv', 'update_running_avg',
     'psd_inverse', 'sym_eig', 'jacobi_eigh', 'subspace_eigh',
+    'newton_schulz_inverse',
     'clamp_eigvals', 'add_scaled_identity',
     'masked_trace', 'identity_pad',
 ]

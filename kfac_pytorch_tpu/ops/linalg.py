@@ -41,6 +41,35 @@ def psd_inverse(x):
         chol, y, left_side=True, lower=True, transpose_a=True)
 
 
+def newton_schulz_inverse(a, x0, iters=2):
+    """Warm matrix inverse by Newton-Schulz iteration (batched):
+    ``X <- X (2I - A X)``, seeded with a previous inverse.
+
+    Between K-FAC inverse updates the damped factor drifts by
+    O(1 - factor_decay), so the stored inverse satisfies
+    ``||I - A X0|| << 1`` and each iteration SQUARES that residual —
+    two iterations reach f32 noise for healthy tracking. Pure batched
+    matmuls (the MXU-shaped warm path for the Cholesky variants, the
+    inverse-side twin of :func:`subspace_eigh`). Symmetry is preserved
+    by the iteration for symmetric ``a``/``x0``; a final symmetrization
+    removes f32 drift.
+
+    Returns ``(x, resid)`` where ``resid[i] = max |I - A_i X_i|`` after
+    the last iteration — the caller gates acceptance on it (NS diverges
+    when the seed is too stale: ``||I - A X0|| > 1``).
+    """
+    mm = functools.partial(jnp.einsum, precision=lax.Precision.HIGHEST)
+    x = x0.astype(a.dtype)
+    for _ in range(iters):
+        ax = mm('...ij,...jk->...ik', a, x)
+        x = 2.0 * x - mm('...ij,...jk->...ik', x, ax)
+    x = 0.5 * (x + jnp.swapaxes(x, -1, -2))
+    eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+    resid = jnp.max(jnp.abs(eye - mm('...ij,...jk->...ik', a, x)),
+                    axis=(-2, -1))
+    return x, resid
+
+
 def sym_eig(x, impl=None, basis=None, sweeps=None):
     """Symmetric eigendecomposition ``(eigvals, eigvecs)`` (batched).
 

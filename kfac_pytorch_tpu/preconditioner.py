@@ -89,21 +89,25 @@ class KFAC:
         retained eigenbasis (E-KFAC-style amortization, two matmuls per
         bucket instead of an eigh). None (default) = every inverse update
         is a full decomposition, the reference cadence.
-      warm_start_basis: eigh variants only (beyond reference) — full
-        decompositions after the first start from the previous
-        eigenbasis. Effective when KFAC_EIGH_IMPL resolves to 'jacobi'
-        (rotate, few Jacobi sweeps, rotate back) or 'subspace'/'auto'
-        (orthogonal-iteration tracking, ops.subspace_eigh — the
-        MXU-shaped warm kernel, chosen by real-chip measurement);
-        composes with basis_update_freq.
+      warm_start_basis: beyond reference — decompositions after the
+        first start from the previous one. Eigh variants: the stored
+        eigenbasis seeds perturbative tracking (ops.subspace_eigh,
+        KFAC_EIGH_IMPL='subspace'/'auto' — the MXU-shaped warm kernel,
+        chosen by real-chip measurement) or rotated Jacobi sweeps
+        ('jacobi'); composes with basis_update_freq. Cholesky variants:
+        the stored inverse seeds Newton-Schulz iteration
+        (ops.newton_schulz_inverse) with a residual-gated Cholesky
+        fallback — pure matmuls on the inverse-update hot path.
       warm_sweeps: iteration count for warm-started full decompositions:
-        Jacobi sweeps (None = the kernel's warm default, 5) or subspace
-        tracking steps (None = 2). Both defaults are
-        calibrated for the stat_decay=0.95 / <=10-step full-interval
-        drift regime — raise for longer intervals between fulls (large
-        basis_update_freq / kfac_update_freq) or faster factor decay:
-        the stored basis rotates further between fulls and the default
-        can under-converge.
+        Jacobi sweeps (None = the kernel's warm default, 5), subspace
+        tracking steps (None = 2), or Newton-Schulz iterations
+        (None = 2). The defaults are calibrated for the
+        stat_decay=0.95 / <=10-step full-interval drift regime — raise
+        for longer intervals between fulls (large basis_update_freq /
+        kfac_update_freq) or faster factor decay: the stored
+        decomposition drifts further between fulls and the default can
+        under-converge (Newton-Schulz self-reports: a stale seed fails
+        the residual gate and falls back to Cholesky).
       cold_restart_every: with warm_start_basis, force a cold (from
         scratch) full decomposition after this many consecutive warm
         ones — the chained basis Q <- Q @ V' accumulates ~1e-7
@@ -149,9 +153,7 @@ class KFAC:
         if basis_update_freq is not None and self.method != 'eigh':
             raise ValueError('basis_update_freq applies to eigh variants')
         self.basis_update_freq = basis_update_freq
-        if warm_start_basis and self.method != 'eigh':
-            raise ValueError('warm_start_basis applies to eigh variants')
-        if warm_start_basis:
+        if warm_start_basis and self.method == 'eigh':
             import os
             import warnings
             if os.environ.get('KFAC_EIGH_IMPL', 'xla') == 'xla':
@@ -354,19 +356,24 @@ class KFAC:
                         self.comm_mode,
                         communicate=not self.exclude_communicate_inverse)
             else:
-                basis_local = None
-                if (self.method == 'eigh' and self.warm_start_basis
-                        and warm_basis):
+                basis_local = invs_prev = None
+                if self.warm_start_basis and warm_basis:
                     # warm_basis is STATIC, set by the trainer only after
                     # a full decomposition exists (a zero basis would
-                    # silently corrupt the rotated problem)
-                    basis_local = engine.local_evecs(
-                        plan, decomp, axis_name, self.comm_mode)
+                    # silently corrupt the rotated eigh problem; a zero
+                    # inverse seed is caught by the NS residual gate)
+                    if self.method == 'eigh':
+                        basis_local = engine.local_evecs(
+                            plan, decomp, axis_name, self.comm_mode)
+                    else:
+                        invs_prev = engine.local_invs(
+                            plan, decomp, axis_name, self.comm_mode)
                 with jax.named_scope('kfac.ComputeInverse'):
                     decomp_local = engine.compute_decomposition(
                         plan, factors, damping, self.method, self.eps,
                         axis_name, basis_local=basis_local,
-                        warm_sweeps=self.warm_sweeps)
+                        warm_sweeps=self.warm_sweeps,
+                        invs_prev_local=invs_prev)
                 if self.comm_mode == 'inverse':
                     with jax.named_scope('kfac.CommunicateInverse'):
                         decomp = engine.gather_decomposition(

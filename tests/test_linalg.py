@@ -249,3 +249,24 @@ def test_subspace_eigh_chained_tracking_no_accumulation():
     assert max(errs) < 0.06, (max(errs), errs[-5:])
     # no upward trend: the last 10 no worse than the first 10's envelope
     assert max(errs[-10:]) < max(errs[:10]) + 0.02, errs
+
+
+def test_newton_schulz_inverse_warm_and_residual():
+    """Seeded with the exact previous inverse under small drift, two NS
+    iterations reach f32 noise; a garbage seed reports a large residual
+    (the engine's fallback gate)."""
+    rng = np.random.RandomState(5)
+    a0 = _spd(rng, 3, 32, 32) / 32
+    x0 = np.linalg.inv(a0)
+    drift = _spd(rng, 3, 32, 32) / 32
+    a1 = (0.95 * a0 + 0.05 * drift).astype(np.float32)
+
+    x, resid = ops.newton_schulz_inverse(jnp.asarray(a1), jnp.asarray(x0))
+    x, resid = np.asarray(x), np.asarray(resid)
+    assert (resid < 1e-2).all(), resid
+    np.testing.assert_allclose(x, np.linalg.inv(a1), rtol=5e-3, atol=1e-4)
+    np.testing.assert_allclose(x, np.swapaxes(x, -1, -2), atol=1e-6)
+
+    _, bad = ops.newton_schulz_inverse(jnp.asarray(a1),
+                                       jnp.zeros_like(jnp.asarray(a1)))
+    assert (np.asarray(bad) >= 1.0 - 1e-6).all()  # ||I|| — gate rejects

@@ -196,12 +196,17 @@ def test_warm_start_basis_matches_cold_eigh(monkeypatch):
 
 
 def test_warm_start_validation(monkeypatch):
-    with pytest.raises(ValueError):
-        _setup('inverse_dp', warm_start_basis=True)
     # opting in while the eigh impl is XLA (which cannot warm-start) warns
     monkeypatch.delenv('KFAC_EIGH_IMPL', raising=False)
     with pytest.warns(UserWarning, match='warm_start_basis'):
         _setup('eigen_dp', warm_start_basis=True)
+    # Cholesky variants warm-start via Newton-Schulz — accepted, no
+    # eigh-impl warning (the env knob is irrelevant to that path)
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter('always')
+        _setup('inverse_dp', warm_start_basis=True)
+    assert not any('warm_start_basis' in str(x.message) for x in rec)
 
 
 def test_basis_update_freq_gating_and_validation():
@@ -309,3 +314,25 @@ def test_warm_start_subspace_matches_cold_eigh(monkeypatch, variant):
                                    np.asarray(s2.decomp['evals'][k]),
                                    rtol=1e-3, atol=1e-4)
 
+
+
+def test_warm_start_newton_schulz_matches_cold_cholesky():
+    """inverse_dp warm step (Newton-Schulz seeded by the stored inverse)
+    must reproduce the cold Cholesky preconditioning on unchanged
+    factors; a fresh (zero-inverse) state under warm_basis=True must
+    fall back to Cholesky via the residual gate and still be exact."""
+    precond, state, grads, acts, gs, metas = _setup(
+        'inverse_dp', warm_start_basis=True)
+    g_cold, s1 = precond.step(state, grads, acts, gs)
+    g_warm, s2 = precond.step(s1, grads, update_factors=False,
+                              update_inverse=True, warm_basis=True)
+    for name in metas:
+        np.testing.assert_allclose(np.asarray(g_cold[name]['kernel']),
+                                   np.asarray(g_warm[name]['kernel']),
+                                   rtol=1e-3, atol=1e-4)
+    # zero-seed fallback: warm requested on the fresh state
+    g_fb, _ = precond.step(state, grads, acts, gs, warm_basis=True)
+    for name in metas:
+        np.testing.assert_allclose(np.asarray(g_fb[name]['kernel']),
+                                   np.asarray(g_cold[name]['kernel']),
+                                   rtol=1e-4, atol=1e-5)
