@@ -48,7 +48,9 @@ def main():
         a = capture.layer_act(acts, meta)
         fn = jax.jit(lambda t, m=meta: ops.extract_patches(
             t, m.kernel_size, m.strides, m.padding))
-        t = timeit(fn, a)
+        # vary inputs per iteration (remote execution caches can
+        # serve identical repeats — scripts/utils.timeit)
+        t = timeit(fn, a, vary=lambda i, a=a: (a + 1e-3 * i,))
         total += t
         print(f'{meta.name:<44} {str(tuple(a.shape)):<24} {t * 1e3:>11.3f}')
     print(f'total per-step patch-extraction time: {total * 1e3:.3f} ms')

@@ -316,13 +316,16 @@ def test_warm_start_subspace_matches_cold_eigh(monkeypatch, variant):
 
 
 
-def test_warm_start_newton_schulz_matches_cold_cholesky():
-    """inverse_dp warm step (Newton-Schulz seeded by the stored inverse)
-    must reproduce the cold Cholesky preconditioning on unchanged
-    factors; a fresh (zero-inverse) state under warm_basis=True must
-    fall back to Cholesky via the residual gate and still be exact."""
+@pytest.mark.parametrize('variant', ['inverse_dp', 'inverse'])
+def test_warm_start_newton_schulz_matches_cold_cholesky(variant):
+    """Cholesky-variant warm step (Newton-Schulz seeded by the stored
+    inverse) must reproduce the cold Cholesky preconditioning on
+    unchanged factors — 'inverse' additionally routes local_invs through
+    the comm_pred owner layout; a fresh (zero-inverse) state under
+    warm_basis=True must fall back to Cholesky via the residual gate and
+    still be exact."""
     precond, state, grads, acts, gs, metas = _setup(
-        'inverse_dp', warm_start_basis=True)
+        variant, warm_start_basis=True)
     g_cold, s1 = precond.step(state, grads, acts, gs)
     g_warm, s2 = precond.step(s1, grads, update_factors=False,
                               update_inverse=True, warm_basis=True)
