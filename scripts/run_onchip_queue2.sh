@@ -5,12 +5,15 @@
 # the fixed harness (host-fetch fence + per-iteration input jitter).
 # Sequential, timeout-wrapped, logs under logs/onchip/.
 #
+# ORDERED BY ROUND VALUE (the tunnel has been down for hours and may not
+# stay up): the official bench artifacts first — they also prime the
+# compile cache for the driver's end-of-round bench.py run — then the
+# decision A/Bs, then convergence legs.
+#
 # Dropped from the original plan: BENCH_FULL KFAC_EIGH_IMPL=jacobi legs —
 # the real-fenced probe shows batched Jacobi loses to XLA QDWH per matrix
-# at 512 (>=1.6x) and catastrophically at 1024 (~79 s/call), so running a
-# full ResNet-50 eigen_dp bench through it would burn hours measuring a
-# known loser. The 'paired' rotation form gets one cheap bench_ops probe
-# instead (gather-free — the one variant that might map to the MXU).
+# at 512 (>=1.6x) and collapses (~79 s/call) at 1024. The 'paired'
+# rotation form gets one cheap bench_ops probe instead.
 #
 # Usage: nohup bash scripts/run_onchip_queue2.sh &
 
@@ -33,24 +36,25 @@ run() {  # run <tag> <timeout_s> <cmd...>
 run probe 120 python -c "import jax; print(jax.devices())" || {
   echo "tunnel down — aborting queue2" | tee -a "$L.summary"; exit 1; }
 
-# 1. real-fenced op A/B: XLA eigh vs chol_inv vs (<=1024) jacobi, three
-#    matmul precisions — decides KFAC_EIGH_IMPL auto + eigh precision
+# 1. headline bench with the real fence — the official-number candidate
+#    (includes the warm Newton-Schulz freq-1 measurement)
+run bench_headline 5400 python bench.py
+
+# 2. full bench: + eigen_dp stock / basis-amortized / warm-subspace legs
+run bench_full 7200 env BENCH_FULL=1 python bench.py
+
+# 3. real-fenced op A/B: XLA eigh vs chol_inv vs (<=1024) jacobi, three
+#    matmul precisions — decides the eigh precision default
 run bench_ops 5400 python scripts/bench_ops.py
 
-# 2. the gather-free paired-rotation jacobi: keep or delete the knob
-run bench_ops_paired 3600 env KFAC_JACOBI_ROT=paired \
-    python scripts/bench_ops.py --dims 512 1024
-
-# 3. flash A/B re-run under the fixed harness (confirm the auto-bwd
+# 4. flash A/B re-run under the fixed harness (confirm the auto-bwd
 #    crossover measured with the old fence)
 run flash_ab 3600 python scripts/bench_flash.py \
     --seq-lens 8192 32768 --bwd-impls pallas recompute
 
-# 4. headline bench with the real fence — the official-number candidate
-run bench_headline 5400 python bench.py
-
-# 5. full bench: + eigen_dp stock and basis-amortized legs (XLA eigh)
-run bench_full 7200 env BENCH_FULL=1 python bench.py
+# 5. the gather-free paired-rotation jacobi: keep or delete the knob
+run bench_ops_paired 3600 env KFAC_JACOBI_ROT=paired \
+    python scripts/bench_ops.py --dims 512 1024
 
 # 6. per-phase breakdown on the flagship config (5 extra programs)
 run bench_breakdown 7200 env BENCH_BREAKDOWN=1 python bench.py
