@@ -168,24 +168,33 @@ def _local_table(arr, axis_name):
     return jnp.take(jnp.asarray(arr), coll.axis_index(axis_name), axis=0)
 
 
-def local_evecs(plan, decomp, axis_name, comm_mode):
-    """This device's eigenbasis rows from a stored decomposition (local
-    already in 'pred' mode; sliced out of the gathered/replicated layout
-    in 'inverse' mode).
-
-    Never-decomposed (all-zero) rows come back as the identity, so a warm
-    request against a fresh state degrades to a cold Jacobi instead of
-    rotating into a zero 'basis' and corrupting the decomposition — a
-    guard for direct ``KFAC.step(warm_basis=True)`` callers that bypass
-    the trainer-side seen-inverse gate."""
+def _local_rows(plan, tree, axis_name, comm_mode):
+    """Per-bucket: this device's rows of a stored decomposition component
+    (local already in 'pred' mode; sliced out of the gathered/replicated
+    layout in 'inverse' mode)."""
     out = {}
     for bdim in plan.bucket_dims:
         key = _key(bdim)
-        q = decomp['evecs'][key]
+        x = tree[key]
         if comm_mode == 'inverse':
             per_dev = plan.buckets[bdim].per_dev
             idx = coll.axis_index(axis_name)
-            q = lax.dynamic_slice_in_dim(q, idx * per_dev, per_dev, axis=0)
+            x = lax.dynamic_slice_in_dim(x, idx * per_dev, per_dev, axis=0)
+        out[key] = x
+    return out
+
+
+def local_evecs(plan, decomp, axis_name, comm_mode):
+    """This device's eigenbasis rows from a stored decomposition.
+
+    Never-decomposed (all-zero) rows come back as the identity, so a warm
+    request against a fresh state degrades to a cold decomposition
+    instead of rotating into a zero 'basis' and corrupting it — a guard
+    for direct ``KFAC.step(warm_basis=True)`` callers that bypass the
+    trainer-side seen-inverse gate."""
+    out = {}
+    for key, q in _local_rows(plan, decomp['evecs'], axis_name,
+                              comm_mode).items():
         valid = jnp.any(q != 0, axis=(-2, -1), keepdims=True)
         out[key] = jnp.where(valid, q, jnp.eye(q.shape[-1], dtype=q.dtype))
     return out
@@ -197,16 +206,7 @@ def local_invs(plan, decomp, axis_name, comm_mode):
     — a zero seed has residual ``||I|| = 1`` and fails the NS acceptance
     gate, forcing the Cholesky fallback (an identity 'seed' could make
     NS diverge instead when ``||I - A|| > 1``)."""
-    out = {}
-    for bdim in plan.bucket_dims:
-        key = _key(bdim)
-        x = decomp['invs'][key]
-        if comm_mode == 'inverse':
-            per_dev = plan.buckets[bdim].per_dev
-            idx = coll.axis_index(axis_name)
-            x = lax.dynamic_slice_in_dim(x, idx * per_dev, per_dev, axis=0)
-        out[key] = x
-    return out
+    return _local_rows(plan, decomp['invs'], axis_name, comm_mode)
 
 
 #: NS acceptance threshold on the returned inverse's residual

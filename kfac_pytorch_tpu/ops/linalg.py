@@ -41,6 +41,11 @@ def psd_inverse(x):
         chol, y, left_side=True, lower=True, transpose_a=True)
 
 
+#: batched matmul at HIGHEST internal precision — the warm-path kernels
+#: (Newton-Schulz, subspace tracking) are accuracy-sensitive contractions
+_mm = functools.partial(jnp.einsum, precision=lax.Precision.HIGHEST)
+
+
 def newton_schulz_inverse(a, x0, iters=2):
     """Warm matrix inverse by Newton-Schulz iteration (batched):
     ``X <- X (2I - A X)``, seeded with a previous inverse.
@@ -58,14 +63,13 @@ def newton_schulz_inverse(a, x0, iters=2):
     the last iteration — the caller gates acceptance on it (NS diverges
     when the seed is too stale: ``||I - A X0|| > 1``).
     """
-    mm = functools.partial(jnp.einsum, precision=lax.Precision.HIGHEST)
     x = x0.astype(a.dtype)
     for _ in range(iters):
-        ax = mm('...ij,...jk->...ik', a, x)
-        x = 2.0 * x - mm('...ij,...jk->...ik', x, ax)
+        ax = _mm('...ij,...jk->...ik', a, x)
+        x = 2.0 * x - _mm('...ij,...jk->...ik', x, ax)
     x = 0.5 * (x + jnp.swapaxes(x, -1, -2))
     eye = jnp.eye(a.shape[-1], dtype=a.dtype)
-    resid = jnp.max(jnp.abs(eye - mm('...ij,...jk->...ik', a, x)),
+    resid = jnp.max(jnp.abs(eye - _mm('...ij,...jk->...ik', a, x)),
                     axis=(-2, -1))
     return x, resid
 
@@ -152,13 +156,10 @@ def subspace_eigh(x, basis, steps=None, tau=0.01, clip=0.5):
     Returns unsorted ``(eigvals, eigvecs)`` like :func:`jacobi_eigh`.
     """
     steps = 2 if steps is None else max(int(steps), 1)
-    n = x.shape[-1]
-    eye = jnp.eye(n, dtype=x.dtype)
     q = basis.astype(x.dtype)
-    mm = functools.partial(jnp.einsum, precision=lax.Precision.HIGHEST)
     for _ in range(steps):
-        xq = mm('...ij,...jk->...ik', x, q)
-        b = mm('...ji,...jk->...ik', q, xq)
+        xq = _mm('...ij,...jk->...ik', x, q)
+        b = _mm('...ji,...jk->...ik', q, xq)
         d = jnp.diagonal(b, axis1=-2, axis2=-1)
         # floor the spread at eps-relative scale: a constant-diagonal slot
         # (e.g. an all-padding identity block) has spread 0, and a tiny
@@ -169,12 +170,13 @@ def subspace_eigh(x, basis, steps=None, tau=0.01, clip=0.5):
         spread = jnp.maximum(jnp.max(d, axis=-1) - jnp.min(d, axis=-1),
                              eps_floor)[..., None, None]
         denom = d[..., None, :] - d[..., :, None]        # d_j - d_i
+        # reg's diagonal is exactly zero (denom there is 0), so k needs
+        # no separate diagonal masking
         reg = denom / (denom * denom + (tau * spread) ** 2)
-        k = jnp.clip((b - d[..., :, None] * eye) * reg, -clip, clip)
-        k = k * (1 - eye)                                # zero diagonal
-        q = _chol_qr(q + mm('...ij,...jk->...ik', q, k))
+        k = jnp.clip(b * reg, -clip, clip)
+        q = _chol_qr(q + _mm('...ij,...jk->...ik', q, k))
         q = _chol_qr(q)                                  # CholeskyQR2
-    xq = mm('...ij,...jk->...ik', x, q)
+    xq = _mm('...ij,...jk->...ik', x, q)
     w = jnp.sum(q * xq, axis=-2)
     return w, q
 
