@@ -186,12 +186,10 @@ def main():
         from kfac_pytorch_tpu.utils import profiling
         batch = next(train_loader.epoch())
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
-        mean, std, state = profiling.time_steps(
-            step, state, batch, iters=SPEED_ITERS, warmup=5,
-            kw_fn=lambda i: dict(lr=lr_fn(i)),
+        profiling.speed_report(
+            log, step, state, batch, len(batch['label']), unit='imgs/sec',
+            iters=SPEED_ITERS, kw_fn=lambda i: dict(lr=lr_fn(i)),
             damping=precond.damping if precond else 0.0)
-        log.info('SPEED: iter time %.4f +- %.4f s (imgs/sec %.1f)',
-                 mean, std, args.batch_size / mean)
         return
 
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer

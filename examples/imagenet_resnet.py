@@ -197,12 +197,10 @@ def main():
         batch = next(train_loader.epoch())
         batch = {'input': jnp.asarray(batch['input'], dtype),
                  'label': jnp.asarray(batch['label'])}
-        mean, std, state = profiling.time_steps(
-            step, state, batch, iters=60, warmup=5,
+        profiling.speed_report(
+            log, step, state, batch, len(batch['label']), unit='imgs/sec',
             kw_fn=lambda i: dict(lr=lr_fn(i)),
             damping=precond.damping if precond else 0.0)
-        log.info('SPEED: iter %.4f +- %.4f s (%.1f imgs/s)',
-                 mean, std, args.batch_size / mean)
         return
 
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer

@@ -263,6 +263,19 @@ def main():
             m.update(metrics['loss'])
         return state, m.avg
 
+    if args.speed:
+        # SPEED mode: steady-state iteration time, no eval (reference
+        # transformer trainer's speed measurement convention). `sample`
+        # is the already-built batch prefix — its REAL row count feeds
+        # the tokens/sec (a small dataset silently truncates the batch).
+        from kfac_pytorch_tpu.utils import profiling
+        batch = {'input': sample, 'label': sample[1]}
+        profiling.speed_report(
+            log, step, state, batch,
+            sample[0].shape[0] * args.max_len, lr=args.base_lr,
+            damping=args.damping if precond else 0.0)
+        return
+
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
     for epoch in range(args.epochs):

@@ -64,6 +64,7 @@ def parse_args():
     p.add_argument('--num-devices', type=int, default=1)
     p.add_argument('--seed', type=int, default=42)
     p.add_argument('--synthetic-size', type=int, default=1024)
+    p.add_argument('--speed', action='store_true')
     p.add_argument('--log-dir', default='./logs',
                    help='per-run log files land here')
     p.add_argument('--tb-dir', default=None,
@@ -198,6 +199,17 @@ def main():
         return jnp.argmax(s, -1), jnp.argmax(e, -1)
 
     rs = np.random.RandomState(args.seed)
+    if args.speed:
+        from kfac_pytorch_tpu.utils import profiling
+        n = min(args.batch_size, len(ids))  # real rows, not requested
+        batch = {'input': (jnp.asarray(ids[:n]), jnp.asarray(types[:n]),
+                           jnp.asarray(mask[:n])),
+                 'label': jnp.asarray(np.stack([starts[:n], ends[:n]], 1))}
+        profiling.speed_report(
+            log, step, state, batch, n * ids.shape[1], lr=args.base_lr,
+            damping=args.damping if precond else 0.0)
+        return
+
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
     for epoch in range(args.epochs):

@@ -58,6 +58,7 @@ def parse_args():
     p.add_argument('--seed', type=int, default=42)
     p.add_argument('--synthetic-vocab', type=int, default=256)
     p.add_argument('--synthetic-tokens', type=int, default=100000)
+    p.add_argument('--speed', action='store_true')
     p.add_argument('--log-dir', default='./logs',
                    help='per-run log files land here')
     p.add_argument('--tb-dir', default=None,
@@ -140,9 +141,21 @@ def main():
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
 
+    n_steps = (train_data.shape[1] - 1) // args.bptt
+    if args.speed:
+        from kfac_pytorch_tpu.utils import profiling
+        # clamp to the data actually available (the training path would
+        # just run zero steps; a speed batch must still be well-formed)
+        bptt = min(args.bptt, train_data.shape[1] - 1)
+        batch = {'input': jnp.asarray(train_data[:, :bptt]),
+                 'label': jnp.asarray(train_data[:, 1:bptt + 1])}
+        profiling.speed_report(
+            log, step, state, batch, train_data.shape[0] * bptt,
+            lr=args.base_lr, damping=args.damping)
+        return
+
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
-    n_steps = (train_data.shape[1] - 1) // args.bptt
     for epoch in range(args.epochs):
         t0 = time.time()
         m = utils.Metric('loss')

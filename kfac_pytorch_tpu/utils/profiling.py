@@ -91,6 +91,21 @@ def time_steps(step_fn, state, batch, iters=30, warmup=5, kw_fn=None, **kw):
     return float(np.mean(times)), float(np.std(times)), state
 
 
+def speed_report(log, step_fn, state, batch, units_per_iter,
+                 unit='tokens/sec', iters=60, warmup=5, kw_fn=None, **kw):
+    """The SPEED-mode measurement + log line shared by the example
+    trainers: steady-state iteration time via :func:`time_steps`, one
+    canonical format (scripts/parse_logs.py parses it). Pass the REAL
+    per-iteration work in ``units_per_iter`` (e.g. actual batch rows x
+    sequence length — not the requested batch size, which a small
+    dataset may silently truncate). Returns the advanced state."""
+    mean, std, state = time_steps(step_fn, state, batch, iters=iters,
+                                  warmup=warmup, kw_fn=kw_fn, **kw)
+    log.info('SPEED: iter time %.4f +- %.4f s (%s %.1f)',
+             mean, std, unit, units_per_iter / mean)
+    return state
+
+
 def exclude_parts_breakdown(make_step, batch, iters=20, **kw):
     """Attribute per-phase cost by ablation subtraction.
 
