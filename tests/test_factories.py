@@ -64,3 +64,29 @@ def test_exclude_parts_breakdown_shape():
     out = profiling.exclude_parts_breakdown(make_step, None, iters=2)
     assert set(out) == {'Total', 'Rest'} | set(profiling.PHASES)
     assert all(v >= 0 for v in out.values())
+
+
+def test_speed_report_logs_real_units(caplog):
+    """speed_report must emit the canonical parseable SPEED line with the
+    caller-supplied per-iteration unit count."""
+    import logging
+
+    calls = {'n': 0}
+
+    def fake_step(state, batch, **kw):
+        calls['n'] += 1
+        return state, {'loss': jnp.float32(1.0)}
+
+    log = logging.getLogger('speed-test')
+    with caplog.at_level(logging.INFO, logger='speed-test'):
+        profiling.speed_report(log, fake_step, 0, None, 256,
+                               unit='imgs/sec', iters=3, warmup=1)
+    assert calls['n'] == 4
+    msg = caplog.records[-1].getMessage()
+    assert msg.startswith('SPEED: iter time ') and 'imgs/sec' in msg
+    # the canonical format round-trips through the log parser
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__), '..'))
+    from scripts.parse_logs import SPEED_RE
+    assert SPEED_RE.search('x ' + msg)
