@@ -59,6 +59,10 @@ BATCH = int(os.environ.get('BENCH_BATCH', 32))
 IMG = int(os.environ.get('BENCH_IMG', 224))
 MODEL = os.environ.get('BENCH_MODEL', 'resnet50')
 ITERS = int(os.environ.get('BENCH_ITERS', 20))
+# optional legs start only while under this budget (seconds, counted
+# from the end of the headline legs) — parsed here so a malformed value
+# fails fast, before any chip work
+TIME_BUDGET_S = float(os.environ.get('BENCH_TIME_BUDGET', 2400))
 WARMUP = 3
 BASELINE_KFAC_ITER_S = 0.487  # scripts/time_breakdown.py:26 (1 GPU, bs 32)
 METRIC = 'resnet50_imagenet_dpkfac_imgs_per_sec_per_chip'
@@ -200,11 +204,22 @@ def _run(devices):
     # breakdown setting) and at the deployed freq-10 amortization
     inv1_s = _measure_variant(model, tx, batch, 'inverse_dp', 1, 1, ITERS)
 
+    # once the headline legs are in hand, the optional legs must not
+    # push the process into an outer timeout (a killed process emits NO
+    # JSON and zeroes the round): each remaining leg starts only while
+    # under the budget — on a cold compile cache the fresh programs cost
+    # many minutes each through the remote-compile service
+    t_start = time.perf_counter()
+
     def _optional(fn):
         # secondary measurements must not kill the headline result if the
         # chip tunnel hiccups mid-compile; the traceback goes to stderr
         # (stdout stays one clean JSON line) so a real bug in the measured
         # path is still diagnosable from a null field
+        if time.perf_counter() - t_start > TIME_BUDGET_S:
+            print('BENCH_TIME_BUDGET exceeded — skipping remaining '
+                  'optional leg', file=sys.stderr, flush=True)
+            return None
         try:
             return fn()
         except Exception:
