@@ -32,14 +32,37 @@ from jax import lax
 _NEG_INF = -1e30
 
 
+#: 'auto' forward crossover: measured on a real v5e chip (2026-07-31,
+#: B=1 H=8 D=64 causal fwd+bwd, logs/onchip/queue_0731_0346.summary) the
+#: XLA blockwise path wins below this key length (8k: 43.5 ms vs 59.4;
+#: 16k: 103.6 vs 180.9) while at 32k the Pallas kernel is the only path
+#: that compiles at all (XLA: remote-compile failure; Pallas: 657 ms).
+#: Lk is a static shape, so the choice is made at trace time — the same
+#: policy shape as ops.pallas_attention.AUTO_BWD_PALLAS_MIN_LK.
+AUTO_FWD_PALLAS_MIN_LK = 32768
+
+
 def _default_block_impl():
-    """'pallas' on TPU, 'xla' elsewhere (interpret mode is for tests).
-    KFAC_ATTN_IMPL overrides ('xla' | 'pallas' | 'pallas_interpret')."""
+    """'auto' on TPU (length-gated XLA/Pallas, see :func:`_fwd_impl_for`),
+    'xla' elsewhere (interpret mode is for tests). KFAC_ATTN_IMPL
+    overrides ('auto' | 'xla' | 'pallas' | 'pallas_interpret')."""
     import os
     env = os.environ.get('KFAC_ATTN_IMPL')
     if env:
         return env
-    return 'pallas' if jax.default_backend() == 'tpu' else 'xla'
+    return 'auto' if jax.default_backend() == 'tpu' else 'xla'
+
+
+def _fwd_impl_for(impl, lk):
+    """Resolve the forward block implementation; 'auto' picks by the
+    (static) key length of this block — XLA blockwise below the measured
+    v5e crossover, the Pallas flash kernel at/above it."""
+    if impl not in ('auto', 'xla', 'pallas', 'pallas_interpret'):
+        raise ValueError(f'KFAC_ATTN_IMPL={impl!r}: expected '
+                         "'auto', 'xla', 'pallas' or 'pallas_interpret'")
+    if impl == 'auto':
+        return 'pallas' if lk >= AUTO_FWD_PALLAS_MIN_LK else 'xla'
+    return impl
 
 
 def interpreted_attention_active():
@@ -60,9 +83,11 @@ def _block_attn_dispatch(q, k, v, q_start, k_start, causal, kv_mask,
 
     'xla': plain jnp ops (materializes the [Lq, Lk] block scores and lets
     XLA fuse); 'pallas'/'pallas_interpret': the fused flash kernel
-    (ops/pallas_attention.py), which never materializes scores in HBM.
+    (ops/pallas_attention.py), which never materializes scores in HBM;
+    'auto': length-gated choice between them (:func:`_fwd_impl_for`).
     Both return identical (m, l, pv).
     """
+    block_impl = _fwd_impl_for(block_impl, k.shape[2])
     if block_impl == 'xla':
         bias = _bias_for_block(q_start, k_start, q.shape[2], k.shape[2],
                                causal, kv_mask)

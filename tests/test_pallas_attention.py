@@ -210,6 +210,31 @@ def test_bwd_impl_auto_policy():
         _bwd_impl_for('fused', 1024)
 
 
+def test_fwd_impl_auto_policy(monkeypatch):
+    """'auto' forward resolves by static block key length, mirroring the
+    backward policy: XLA blockwise below the measured v5e crossover
+    (fwd+bwd 8k: XLA 43.5 ms vs Pallas 59.4; 16k: 103.6 vs 180.9), the
+    Pallas kernel at/above it (32k: only Pallas compiles,
+    logs/onchip/queue_0731_0346.summary) — VERDICT r2 #3."""
+    from kfac_pytorch_tpu.parallel.ring_attention import (
+        AUTO_FWD_PALLAS_MIN_LK, _default_block_impl, _fwd_impl_for)
+    assert _fwd_impl_for('auto', 1024) == 'xla'
+    assert _fwd_impl_for('auto', AUTO_FWD_PALLAS_MIN_LK - 128) == 'xla'
+    assert _fwd_impl_for('auto', AUTO_FWD_PALLAS_MIN_LK) == 'pallas'
+    assert _fwd_impl_for('auto', 2 * AUTO_FWD_PALLAS_MIN_LK) == 'pallas'
+    # explicit choices pass through untouched; junk is rejected
+    assert _fwd_impl_for('xla', 1 << 20) == 'xla'
+    assert _fwd_impl_for('pallas', 8) == 'pallas'
+    assert _fwd_impl_for('pallas_interpret', 8) == 'pallas_interpret'
+    with pytest.raises(ValueError):
+        _fwd_impl_for('fused', 1024)
+    # off-TPU default stays 'xla' (tests run on the CPU mesh); cleared
+    # env so a KFAC_ATTN_IMPL override in the test environment can't
+    # perturb the default-path assertion
+    monkeypatch.delenv('KFAC_ATTN_IMPL', raising=False)
+    assert _default_block_impl() in ('xla', 'auto')
+
+
 def test_ring_with_pallas_blocks_matches_dense():
     devs = jax.devices()[:8]
     mesh = Mesh(np.array(devs), ('seq',))
