@@ -115,6 +115,37 @@ def augment_cifar(rng, x):
     return out
 
 
+class PrefetchIterator:
+    """Iterator over prefetched batches with DETERMINISTIC release.
+
+    Wraps the prefetch generator so call sites don't have to rely on
+    CPython refcounting to finalize it: ``close()`` (idempotent) stops
+    the producer thread immediately, and the object is its own context
+    manager (``with loader.epoch() as it: ...``). Without an explicit
+    close, a pinned iterator (stored traceback, reference cycle,
+    non-refcounted runtime) would leave the daemon producer spinning on
+    put timeouts, holding up to ``depth`` batches in memory.
+    """
+
+    def __init__(self, gen):
+        self._gen = gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self):
+        self._gen.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def prefetch(gen, depth=2):
     """Run a batch generator in a background thread, ``depth`` items ahead
     — host batch assembly (gather + normalize + augmentation) overlaps
@@ -125,12 +156,14 @@ def prefetch(gen, depth=2):
     processes to fork or keep alive. Exceptions in the producer re-raise
     at the consuming site; the yielded sequence is identical to ``gen``.
 
-    Abandoning the iterator releases the producer thread when the
-    generator finalizes (promptly under CPython refcounting). If the
-    iterator may be pinned past its useful life — e.g. a stored
-    exception traceback holding the consuming frame — call ``.close()``
-    on it (or wrap in ``contextlib.closing``) for deterministic release.
-    """
+    Returns a :class:`PrefetchIterator`: abandoning it releases the
+    producer thread when the wrapped generator finalizes (promptly under
+    CPython refcounting), and ``close()`` / ``with`` releases it
+    deterministically."""
+    return PrefetchIterator(_prefetch_gen(gen, depth))
+
+
+def _prefetch_gen(gen, depth):
     if depth <= 0:
         yield from gen
         return

@@ -212,8 +212,9 @@ def local_invs(plan, decomp, axis_name, comm_mode):
 #: NS acceptance threshold on the returned inverse's residual
 #: ``max |I - A X|`` (measured AFTER the final iteration, i.e. the bound
 #: on the accepted result itself): healthy tracking sits at f32 noise —
-#: a result that still carries >5% residual means the seed was too stale,
-#: and the batched Cholesky recomputes the bucket from scratch.
+#: a slot that still carries >5% residual means its seed was too stale,
+#: and the batched Cholesky recomputes THAT slot from scratch (per-slot
+#: gate; healthy bucket-mates keep their NS result).
 NS_ACCEPT_RESID = 0.05
 
 
@@ -284,13 +285,11 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
         if invs_prev_local is None:
             invs[key] = ops.psd_inverse(damped)
         else:
-            ns, resid = ops.newton_schulz_inverse(
+            invs[key] = ops.warm_inverse(
                 damped, invs_prev_local[key],
                 iters=2 if warm_sweeps is None else max(int(warm_sweeps),
-                                                        1))
-            invs[key] = lax.cond(jnp.max(resid) < NS_ACCEPT_RESID,
-                                 lambda ns=ns: ns,
-                                 lambda d=damped: ops.psd_inverse(d))
+                                                        1),
+                accept_resid=NS_ACCEPT_RESID)
     return {'invs': invs}
 
 

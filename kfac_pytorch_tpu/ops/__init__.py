@@ -14,6 +14,7 @@ from kfac_pytorch_tpu.ops.linalg import (
     jacobi_eigh,
     subspace_eigh,
     newton_schulz_inverse,
+    warm_inverse,
     clamp_eigvals,
     add_scaled_identity,
     masked_trace,
@@ -24,7 +25,7 @@ __all__ = [
     'extract_patches', 'compute_a_dense', 'compute_a_conv',
     'compute_g_dense', 'compute_g_conv', 'update_running_avg',
     'psd_inverse', 'sym_eig', 'jacobi_eigh', 'subspace_eigh',
-    'newton_schulz_inverse',
+    'newton_schulz_inverse', 'warm_inverse',
     'clamp_eigvals', 'add_scaled_identity',
     'masked_trace', 'identity_pad',
 ]

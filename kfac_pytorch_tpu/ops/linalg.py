@@ -74,6 +74,27 @@ def newton_schulz_inverse(a, x0, iters=2):
     return x, resid
 
 
+def warm_inverse(damped, seed, iters=2, accept_resid=0.05):
+    """Newton-Schulz warm inverse with a PER-SLOT acceptance gate.
+
+    Runs :func:`newton_schulz_inverse` seeded by ``seed`` and accepts
+    each batch slot independently: slots whose final residual
+    ``max |I - A X|`` clears ``accept_resid`` keep the NS result; the
+    rest are recomputed by the batched Cholesky :func:`psd_inverse` and
+    spliced in (one stale/zero-seeded slot must not drag its healthy
+    bucket-mates back to cold Cholesky). The all-healthy fast path is
+    guarded by an outer ``lax.cond`` so the Cholesky program only ever
+    executes when some slot actually failed.
+    """
+    ns, resid = newton_schulz_inverse(damped, seed, iters=iters)
+    slot_ok = resid < accept_resid
+    return lax.cond(
+        jnp.all(slot_ok),
+        lambda ns=ns: ns,
+        lambda ns=ns, ok=slot_ok, d=damped: jnp.where(
+            ok[..., None, None], ns, psd_inverse(d)))
+
+
 def sym_eig(x, impl=None, basis=None, sweeps=None):
     """Symmetric eigendecomposition ``(eigvals, eigvecs)`` (batched).
 

@@ -44,15 +44,30 @@ def host_fence(out):
     TPU platform (measured 2026-07-31, scripts/check_eigh_onchip.py: a
     multi-second eigh 'blocked' in 0.15 ms while a forced transfer took
     the full compute time). A host transfer cannot complete before the
-    producing computation has run, and a single TPU core executes
-    programs in submission order, so fetching from the LAST dispatched
-    program's output fences all of them. Only a scalar-sized slice
-    travels, keeping wire time out of the measurement."""
+    producing computation has run, and a TPU core executes programs in
+    submission order, so fetching from the LAST dispatched program's
+    output fences all of them. Only scalar-sized slices travel, keeping
+    wire time out of the measurement.
+
+    On a multi-device mesh the fetch covers EVERY addressable shard of
+    the last leaf — fencing one device would let peer devices'
+    post-collective epilogue still be in flight (and ``np.asarray`` of a
+    non-fully-replicated sharded array would raise rather than fence).
+    Multi-host scope: each process fences its OWN addressable devices;
+    remote hosts' devices are fenced by their own process's call."""
     leaves = [x for x in jax.tree.leaves(out) if hasattr(x, 'shape')]
     if not leaves:
         return jax.block_until_ready(out)
     x = leaves[-1]
-    np.asarray(x[(slice(0, 1),) * getattr(x, 'ndim', 0)])
+    shards = getattr(x, 'addressable_shards', None)
+    if shards is not None:
+        # an EMPTY list (multi-host leaf with no local shard) correctly
+        # fences nothing — this process has no device work to wait on
+        for s in shards:
+            d = s.data
+            np.asarray(d[(slice(0, 1),) * getattr(d, 'ndim', 0)])
+    else:
+        np.asarray(x[(slice(0, 1),) * getattr(x, 'ndim', 0)])
 
 
 def fence_rtt(out, samples=3):

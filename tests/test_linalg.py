@@ -270,3 +270,34 @@ def test_newton_schulz_inverse_warm_and_residual():
     _, bad = ops.newton_schulz_inverse(jnp.asarray(a1),
                                        jnp.zeros_like(jnp.asarray(a1)))
     assert (np.asarray(bad) >= 1.0 - 1e-6).all()  # ||I|| — gate rejects
+
+
+def test_warm_inverse_per_slot_gate():
+    """ADVICE r2: the NS acceptance gate is per-slot — a zero-seeded slot
+    falls back to the exact Cholesky inverse while its healthy
+    bucket-mates keep the NS result (no bucket-wide cold restart)."""
+    rng = np.random.RandomState(7)
+    a0 = _spd(rng, 3, 32, 32) / 32
+    drift = _spd(rng, 3, 32, 32) / 32
+    a1 = (0.97 * a0 + 0.03 * drift).astype(np.float32)
+    seed = np.linalg.inv(a0).astype(np.float32)
+    seed[1] = 0.0  # slot 1: stale-to-death seed; 0 and 2 healthy
+
+    out = np.asarray(ops.warm_inverse(jnp.asarray(a1), jnp.asarray(seed)))
+    ns, resid = ops.newton_schulz_inverse(jnp.asarray(a1),
+                                          jnp.asarray(seed))
+    ns, resid = np.asarray(ns), np.asarray(resid)
+    assert resid[1] >= 1.0 - 1e-6 and (resid[[0, 2]] < 0.05).all()
+    # healthy slots: the NS result verbatim
+    np.testing.assert_array_equal(out[0], ns[0])
+    np.testing.assert_array_equal(out[2], ns[2])
+    # failed slot: the batched Cholesky inverse, exact
+    chol = np.asarray(ops.psd_inverse(jnp.asarray(a1)))
+    np.testing.assert_array_equal(out[1], chol[1])
+    np.testing.assert_allclose(out[1], np.linalg.inv(a1[1]),
+                               rtol=5e-3, atol=1e-4)
+    # all-healthy fast path: identical to plain NS
+    good = np.linalg.inv(a0).astype(np.float32)
+    out2 = np.asarray(ops.warm_inverse(jnp.asarray(a1), jnp.asarray(good)))
+    ns2, _ = ops.newton_schulz_inverse(jnp.asarray(a1), jnp.asarray(good))
+    np.testing.assert_array_equal(out2, np.asarray(ns2))

@@ -266,6 +266,23 @@ def test_loader_prefetch_identical_and_propagates():
               if t.daemon and 'prefetch' in repr(t.name).lower()]
     assert not leaked, leaked
 
+    # explicit close(): deterministic producer release without relying on
+    # refcounting (ADVICE r2) — also usable as a context manager
+    e = data.Loader(x, y, 16, train=True, seed=3, shard=(0, 1))
+    it = e.epoch(prefetch_depth=2)
+    next(it)
+    it.close()
+    _time.sleep(0.3)
+    leaked = [t for t in _threading.enumerate()
+              if t.daemon and 'prefetch' in repr(t.name).lower()]
+    assert not leaked, leaked
+    with e.epoch(prefetch_depth=2) as it2:
+        next(it2)
+    _time.sleep(0.3)
+    leaked = [t for t in _threading.enumerate()
+              if t.daemon and 'prefetch' in repr(t.name).lower()]
+    assert not leaked, leaked
+
 
 def test_parse_logs_all_speed_formats(tmp_path):
     """scripts/parse_logs.py must recognize every trainer's SPEED line
