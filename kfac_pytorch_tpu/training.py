@@ -297,6 +297,19 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                     'build_train_step.') from e
             raise
 
+    # Warm-tracking host state, exposed for checkpoint/resume: three
+    # scalars ('yes', 'last_full', 'warm_streak') that are per-process
+    # and NOT part of the on-device TrainState. Resume semantics WITHOUT
+    # restoring it are safe by construction: the first inverse update of
+    # a resumed run is always a full cold decomposition (no 'last_full'
+    # yet) and the cold_restart_every streak restarts from zero — only
+    # the *cadence* of future cold restarts shifts, never correctness.
+    # Callers wanting bit-identical cadence across preemption can dump
+    # this dict (plain ints/bools, json-safe) next to the checkpoint and
+    # assign it back onto the new step_fn: step_fn.warm_tracking.update(
+    # saved). Pinned by tests/test_training.py::
+    # test_warm_tracking_resume_semantics.
+    step_fn.warm_tracking = seen_inverse
     return step_fn
 
 

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Discriminating real-data A/B on the HARDENED digits task (VERDICT r2
+# #5 / weak #6): the stock digits-CIFAR task saturates ~.99 val on both
+# arms and its 297-image val set quantizes at 0.34%, too coarse to
+# separate the warm-kernel legs. This task is 300 train images with 30%
+# train-label noise against a 600-image clean val set (0.17%
+# quantization, generalization gap forced open), same unmodified
+# reference recipe otherwise.
+#
+# Five 40-epoch legs, sequential, on the virtual CPU mesh (nd=4):
+# SGD / cold eigen_dp / warm-NS inverse_dp / basis10 eigen_dp /
+# warm-subspace eigen_dp — the same leg set as the round-2 evidence,
+# now on a task that can actually rank them. TB scalars land under
+# logs/tb_digits_hard/<leg> for plotting.
+#
+# Usage: nohup bash scripts/run_digits_hard_ab.sh > logs/digits_hard_ab.log 2>&1 &
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p logs/tb_digits_hard
+
+python scripts/make_digits_cifar.py /tmp/digits_hard \
+    --train-n 300 --val-n 600 --label-noise 0.3
+
+common=(data_dir=/tmp/digits_hard nworkers=4 batch_size=32 epochs=40
+        lr_decay="25 35")
+
+leg() {  # leg <name> <env...> -- <extra trainer args...>
+  local name=$1; shift
+  local envs=()
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  echo "=== leg $name $(date +%H:%M:%S)"
+  env "${common[@]}" "${envs[@]}" KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=4 \
+      bash train_cifar10.sh --tb-dir "logs/tb_digits_hard/$name" "$@" \
+    || echo "=== leg $name FAILED rc=$?"
+}
+
+leg sgd            kfac=0 --
+leg cold_eigen     kfac=1 kfac_name=eigen_dp --
+leg warm_ns        kfac=1 kfac_name=inverse_dp -- --kfac-warm-start
+leg basis10        kfac=1 kfac_name=eigen_dp basis_freq=10 --
+leg warm_subspace  kfac=1 kfac_name=eigen_dp KFAC_EIGH_IMPL=subspace \
+    -- --kfac-warm-start
+
+echo "=== digits-hard A/B complete $(date)"
+python scripts/parse_logs.py logs/cifar10_*digits_hard*.log 2>/dev/null \
+  || true
