@@ -1,3 +1,33 @@
 """Shared test helpers."""
 
+import socket
+import subprocess
+
 from kfac_pytorch_tpu.models.tiny import TinyCNN  # noqa: F401 (re-export)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def communicate_all(procs, timeout=450):
+    """communicate() with every process of a multi-process drill; on any
+    timeout, kill them all and surface EVERY worker's output — the stuck
+    worker is usually blocked on a failed peer's init barrier, so the
+    root cause lives in the peer's stdout."""
+    outs = []
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=timeout)[0])
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            everything = list(outs)
+            for q in procs[len(outs):]:
+                everything.append(q.communicate()[0])
+            raise AssertionError(
+                f'worker timed out; all outputs: {everything}')
+    return outs

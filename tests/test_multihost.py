@@ -9,9 +9,12 @@ reference: launch_horovod.sh:32) plus cross-process batch sharding via
 decreasing loss."""
 
 import os
-import socket
 import subprocess
 import sys
+
+import pytest
+
+from tests.helpers import communicate_all, free_port
 
 _WORKER = r'''
 import os, sys
@@ -79,19 +82,14 @@ print(f'LOSSES {ls[0]:.6f} {ls[-1]:.6f}', flush=True)
 '''
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        return s.getsockname()[1]
-
-
+@pytest.mark.slow
 def test_two_process_distributed_kfac_training(tmp_path):
     # subprocess.communicate(timeout=...) below bounds the test's runtime
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = _WORKER % {'repo': repo}
     base = {k: v for k, v in os.environ.items()
             if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
-    base.update(JAX_COORDINATOR_ADDRESS=f'127.0.0.1:{_free_port()}',
+    base.update(JAX_COORDINATOR_ADDRESS=f'127.0.0.1:{free_port()}',
                 KFAC_TPU_MULTIHOST='1', JAX_NUM_PROCESSES='2',
                 KFAC_TEST_CKPT_DIR=str(tmp_path / 'ckpt'))
     procs = []
@@ -101,22 +99,7 @@ def test_two_process_distributed_kfac_training(tmp_path):
             procs.append(subprocess.Popen(
                 [sys.executable, '-c', worker], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        outs = []
-        for p in procs:
-            try:
-                outs.append(p.communicate(timeout=450)[0])
-            except subprocess.TimeoutExpired:
-                # kill everyone, then read ALL outputs — the stuck worker
-                # is usually blocked on a failed peer's init barrier, so
-                # the root cause lives in the peer's stdout
-                for q in procs:
-                    if q.poll() is None:
-                        q.kill()
-                everything = list(outs)
-                for q in procs[len(outs):]:
-                    everything.append(q.communicate()[0])
-                raise AssertionError(
-                    f'worker timed out; all outputs: {everything}')
+        outs = communicate_all(procs)
     finally:
         for p in procs:
             if p.poll() is None:
