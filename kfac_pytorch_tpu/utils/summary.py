@@ -117,6 +117,79 @@ def maybe_writer(tb_dir):
     return None
 
 
+def _read_varint(buf, i):
+    n = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def _walk_fields(buf):
+    """Yield (field_number, wire_type, value) over one proto message.
+    value is: varint int (wire 0), 8-byte bytes (wire 1), payload bytes
+    (wire 2), 4-byte bytes (wire 5)."""
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        num, wire = key >> 3, key & 0x7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 1:
+            v, i = buf[i:i + 8], i + 8
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v, i = buf[i:i + ln], i + ln
+        elif wire == 5:
+            v, i = buf[i:i + 4], i + 4
+        else:  # pragma: no cover — the writer never emits groups
+            raise ValueError(f'unsupported wire type {wire}')
+        yield num, wire, v
+
+
+def read_scalars(log_dir):
+    """Read every scalar series from the event files under ``log_dir`` —
+    the inverse of :class:`SummaryWriter` (same hand-decoded TFRecord +
+    Event wire format, so the round trip needs no tensorboard install;
+    also loads files written by stock writers as long as they carry
+    simple_value summaries). Returns ``{tag: [(step, value), ...]}``
+    in file order; multiple event files are read in filename order."""
+    series = {}
+    names = sorted(f for f in os.listdir(log_dir)
+                   if f.startswith('events.out.tfevents'))
+    for name in names:
+        with open(os.path.join(log_dir, name), 'rb') as f:
+            data = f.read()
+        i = 0
+        while i + 12 <= len(data):
+            (ln,) = struct.unpack('<Q', data[i:i + 8])
+            if i + 12 + ln + 4 > len(data):
+                break  # truncated tail (live writer / killed run) — skip
+            payload = data[i + 12:i + 12 + ln]
+            i += 12 + ln + 4  # len + len-crc + payload + payload-crc
+            step = 0
+            for num, wire, v in _walk_fields(payload):
+                if num == 2 and wire == 0:
+                    step = v
+                elif num == 5 and wire == 2:      # Summary
+                    for n2, w2, val_msg in _walk_fields(v):
+                        if n2 != 1 or w2 != 2:
+                            continue
+                        tag, value = None, None
+                        for n3, w3, v3 in _walk_fields(val_msg):
+                            if n3 == 1 and w3 == 2:
+                                tag = v3.decode()
+                            elif n3 == 2 and w3 == 5:
+                                (value,) = struct.unpack('<f', v3)
+                        if tag is not None and value is not None:
+                            series.setdefault(tag, []).append(
+                                (step, value))
+    return series
+
+
 def log_epoch_scalars(tb, epoch, train_loss, lr, val_loss, val_acc):
     """The trainers' shared per-epoch scalar set. ``tb`` may be None.
     Callers must pass already-synced metric values — Metric.sync() is a

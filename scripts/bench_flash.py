@@ -49,8 +49,18 @@ def main():
                          '(bwd mode pins the pallas forward)')
     impls = args.impls or ['xla', 'pallas' if on_tpu else
                            'pallas_interpret']
+    tile_env = [k for k in ('KFAC_FLASH_TQ', 'KFAC_FLASH_TK')
+                if k in os.environ]
     print(f'device: {jax.devices()[0]}; B={args.batch} H={args.heads} '
           f'D={args.d_head}; fwd+bwd causal attention')
+    if tile_env:
+        # report the EFFECTIVE tile per length — _fwd_tile clamps/rounds
+        # the request (e.g. 480->128), so echoing the raw env would
+        # misattribute sweep rows
+        from kfac_pytorch_tpu.ops.pallas_attention import _fwd_tile
+        for L in args.seq_lens:
+            eff = {k: _fwd_tile(k, 128, L) for k in tile_env}
+            print(f'  L={L:>7} effective tiles: {eff}')
 
     for L in args.seq_lens:
         rng = np.random.RandomState(0)

@@ -1,0 +1,81 @@
+"""Summarize + plot the hardened-digits A/B from its TensorBoard scalars
+(VERDICT r2 #5: 'a gap bigger than noise in either direction, logged +
+plotted from TB scalars').
+
+Reads every leg directory under the given TB root (written by
+scripts/run_digits_hard_ab.sh via --tb-dir) with the framework's native
+event-file reader (utils/summary.read_scalars — no tensorboard install),
+prints a final/best val-accuracy table with the val-set quantization
+noise floor, and writes a val-accuracy-vs-epoch PNG next to the root.
+
+Usage: python scripts/plot_digits_ab.py [logs/tb_digits_hard] [--val-n 600]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from kfac_pytorch_tpu.utils.summary import read_scalars
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('root', nargs='?', default='logs/tb_digits_hard')
+    ap.add_argument('--val-n', type=int, default=600,
+                    help='held-out set size (quantization = 1/N)')
+    args = ap.parse_args()
+
+    legs = {}
+    for name in sorted(os.listdir(args.root)):
+        d = os.path.join(args.root, name)
+        if not os.path.isdir(d):
+            continue
+        series = read_scalars(d)
+        if 'val/accuracy' in series:
+            legs[name] = series['val/accuracy']
+    if not legs:
+        raise SystemExit(f'no val/accuracy series under {args.root}')
+
+    quant = 1.0 / args.val_n
+    print(f'leg                 final   best    best@ep   '
+          f'(val quantization {quant:.4f})')
+    for name, acc in legs.items():
+        steps, vals = zip(*acc)
+        best_i = max(range(len(vals)), key=vals.__getitem__)
+        print(f'{name:<18}  {vals[-1]:.4f}  {vals[best_i]:.4f}  '
+              f'{steps[best_i]:>5}')
+    # pairwise final-accuracy gaps in units of the quantization floor
+    names = list(legs)
+    print('\npairwise final-acc gaps (in val-quantization units):')
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            gap = legs[a][-1][1] - legs[b][-1][1]
+            print(f'  {a} vs {b}: {gap:+.4f} ({gap / quant:+.1f}q)')
+
+    try:
+        import matplotlib
+        matplotlib.use('Agg')
+        import matplotlib.pyplot as plt
+    except Exception:
+        print('\nmatplotlib unavailable — table only')
+        return
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for name, acc in legs.items():
+        steps, vals = zip(*acc)
+        ax.plot(steps, vals, label=name, linewidth=1.5)
+    ax.set_xlabel('epoch')
+    ax.set_ylabel('val accuracy')
+    ax.set_title('hardened digits (300 train / 30% label noise / '
+                 f'{args.val_n} clean val)')
+    ax.legend(loc='lower right', fontsize=8)
+    ax.grid(alpha=0.3)
+    out = os.path.join(os.path.dirname(os.path.abspath(args.root)),
+                       'digits_hard_ab.png')
+    fig.savefig(out, dpi=120, bbox_inches='tight')
+    print(f'\nwrote {out}')
+
+
+if __name__ == '__main__':
+    main()

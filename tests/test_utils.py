@@ -197,6 +197,34 @@ def test_summary_writer_tensorboard_roundtrip(tmp_path):
     assert got == [(0, 'train/loss', 2.5), (7, 'val/accuracy', 0.875)], got
 
 
+def test_summary_native_reader_roundtrip(tmp_path):
+    """read_scalars is the writer's exact inverse (no tensorboard
+    install needed): every series comes back tagged, stepped and in
+    order — the basis for scripts/plot_digits_ab.py's TB-scalar plots."""
+    from kfac_pytorch_tpu.utils.summary import SummaryWriter, read_scalars
+    w = SummaryWriter(str(tmp_path))
+    for e in range(3):
+        w.add_scalar('train/loss', 2.5 - e, e)
+        w.add_scalar('val/accuracy', 0.5 + 0.1 * e, e)
+    w.add_scalar('train/lr', 0.1, 99)
+    w.close()
+    got = read_scalars(str(tmp_path))
+    assert got['train/loss'] == [(0, 2.5), (1, 1.5), (2, 0.5)]
+    assert got['val/accuracy'] == [(0, 0.5), (1, pytest.approx(0.6)),
+                                   (2, pytest.approx(0.7))]
+    assert got['train/lr'] == [(99, pytest.approx(0.1))]
+
+    # a truncated tail (live writer mid-record / killed run) must skip
+    # the partial record, not crash the whole read
+    import glob as _glob
+    f = _glob.glob(str(tmp_path) + '/events.out.tfevents.*')[0]
+    data = open(f, 'rb').read()
+    open(f, 'wb').write(data[:-7])
+    trunc = read_scalars(str(tmp_path))
+    assert trunc['train/loss'] == got['train/loss']
+    assert trunc.get('train/lr', []) == []  # clipped final record dropped
+
+
 def test_setup_run_logging_rank0_only_file(tmp_path, monkeypatch):
     """Process 0 gets the per-run file; peer processes stream only — on a
     shared filesystem their identical timestamp suffix would otherwise
