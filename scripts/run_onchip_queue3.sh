@@ -1,6 +1,6 @@
 #!/bin/bash
 # Round-3 on-chip queue — RESUMABLE. Every leg is guarded by a
-# done-marker (logs/onchip/done/<tag>.done, created on rc=0), so the
+# done-marker ("$D"/done/<tag>.done, created on rc=0), so the
 # watcher (scripts/watch_tunnel.sh) can re-run this script in every
 # tunnel window and only the unfinished legs execute. Before each leg the
 # tunnel is re-probed; if it stopped answering, the pass aborts and the
@@ -18,15 +18,48 @@
 
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p logs/onchip/done
+# QUEUE_STATE_DIR redirects every marker/log/harvest path — the CPU
+# rehearsal (tests and pre-window dry runs) must never touch the real
+# on-chip markers. QUEUE_PROBE_CMD stubs the tunnel probe the same way.
+if [ -n "${QUEUE_SMOKE:-}" ]; then
+  # a rehearsal must NEVER touch the real on-chip markers: smoke mode
+  # defaults its own state dir (and self-contains the bench smoke
+  # sizes below) unless one was given explicitly
+  D=${QUEUE_STATE_DIR:-logs/queue_smoke}
+else
+  D=${QUEUE_STATE_DIR:-logs/onchip}
+fi
+mkdir -p "$D/done"
 TS=$(date +%m%d_%H%M)
-L="logs/onchip/queue3_${TS}"
+L="$D/queue3_${TS}"
 S="$L.summary"
 
-probe() { timeout 120 python -c "import jax; print(jax.devices())" \
-          > /dev/null 2>&1; }
+probe() {
+  bash -c "${QUEUE_PROBE_CMD:-timeout 120 python -c 'import jax; print(jax.devices())'}" \
+    > /dev/null 2>&1
+}
 
 MAX_ATTEMPTS=${QUEUE_MAX_ATTEMPTS:-3}
+
+# QUEUE_SMOKE=1: shrink every leg's workload so the ENTIRE queue can be
+# rehearsed end-to-end on the CPU mesh before it ever burns a tunnel
+# window (export KFAC_PLATFORM=cpu and the BENCH_* smoke sizes too —
+# bench.py reads those from the environment). The real path is the
+# unset case: identical commands with full-size arguments.
+if [ -n "${QUEUE_SMOKE:-}" ]; then
+  FLASH_LENS="64 128"; FLASH_BIG=256; OPS_ARGS="--dims 64 128"
+  PAIRED_DIMS="64 128"; EPOCHS=2
+  # self-contained CPU rehearsal: the bench.py legs read these from the
+  # environment — without them a "rehearsal" would run full-size
+  # resnet50 benching for hours
+  export KFAC_PLATFORM=${KFAC_PLATFORM:-cpu}
+  export KFAC_HOST_DEVICES=${KFAC_HOST_DEVICES:-1}
+  export BENCH_MODEL=${BENCH_MODEL:-resnet20} BENCH_IMG=${BENCH_IMG:-32}
+  export BENCH_BATCH=${BENCH_BATCH:-8} BENCH_ITERS=${BENCH_ITERS:-3}
+else
+  FLASH_LENS="8192 16384"; FLASH_BIG=32768; OPS_ARGS=""
+  PAIRED_DIMS="512 1024"; EPOCHS=100
+fi
 
 # bench.py legs set NEXT_NO_DONE=1: rc=0 alone must NOT mark them done
 # (bench.py exits 0 even when its defining optional leg was budget-
@@ -37,16 +70,16 @@ NEXT_NO_DONE=0
 run() {  # run <tag> <timeout_s> <cmd...>
   local tag=$1 to=$2; shift 2
   local no_done=$NEXT_NO_DONE; NEXT_NO_DONE=0
-  if [ -f "logs/onchip/done/$tag.done" ]; then
+  if [ -f "$D/done/$tag.done" ]; then
     echo "[skip] $tag (done)" | tee -a "$S"; return 0
   fi
   # a leg that fails MAX_ATTEMPTS times with the tunnel up is a real
   # failure (e.g. the 32k XLA compile): record it and stop burning
   # tunnel windows on it — .gaveup counts as terminal for ALL below
-  local att_f="logs/onchip/done/$tag.attempts"
+  local att_f="$D/done/$tag.attempts"
   local att; att=$(cat "$att_f" 2>/dev/null || echo 0)
   if [ "$att" -ge "$MAX_ATTEMPTS" ]; then
-    touch "logs/onchip/done/$tag.gaveup"
+    touch "$D/done/$tag.gaveup"
     echo "[gaveup] $tag after $att attempts" | tee -a "$S"; return 1
   fi
   if ! probe; then
@@ -63,7 +96,7 @@ run() {  # run <tag> <timeout_s> <cmd...>
   echo "=== [$tag] rc=$rc $(date +%H:%M:%S)" | tee -a "$S"
   tail -5 "$L.$tag.log" >> "$S"
   if [ "$rc" -eq 0 ] && [ "$no_done" -eq 0 ]; then
-    touch "logs/onchip/done/$tag.done"
+    touch "$D/done/$tag.done"
   elif [ "$rc" -ne 0 ] && probe; then
     # tunnel still up => the failure was the leg's own, count it;
     # tunnel down => environmental, don't charge the leg
@@ -83,12 +116,12 @@ harvest() {  # harvest <tag> <required_key> <rc> — after a bench.py leg,
   local tag=$1 key=$2 rc=$3
   local line
   line=$(grep -h '"metric"' "$L.$tag.log" 2>/dev/null | tail -1)
-  if [ -z "$line" ] && [ -f "logs/onchip/$tag.partial.json" ]; then
-    line=$(cat "logs/onchip/$tag.partial.json")
+  if [ -z "$line" ] && [ -f "$D/$tag.partial.json" ]; then
+    line=$(cat "$D/$tag.partial.json")
   fi
   [ -n "$line" ] || return 0
-  printf '%s\n' "$line" > "logs/onchip/$tag.json"
-  if [ -f "logs/onchip/done/$tag.done" ]; then return 0; fi
+  printf '%s\n' "$line" > "$D/$tag.json"
+  if [ -f "$D/done/$tag.done" ]; then return 0; fi
   if printf '%s' "$line" | KEY="$key" python -c '
 import json, os, sys
 d = json.load(sys.stdin)
@@ -96,9 +129,9 @@ k = os.environ["KEY"]
 v = d.get(k) if k == "value" else d.get("extra", {}).get(k)
 sys.exit(0 if v is not None else 1)' 2>/dev/null; then
     echo "[harvest] $tag: JSON carries $key — marking done" | tee -a "$S"
-    touch "logs/onchip/done/$tag.done"
+    touch "$D/done/$tag.done"
   elif [ "$rc" -eq 0 ]; then
-    local att_f="logs/onchip/done/$tag.attempts"
+    local att_f="$D/done/$tag.attempts"
     local att; att=$(cat "$att_f" 2>/dev/null || echo 0)
     echo $((att + 1)) > "$att_f"
     echo "[harvest] $tag: rc=0 but $key missing — attempt charged" \
@@ -111,7 +144,7 @@ sys.exit(0 if v is not None else 1)' 2>/dev/null; then
 #    Keep the JSON where the round summary can cite it.
 NEXT_NO_DONE=1
 run bench_headline 5400 env \
-    BENCH_PARTIAL_PATH=logs/onchip/bench_headline.partial.json \
+    BENCH_PARTIAL_PATH="$D"/bench_headline.partial.json \
     python bench.py
 harvest bench_headline value $?
 
@@ -121,7 +154,7 @@ harvest bench_headline value $?
 #    breakdown ladder out of its own run.
 NEXT_NO_DONE=1
 run bench_breakdown 7200 env BENCH_BREAKDOWN=1 BENCH_TIME_BUDGET=5000 \
-    BENCH_PARTIAL_PATH=logs/onchip/bench_breakdown.partial.json \
+    BENCH_PARTIAL_PATH="$D"/bench_breakdown.partial.json \
     python bench.py
 harvest bench_breakdown phase_breakdown_s $?
 
@@ -131,36 +164,36 @@ harvest bench_breakdown phase_breakdown_s $?
 #    decision data done before all three legs exist.
 NEXT_NO_DONE=1
 run bench_full 7200 env BENCH_FULL=1 BENCH_TIME_BUDGET=5000 \
-    BENCH_PARTIAL_PATH=logs/onchip/bench_full.partial.json \
+    BENCH_PARTIAL_PATH="$D"/bench_full.partial.json \
     python bench.py
 harvest bench_full eigen_dp_iter_s_freq10_warm_subspace $?
 
 # 4. fenced op A/B at ResNet-50 bucket dims: XLA eigh vs chol vs subspace
 #    vs (<=1024) jacobi, three matmul precisions
-run bench_ops 5400 python scripts/bench_ops.py
+run bench_ops 5400 python scripts/bench_ops.py $OPS_ARGS
 
 # 5. paired-rotation jacobi keep/drop decision (VERDICT #9)
 run bench_ops_paired 3600 env KFAC_JACOBI_ROT=paired \
-    python scripts/bench_ops.py --dims 512 1024
+    python scripts/bench_ops.py --dims $PAIRED_DIMS
 
 # 6. flash forward crossover re-check under the fixed fence + the 32k
 #    XLA retry (VERDICT #3/#7): both columns at 8k/16k/32k
 run flash_fwd_xover 3600 python scripts/bench_flash.py \
-    --seq-lens 8192 16384 --impls xla pallas
-run flash_32k_xla 1800 python scripts/bench_flash.py --seq-lens 32768 \
+    --seq-lens $FLASH_LENS --impls xla pallas
+run flash_32k_xla 1800 python scripts/bench_flash.py --seq-lens $FLASH_BIG \
     --impls xla
-run flash_32k_pallas 1800 python scripts/bench_flash.py --seq-lens 32768 \
+run flash_32k_pallas 1800 python scripts/bench_flash.py --seq-lens $FLASH_BIG \
     --impls pallas
 
 # 6b. forward tile sweep (VERDICT r2 weak #3 alternative): can larger
 #     K/Q tiles close the Pallas-vs-XLA gap at 8k/16k? Trace-time env
 #     knobs, one process per config.
 run flash_tile_tk512 2700 env KFAC_FLASH_TK=512 \
-    python scripts/bench_flash.py --seq-lens 8192 16384 --impls pallas
+    python scripts/bench_flash.py --seq-lens $FLASH_LENS --impls pallas
 run flash_tile_tk2048 2700 env KFAC_FLASH_TK=2048 \
-    python scripts/bench_flash.py --seq-lens 8192 16384 --impls pallas
+    python scripts/bench_flash.py --seq-lens $FLASH_LENS --impls pallas
 run flash_tile_tq512_tk512 2700 env KFAC_FLASH_TQ=512 KFAC_FLASH_TK=512 \
-    python scripts/bench_flash.py --seq-lens 8192 16384 --impls pallas
+    python scripts/bench_flash.py --seq-lens $FLASH_LENS --impls pallas
 
 # 7. on-chip real-data convergence: digits-CIFAR (hardened task),
 #    unmodified reference recipe; K-FAC vs SGD vs warm-subspace.
@@ -168,13 +201,13 @@ run flash_tile_tq512_tk512 2700 env KFAC_FLASH_TQ=512 KFAC_FLASH_TK=512 \
 #    dataset they would burn their attempts (and hours of tunnel time)
 #    failing on the root cause mkdata still has retries left for.
 run mkdata 300 python scripts/make_digits_cifar.py
-if [ -f logs/onchip/done/mkdata.done ]; then
+if [ -f "$D/done/mkdata.done" ]; then
   run digits_kfac 7200 env data_dir=/tmp/digits_cifar nworkers=1 kfac=1 \
-      epochs=100 bash train_cifar10.sh
+      epochs=$EPOCHS bash train_cifar10.sh
   run digits_sgd 7200 env data_dir=/tmp/digits_cifar nworkers=1 kfac=0 \
-      epochs=100 bash train_cifar10.sh
+      epochs=$EPOCHS bash train_cifar10.sh
   run digits_kfac_subspace 7200 env data_dir=/tmp/digits_cifar nworkers=1 \
-      kfac=1 epochs=100 KFAC_EIGH_IMPL=subspace bash train_cifar10.sh \
+      kfac=1 epochs=$EPOCHS KFAC_EIGH_IMPL=subspace bash train_cifar10.sh \
       --kfac-warm-start
 else
   echo "[defer] digits legs await mkdata" | tee -a "$S"
@@ -187,10 +220,10 @@ for tag in bench_headline bench_breakdown bench_full bench_ops \
            flash_32k_pallas flash_tile_tk512 flash_tile_tk2048 \
            flash_tile_tq512_tk512 mkdata digits_kfac digits_sgd \
            digits_kfac_subspace; do
-  [ -f "logs/onchip/done/$tag.done" ] || \
-    [ -f "logs/onchip/done/$tag.gaveup" ] || all_done=0
+  [ -f "$D/done/$tag.done" ] || \
+    [ -f "$D/done/$tag.gaveup" ] || all_done=0
 done
 if [ "$all_done" -eq 1 ]; then
-  touch logs/onchip/done/ALL
+  touch "$D"/done/ALL
   echo "QUEUE3 COMPLETE $(date)" | tee -a "$S"
 fi

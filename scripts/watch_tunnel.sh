@@ -16,18 +16,22 @@
 
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p logs/onchip/done
-W=logs/onchip/watch_tunnel.log
+# same state-dir/probe overrides as the queue, so a redirected or
+# stubbed rehearsal exercises the watcher too (defaulting here keeps
+# watcher and queue pointed at the SAME dir when only one is launched)
+D=${QUEUE_STATE_DIR:-logs/onchip}
+mkdir -p "$D/done"
+W="$D/watch_tunnel.log"
 PROBE_EVERY=${WATCH_PROBE_EVERY:-150}   # seconds between probes
 
 echo "[watch] start $(date) pid=$$ probe_every=${PROBE_EVERY}s" >> "$W"
 
 while true; do
-  if [ -f logs/onchip/done/ALL ]; then
+  if [ -f "$D/done/ALL" ]; then
     echo "[watch] queue fully complete — exiting $(date)" >> "$W"
     exit 0
   fi
-  if timeout 120 python -c "import jax; print(jax.devices())" \
+  if bash -c "${QUEUE_PROBE_CMD:-timeout 120 python -c 'import jax; print(jax.devices())'}" \
       >> "$W" 2>/dev/null; then
     echo "[watch] tunnel UP $(date) — running queue3" >> "$W"
     bash scripts/run_onchip_queue3.sh >> "$W" 2>&1
