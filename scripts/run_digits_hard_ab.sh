@@ -38,6 +38,7 @@ leg() {  # leg <name> <env...> -- <extra trainer args...>
 
 leg sgd            kfac=0 --
 leg cold_eigen     kfac=1 kfac_name=eigen_dp --
+leg cold_chol      kfac=1 kfac_name=inverse_dp --
 leg warm_ns        kfac=1 kfac_name=inverse_dp -- --kfac-warm-start
 leg basis10        kfac=1 kfac_name=eigen_dp basis_freq=10 --
 leg warm_subspace  kfac=1 kfac_name=eigen_dp KFAC_EIGH_IMPL=subspace \
