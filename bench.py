@@ -316,37 +316,38 @@ def _run(devices):
         s, _ = _time_steps(sgd_step, state, batch, ITERS)
         return s
 
-    sgd_s = _optional(_sgd)
-    if sgd_s is not None:
-        extra['sgd_iter_s'] = round(sgd_s, 4)
-        extra['kfac_overhead_vs_sgd_freq1'] = round(inv1_s / sgd_s, 3)
-    _checkpoint()
+    def _leg(key, seconds, digits=4):
+        # record a completed optional leg (None = failed/skipped stays
+        # the pre-seeded null) and persist the running partial
+        if seconds is not None:
+            extra[key] = round(seconds, digits)
+        _checkpoint()
+        return seconds
 
-    inv10_s = _optional(lambda: _measure_variant(
-        model, tx, batch, 'inverse_dp', 10, 10, ITERS))
-    if inv10_s is not None:
-        extra['inverse_dp_iter_s_freq10'] = round(inv10_s, 4)
-        if sgd_s is not None:
-            extra['kfac_overhead_vs_sgd_freq10'] = round(inv10_s / sgd_s, 3)
-    _checkpoint()
+    sgd_s = _leg('sgd_iter_s', _optional(_sgd))
+    if sgd_s is not None:
+        extra['kfac_overhead_vs_sgd_freq1'] = round(inv1_s / sgd_s, 3)
+
+    inv10_s = _leg('inverse_dp_iter_s_freq10', _optional(
+        lambda: _measure_variant(model, tx, batch, 'inverse_dp', 10, 10,
+                                 ITERS)))
+    if inv10_s is not None and sgd_s is not None:
+        extra['kfac_overhead_vs_sgd_freq10'] = round(inv10_s / sgd_s, 3)
+        _checkpoint()
     # warm Newton-Schulz inverse at freq 1: every step's inverse update is
     # ~4 batched matmuls seeded by the stored inverse (residual-gated
     # Cholesky fallback) — the headline-config candidate; reported
     # alongside the reference-parity cold number that stays the headline
-    inv1_warm_s = _optional(lambda: _measure_variant(
-        model, tx, batch, 'inverse_dp', 1, 1, ITERS, warm_start=True))
-    if inv1_warm_s is not None:
-        extra['inverse_dp_iter_s_freq1_warm_ns'] = round(inv1_warm_s, 4)
-    _checkpoint()
+    _leg('inverse_dp_iter_s_freq1_warm_ns', _optional(
+        lambda: _measure_variant(model, tx, batch, 'inverse_dp', 1, 1,
+                                 ITERS, warm_start=True)))
     # reference-default eigen_dp at deployed amortization: opt-in — its
     # eigh program is by far the slowest compile and the headline metric
     # doesn't use it (BENCH_FULL=1 to include)
     if os.environ.get('BENCH_FULL'):
-        eig10_s = _optional(lambda: _measure_variant(
-            model, tx, batch, 'eigen_dp', 10, 10, min(ITERS, 10)))
-        if eig10_s is not None:
-            extra['eigen_dp_iter_s_freq10'] = round(eig10_s, 4)
-        _checkpoint()
+        _leg('eigen_dp_iter_s_freq10', _optional(
+            lambda: _measure_variant(model, tx, batch, 'eigen_dp', 10, 10,
+                                     min(ITERS, 10))))
         # + eigenbasis amortization: full eigh every 100 steps, eigenvalue
         # refresh at the freq-10 inverse updates. The timed window
         # contains refreshes only — which IS the steady state at this
@@ -354,25 +355,19 @@ def _run(devices):
         # never land in a 10-iter window, so warm_start is deliberately
         # NOT part of this measurement. Combine with KFAC_EIGH_IMPL to
         # switch the eigh kernel of the fulls outside the window.
-        eig_amort_s = _optional(lambda: _measure_variant(
-            model, tx, batch, 'eigen_dp', 10, 10, min(ITERS, 10),
-            basis_freq=100))
-        if eig_amort_s is not None:
-            extra['eigen_dp_iter_s_freq10_basis100'] = round(eig_amort_s, 4)
-        _checkpoint()
+        _leg('eigen_dp_iter_s_freq10_basis100', _optional(
+            lambda: _measure_variant(model, tx, batch, 'eigen_dp', 10, 10,
+                                     min(ITERS, 10), basis_freq=100)))
         # + warm subspace tracking: every freq-10 inverse update is a
         # FULL decomposition, but warm — perturbative tracking steps in
         # the stored basis (ops.subspace_eigh) instead of QDWH. The timed
         # window contains one warm full, so this measures the real
         # steady-state of the reference cadence with the MXU-shaped
         # kernel (the candidate fix for eigen_dp's TPU gap).
-        eig_warm_s = _optional(lambda: _measure_variant(
-            model, tx, batch, 'eigen_dp', 10, 10, min(ITERS, 10),
-            warm_start=True, eigh_impl='subspace'))
-        if eig_warm_s is not None:
-            extra['eigen_dp_iter_s_freq10_warm_subspace'] = round(
-                eig_warm_s, 4)
-        _checkpoint()
+        _leg('eigen_dp_iter_s_freq10_warm_subspace', _optional(
+            lambda: _measure_variant(model, tx, batch, 'eigen_dp', 10, 10,
+                                     min(ITERS, 10), warm_start=True,
+                                     eigh_impl='subspace')))
 
     flops_iter = _optional(lambda: _model_flops_per_iter(model, batch))
     peak = _peak_flops(devices[0])

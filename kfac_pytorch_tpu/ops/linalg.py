@@ -90,9 +90,9 @@ def warm_inverse(damped, seed, iters=2, accept_resid=0.05):
     slot_ok = resid < accept_resid
     return lax.cond(
         jnp.all(slot_ok),
-        lambda ns=ns: ns,
-        lambda ns=ns, ok=slot_ok, d=damped: jnp.where(
-            ok[..., None, None], ns, psd_inverse(d)))
+        lambda: ns,
+        lambda: jnp.where(slot_ok[..., None, None], ns,
+                          psd_inverse(damped)))
 
 
 def sym_eig(x, impl=None, basis=None, sweeps=None):
