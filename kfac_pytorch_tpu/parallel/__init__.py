@@ -26,6 +26,10 @@ from kfac_pytorch_tpu.parallel.ring_attention import (
     ring_attention,
     ulysses_attention,
 )
+from kfac_pytorch_tpu.parallel.tp import (
+    ColumnParallelDense,
+    RowParallelDense,
+)
 
 __all__ = [
     'round_robin_assign', 'balanced_assign', 'block_partition',
@@ -33,4 +37,5 @@ __all__ = [
     'axis_size',
     'make_mesh', 'data_parallel_specs',
     'ring_attention', 'ulysses_attention',
+    'ColumnParallelDense', 'RowParallelDense',
 ]

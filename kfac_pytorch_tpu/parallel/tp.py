@@ -1,0 +1,103 @@
+"""Tensor (model) parallelism: Megatron-style column/row-parallel Dense
+layers with per-slice K-FAC.
+
+The reference has no tensor parallelism — every layer fits one GPU and
+its K-FAC factors are computed on whole-layer matrices. On TPU, sharding
+a layer's feature dimension over a mesh axis is first-class (the 'model'
+axis of a ('data', 'model') mesh), and K-FAC composes with it cleanly:
+
+- :class:`ColumnParallelDense` — kernel sharded on the OUTPUT dim
+  (``P(None, 'model')``): input replicated over ``axis``, output is this
+  rank's feature slice. Follow with elementwise ops and a row-parallel
+  layer.
+- :class:`RowParallelDense` — kernel sharded on the INPUT dim
+  (``P('model', None)``): input is the local slice, the partial products
+  are ``psum``-reduced over ``axis`` to the full output, and the bias is
+  added ONCE after the reduction (replicated, outside the slice's K-FAC
+  factor — Megatron's reduce-then-bias).
+
+K-FAC semantics (per-slice block-diagonal): each model-rank runs the
+ordinary preconditioner on its LOCAL slice layers with the data axis as
+the K-FAC world. The inner Dense's capture taps do exactly the right
+thing under shard_map:
+
+- column layer: 'a' = the replicated input (its A factor is the full
+  layer's A), 'g' = the local output slice's grads (its G factor is the
+  slice-diagonal block of the full G);
+- row layer: 'a' = the local input slice, 'g' = the PRE-reduction
+  partial output's cotangent — which the psum backward replicates from
+  the full dL/dy, so ``dL/dW_slice = a_slice^T g`` is exact.
+
+Preconditioning each slice with (A, G_slice) is the standard
+block-diagonal tensor-parallel K-FAC approximation; with one model rank
+it degenerates to the exact whole-layer factors. Each rank's K-FAC must
+be built over the DATA axis only (``axis_name='data'``): gradients of
+sharded params are already local (autodiff inserts no psum for varying
+params), and cross-model-rank factor averaging would wrongly mix
+distinct diagonal blocks. Pinned by tests/test_tp.py against exact
+per-slice oracles.
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import nn as knn
+from kfac_pytorch_tpu.parallel import collectives as coll
+
+
+class ColumnParallelDense(linen.Module):
+    """This rank's output-slice of a Dense whose kernel is sharded on the
+    output dim over ``axis``. ``features_per_shard`` is the LOCAL width:
+    the global layer has ``features_per_shard * axis_size`` features.
+
+    The input must be replicated over ``axis``; the K-FAC capture of the
+    inner Dense then yields the full-layer A factor and the slice-block G
+    factor."""
+    features_per_shard: int
+    axis: Optional[str] = 'model'
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = knn.default_kernel_init
+    kfac_enabled: bool = True
+
+    @linen.compact
+    def __call__(self, x):
+        return knn.Dense(self.features_per_shard, use_bias=self.use_bias,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         kernel_init=self.kernel_init,
+                         kfac_enabled=self.kfac_enabled, name='slice')(x)
+
+
+class RowParallelDense(linen.Module):
+    """Full-width output from this rank's input-slice of a Dense whose
+    kernel is sharded on the input dim over ``axis``: local partial
+    product, ``psum`` over ``axis``, then the (replicated) bias once.
+
+    The bias is a plain param outside the K-FAC factor — it is added
+    after the cross-rank reduction, so no single slice owns it (the
+    optimizer updates it SGD-style; Megatron semantics). ``axis=None``
+    degenerates to a single-slice dense, same as the rest of
+    ``parallel/``."""
+    features: int
+    axis: Optional[str] = 'model'
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = knn.default_kernel_init
+    kfac_enabled: bool = True
+
+    @linen.compact
+    def __call__(self, x):
+        y = knn.Dense(self.features, use_bias=False, dtype=self.dtype,
+                      param_dtype=self.param_dtype,
+                      kernel_init=self.kernel_init,
+                      kfac_enabled=self.kfac_enabled, name='slice')(x)
+        y = coll.psum(y, self.axis)
+        if self.use_bias:
+            bias = self.param('bias', linen.initializers.zeros_init(),
+                              (self.features,), self.param_dtype)
+            y = y + bias
+        return y
