@@ -310,6 +310,11 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     # saved). Pinned by tests/test_training.py::
     # test_warm_tracking_resume_semantics.
     step_fn.warm_tracking = seen_inverse
+    # the jitted variant cache + constructor, exposed for introspection:
+    # scripts/comm_count.py builds a variant via make_variant and lowers
+    # it WITHOUT executing a step (AOT lower/compile only)
+    step_fn.variants = variants
+    step_fn.make_variant = make_variant
     return step_fn
 
 

@@ -1,0 +1,163 @@
+"""Compiler-level proof of the DP-KFAC communication story: count the
+XLA collectives in each variant's COMPILED train step.
+
+The reference's argument for DP-KFAC (kfac_preconditioner_*_dp.py) is
+that it deletes the FactorComm (0.300 s) and shrinks the InverseComm
+(0.146 s) terms of the 64-GPU MPD ledger (reference
+scripts/time_breakdown.py:27). On TPU the equivalent evidence is
+hardware-independent: lower the full jitted K-FAC train step over an
+8-device mesh and count the all-reduce / all-gather /
+collective-permute ops XLA actually emitted. MPD variants ('eigen',
+'inverse') must show the factor-reduction collectives; DP variants
+('eigen_dp', 'inverse_dp') must show NONE beyond the gradient allreduce
++ preconditioned-output gather; SGD is the gradient-allreduce floor.
+
+Usage: KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=8 python scripts/comm_count.py
+"""
+
+import collections
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+from scripts.utils import force_platform
+
+force_platform()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import models, training
+
+#: one HLO instruction line: `%x = <result type> all-reduce(...)` — the
+#: result type carries the payload shape(s) (tuples for variadic ops).
+#: The async forms TPU/GPU backends emit for latency hiding
+#: (all-reduce-start / -done pairs) are counted via their -start op,
+#: whose result type carries the payload; -done carries none.
+COLLECTIVE_LINE_RE = re.compile(
+    r'= (.*?) ((?:all-reduce|all-gather|collective-permute|reduce-scatter|'
+    r'all-to-all)(?:-start)?)\(')
+SHAPE_RE = re.compile(r'\b([a-z]\w*)\[([0-9,]*)\]')
+DTYPE_BYTES = {'f32': 4, 'bf16': 2, 'f16': 2, 'f64': 8, 's32': 4,
+               'u32': 4, 's64': 8, 'u64': 8, 's8': 1, 'u8': 1, 'pred': 1,
+               'f8e4m3fn': 1, 'f8e5m2': 1, 'c64': 8, 'c128': 16,
+               's16': 2, 'u16': 2}
+_WARNED_DTYPES = set()
+
+
+def _payload_bytes(result_type):
+    total = 0
+    for dt, dims in SHAPE_RE.findall(result_type):
+        size = DTYPE_BYTES.get(dt)
+        if size is None:
+            if dt not in _WARNED_DTYPES:
+                _WARNED_DTYPES.add(dt)
+                print(f'warning: unknown dtype {dt!r} in collective '
+                      'result type — assuming 4 bytes', file=sys.stderr)
+            size = 4
+        n = 1
+        for d in dims.split(','):
+            if d:
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def _ce(outputs, batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, batch['label']).mean()
+
+
+def collective_counts(variant, ndev=8, model_name='resnet20', model=None,
+                      hw=32):
+    """({op_kind: count}, {op_kind: bytes}) over the compiled
+    (SPMD-partitioned) HLO of one full
+    factor+inverse+precondition+update step."""
+    if len(jax.devices()) < ndev or ndev < 2:
+        raise SystemExit(
+            f'need a >=2-device mesh (have {len(jax.devices())}, asked '
+            f'{ndev}): on one device XLA elides every collective and the '
+            'ledger would read all-zero. Run with KFAC_PLATFORM=cpu '
+            'KFAC_HOST_DEVICES=8.')
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ('batch',))
+    rng = np.random.RandomState(0)
+    batch = {'input': jnp.asarray(rng.randn(2 * ndev, hw, hw, 3),
+                                  jnp.float32),
+             'label': jnp.asarray(rng.randint(0, 10, 2 * ndev))}
+    if model is None:
+        model = models.get_model(model_name, num_classes=10)
+    tx = training.sgd(0.1, momentum=0.9)
+    precond = None
+    if variant != 'sgd':
+        precond = kfac.KFAC(variant=variant, lr=0.1, damping=0.003,
+                            fac_update_freq=1, kfac_update_freq=1,
+                            num_devices=ndev, axis_name='batch',
+                            assignment='balanced')
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0),
+                                      batch['input'])
+    step = training.build_train_step(model, tx, precond, _ce,
+                                     axis_name='batch', mesh=mesh,
+                                     extra_mutable=('batch_stats',),
+                                     donate=False)
+    # build the full factor+inverse variant WITHOUT executing a step
+    # (AOT lower/compile only — executing first would compile the same
+    # program twice) and read the compiled SPMD module's text
+    from kfac_pytorch_tpu.preconditioner import KFACHyperParams
+    hyper = KFACHyperParams(lr=jnp.float32(0.1), damping=jnp.float32(0.003))
+    jitted = step.make_variant(precond is not None, precond is not None)
+    txt = jitted.lower(state, batch, hyper).compile().as_text()
+    counts = collections.Counter()
+    bytes_by_kind = collections.Counter()
+    for result_type, kind in COLLECTIVE_LINE_RE.findall(txt):
+        counts[kind] += 1
+        bytes_by_kind[kind] += _payload_bytes(result_type)
+    return dict(counts), dict(bytes_by_kind)
+
+
+def main():
+    ndev = int(os.environ.get('KFAC_HOST_DEVICES', '8'))
+    model_name = os.environ.get('COMM_COUNT_MODEL', 'resnet20')
+    print(f'model={model_name} ndev={ndev} (counts from the compiled '
+          'SPMD module)')
+    counts, volumes = {}, {}
+    for variant in ('sgd', 'eigen', 'inverse', 'eigen_dp', 'inverse_dp'):
+        counts[variant], volumes[variant] = collective_counts(
+            variant, ndev=ndev, model_name=model_name)
+        print(f'{variant:>12}: ops {counts[variant]}  '
+              f'MiB {{'
+              + ', '.join(f'{k}: {v / 2**20:.2f}'
+                          for k, v in volumes[variant].items())
+              + '}', flush=True)
+
+    kinds = sorted({k for r in counts.values() for k in r})
+    print('\nvariant       '
+          + '  '.join(f'{k + " (n/MiB)":>26}' for k in kinds))
+    for v in counts:
+        print(f'{v:<12} ' + '  '.join(
+            f'{counts[v].get(k, 0):>16}/{volumes[v].get(k, 0)/2**20:8.2f}'
+            for k in kinds))
+
+    # the ledger analog (reference scripts/time_breakdown.py:27): K-FAC
+    # comm VOLUME beyond the SGD gradient-allreduce floor
+    sgd_bytes = sum(volumes['sgd'].values())
+    print(f'\nSGD gradient-allreduce floor: {sgd_bytes / 2**20:.2f} MiB')
+    for variant in ('eigen', 'inverse', 'eigen_dp', 'inverse_dp'):
+        extra = sum(volumes[variant].values()) - sgd_bytes
+        print(f'{variant:>12}: +{extra / 2**20:8.2f} MiB K-FAC comm per '
+              'full factor+inverse step')
+    e, edp = (sum(volumes['eigen'].values()) - sgd_bytes,
+              sum(volumes['eigen_dp'].values()) - sgd_bytes)
+    if e > 0:
+        print(f'\nDP-KFAC deletes {100 * (1 - edp / e):.0f}% of MPD '
+              "eigen's K-FAC comm volume — the FactorComm-deletion claim "
+              '(reference time_breakdown.py:27), compiler-verified')
+
+
+if __name__ == '__main__':
+    main()
