@@ -34,6 +34,31 @@ def sinusoid_position_encoding(n_position, d_model):
     return jnp.asarray(enc)
 
 
+def multi_head_attention_core(q, k, v, n_head, d_k, d_v, mask, dropout,
+                              train, dropout_rng=None):
+    """The shared scaled-dot-product multi-head core: [B, L, h*d]
+    projections in, merged [B, Lq, h*d_v] out. Used by both the dense
+    :class:`MultiHeadAttention` and the tensor-parallel
+    ``parallel.tp.TPMultiHeadAttention`` (where ``n_head`` is the LOCAL
+    head count and ``dropout_rng`` decorrelates the per-head dropout
+    across model ranks) — one definition, so the blocks cannot drift.
+    Must be called inside a linen module's ``__call__`` (the Dropout
+    submodule registers to the caller)."""
+    B, Lq = q.shape[0], q.shape[1]
+    Lk = k.shape[1]
+    q = q.reshape(B, Lq, n_head, d_k).transpose(0, 2, 1, 3)
+    k = k.reshape(B, Lk, n_head, d_k).transpose(0, 2, 1, 3)
+    v = v.reshape(B, Lk, n_head, d_v).transpose(0, 2, 1, 3)
+    attn = jnp.einsum('bhqd,bhkd->bhqk', q, k) / math.sqrt(d_k)
+    if mask is not None:
+        attn = jnp.where(mask, attn, -1e9)
+    attn = jax.nn.softmax(attn, axis=-1)
+    attn = linen.Dropout(dropout, deterministic=not train)(
+        attn, rng=dropout_rng)
+    out = jnp.einsum('bhqk,bhkd->bhqd', attn, v)
+    return out.transpose(0, 2, 1, 3).reshape(B, Lq, n_head * d_v)
+
+
 class MultiHeadAttention(linen.Module):
     """Post-norm multi-head attention (reference:
     examples/transformer/SubLayers.py:11-61)."""
@@ -50,18 +75,8 @@ class MultiHeadAttention(linen.Module):
         q = knn.Dense(h * dk, use_bias=False, name='w_q')(q_in)
         k = knn.Dense(h * dk, use_bias=False, name='w_k')(k_in)
         v = knn.Dense(h * dv, use_bias=False, name='w_v')(v_in)
-        B, Lq = q.shape[0], q.shape[1]
-        Lk = k.shape[1]
-        q = q.reshape(B, Lq, h, dk).transpose(0, 2, 1, 3)
-        k = k.reshape(B, Lk, h, dk).transpose(0, 2, 1, 3)
-        v = v.reshape(B, Lk, h, dv).transpose(0, 2, 1, 3)
-        attn = jnp.einsum('bhqd,bhkd->bhqk', q, k) / math.sqrt(dk)
-        if mask is not None:
-            attn = jnp.where(mask, attn, -1e9)
-        attn = jax.nn.softmax(attn, axis=-1)
-        attn = linen.Dropout(self.dropout, deterministic=not train)(attn)
-        out = jnp.einsum('bhqk,bhkd->bhqd', attn, v)
-        out = out.transpose(0, 2, 1, 3).reshape(B, Lq, h * dv)
+        out = multi_head_attention_core(q, k, v, h, dk, dv, mask,
+                                        self.dropout, train)
         out = knn.Dense(self.d_model, use_bias=False, name='w_o')(out)
         out = linen.Dropout(self.dropout, deterministic=not train)(out)
         out = linen.LayerNorm(epsilon=1e-6, name='ln')(out + residual)
@@ -76,6 +91,8 @@ class PositionwiseFFN(linen.Module):
 
     @linen.compact
     def __call__(self, x, train=True):
+        # KEEP IN SYNC with parallel/tp.TPPositionwiseFFN (same body,
+        # tensor-sharded dense layers)
         residual = x
         h = knn.Dense(self.d_inner, name='w_1')(x)
         h = linen.relu(h)

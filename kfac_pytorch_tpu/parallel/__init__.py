@@ -29,6 +29,9 @@ from kfac_pytorch_tpu.parallel.ring_attention import (
 from kfac_pytorch_tpu.parallel.tp import (
     ColumnParallelDense,
     RowParallelDense,
+    TPMultiHeadAttention,
+    TPPositionwiseFFN,
+    TPEncoderLayer,
 )
 
 __all__ = [
@@ -38,4 +41,5 @@ __all__ = [
     'make_mesh', 'data_parallel_specs',
     'ring_attention', 'ulysses_attention',
     'ColumnParallelDense', 'RowParallelDense',
+    'TPMultiHeadAttention', 'TPPositionwiseFFN', 'TPEncoderLayer',
 ]

@@ -41,6 +41,7 @@ per-slice oracles.
 from typing import Any, Callable, Optional
 
 import flax.linen as linen
+import jax
 import jax.numpy as jnp
 
 from kfac_pytorch_tpu import nn as knn
@@ -101,3 +102,102 @@ class RowParallelDense(linen.Module):
                               (self.features,), self.param_dtype)
             y = y + bias
         return y
+
+
+class TPMultiHeadAttention(linen.Module):
+    """Megatron-sharded post-norm multi-head attention: the HEADS are
+    sharded over ``axis`` (``n_head_per_shard`` local heads; global head
+    count = local x axis size). Q/K/V projections are column-parallel
+    (each rank projects only its heads), the attention math is
+    rank-local (heads are independent — zero cross-rank communication),
+    and the output projection is row-parallel (one psum rebuilds the
+    full d_model output). Mirrors models/transformer.MultiHeadAttention
+    (reference examples/transformer/SubLayers.py:11-61) with identical
+    math at any shard count — parity pinned by tests/test_tp.py."""
+    n_head_per_shard: int
+    d_model: int
+    d_k: int
+    d_v: int
+    axis: Optional[str] = 'model'
+    dropout: float = 0.1
+
+    @linen.compact
+    def __call__(self, q_in, k_in, v_in, mask=None, train=True):
+        from kfac_pytorch_tpu.models.transformer import (
+            multi_head_attention_core)
+        h, dk, dv = self.n_head_per_shard, self.d_k, self.d_v
+        residual = q_in
+        q = ColumnParallelDense(h * dk, axis=self.axis, use_bias=False,
+                                name='w_q')(q_in)
+        k = ColumnParallelDense(h * dk, axis=self.axis, use_bias=False,
+                                name='w_k')(k_in)
+        v = ColumnParallelDense(h * dv, axis=self.axis, use_bias=False,
+                                name='w_v')(v_in)
+        # the attention-probability dropout must draw an INDEPENDENT mask
+        # per model rank (each rank holds different global heads — the
+        # dense block draws per-head masks, so sharing one mask across
+        # ranks would correlate head groups and make training depend on
+        # the shard count); fold the rank index into the rng. The
+        # post-projection dropout below runs on the REPLICATED tensor and
+        # must keep the shared key (identical mask on every rank).
+        drop_rng = None
+        if train and self.dropout > 0.0:
+            drop_rng = jax.random.fold_in(self.make_rng('dropout'),
+                                          coll.axis_index(self.axis))
+        out = multi_head_attention_core(q, k, v, h, dk, dv, mask,
+                                        self.dropout, train,
+                                        dropout_rng=drop_rng)
+        out = RowParallelDense(self.d_model, axis=self.axis,
+                               use_bias=False, name='w_o')(out)
+        out = linen.Dropout(self.dropout, deterministic=not train)(out)
+        return linen.LayerNorm(epsilon=1e-6, name='ln')(out + residual)
+
+
+class TPPositionwiseFFN(linen.Module):
+    """Megatron-sharded post-norm FFN: column-parallel up-projection
+    (``d_inner_per_shard`` local hidden units), rank-local relu,
+    row-parallel down-projection. Mirrors
+    models/transformer.PositionwiseFFN (reference SubLayers.py:135-162);
+    w_2's bias is added once after the reduction (Megatron
+    reduce-then-bias, outside the slice's K-FAC factor)."""
+    d_model: int
+    d_inner_per_shard: int
+    axis: Optional[str] = 'model'
+    dropout: float = 0.1
+
+    @linen.compact
+    def __call__(self, x, train=True):
+        # KEEP IN SYNC with models/transformer.PositionwiseFFN — same
+        # body with the dense layers swapped for the parallel primitives
+        # (tests/test_tp.py pins the exact equivalence)
+        residual = x
+        h = ColumnParallelDense(self.d_inner_per_shard, axis=self.axis,
+                                name='w_1')(x)
+        h = linen.relu(h)
+        h = RowParallelDense(self.d_model, axis=self.axis, name='w_2')(h)
+        h = linen.Dropout(self.dropout, deterministic=not train)(h)
+        return linen.LayerNorm(epsilon=1e-6, name='ln')(h + residual)
+
+
+class TPEncoderLayer(linen.Module):
+    """models/transformer.EncoderLayer with both sublayers tensor-sharded
+    over ``axis`` — the full Megatron transformer block. Per-slice K-FAC
+    applies unchanged (the sublayers are built from the Column/Row
+    primitives whose factor semantics tests/test_tp.py pins)."""
+    d_model: int
+    d_inner_per_shard: int
+    n_head_per_shard: int
+    d_k: int
+    d_v: int
+    axis: Optional[str] = 'model'
+    dropout: float = 0.1
+
+    @linen.compact
+    def __call__(self, x, mask=None, train=True):
+        x = TPMultiHeadAttention(self.n_head_per_shard, self.d_model,
+                                 self.d_k, self.d_v, axis=self.axis,
+                                 dropout=self.dropout,
+                                 name='self_attn')(x, x, x, mask, train)
+        return TPPositionwiseFFN(self.d_model, self.d_inner_per_shard,
+                                 axis=self.axis, dropout=self.dropout,
+                                 name='ffn')(x, train)

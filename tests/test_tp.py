@@ -271,3 +271,165 @@ def test_dp_tp_kfac_matches_model_only_full_batch():
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4),
         got, want)
+
+
+# ---------------------------------------------------------------------------
+# Megatron transformer block
+# ---------------------------------------------------------------------------
+
+TD, TH, TDK, TDI, TL = 16, 4, 4, 32, 6   # d_model, heads, d_k=d_v, d_inner, L
+TH_L, TDI_L = TH // NM, TDI // NM
+
+TP_BLOCK_SPECS = {
+    'self_attn': {
+        'w_q': {'slice': {'kernel': P(None, 'model')}},
+        'w_k': {'slice': {'kernel': P(None, 'model')}},
+        'w_v': {'slice': {'kernel': P(None, 'model')}},
+        'w_o': {'slice': {'kernel': P('model', None)}},
+        'ln': {'scale': P(), 'bias': P()}},
+    'ffn': {
+        'w_1': {'slice': {'kernel': P(None, 'model'), 'bias': P('model')}},
+        'w_2': {'slice': {'kernel': P('model', None)}, 'bias': P()},
+        'ln': {'scale': P(), 'bias': P()}},
+}
+
+
+def _block_data(seed=3):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(B, TL, TD), jnp.float32)
+
+
+def _plain_block_params(seed=4):
+    from kfac_pytorch_tpu.models.transformer import EncoderLayer
+    plain = EncoderLayer(TD, TDI, TH, TDK, TDK, dropout=0.0)
+    params = plain.init(jax.random.PRNGKey(seed), _block_data(), None,
+                        train=False)['params']
+    return plain, params
+
+
+def _tp_block_params(pp):
+    """Global TP-structured params from the plain block's (head-block
+    column slicing is contiguous, so the full arrays transfer as-is)."""
+    a, f = pp['self_attn'], pp['ffn']
+    return {
+        'self_attn': {
+            'w_q': {'slice': {'kernel': a['w_q']['kernel']}},
+            'w_k': {'slice': {'kernel': a['w_k']['kernel']}},
+            'w_v': {'slice': {'kernel': a['w_v']['kernel']}},
+            'w_o': {'slice': {'kernel': a['w_o']['kernel']}},
+            'ln': dict(a['ln'])},
+        'ffn': {
+            'w_1': {'slice': {'kernel': f['w_1']['kernel'],
+                              'bias': f['w_1']['bias']}},
+            'w_2': {'slice': {'kernel': f['w_2']['kernel']},
+                    'bias': f['w_2']['bias']},
+            'ln': dict(f['ln'])},
+    }
+
+
+def test_tp_encoder_block_matches_dense_block():
+    """The full Megatron block (sharded attention heads + sharded FFN)
+    reproduces models/transformer.EncoderLayer exactly — outputs AND the
+    parameter gradients (slices thereof) on a 2-rank model mesh."""
+    x = _block_data()
+    plain, pp = _plain_block_params()
+    tpp = _tp_block_params(pp)
+    block = tp.TPEncoderLayer(TD, TDI_L, TH_L, TDK, TDK, dropout=0.0)
+
+    @functools.partial(jax.shard_map, mesh=_model_mesh(),
+                       in_specs=(TP_BLOCK_SPECS, P()),
+                       out_specs=(P(), TP_BLOCK_SPECS))
+    def fwd_bwd(params, x):
+        def loss_fn(p):
+            out = block.apply({'params': p}, x, None, train=False)
+            return (out ** 2).mean(), out
+        (loss, out), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return loss, grads
+
+    loss_tp, grads_tp = fwd_bwd(tpp, x)
+
+    def plain_loss(p):
+        out = plain.apply({'params': p}, x, None, train=False)
+        return (out ** 2).mean()
+
+    loss_pl, grads_pl = jax.value_and_grad(plain_loss)(pp)
+    np.testing.assert_allclose(float(loss_tp), float(loss_pl), rtol=1e-6)
+    flat_tp = _tp_block_params(grads_pl)  # plain grads in TP layout
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        grads_tp, flat_tp)
+
+
+def test_tp_encoder_block_kfac_dp_tp_invariance():
+    """One K-FAC step on the Megatron block over a 2x2 ('data', 'model')
+    mesh (MPD 'eigen' over the data axis) equals the model-only mesh run
+    on the full batch — data sharding must not change the math, with the
+    TP block's full capture set (6 sliced dense sublayers) in play."""
+    ND = 2
+    x = _block_data()
+    y = _block_data(seed=9)  # regression target
+    _, pp = _plain_block_params()
+    tpp = _tp_block_params(pp)
+    block = tp.TPEncoderLayer(TD, TDI_L, TH_L, TDK, TDK, dropout=0.0)
+    local = tp.TPEncoderLayer(TD, TDI_L, TH_L, TDK, TDK, axis=None,
+                              dropout=0.0)
+
+    def mse(out, target):
+        return ((out - target) ** 2).mean()
+
+    def make_pre(nd, axis):
+        pre = kfac.KFAC(variant='eigen', lr=LR, damping=DAMPING,
+                        fac_update_freq=1, kfac_update_freq=1,
+                        num_devices=nd, axis_name=axis)
+        variables = capture.init(local, jax.random.PRNGKey(0), x,
+                                 None, train=False)
+        pre.setup(capture.collect_layer_meta(local, variables, x, None,
+                                             train=False))
+        return pre
+
+    pre_dp = make_pre(ND, 'data')
+    kstate = jax.tree.map(lambda a: jnp.stack([a] * NM), pre_dp.init())
+    kspecs = jax.tree.map(lambda s: P('model', *s),
+                          pre_dp.state_pspecs('data'),
+                          is_leaf=lambda v: isinstance(v, P))
+    mesh = Mesh(np.array(jax.devices()[:ND * NM]).reshape(ND, NM),
+                ('data', 'model'))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(TP_BLOCK_SPECS, kspecs, P('data'), P('data')),
+        out_specs=TP_BLOCK_SPECS)
+    def dp_tp_step(params, kstate, x, y):
+        _, _, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+            block, lambda out: mse(out, y), {'params': params}, x, None,
+            train=False, axis_name=('data', 'model'))
+        grads = kfac.parallel.average_grads(grads, 'data')
+        k = jax.tree.map(lambda a: a[0], kstate)
+        new_grads, _ = pre_dp.step(k, grads, acts, gs, axis_name='data')
+        return new_grads
+
+    got = dp_tp_step(tpp, kstate, x, y)
+
+    pre_1 = make_pre(1, None)
+    k1 = jax.tree.map(lambda a: jnp.stack([a] * NM), pre_1.init())
+
+    @functools.partial(jax.shard_map, mesh=_model_mesh(),
+                       in_specs=(TP_BLOCK_SPECS,
+                                 jax.tree.map(lambda _: P('model'), k1),
+                                 P(), P()),
+                       out_specs=TP_BLOCK_SPECS)
+    def tp_step(params, kstate, x, y):
+        _, _, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+            block, lambda out: mse(out, y), {'params': params}, x, None,
+            train=False, axis_name='model')
+        k = jax.tree.map(lambda a: a[0], kstate)
+        new_grads, _ = pre_1.step(k, grads, acts, gs)
+        return new_grads
+
+    want = tp_step(tpp, k1, x, y)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-4),
+        got, want)
