@@ -119,6 +119,8 @@ class TPMultiHeadAttention(linen.Module):
     d_k: int
     d_v: int
     axis: Optional[str] = 'model'
+    seq_axis: Optional[str] = None
+    causal: bool = False
     dropout: float = 0.1
 
     @linen.compact
@@ -133,20 +135,57 @@ class TPMultiHeadAttention(linen.Module):
                                 name='w_k')(k_in)
         v = ColumnParallelDense(h * dv, axis=self.axis, use_bias=False,
                                 name='w_v')(v_in)
-        # the attention-probability dropout must draw an INDEPENDENT mask
-        # per model rank (each rank holds different global heads — the
-        # dense block draws per-head masks, so sharing one mask across
-        # ranks would correlate head groups and make training depend on
-        # the shard count); fold the rank index into the rng. The
-        # post-projection dropout below runs on the REPLICATED tensor and
-        # must keep the shared key (identical mask on every rank).
-        drop_rng = None
-        if train and self.dropout > 0.0:
-            drop_rng = jax.random.fold_in(self.make_rng('dropout'),
-                                          coll.axis_index(self.axis))
-        out = multi_head_attention_core(q, k, v, h, dk, dv, mask,
-                                        self.dropout, train,
-                                        dropout_rng=drop_rng)
+        if self.seq_axis is not None:
+            # sequence-sharded path: the local heads run EXACT ring
+            # attention over the seq axis (K/V shards rotate over ICI,
+            # parallel/ring_attention.py) — heads x sequence x data, a
+            # 3-D ('data', 'seq', 'model') mesh in one block. ``mask``
+            # here is the key-padding mask [B, Lk_local] (True=attend)
+            # or None; attention-probability dropout is unsupported in
+            # the streamed softmax (reference parity holds in the
+            # dropout-free regime the bench/eval paths use).
+            if train and self.dropout > 0.0:
+                raise ValueError('seq_axis attention has no '
+                                 'probability-dropout (streamed softmax)'
+                                 '; set dropout=0 or train=False')
+            if mask is not None and mask.ndim != 2:
+                raise ValueError(
+                    'seq_axis attention takes a [B, Lk_local] key-padding '
+                    f'mask, got ndim={mask.ndim} — full [.., Lq, Lk] '
+                    'attention masks are the dense-path contract')
+            from kfac_pytorch_tpu.parallel.ring_attention import (
+                ring_attention)
+            B, Lq = q.shape[0], q.shape[1]
+            qh = q.reshape(B, Lq, h, dk).transpose(0, 2, 1, 3)
+            kh = k.reshape(B, -1, h, dk).transpose(0, 2, 1, 3)
+            vh = v.reshape(B, -1, h, dv).transpose(0, 2, 1, 3)
+            o = ring_attention(qh, kh, vh, axis_name=self.seq_axis,
+                               causal=self.causal, kv_mask=mask)
+            out = o.transpose(0, 2, 1, 3).reshape(B, Lq, h * dv)
+        else:
+            # the attention-probability dropout must draw an INDEPENDENT
+            # mask per model rank (each rank holds different global heads
+            # — the dense block draws per-head masks, so sharing one mask
+            # across ranks would correlate head groups and make training
+            # depend on the shard count); fold the rank index into the
+            # rng. The post-projection dropout below runs on the
+            # REPLICATED tensor and must keep the shared key (identical
+            # mask on every rank).
+            drop_rng = None
+            if train and self.dropout > 0.0:
+                drop_rng = jax.random.fold_in(self.make_rng('dropout'),
+                                              coll.axis_index(self.axis))
+            att_mask = mask
+            if self.causal:
+                # causal must mean the same thing on every shard config —
+                # the seq path streams it, the dense path applies it here
+                cm = jnp.tril(jnp.ones((q_in.shape[1], k_in.shape[1]),
+                                       bool))[None, None]
+                att_mask = cm if mask is None else jnp.logical_and(mask,
+                                                                   cm)
+            out = multi_head_attention_core(q, k, v, h, dk, dv, att_mask,
+                                            self.dropout, train,
+                                            dropout_rng=drop_rng)
         out = RowParallelDense(self.d_model, axis=self.axis,
                                use_bias=False, name='w_o')(out)
         out = linen.Dropout(self.dropout, deterministic=not train)(out)
@@ -190,12 +229,16 @@ class TPEncoderLayer(linen.Module):
     d_k: int
     d_v: int
     axis: Optional[str] = 'model'
+    seq_axis: Optional[str] = None
+    causal: bool = False
     dropout: float = 0.1
 
     @linen.compact
     def __call__(self, x, mask=None, train=True):
         x = TPMultiHeadAttention(self.n_head_per_shard, self.d_model,
                                  self.d_k, self.d_v, axis=self.axis,
+                                 seq_axis=self.seq_axis,
+                                 causal=self.causal,
                                  dropout=self.dropout,
                                  name='self_attn')(x, x, x, mask, train)
         return TPPositionwiseFFN(self.d_model, self.d_inner_per_shard,

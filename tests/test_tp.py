@@ -433,3 +433,52 @@ def test_tp_encoder_block_kfac_dp_tp_invariance():
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-4),
         got, want)
+
+
+def test_tp_sp_block_3axis_matches_dense_block():
+    """The FULL 3-D mesh: ('data', 'seq', 'model') 2x2x2 — batch sharded
+    over data, tokens over seq (exact ring attention rotates K/V per
+    local head group), heads+FFN over model. Output and grad slices must
+    equal the dense EncoderLayer on the full batch, causal masking on."""
+    ND, NS = 2, 2
+    x = _block_data()          # [B, TL, TD]; TL=6 splits over NS=2
+    plain, pp = _plain_block_params()
+    tpp = _tp_block_params(pp)
+    block = tp.TPEncoderLayer(TD, TDI_L, TH_L, TDK, TDK, seq_axis='seq',
+                              causal=True, dropout=0.0)
+    mesh = Mesh(np.array(jax.devices()[:ND * NS * NM]).reshape(ND, NS, NM),
+                ('data', 'seq', 'model'))
+    xspec = P('data', 'seq')
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(TP_BLOCK_SPECS, xspec),
+                       out_specs=(xspec, TP_BLOCK_SPECS))
+    def fwd_bwd(params, x):
+        def loss_fn(p):
+            out = block.apply({'params': p}, x, None, train=False)
+            # global-mean loss: local sum / global count, then psum —
+            # invariant over all three axes
+            s = (out ** 2).sum() / (B * TL * TD)
+            return jax.lax.psum(s, ('data', 'seq')), out
+        (loss, out), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        del loss
+        return out, grads
+
+    out_tp, grads_tp = fwd_bwd(tpp, x)
+
+    # dense oracle: the same math with a causal mask
+    causal = jnp.tril(jnp.ones((TL, TL), bool))[None, None]
+
+    def plain_loss(p):
+        out = plain.apply({'params': p}, x, causal, train=False)
+        return (out ** 2).mean(), out
+
+    (_, out_pl), grads_pl = jax.value_and_grad(
+        plain_loss, has_aux=True)(pp)
+    np.testing.assert_allclose(np.asarray(out_tp), np.asarray(out_pl),
+                               rtol=2e-4, atol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
+        grads_tp, _tp_block_params(grads_pl))
