@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh
+axis, differentiated THROUGH the collective.
+
+The reference has no pipeline parallelism (its models fit one GPU). On
+TPU the natural implementation is SPMD: every rank runs the same
+``lax.scan`` of ticks; at tick ``t`` rank ``i`` processes microbatch
+``t - i`` (the GPipe schedule, bubbles included), and activations hop to
+the next stage with ONE ``lax.ppermute`` per tick (neighbor traffic —
+rides a single ICI hop on a ring mesh). The backward pass needs no
+hand-written schedule at all: ``jax.grad`` of a ppermute is the reversed
+ppermute, so differentiating the forward scan IS the reverse pipeline —
+cotangents hop backward stage-to-stage with the same bubble structure.
+
+Scope: homogeneous stages (equal activation widths between stages — each
+stage is e.g. one transformer block or one equal-width MLP segment) and
+last-stage outputs. Bubble ticks compute garbage that is masked out of
+the collected outputs, so their cotangents are exactly zero and
+gradients equal the unpipelined model's (pinned by
+tests/test_pipeline.py against the sequential composition).
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(stage_apply: Callable, params_local, x, n_microbatches,
+          axis_name):
+    """Run ``n_microbatches`` through an S-stage pipeline over
+    ``axis_name``; must be called inside shard_map over that axis.
+
+    Args:
+      stage_apply: ``stage_apply(params_local, h) -> h`` — THIS rank's
+        stage. Activation shape must be identical between stages.
+      params_local: this rank's stage parameters (pytree; sharded over
+        ``axis_name`` by the caller's in_specs).
+      x: ``[B, ...]`` the full local batch (consumed at stage 0; other
+        ranks ignore it). B must divide by ``n_microbatches``.
+      n_microbatches: M >= 1; the bubble fraction is (S-1)/(M+S-1).
+      axis_name: the pipeline mesh axis.
+
+    Returns ``[B, ...]`` outputs in input order, valid on the LAST stage
+    rank (other ranks return zeros — psum or gather as needed).
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = n_microbatches
+    B = x.shape[0]
+    assert B % M == 0, (B, M)
+    mb = x.reshape(M, B // M, *x.shape[1:])
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(h_in, t):
+        # stage 0 injects microbatch t (clamped; ticks >= M re-inject the
+        # last microbatch and are masked out of the outputs), later
+        # stages consume the activation that hopped in last tick
+        x_t = lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M - 1), axis=0,
+                                       keepdims=False)
+        h = jnp.where(idx == 0, x_t, h_in)
+        h = stage_apply(params_local, h)
+        # collect at the last stage: tick t completes microbatch t-(S-1)
+        valid = jnp.logical_and(idx == S - 1,
+                                jnp.logical_and(t >= S - 1, t <= M + S - 2))
+        out_t = jnp.where(valid, h, 0)
+        h_next = lax.ppermute(h, axis_name, fwd_perm)
+        return h_next, out_t
+
+    # the carry must hold the full varying set of the loop (x's axes,
+    # e.g. 'data', AND the stage params' pipeline axis) so the scan
+    # carry type is stable under shard_map's vma checker: derive the
+    # zeros from the input AND every params leaf (a single leaf could
+    # miss axes that only other leaves vary over; zero leaves also keeps
+    # a stateless stage working)
+    h0 = 0 * mb[0]
+    h0 = h0 + sum(jax.tree.leaves(jax.tree.map(
+        lambda p: 0 * p.reshape(-1)[0], params_local)), jnp.float32(0))
+    _, outs = lax.scan(tick, h0, jnp.arange(M + S - 1))
+    # outs: [T, Bm, ...]; microbatch m sits at tick m + S - 1
+    outs = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
+    return outs.reshape(B, *outs.shape[2:])

@@ -1,0 +1,105 @@
+"""GPipe pipeline (parallel/pipeline.py) on the CPU mesh: the pipelined
+model must equal the sequential composition exactly — outputs, loss, and
+every stage's parameter gradients (the backward schedule is autodiff
+through the ppermuted forward scan, so this pins that whole mechanism)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kfac_pytorch_tpu import nn as knn
+from kfac_pytorch_tpu.parallel.pipeline import gpipe
+
+S, M, B, D = 4, 8, 16, 12   # stages, microbatches, batch, width
+
+
+class Stage(linen.Module):
+    """One homogeneous pipeline stage: Dense + gelu (width-preserving)."""
+    @linen.compact
+    def __call__(self, h):
+        return jax.nn.gelu(knn.Dense(D, name='fc')(h))
+
+
+def _params(seed):
+    rng = np.random.RandomState(seed)
+    return {'fc': {'kernel': jnp.asarray(rng.randn(D, D) * 0.4,
+                                         jnp.float32),
+                   'bias': jnp.asarray(rng.randn(D) * 0.1, jnp.float32)}}
+
+
+def test_gpipe_matches_sequential():
+    x = jnp.asarray(np.random.RandomState(0).randn(B, D), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randn(B, D), jnp.float32)
+    stage = Stage()
+    stacked = jax.tree.map(lambda *a: jnp.stack(a),
+                           *[_params(i) for i in range(S)])
+    mesh = Mesh(np.array(jax.devices()[:S]), ('pipe',))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P('pipe'), stacked), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P('pipe'), stacked)))
+    def piped(params_stacked, x, y):
+        params = jax.tree.map(lambda a: a[0], params_stacked)
+
+        def loss_fn(p):
+            out = gpipe(lambda pp, h: stage.apply({'params': pp}, h),
+                        p, x, M, 'pipe')
+            # outputs are valid on the LAST stage only (zeros elsewhere):
+            # the loss must be computed there alone, then psum-replicated
+            err = ((out - y) ** 2).mean()
+            err = jnp.where(jax.lax.axis_index('pipe') == S - 1, err, 0.0)
+            return jax.lax.psum(err, 'pipe')
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return loss, jax.tree.map(lambda a: a[None], grads)
+
+    loss_p, grads_p = piped(stacked, x, y)
+
+    def seq_loss(params_stacked):
+        h = x
+        for i in range(S):
+            p = jax.tree.map(lambda a: a[i], params_stacked)
+            h = stage.apply({'params': p}, h)
+        return ((h - y) ** 2).mean()
+
+    loss_s, grads_s = jax.value_and_grad(seq_loss)(stacked)
+    np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        grads_p, grads_s)
+
+
+def test_gpipe_single_microbatch_and_order():
+    """M=1 (pure model parallelism, maximal bubble) still matches, and
+    outputs come back in input order for M > 1."""
+    x = jnp.asarray(np.random.RandomState(2).randn(B, D), jnp.float32)
+    stage = Stage()
+    stacked = jax.tree.map(lambda *a: jnp.stack(a),
+                           *[_params(10 + i) for i in range(S)])
+    mesh = Mesh(np.array(jax.devices()[:S]), ('pipe',))
+
+    def run(m):
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P('pipe'), stacked), P()),
+            out_specs=P())
+        def piped(params_stacked, x):
+            params = jax.tree.map(lambda a: a[0], params_stacked)
+            out = gpipe(lambda pp, h: stage.apply({'params': pp}, h),
+                        params, x, m, 'pipe')
+            return jax.lax.psum(out, 'pipe')  # valid only on last stage
+        return piped(stacked, x)
+
+    h = x
+    for i in range(S):
+        p = jax.tree.map(lambda a: a[i], stacked)
+        h = stage.apply({'params': p}, h)
+    for m in (1, 2, 8):
+        np.testing.assert_allclose(np.asarray(run(m)), np.asarray(h),
+                                   rtol=1e-5, atol=1e-6)
