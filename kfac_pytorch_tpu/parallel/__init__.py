@@ -26,6 +26,7 @@ from kfac_pytorch_tpu.parallel.ring_attention import (
     ring_attention,
     ulysses_attention,
 )
+from kfac_pytorch_tpu.parallel.moe import ExpertFFN, SwitchMoE
 from kfac_pytorch_tpu.parallel.pipeline import gpipe
 from kfac_pytorch_tpu.parallel.tp import (
     ColumnParallelDense,
@@ -43,5 +44,5 @@ __all__ = [
     'ring_attention', 'ulysses_attention',
     'ColumnParallelDense', 'RowParallelDense',
     'TPMultiHeadAttention', 'TPPositionwiseFFN', 'TPEncoderLayer',
-    'gpipe',
+    'gpipe', 'ExpertFFN', 'SwitchMoE',
 ]

@@ -1,0 +1,102 @@
+"""Expert parallelism: Switch-style top-1 mixture-of-experts with
+``all_to_all`` token dispatch over an 'expert' mesh axis.
+
+The reference has no MoE/expert parallelism. The TPU-native shape: one
+expert FFN per mesh rank; each rank's local tokens are routed by a
+(replicated) top-1 gate, packed into per-expert slots, exchanged with
+TWO ``lax.all_to_all``s (dispatch and return — the canonical EP
+collective pattern), processed by the rank-local expert, and combined
+scaled by the gate probability.
+
+K-FAC composes per-expert: the expert's Dense layers are ordinary
+capture layers, so each rank's factors are computed from the token batch
+ITS expert actually processed — owner-local (DP-KFAC-style) semantics
+over the expert axis, with the data axis as the K-FAC world exactly as
+in ``parallel/tp.py``. Padded (empty) slots are zero rows: they add
+nothing to the G moments or the kernel block of A, but the bias-
+augmentation column (ops.compute_a_dense appends ones) gives each empty
+slot a unit contribution to A's bias-bias entry — so run EP K-FAC with
+capacity sized near the actual load, or the bias coordinate of the
+preconditioner is damped proportionally to the empty-slot fraction.
+
+Capacity: ``capacity`` slots per (local rank -> expert) pair. With
+``capacity = local token count`` no token can ever drop and the layer is
+EXACTLY the dense computation ``y_t = p_t * FFN_{e_t}(x_t)`` (pinned by
+tests/test_moe.py); smaller capacities drop overflow tokens to zero
+output (standard Switch behavior, the memory/compute knob).
+"""
+
+from typing import Optional
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kfac_pytorch_tpu import nn as knn
+
+
+class ExpertFFN(linen.Module):
+    """One expert: Dense -> gelu -> Dense, both K-FAC capture layers."""
+    d_model: int
+    d_hidden: int
+
+    @linen.compact
+    def __call__(self, x):
+        h = jax.nn.gelu(knn.Dense(self.d_hidden, name='w_in')(x))
+        return knn.Dense(self.d_model, name='w_out')(h)
+
+
+class SwitchMoE(linen.Module):
+    """Top-1 routed MoE over ``axis`` (one expert per rank).
+
+    Input ``[T_local, d_model]`` tokens (flatten batch x sequence first);
+    output the same shape. The gate is a replicated plain Dense (not
+    K-FAC-captured — its K-FAC treatment would need the router's
+    load-balancing loss machinery; SGD-updated like LayerNorms). Returns
+    ``(y, aux)`` with ``aux['gate_probs']`` for an optional
+    load-balancing loss.
+
+    ``axis=None`` degenerates to a single local expert (world=1 path,
+    same convention as the rest of ``parallel/``)."""
+    d_model: int
+    d_hidden: int
+    capacity: int
+    axis: Optional[str] = 'expert'
+
+    @linen.compact
+    def __call__(self, x):
+        T, d = x.shape
+        n = 1 if self.axis is None else lax.axis_size(self.axis)
+        C = self.capacity
+        logits = linen.Dense(n, name='gate')(x)          # [T, n]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)              # [T]
+        p_top = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+        # slot position of each token within its expert's local buffer
+        onehot = jax.nn.one_hot(expert, n, dtype=jnp.int32)   # [T, n]
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1         # [T, n]
+        slot = pos.max(axis=-1)                               # [T]
+        keep = slot < C                                       # overflow drops
+        # dispatch tensor [T, n, C]: token t -> (expert e_t, slot)
+        disp = (jax.nn.one_hot(expert, n)[:, :, None]
+                * jax.nn.one_hot(jnp.where(keep, slot, 0), C)[:, None, :]
+                * keep[:, None, None])
+        xbuf = jnp.einsum('tec,td->ecd', disp, x)             # [n, C, d]
+
+        if self.axis is not None:
+            # dispatch all_to_all: rank r sends xbuf[e] to rank e and
+            # receives every rank's buffer for ITS expert -> [n, C, d]
+            # (n source ranks x C slots each)
+            xbuf = lax.all_to_all(xbuf, self.axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+        ybuf = ExpertFFN(self.d_model, self.d_hidden,
+                         name='expert')(xbuf.reshape(-1, d))
+        ybuf = ybuf.reshape(-1, C, d)
+        if self.axis is not None:
+            # return all_to_all: send each source rank its tokens back
+            ybuf = lax.all_to_all(ybuf, self.axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+        y = jnp.einsum('tec,ecd->td', disp, ybuf)
+        return y * p_top[:, None], {'gate_probs': probs, 'dropped': ~keep}
