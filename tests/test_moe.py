@@ -118,3 +118,90 @@ def test_switch_moe_capacity_drops_zero():
     assert bool(aux['dropped'][1:].all()) is True
     np.testing.assert_array_equal(np.asarray(y[1:]), 0)
     assert np.abs(np.asarray(y[0])).max() > 0
+
+def test_moe_kfac_dp_ep_exact():
+    """One K-FAC step on a (1, 2) ('data', 'expert') mesh equals the
+    expert-mesh-only run EXACTLY: the EP composition (token routing +
+    all_to_all dispatch + per-expert capture + the engine) adds no
+    numerical difference. (The nd>=2 K-FAC world under an orthogonal
+    expert axis is a separate cross-mesh invariance question — the
+    factor stats were verified equal there but the MPD-eigen gather
+    path's invariance is unconfirmed; tracked in NOTES.md round 3.)"""
+    import kfac_pytorch_tpu as kfac
+    from kfac_pytorch_tpu import capture
+
+    ND, NE2 = 2, 2
+    T = NE2 * TL
+    x = jnp.asarray(np.random.RandomState(5).randn(ND * T, D), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(6).randn(ND * T, D), jnp.float32)
+    gate, experts, stacked = _params(11)
+    gate = {'kernel': gate['kernel'][:, :NE2], 'bias': gate['bias'][:NE2]}
+    stacked2 = jax.tree.map(lambda a: a[:NE2], stacked)
+    local = SwitchMoE(D, DH, capacity=T, axis=None)
+
+    def make_pre(nd, axis):
+        pre = kfac.KFAC(variant='eigen', lr=0.1, damping=0.01,
+                        fac_update_freq=1, kfac_update_freq=1,
+                        num_devices=nd, axis_name=axis)
+        xs = x[:T]
+        variables = capture.init(local, jax.random.PRNGKey(0), xs)
+        pre.setup(capture.collect_layer_meta(local, variables, xs))
+        return pre
+
+    especs = jax.tree.map(lambda _: P('expert'), stacked2)
+    pspec = {'gate': P(), 'expert': especs}
+    params = {'gate': gate, 'expert': stacked2}
+
+    def global_mse(out, y, axes):
+        s = ((out - y) ** 2).sum() / (ND * T * D)
+        return jax.lax.psum(s, axes)
+
+    def run(mesh, axes, kfac_axis, nd, cap):
+        # capacity = the mesh's LOCAL token count: no token can drop and
+        # every expert's TOTAL buffer rows (sources x capacity, summed
+        # over the K-FAC world) are equal across meshes — the factor
+        # normalization counts buffer rows, so unequal buffers would
+        # scale the factors differently and break the invariance
+        moe = SwitchMoE(D, DH, capacity=cap, axis='expert')
+        pre = make_pre(nd, kfac_axis)
+        kstate = jax.tree.map(lambda a: jnp.stack([a] * NE2), pre.init())
+        inner = (pre.state_pspecs(kfac_axis) if kfac_axis
+                 else jax.tree.map(lambda _: P(),
+                                   pre.state_pspecs(None)))
+        kspecs = jax.tree.map(lambda s: P('expert', *s), inner,
+                              is_leaf=lambda v: isinstance(v, P))
+        xspec = P(axes) if isinstance(axes, str) else P(axes)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=({'gate': P(), 'expert': especs}, kspecs,
+                      xspec, xspec),
+            out_specs={'gate': P(), 'expert': especs})
+        def step(params, kstate, x, y):
+            local_p = {'gate': params['gate'],
+                       'expert': jax.tree.map(lambda a: a[0],
+                                              params['expert'])}
+            all_axes = (('data', 'expert') if kfac_axis else 'expert')
+            _, _, grads, acts, gs, _ = \
+                capture.value_and_grad_with_capture(
+                    moe, lambda o: global_mse(o[0], y, all_axes),
+                    {'params': local_p}, x, axis_name=all_axes)
+            k = jax.tree.map(lambda a: a[0], kstate)
+            new_grads, _ = pre.step(k, grads, acts, gs,
+                                    axis_name=kfac_axis)
+            return {'gate': new_grads['gate'],
+                    'expert': jax.tree.map(lambda a: a[None],
+                                           new_grads['expert'])}
+
+        return step(params, kstate, x, y)
+
+    total = ND * T
+    mesh_dp = Mesh(np.array(jax.devices()[:NE2]).reshape(1, NE2),
+                   ('data', 'expert'))
+    got = run(mesh_dp, ('data', 'expert'), 'data', 1, cap=total // NE2)
+    mesh_e = Mesh(np.array(jax.devices()[:NE2]), ('expert',))
+    want = run(mesh_e, 'expert', None, 1, cap=total // NE2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        got, want)
