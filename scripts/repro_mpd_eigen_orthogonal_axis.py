@@ -1,3 +1,20 @@
+"""Reproducer (round 3): MPD 'eigen' nd>=2 vs nd=1 divergence when the
+K-FAC state varies over an ORTHOGONAL mesh axis ('expert').
+
+Findings so far (all on the virtual CPU mesh):
+- (data=1, expert=2) vs expert-only: EXACT match (the EP composition is
+  sound) — pinned by tests/test_moe.py::test_moe_kfac_dp_ep_exact.
+- factor A/G moments and parameter grads: bitwise-equal across meshes.
+- VARIANT=eigen_dp: the owner rank's layers match its own-capture nd=1
+  oracle exactly; non-owner layers differ BY DESIGN (owner-local stats).
+- VARIANT=eigen (default): data-rank-0's preconditioned grads differ
+  from the in-program nd=1 engine on the same captures — the suspect is
+  the comm_inverse gather path under the orthogonal axis. Next step: a
+  layout-aware per-layer eigenpair comparison (bucket row order differs
+  between nd=1 and nd=2 plans, so raw state arrays cannot be compared).
+
+Usage: [NOKL=1] [VARIANT=eigen|eigen_dp] python scripts/repro_mpd_eigen_orthogonal_axis.py
+"""
 import sys; sys.path.insert(0, 'tests'); sys.path.insert(0, '.')
 from kfac_pytorch_tpu.utils.platform import force_host_platform
 force_host_platform("cpu", 8)
