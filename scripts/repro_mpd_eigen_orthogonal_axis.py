@@ -1,21 +1,30 @@
-"""Reproducer (round 3): MPD 'eigen' nd>=2 vs nd=1 divergence when the
-K-FAC state varies over an ORTHOGONAL mesh axis ('expert').
+"""RESOLVED case study (round 3): an apparent MPD-'eigen' nd>=2
+divergence under an ORTHOGONAL varying mesh axis ('expert') that was NOT
+an engine bug. Kept as a postmortem because both failure modes are easy
+to hit again:
 
-Findings so far (all on the virtual CPU mesh):
-- (data=1, expert=2) vs expert-only: EXACT match (the EP composition is
-  sound) — pinned by tests/test_moe.py::test_moe_kfac_dp_ep_exact.
-- factor A/G moments and parameter grads: bitwise-equal across meshes.
-- VARIANT=eigen_dp: the owner rank's layers match its own-capture nd=1
-  oracle exactly; non-owner layers differ BY DESIGN (owner-local stats).
-- VARIANT=eigen (default): data-rank-0's preconditioned grads differ
-  from the in-program nd=1 engine on the same captures — the suspect is
-  the comm_inverse gather path under the orthogonal axis. Next step: a
-  layout-aware per-layer eigenpair comparison (bucket row order differs
-  between nd=1 and nd=2 plans, so raw state arrays cannot be compared).
+1. The K-FAC capture convention is a LOCAL-mean loss. A globally
+   psum-normalized loss leaves grads and A factors equal but makes the
+   engine's G-factor scale shard-size-dependent (local cotangents x
+   local-batch scaling), so cross-mesh comparisons diverge in exactly
+   the preconditioned output while every input looks equal.
+2. `check_vma=False` on a shard_map disables vma autodiff's AUTOMATIC
+   cross-axis gradient psum — debug probes taken under it show grads
+   missing their reductions and will send the investigation sideways.
+
+With the convention respected the full nd=2 cross-mesh invariance
+passes: tests/test_moe.py::test_moe_kfac_dp_ep_invariance.
 
 Usage: [NOKL=1] [VARIANT=eigen|eigen_dp] python scripts/repro_mpd_eigen_orthogonal_axis.py
 """
 import sys; sys.path.insert(0, 'tests'); sys.path.insert(0, '.')
+print('=' * 72)
+print('POSTMORTEM REPRODUCER: the harness below DELIBERATELY commits the')
+print('two mistakes the docstring describes (global-psum loss and')
+print('check_vma=False probes) — divergent numbers in this output are the')
+print('EXPECTED broken-harness signature, NOT an engine bug. The correct-')
+print('convention invariance passes in tests/test_moe.py.')
+print('=' * 72)
 from kfac_pytorch_tpu.utils.platform import force_host_platform
 force_host_platform("cpu", 8)
 print('importing test_moe', flush=True)
