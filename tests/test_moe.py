@@ -8,7 +8,6 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from flax import linen
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kfac_pytorch_tpu.parallel.moe import ExpertFFN, SwitchMoE
@@ -154,7 +153,6 @@ def test_moe_kfac_dp_ep_invariance():
         return pre
 
     especs = jax.tree.map(lambda _: P('expert'), stacked2)
-    pspec = {'gate': P(), 'expert': especs}
     params = {'gate': gate, 'expert': stacked2}
 
 
