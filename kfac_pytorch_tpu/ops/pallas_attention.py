@@ -114,16 +114,44 @@ def _fwd_kernel(meta_ref, q_ref, k_ref, v_ref, mask_ref,
         o_ref[0] = acc_scr[...]
 
 
+_TILE_WARNED = set()
+
+
+def _warn_tile_once(key, msg):
+    if key not in _TILE_WARNED:
+        _TILE_WARNED.add(key)
+        import sys
+        print(f'kfac_pytorch_tpu: {msg}', file=sys.stderr)
+
+
 def _fwd_tile(env_var, default, length):
     """Forward tile size: the env override (KFAC_FLASH_TQ/TK) rounded
     down to a power of two, clamped to the sequence length, and halved
     until it divides it — the caller pads lengths to a multiple of 8, so
     the fallback terminates at a valid multiple-of-8 tile (Mosaic's
-    sublane constraint). TRACE-TIME knob, like KFAC_ATTN_IMPL: read when
-    the kernel is first traced for a shape and baked into the jit cache —
+    sublane constraint). Values above 1024 are clamped (the tq*tk f32
+    p-tile must fit scoped VMEM: 1024^2 ≈ 4 MiB, well under the 16 MiB
+    limit) — a sweep past 1024 would otherwise silently re-measure the
+    1024 point. TRACE-TIME knob, like KFAC_ATTN_IMPL: read when the
+    kernel is first traced for a shape and baked into the jit cache —
     set it before the first compile of a process."""
     import os
-    t = max(8, min(int(os.environ.get(env_var, default)), length))
+    raw = os.environ.get(env_var, default)
+    try:
+        req = int(raw)
+    except (TypeError, ValueError):
+        # a malformed sweep knob must degrade to the default tile, not
+        # kill the run at trace time (ADVICE r3) — but say so, or the
+        # sweep records default-tile timings under the requested label
+        req = default
+        _warn_tile_once(env_var,
+                        f'{env_var}={raw!r} is not an int — using the '
+                        f'default tile {default}')
+    if req > 1024:
+        _warn_tile_once(env_var + ':clamp',
+                        f'{env_var}={req} exceeds the VMEM tile cap — '
+                        'clamping to 1024')
+    t = max(8, min(req, 1024, length))
     t = 1 << (t.bit_length() - 1)
     while length % t and t > 8:
         t //= 2

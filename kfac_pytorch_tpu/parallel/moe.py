@@ -58,7 +58,17 @@ class SwitchMoE(linen.Module):
     load-balancing loss.
 
     ``axis=None`` degenerates to a single local expert (world=1 path,
-    same convention as the rest of ``parallel/``)."""
+    same convention as the rest of ``parallel/``).
+
+    Gradient scaling (ADVICE r3): under the local-mean-loss convention
+    (average over the DATA axis only — README "Loss conventions") the
+    expert axis ALSO shards tokens, so the cross-axis gradient psum sums
+    the ``ne`` per-shard means: gate and expert gradients (and their G
+    factors) carry an extra factor of ``axis_size('expert')`` relative
+    to a dense global-token-mean run. Consistent across mesh shapes
+    (pinned by tests/test_moe.py), but a dense-tuned learning rate does
+    NOT transfer — divide lr by the expert-axis size (or scale the loss
+    by ``1/ne``) when porting hyperparameters from a dense run."""
     d_model: int
     d_hidden: int
     capacity: int

@@ -52,12 +52,22 @@ def gpipe(stage_apply: Callable, params_local, x, n_microbatches,
     mb = x.reshape(M, B // M, *x.shape[1:])
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
 
+    # the carry dtype must be the stage OUTPUT dtype (a bf16 stage fed
+    # through an f32 carry would mismatch lax.scan's carry type): fix it
+    # abstractly, and confirm the stage is a dtype fixed point
+    out = jax.eval_shape(stage_apply, params_local,
+                         jax.ShapeDtypeStruct(mb[0].shape, mb[0].dtype))
+    out = jax.eval_shape(stage_apply, params_local,
+                         jax.ShapeDtypeStruct(out.shape, out.dtype))
+    assert out.shape == mb[0].shape, (out.shape, mb[0].shape)
+    dt = out.dtype
+
     def tick(h_in, t):
         # stage 0 injects microbatch t (clamped; ticks >= M re-inject the
         # last microbatch and are masked out of the outputs), later
         # stages consume the activation that hopped in last tick
         x_t = lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M - 1), axis=0,
-                                       keepdims=False)
+                                       keepdims=False).astype(dt)
         h = jnp.where(idx == 0, x_t, h_in)
         h = stage_apply(params_local, h)
         # collect at the last stage: tick t completes microbatch t-(S-1)
@@ -73,9 +83,10 @@ def gpipe(stage_apply: Callable, params_local, x, n_microbatches,
     # zeros from the input AND every params leaf (a single leaf could
     # miss axes that only other leaves vary over; zero leaves also keeps
     # a stateless stage working)
-    h0 = 0 * mb[0]
+    h0 = (0 * mb[0]).astype(dt)
     h0 = h0 + sum(jax.tree.leaves(jax.tree.map(
-        lambda p: 0 * p.reshape(-1)[0], params_local)), jnp.float32(0))
+        lambda p: (0 * p.reshape(-1)[0]).astype(dt), params_local)),
+        jnp.zeros((), dt))
     _, outs = lax.scan(tick, h0, jnp.arange(M + S - 1))
     # outs: [T, Bm, ...]; microbatch m sits at tick m + S - 1
     outs = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)

@@ -50,9 +50,19 @@ DTYPE_BYTES = {'f32': 4, 'bf16': 2, 'f16': 2, 'f64': 8, 's32': 4,
 _WARNED_DTYPES = set()
 
 
-def _payload_bytes(result_type):
+def _payload_bytes(result_type, kind=''):
+    shapes = SHAPE_RE.findall(result_type)
+    if kind.endswith('-start') and result_type.lstrip().startswith('('):
+        # an async -start op's tuple result is (operand aliases...,
+        # outputs..., context scalars...): counting every element roughly
+        # DOUBLES the volume (ADVICE r3). Drop the u32/s32 context
+        # scalars, then keep only the output half.
+        shapes = [s for s in shapes
+                  if not (s[1] == '' and s[0] in ('u32', 's32'))]
+        if shapes and len(shapes) % 2 == 0:
+            shapes = shapes[len(shapes) // 2:]
     total = 0
-    for dt, dims in SHAPE_RE.findall(result_type):
+    for dt, dims in shapes:
         size = DTYPE_BYTES.get(dt)
         if size is None:
             if dt not in _WARNED_DTYPES:
@@ -116,7 +126,7 @@ def collective_counts(variant, ndev=8, model_name='resnet20', model=None,
     bytes_by_kind = collections.Counter()
     for result_type, kind in COLLECTIVE_LINE_RE.findall(txt):
         counts[kind] += 1
-        bytes_by_kind[kind] += _payload_bytes(result_type)
+        bytes_by_kind[kind] += _payload_bytes(result_type, kind)
     return dict(counts), dict(bytes_by_kind)
 
 

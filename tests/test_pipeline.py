@@ -75,6 +75,38 @@ def test_gpipe_matches_sequential():
         grads_p, grads_s)
 
 
+def test_gpipe_bf16_stage():
+    """A bf16 stage (bench-model dtype) must pipeline: the scan carry is
+    resolved to the stage OUTPUT dtype (ADVICE r3 — the old f32 zero-sum
+    carry mismatched lax.scan's carry type), and the result must track
+    the f32 sequential composition to bf16 accuracy."""
+    x = jnp.asarray(np.random.RandomState(3).randn(B, D), jnp.float32)
+    stage = Stage()
+    stacked = jax.tree.map(lambda *a: jnp.stack(a),
+                           *[_params(20 + i) for i in range(S)])
+    stacked_bf = jax.tree.map(lambda a: a.astype(jnp.bfloat16), stacked)
+    mesh = Mesh(np.array(jax.devices()[:S]), ('pipe',))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P('pipe'), stacked_bf), P()),
+        out_specs=P())
+    def piped(params_stacked, x):
+        params = jax.tree.map(lambda a: a[0], params_stacked)
+        out = gpipe(lambda pp, h: stage.apply({'params': pp}, h),
+                    params, x, M, 'pipe')
+        return jax.lax.psum(out, 'pipe')
+
+    out = piped(stacked_bf, x.astype(jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16, out.dtype
+    h = x
+    for i in range(S):
+        p = jax.tree.map(lambda a: a[i], stacked)
+        h = stage.apply({'params': p}, h)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(h), rtol=0.1, atol=0.1)
+
+
 def test_gpipe_single_microbatch_and_order():
     """M=1 (pure model parallelism, maximal bubble) still matches, and
     outputs come back in input order for M > 1."""
