@@ -200,6 +200,53 @@ def apply_with_capture(model, variables, *args, taps=None, mutable=(),
     return out, acts, mutated
 
 
+def check_local_mean_loss(loss, batch, axis_name):
+    """Trace-time guard for the LOCAL-mean loss convention (free: reads
+    avals only, compiles to nothing).
+
+    The engine's G-factor scaling assumes the loss fed to the capture
+    backward is the mean over the LOCAL shard only (the reference's
+    per-rank hook semantics: each rank's backward sees that rank's
+    per-example output-gradients, kfac_preconditioner_base.py:122-130).
+    A loss that was psum/pmean-normalized across the K-FAC world scales
+    every cotangent by the shard count, so the preconditioner silently
+    depends on the mesh shape — the round-3 postmortem bug
+    (scripts/repro_mpd_eigen_orthogonal_axis.py, NOTES.md).
+
+    Detection rides shard_map's varying-manual-axes (vma) tracking: the
+    batch varies over the axes its shards differ on; a local-mean loss
+    inherits those axes, while a cross-axis pmean/psum strips them.
+    Raises ValueError on violation. No-ops where vma is unavailable
+    (outside shard_map, or ``check_vma=False`` — but beware:
+    ``check_vma=False`` ALSO disables the cross-axis cotangent psums the
+    capture relies on, the postmortem's second trap).
+    """
+    if axis_name is None:
+        return
+    axes = {axis_name} if isinstance(axis_name, str) else set(axis_name)
+
+    def vma_of(tree):
+        out = set()
+        for leaf in jax.tree.leaves(tree):
+            out |= set(getattr(jax.typeof(leaf), 'vma', ()) or ())
+        return out
+
+    missing = (vma_of(batch) & axes) - vma_of(loss)
+    if missing:
+        raise ValueError(
+            'K-FAC capture loss convention violation: the loss is '
+            f'invariant over mesh axes {sorted(missing)} that the batch '
+            'varies over — it was psum/pmean-normalized across the '
+            'K-FAC world before the capture backward. The convention is '
+            'the LOCAL-mean loss (mean over this shard only); average '
+            'the GRADIENTS over the K-FAC world instead '
+            '(parallel.average_grads). A globally-normalized loss '
+            'scales every G factor by the shard count, making the '
+            'preconditioner depend on the mesh shape. See README '
+            '"Loss conventions" and '
+            'scripts/repro_mpd_eigen_orthogonal_axis.py.')
+
+
 def value_and_grad_with_capture(model, loss_fn, variables, *args,
                                 mutable=(), wrt='params', axis_name=None,
                                 **kwargs):
@@ -209,7 +256,12 @@ def value_and_grad_with_capture(model, loss_fn, variables, *args,
     reference's forward/backward with hooks armed (one ``model(data)`` +
     ``loss.backward()``, kfac_preconditioner_base.py:122-130).
 
-    ``loss_fn(outputs)`` must return a scalar (close over targets).
+    ``loss_fn(outputs)`` must return a scalar (close over targets) and
+    MUST be the LOCAL-mean loss — the mean over this shard's examples
+    only, never psum/pmean-normalized across the mesh (see
+    :func:`check_local_mean_loss`; ``training.build_train_step`` applies
+    that guard automatically, direct harnesses should call it
+    themselves).
     Pass ``axis_name`` when calling inside shard_map over a data-parallel
     axis (see :func:`make_zero_taps`); param grads then come back psummed
     over the axis (divide by axis size — ``parallel.average_grads``) while

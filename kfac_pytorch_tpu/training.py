@@ -80,7 +80,22 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
       tx: optax transformation (e.g. ``sgd(...)``).
       precond: a set-up ``KFAC`` instance, or None for the pure-SGD baseline
         (the ``kfac=0`` convention, reference README.md:80).
-      loss_fn: ``loss_fn(outputs, batch) -> scalar`` (local-mean loss).
+      loss_fn: ``loss_fn(outputs, batch) -> scalar``, and it MUST be the
+        LOCAL-mean loss: the mean over this shard's examples only.
+        Under data parallelism do NOT psum/pmean-normalize the loss
+        inside ``loss_fn`` — the step averages the GRADIENTS across the
+        K-FAC world itself (``parallel.average_grads``) and pmeans the
+        reported loss metric separately. Why it matters: the capture
+        backward's cotangents feed the K-FAC G factors, whose scaling
+        assumes local-mean cotangents; a globally-normalized loss
+        multiplies every G by the shard count, so the preconditioner
+        (and anything tuned against it — lr, damping) silently changes
+        with the mesh shape. This exact mistake cost round 3 a day of
+        debugging (scripts/repro_mpd_eigen_orthogonal_axis.py); a free
+        trace-time guard (``capture.check_local_mean_loss``) now rejects
+        it — unless ``check_vma=False``, which disables both the guard
+        AND the cross-axis cotangent psums capture relies on (see README
+        "Loss conventions").
       axis_name/mesh: data-parallel axis; None for single device.
       extra_mutable: extra mutable collections (e.g. ('batch_stats',)).
       sync_extra_vars: pmean mutated collections across the axis so
@@ -150,6 +165,10 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                 capture.value_and_grad_with_capture(
                     model, lambda o: loss_fn(o, batch), variables, x,
                     mutable=extra_mutable, axis_name=axis_name, rngs=rngs)
+            # trace-time convention guard (free): the capture loss must
+            # be the LOCAL mean, or every G factor scales with the
+            # shard count (the round-3 postmortem bug)
+            capture.check_local_mean_loss(loss, batch, axis_name)
             if fisher_type == 'F1mc':
                 # true-Fisher MC estimate: re-capture (a, g) from a backward
                 # against labels sampled from the model's own distribution;
@@ -162,9 +181,12 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                 if axis_name is not None:
                     key = jax.random.fold_in(key, coll.axis_index(axis_name))
                 pseudo = fisher_sample_fn(key, jax.lax.stop_gradient(out))
-                _, _, _, acts, gs, _ = capture.value_and_grad_with_capture(
-                    model, lambda o: fisher_loss_fn(o, pseudo), variables, x,
-                    mutable=extra_mutable, axis_name=axis_name, rngs=rngs)
+                floss, _, _, acts, gs, _ = \
+                    capture.value_and_grad_with_capture(
+                        model, lambda o: fisher_loss_fn(o, pseudo),
+                        variables, x, mutable=extra_mutable,
+                        axis_name=axis_name, rngs=rngs)
+                capture.check_local_mean_loss(floss, pseudo, axis_name)
         else:
             def plain_loss(params):
                 out, mutated = model.apply(
@@ -175,6 +197,10 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             (loss, (out, mutated)), grads = jax.value_and_grad(
                 plain_loss, has_aux=True)(state.params)
             acts = gs = None
+            # same convention on the SGD path: average_grads below
+            # divides the psummed grads by world size, so a pre-pmean'd
+            # loss would double-normalize the update
+            capture.check_local_mean_loss(loss, batch, axis_name)
 
         grads = coll.average_grads(grads, axis_name)
         loss = coll.pmean(loss, axis_name)

@@ -14,10 +14,16 @@
 # logs/tb_digits_hard/<leg> for plotting.
 #
 # Usage: nohup bash scripts/run_digits_hard_ab.sh > logs/digits_hard_ab.log 2>&1 &
+# AB_SEED=<n> re-runs the whole ladder under a different trainer seed
+# (init + shuffle; the dataset/noise split stays fixed) into
+# logs/tb_digits_hard_s<n> — error bars across seeds (VERDICT r3 #8).
 
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p logs/tb_digits_hard
+SEED=${AB_SEED:-42}
+TB=logs/tb_digits_hard
+[ "$SEED" != 42 ] && TB="logs/tb_digits_hard_s$SEED"
+mkdir -p "$TB"
 
 python scripts/make_digits_cifar.py /tmp/digits_hard \
     --train-n 300 --val-n 600 --label-noise 0.3
@@ -30,9 +36,9 @@ leg() {  # leg <name> <env...> -- <extra trainer args...>
   local envs=()
   while [ "$1" != "--" ]; do envs+=("$1"); shift; done
   shift
-  echo "=== leg $name $(date +%H:%M:%S)"
+  echo "=== leg $name seed=$SEED $(date +%H:%M:%S)"
   env "${common[@]}" "${envs[@]}" KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=4 \
-      bash train_cifar10.sh --tb-dir "logs/tb_digits_hard/$name" "$@" \
+      bash train_cifar10.sh --tb-dir "$TB/$name" --seed "$SEED" "$@" \
     || echo "=== leg $name FAILED rc=$?"
 }
 

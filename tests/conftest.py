@@ -26,3 +26,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         'markers', 'slow: multi-minute end-to-end drills (subprocess '
         "trainers etc.); deselect with -m 'not slow'")
+    config.addinivalue_line(
+        'markers', 'core: ~1-minute core subset (golden torch-reference '
+        'parity, engine/preconditioner, factors/linalg, loss-convention '
+        "guard); run with -m core (VERDICT r3 #9)")

@@ -10,6 +10,8 @@ import pytest
 
 from kfac_pytorch_tpu import ops
 
+pytestmark = pytest.mark.core
+
 
 def np_patches(x, kh, kw, sh, sw, ph, pw):
     """Naive im2col oracle: NHWC -> [N, OH, OW, kh*kw*C], (kh, kw, c) order."""

@@ -3,8 +3,11 @@
 import jax
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from kfac_pytorch_tpu import ops
+
+pytestmark = pytest.mark.core
 
 
 def _spd(rng, *shape):

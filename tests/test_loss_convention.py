@@ -1,0 +1,120 @@
+"""The LOCAL-mean loss convention guard (capture.check_local_mean_loss):
+it must reject, at trace time, the exact round-3 postmortem mistake — a
+loss psum/pmean-normalized across the K-FAC world before the capture
+backward (scripts/repro_mpd_eigen_orthogonal_axis.py mistake #1) — while
+the convention-respecting local-mean loss passes untouched, both through
+build_train_step (guard applied automatically) and in a direct shard_map
+harness (guard called explicitly, one line)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen
+from jax.sharding import Mesh, PartitionSpec as P
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import capture, training
+from kfac_pytorch_tpu import nn as knn
+
+pytestmark = pytest.mark.core
+
+B, DIN, DOUT, ND = 8, 6, 4, 4
+
+
+class MLP(linen.Module):
+    @linen.compact
+    def __call__(self, x):
+        return knn.Dense(DOUT, name='fc')(x)
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (jnp.asarray(rng.randn(B, DIN), jnp.float32),
+            jnp.asarray(rng.randn(B, DOUT), jnp.float32))
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:ND]), ('batch',))
+
+
+def _direct_harness(global_norm):
+    model = MLP()
+    x, y = _data()
+    variables = capture.init(model, jax.random.PRNGKey(0), x)
+
+    @functools.partial(jax.shard_map, mesh=_mesh(),
+                       in_specs=(P(), P('batch'), P('batch')),
+                       out_specs=P())
+    def step(params, x, y):
+        def loss_fn(out):
+            if global_norm:
+                # the postmortem's mistake: globally-psum-normalized loss
+                return jax.lax.psum(((out - y) ** 2).sum() / y.size,
+                                    'batch')
+            return ((out - y) ** 2).mean()   # LOCAL mean: the convention
+
+        loss, _, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+            model, loss_fn, {'params': params}, x, axis_name='batch')
+        capture.check_local_mean_loss(loss, (x, y), 'batch')
+        return jax.lax.pmean(loss, 'batch')
+
+    return step(variables['params'], x, y)
+
+
+def test_direct_capture_guard_rejects_global_psum_loss():
+    with pytest.raises(ValueError, match='convention'):
+        _direct_harness(global_norm=True)
+
+
+def test_direct_capture_guard_passes_local_mean_loss():
+    assert np.isfinite(float(_direct_harness(global_norm=False)))
+
+
+def _run_train_step(loss_fn, use_kfac=True):
+    model = MLP()
+    x, y = _data()
+    batch = {'input': x, 'label': y}
+    precond = None
+    if use_kfac:
+        precond = kfac.KFAC(variant='eigen_dp', lr=0.1, damping=0.003,
+                            fac_update_freq=1, kfac_update_freq=1,
+                            num_devices=ND, axis_name='batch')
+    tx = training.sgd(0.1)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0), x)
+    step = training.build_train_step(model, tx, precond, loss_fn,
+                                     axis_name='batch', mesh=_mesh())
+    return step(state, batch, lr=0.1, damping=0.003)
+
+
+def test_build_train_step_guard_rejects_pmean_loss():
+    def bad(outputs, batch):
+        return jax.lax.pmean(((outputs - batch['label']) ** 2).mean(),
+                             'batch')
+
+    with pytest.raises(ValueError, match='convention'):
+        _run_train_step(bad)
+
+
+def test_build_train_step_guard_rejects_pmean_loss_sgd_path():
+    """precond=None takes the plain value_and_grad branch, where
+    average_grads still divides psummed grads by world size — a
+    pre-pmean'd loss double-normalizes, so the guard covers it too."""
+    def bad(outputs, batch):
+        return jax.lax.pmean(((outputs - batch['label']) ** 2).mean(),
+                             'batch')
+
+    with pytest.raises(ValueError, match='convention'):
+        _run_train_step(bad, use_kfac=False)
+
+
+def test_build_train_step_local_mean_loss_passes():
+    def good(outputs, batch):
+        return ((outputs - batch['label']) ** 2).mean()
+
+    for use_kfac in (True, False):
+        state, metrics = _run_train_step(good, use_kfac=use_kfac)
+        assert np.isfinite(float(metrics['loss']))

@@ -16,6 +16,8 @@ import kfac_pytorch_tpu as kfac
 from kfac_pytorch_tpu import capture, ops
 from kfac_pytorch_tpu import nn as knn
 
+pytestmark = pytest.mark.core
+
 
 class MLP(linen.Module):
     @linen.compact

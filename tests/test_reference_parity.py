@@ -14,8 +14,11 @@ import numpy as np
 import pytest
 
 REF = '/root/reference'
-pytestmark = pytest.mark.skipif(not os.path.isdir(os.path.join(REF, 'kfac')),
-                                reason='reference checkout not available')
+pytestmark = [
+    pytest.mark.core,
+    pytest.mark.skipif(not os.path.isdir(os.path.join(REF, 'kfac')),
+                       reason='reference checkout not available'),
+]
 
 B, DIN, DH, DOUT = 16, 4, 8, 3
 LR, DAMPING, KL_CLIP, DECAY = 0.1, 0.01, 0.001, 0.95
