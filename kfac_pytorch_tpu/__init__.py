@@ -27,8 +27,10 @@ from kfac_pytorch_tpu import nn
 from kfac_pytorch_tpu import ops
 
 # Variant registry, mirroring the reference factory surface
-# (reference: kfac/__init__.py:8-16).
-KFAC_VARIANTS = ('inverse', 'eigen', 'inverse_dp', 'eigen_dp')
+# (reference: kfac/__init__.py:8-16) plus the beyond-reference 'ekfac'
+# (George et al. 2018: per-example second moments in the joint
+# Kronecker eigenbasis replace the eigenvalue outer product).
+KFAC_VARIANTS = ('inverse', 'eigen', 'inverse_dp', 'eigen_dp', 'ekfac')
 
 
 def get_kfac_module(kfac='eigen_dp'):

@@ -329,6 +329,90 @@ def refresh_decomposition(plan, factors_local, decomp_prev, eps, axis_name,
     return {'evals': evals, 'evecs': evecs_local}
 
 
+def update_ekfac_scales(plan, decomp, acts, gs, batch_averaged,
+                        scales_prev, factor_decay, stats_reduce,
+                        axis_name):
+    """E-KFAC second-moment update in the current (replicated) eigenbasis
+    — beyond the reference (George et al. 2018, 'ekfac' variant).
+
+    For every layer: project this device's captured rows into the
+    layer's Kronecker eigenbasis and accumulate the squared-projection
+    joint moment ``s = E[(Qg' grad_b Qa)^2]`` (ops.ekfac_scales) — two
+    projections and one GEMM per layer, NO eigh. Under MPD semantics
+    (``stats_reduce='pmean'``) the per-shard moments are pmean'd so s is
+    the global-batch estimate, mirroring the factor pmean. EMA'd with
+    ``factor_decay`` like the factors themselves.
+
+    Requires the replicated decomposition layout (comm_mode='inverse'):
+    every device holds every layer's basis. Rows are feature-padded with
+    zeros to the bucket dims, so padded coordinates contribute zero to s
+    and the identity-padded basis block keeps them inert — the same
+    padding contract the pred path uses.
+
+    Returns the new ``{group-key: [m, dg, da]}`` scales dict, stacked to
+    match ``plan.pred_groups`` member order. A zero basis (no
+    decomposition yet) projects everything to zero, so s stays zero and
+    the pred path's validity guard keeps the plain Kronecker denominator
+    — fresh starts and resumes degrade gracefully.
+    """
+    new = {}
+    for gi, pg in enumerate(plan.pred_groups):
+        member_scales = []
+        for pos, i in enumerate(pg.layer_idx):
+            meta = plan.metas[int(i)]
+            a = capture.layer_act(acts, meta)
+            g = capture.layer_g(gs, meta)
+            if meta.kind == 'dense':
+                arows, grows, n = ops.layer_rows_dense(
+                    a, g, meta.use_bias, batch_averaged)
+            else:
+                arows, grows, n = ops.layer_rows_conv(
+                    a, g, meta.kernel_size, meta.strides, meta.padding,
+                    meta.use_bias, batch_averaged)
+            arows = jnp.pad(arows, ((0, 0), (0, pg.da - arows.shape[1])))
+            grows = jnp.pad(grows, ((0, 0), (0, pg.dg - grows.shape[1])))
+            qa = decomp['evecs'][_key(pg.da)][int(pg.row_a[pos])]
+            qg = decomp['evecs'][_key(pg.dg)][int(pg.row_g[pos])]
+            member_scales.append(ops.ekfac_scales(arows, grows, qa, qg, n))
+        s_new = jnp.stack(member_scales)
+        if stats_reduce == 'pmean':
+            with jax.named_scope('kfac.CommunicateFactor.scales'):
+                s_new = coll.pmean(s_new, axis_name)
+        new[f'g{gi}'] = ops.update_running_avg(
+            s_new, scales_prev[f'g{gi}'], factor_decay)
+    return new
+
+
+def rotate_ekfac_scales(plan, scales, evecs_prev, evecs_new):
+    """Re-express stored E-KFAC scales after a basis change.
+
+    The EMA'd moments live in the OLD basis; after a full
+    eigendecomposition replaces Q the diagonal moments cannot be mapped
+    exactly (s is a diagonal in a basis that no longer exists), but the
+    rotation ``s' = (Rg^2) s (Ra^2)^T`` with ``R = Q_new^T Q_old`` is the
+    exact transport of the DIAGONAL approximation ``sum_kl s_kl
+    (q_g,k q_a,l outer)^2`` between bases — it preserves the total mass
+    and degrades to identity when the basis barely moved (warm tracking,
+    refresh steps). Keeps the EMA history useful across basis updates
+    instead of restarting the moments from zero."""
+    out = {}
+    for gi, pg in enumerate(plan.pred_groups):
+        rotated = []
+        s = scales[f'g{gi}']
+        for pos in range(len(pg.layer_idx)):
+            qa_o = evecs_prev['evecs'][_key(pg.da)][int(pg.row_a[pos])]
+            qg_o = evecs_prev['evecs'][_key(pg.dg)][int(pg.row_g[pos])]
+            qa_n = evecs_new['evecs'][_key(pg.da)][int(pg.row_a[pos])]
+            qg_n = evecs_new['evecs'][_key(pg.dg)][int(pg.row_g[pos])]
+            ra = jnp.einsum('ij,ik->jk', qa_o, qa_n,
+                            precision=_PRED_PRECISION) ** 2
+            rg = jnp.einsum('ij,ik->jk', qg_o, qg_n,
+                            precision=_PRED_PRECISION) ** 2
+            rotated.append(rg.T @ s[pos] @ ra)
+        out[f'g{gi}'] = jnp.stack(rotated)
+    return out
+
+
 def gather_decomposition(plan, decomp_local, axis_name, communicate=True):
     """All-gather decomposition rows to every device (comm_inverse mode).
 
@@ -354,10 +438,18 @@ def gather_decomposition(plan, decomp_local, axis_name, communicate=True):
 # Phase 3: preconditioning
 # ---------------------------------------------------------------------------
 
-def _pred_eigh(qg, dg, qa, da, gstack, damping):
+def _pred_eigh(qg, dg, qa, da, gstack, damping, scales=None):
     v1 = jnp.einsum('mji,mjk,mkl->mil', qg, gstack, qa,
                     precision=_PRED_PRECISION)
-    v2 = v1 / (dg[:, :, None] * da[:, None, :] + damping)
+    denom = dg[:, :, None] * da[:, None, :]
+    if scales is not None:
+        # E-KFAC: the per-example second moment replaces the Kronecker
+        # eigenvalue outer product; an all-zero s (no moments accumulated
+        # yet — fresh start or restored pre-ekfac checkpoint) falls back
+        # to the Kronecker denominator per member
+        valid = jnp.any(scales != 0, axis=(-2, -1), keepdims=True)
+        denom = jnp.where(valid, scales, denom)
+    v2 = v1 / (denom + damping)
     return jnp.einsum('mij,mjk,mlk->mil', qg, v2, qa,
                       precision=_PRED_PRECISION)
 
@@ -373,19 +465,23 @@ def _group_grad_stack(plan, pg, grad_mats):
                       for i in pg.layer_idx])
 
 
-def compute_pred_replicated(plan, decomp, grad_mats, damping, method):
+def compute_pred_replicated(plan, decomp, grad_mats, damping, method,
+                            scales=None):
     """Preconditioning with replicated (gathered) decompositions — every
     device computes every layer's pred, zero comm (reference eigen path:
-    all ranks run _compute_pred after broadcast, eigen.py:137-144)."""
+    all ranks run _compute_pred after broadcast, eigen.py:137-144).
+    ``scales``: E-KFAC second moments keyed per pred group (replaces the
+    Kronecker eigenvalue denominators, see update_ekfac_scales)."""
     preds = [None] * plan.num_layers
-    for pg in plan.pred_groups:
+    for gi, pg in enumerate(plan.pred_groups):
         gstack = _group_grad_stack(plan, pg, grad_mats)
         if method == 'eigh':
             qa = decomp['evecs'][_key(pg.da)][pg.row_a]
             da = decomp['evals'][_key(pg.da)][pg.row_a]
             qg = decomp['evecs'][_key(pg.dg)][pg.row_g]
             dg = decomp['evals'][_key(pg.dg)][pg.row_g]
-            pred = _pred_eigh(qg, dg, qa, da, gstack, damping)
+            pred = _pred_eigh(qg, dg, qa, da, gstack, damping,
+                              None if scales is None else scales[f'g{gi}'])
         else:
             inva = decomp['invs'][_key(pg.da)][pg.row_a]
             invg = decomp['invs'][_key(pg.dg)][pg.row_g]

@@ -6,6 +6,9 @@ from kfac_pytorch_tpu.ops.factors import (
     compute_a_conv,
     compute_g_dense,
     compute_g_conv,
+    layer_rows_dense,
+    layer_rows_conv,
+    ekfac_scales,
     update_running_avg,
 )
 from kfac_pytorch_tpu.ops.linalg import (
@@ -23,7 +26,8 @@ from kfac_pytorch_tpu.ops.linalg import (
 
 __all__ = [
     'extract_patches', 'compute_a_dense', 'compute_a_conv',
-    'compute_g_dense', 'compute_g_conv', 'update_running_avg',
+    'compute_g_dense', 'compute_g_conv', 'layer_rows_dense',
+    'layer_rows_conv', 'ekfac_scales', 'update_running_avg',
     'psd_inverse', 'sym_eig', 'jacobi_eigh', 'subspace_eigh',
     'newton_schulz_inverse', 'warm_inverse',
     'clamp_eigvals', 'add_scaled_identity',

@@ -144,6 +144,67 @@ def compute_g_conv(g, batch_averaged=True):
     return _stat_gemm(rows, rows.shape[0])
 
 
+def layer_rows_dense(a, g, use_bias, batch_averaged=True):
+    """Aligned per-example row matrices for a dense layer — the raw rows
+    whose covariances are :func:`compute_a_dense` / :func:`compute_g_dense`
+    (same sequence-mean, bias-column, and batch-averaged-undo
+    conventions). Returns ``(arows [N, d_in(+1)], grows [N, d_out], N)``;
+    row ``b`` of both sides belongs to example ``b``, so the per-example
+    gradient matrix is exactly ``grows[b] arows[b]^T`` — the E-KFAC
+    second-moment input (George et al. 2018, beyond the reference)."""
+    if a.ndim > 2:
+        a = a.mean(axis=tuple(range(1, a.ndim - 1)))
+    if g.ndim > 2:
+        g = g.mean(axis=tuple(range(1, g.ndim - 1)))
+    n = a.shape[0]
+    if use_bias:
+        a = _append_ones_column(a)
+    if batch_averaged:
+        g = g * n
+    return a.astype(_FACTOR_DTYPE), g.astype(_FACTOR_DTYPE), n
+
+
+def layer_rows_conv(a, g, kernel_size, strides, padding, use_bias,
+                    batch_averaged=True):
+    """Aligned per-patch row matrices for a conv layer — same row sets
+    and normalizations as :func:`compute_a_conv` / :func:`compute_g_conv`
+    (patch rows divided by the spatial size, g rows scaled by N and the
+    spatial size), with rows index-aligned per (example, position) so the
+    E-KFAC joint second moment can pair them. Returns
+    ``(arows [N*OH*OW, kh*kw*C(+1)], grows [N*OH*OW, C_out], N)``."""
+    n = a.shape[0]
+    patches = extract_patches(a, kernel_size, strides, padding)
+    spatial = patches.shape[1] * patches.shape[2]
+    arows = patches.reshape(-1, patches.shape[-1])
+    if use_bias:
+        arows = _append_ones_column(arows)
+    arows = arows / spatial
+    grows = g.reshape(-1, g.shape[-1])
+    if batch_averaged:
+        grows = grows * n
+    grows = grows * spatial
+    return arows.astype(_FACTOR_DTYPE), grows.astype(_FACTOR_DTYPE), n
+
+
+def ekfac_scales(arows, grows, qa, qg, n):
+    """E-KFAC second moments in the joint Kronecker eigenbasis:
+    ``s_ij = (1/n) sum_r (qg^T grows_r)_i^2 (arows_r^T qa)_j^2`` — the
+    exact diagonal of ``(Qg (x) Qa)^T F_emp (Qg (x) Qa)`` for dense
+    layers (per-example gradients ``g a^T``), the standard
+    patch-independence approximation for conv. One projection pair plus
+    one squared-feature GEMM; scale-consistent with the Kronecker
+    eigenvalue outer product ``dg (x) da`` it replaces (both estimate the
+    same diagonal, K-FAC via the independence factorization)."""
+    pa = lax.dot_general(arows, qa, (((1,), (0,)), ((), ())),
+                         preferred_element_type=_FACTOR_DTYPE)
+    pg = lax.dot_general(grows, qg, (((1,), (0,)), ((), ())),
+                         preferred_element_type=_FACTOR_DTYPE)
+    return lax.dot_general(
+        pg * pg, (pa * pa) / n,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=_FACTOR_DTYPE).astype(_FACTOR_DTYPE)
+
+
 def update_running_avg(new, current, alpha):
     """Functional running average: ``alpha * new + (1 - alpha) * current``.
 

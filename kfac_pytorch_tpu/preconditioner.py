@@ -62,6 +62,11 @@ _VARIANTS = {
     'inverse_dp': dict(stats_reduce='local', method='cholesky',
                        comm_mode='pred'),
     'eigen_dp': dict(stats_reduce='local', method='eigh', comm_mode='pred'),
+    # beyond reference: E-KFAC (George et al. 2018) — the eigen layout
+    # plus per-example second moments in the joint eigenbasis replacing
+    # the Kronecker eigenvalue product (engine.update_ekfac_scales)
+    'ekfac': dict(stats_reduce='pmean', method='eigh',
+                  comm_mode='inverse', ekfac=True),
 }
 
 
@@ -134,6 +139,7 @@ class KFAC:
         self.stats_reduce = cfg['stats_reduce']
         self.method = cfg['method']
         self.comm_mode = cfg['comm_mode']
+        self.ekfac = cfg.get('ekfac', False)
         self.lr = lr
         self.damping = damping
         self.fac_update_freq = fac_update_freq
@@ -203,7 +209,7 @@ class KFAC:
             from kfac_pytorch_tpu.capture import filter_vocab_head
             metas = filter_vocab_head(metas, self.exclude_vocabulary_size)
         distribute = self.distribute_layer_factors
-        if self.variant == 'eigen' and distribute is None:
+        if self.variant in ('eigen', 'ekfac') and distribute is None:
             # reference auto rule: factor-wise split iff world > #layers
             # (eigen.py:66-71)
             distribute = self.num_devices > len(metas)
@@ -235,6 +241,8 @@ class KFAC:
                     (plan.buckets[d].n_rows, d, d), jnp.float32)
                     for d in plan.bucket_dims},
             }
+            if self.ekfac:
+                decomp['scales'] = self._zero_scales()
         else:
             decomp = {
                 'invs': {str(d): jnp.zeros(
@@ -256,10 +264,20 @@ class KFAC:
         decomp = jax.tree.map(lambda _: dspec, self._decomp_structure())
         return KFACState(step=replicated, factors=factors, decomp=decomp)
 
+    def _zero_scales(self):
+        return {f'g{gi}': jnp.zeros(
+                    (len(pg.layer_idx), pg.dg, pg.da), jnp.float32)
+                for gi, pg in enumerate(self.plan.pred_groups)}
+
     def _decomp_structure(self):
         if self.method == 'eigh':
-            return {'evals': {str(d): 0 for d in self.plan.bucket_dims},
-                    'evecs': {str(d): 0 for d in self.plan.bucket_dims}}
+            out = {'evals': {str(d): 0 for d in self.plan.bucket_dims},
+                   'evecs': {str(d): 0 for d in self.plan.bucket_dims}}
+            if self.ekfac:
+                out['scales'] = {
+                    f'g{gi}': 0
+                    for gi in range(len(self.plan.pred_groups))}
+            return out
         return {'invs': {str(d): 0 for d in self.plan.bucket_dims}}
 
     # -- host-side gating (trainer chooses compiled step variants) --------
@@ -347,6 +365,15 @@ class KFAC:
             # (kfac_preconditioner_base.py:206-226)
             return grads, state.replace(step=state.step + 1, factors=factors)
 
+        scales_prev = None
+        if self.ekfac:
+            # a state restored from a pre-ekfac checkpoint has no
+            # 'scales' key: default to zeros so the pred path's validity
+            # guard falls back to the Kronecker denominator instead of
+            # crashing in the scale update/rotation
+            scales_prev = decomp.get('scales')
+            if scales_prev is None:
+                scales_prev = self._zero_scales()
         if update_inverse:
             if self.method == 'eigh' and not update_basis:
                 # eigenvalue-only refresh in the retained eigenbasis
@@ -355,6 +382,7 @@ class KFAC:
                         plan, factors, decomp, self.eps, axis_name,
                         self.comm_mode,
                         communicate=not self.exclude_communicate_inverse)
+                # basis unchanged -> stored moments stay valid as-is
             else:
                 basis_local = invs_prev = None
                 if self.warm_start_basis and warm_basis:
@@ -376,17 +404,38 @@ class KFAC:
                         invs_prev_local=invs_prev)
                 if self.comm_mode == 'inverse':
                     with jax.named_scope('kfac.CommunicateInverse'):
-                        decomp = engine.gather_decomposition(
+                        new_decomp = engine.gather_decomposition(
                             plan, decomp_local, axis_name,
                             communicate=not self.exclude_communicate_inverse)
+                    if self.ekfac:
+                        # the EMA'd moments live in the OLD basis: carry
+                        # them across the basis change by the squared-
+                        # overlap transport (exact for sign flips /
+                        # unmoved bases, mass-preserving otherwise)
+                        with jax.named_scope('kfac.EkfacScales.rotate'):
+                            scales_prev = engine.rotate_ekfac_scales(
+                                plan, scales_prev, decomp, new_decomp)
+                    decomp = new_decomp
                 else:
                     decomp = decomp_local
+        if self.ekfac:
+            decomp = dict(decomp)
+            decomp['scales'] = scales_prev
+            if (update_factors and acts is not None
+                    and not self.exclude_compute_factor):
+                reduce = ('local' if self.exclude_communicate_factor
+                          else self.stats_reduce)
+                with jax.named_scope('kfac.EkfacScales'):
+                    decomp['scales'] = engine.update_ekfac_scales(
+                        plan, decomp, acts, gs, self.batch_averaged,
+                        scales_prev, self.factor_decay, reduce, axis_name)
 
         grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
         with jax.named_scope('kfac.Precondition'):
             if self.comm_mode == 'inverse':
                 preds = engine.compute_pred_replicated(
-                    plan, decomp, grad_mats, damping, self.method)
+                    plan, decomp, grad_mats, damping, self.method,
+                    scales=decomp.get('scales') if self.ekfac else None)
             else:
                 preds = engine.compute_pred_local(
                     plan, decomp, grad_mats, damping, self.method, axis_name,

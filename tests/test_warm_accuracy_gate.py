@@ -143,6 +143,11 @@ def test_warm_kernel_accuracy_bands():
         'warm_subspace': _run_leg('eigen_dp', xt, yt, xv, yv,
                                   eigh_impl='subspace',
                                   warm_start_basis=True),
+        # E-KFAC (beyond reference): per-example moments in the joint
+        # eigenbasis — alone, and with the amortized basis it exists for
+        'ekfac': _run_leg('ekfac', xt, yt, xv, yv),
+        'ekfac_basis10': _run_leg('ekfac', xt, yt, xv, yv,
+                                  basis_update_freq=10),
     }
     print('warm-gate accuracies:', {k: round(v, 4) for k, v in acc.items()})
 
@@ -162,3 +167,9 @@ def test_warm_kernel_accuracy_bands():
     assert acc['warm_ns'] != acc['cold_chol'], acc
     assert acc['basis10'] != acc['cold_eigen'], acc
     assert acc['warm_subspace'] != acc['cold_eigen'], acc
+    # 4. E-KFAC: calibrated floors (.678/.709 at seed 0; gate 8 points
+    #    under) and amortization-path engagement (basis_update_freq must
+    #    change the trajectory)
+    assert acc['ekfac'] > 0.60, acc
+    assert acc['ekfac_basis10'] > 0.60, acc
+    assert acc['ekfac_basis10'] != acc['ekfac'], acc
