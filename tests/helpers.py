@@ -12,6 +12,32 @@ def free_port():
         return s.getsockname()[1]
 
 
+def run_two_process(argv_fn, env, tag):
+    """Spawn two coordinated jax.distributed workers, collect both
+    outputs, assert both exited 0 and printed an identical ``tag`` line
+    (the cross-process agreement check every multihost drill ends with).
+    ``argv_fn(pid) -> argv list``; ``env`` gets JAX_PROCESS_ID added per
+    worker. Returns the two full outputs."""
+    procs = []
+    try:
+        for pid in range(2):
+            procs.append(subprocess.Popen(
+                argv_fn(pid), env=dict(env, JAX_PROCESS_ID=str(pid)),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = communicate_all(procs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    lines = [[l for l in o.splitlines() if l.startswith(tag)][-1]
+             for o in outs]
+    assert lines[0] == lines[1], lines
+    return outs
+
+
 def communicate_all(procs, timeout=450):
     """communicate() with every process of a multi-process drill; on any
     timeout, kill them all and surface EVERY worker's output — the stuck
