@@ -7,7 +7,8 @@ from kfac_pytorch_tpu.utils.losses import (
     label_smoothing_cross_entropy, sample_pseudo_labels)
 from kfac_pytorch_tpu.utils.checkpoint import (
     save_checkpoint, restore_checkpoint, find_resume_epoch,
-    PreemptionGuard, wait_for_checkpoints, prune_checkpoints)
+    PreemptionGuard, wait_for_checkpoints, prune_checkpoints,
+    reshard_kfac_state)
 from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
@@ -16,5 +17,6 @@ __all__ = [
     'inverse_sqrt', 'label_smoothing_cross_entropy', 'sample_pseudo_labels',
     'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',
     'PreemptionGuard', 'wait_for_checkpoints', 'prune_checkpoints',
+    'reshard_kfac_state',
     'trace', 'time_steps', 'exclude_parts_breakdown',
 ]

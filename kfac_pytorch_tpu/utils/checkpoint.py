@@ -80,6 +80,49 @@ def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True):
             pickle.dump(jax.tree.map(np.asarray, payload), f)
 
 
+def reshard_kfac_state(pre_old, pre_new, kfac_state):
+    """Elastic world-size resume (beyond the reference): re-lay the
+    K-FAC FACTOR state from ``pre_old``'s plan (its ``num_devices``)
+    into ``pre_new``'s — restore a checkpoint taken at one world size
+    into a differently-sized mesh.
+
+    The stacked-bucket layout is device-major per world size (plan.py),
+    so a num_devices change reshuffles which row of which bucket holds
+    each layer's factor — both plans' ``layer_rows`` maps make the
+    transport exact. Only the FACTORS (the accumulated statistics —
+    the state that takes thousands of steps to rebuild) are carried;
+    decompositions re-initialize to zero and are recomputed at the
+    first inverse update, exactly the fresh-start degrade path the
+    trainer already handles (training.py seen-inverse gating; E-KFAC
+    scales likewise re-accumulate — they are basis-bound). The step
+    counter is preserved.
+
+    Host-side numpy: call OUTSIDE jit, with the old state fully
+    addressable (single-host restore, or after a replicated restore).
+    Both preconditioners must be set up on the same layer list.
+    """
+    plan_o, plan_n = pre_old.plan, pre_new.plan
+    assert plan_o is not None and plan_n is not None, 'call setup() first'
+    sig_o = [(m.path, m.in_dim, m.out_dim) for m in plan_o.metas]
+    sig_n = [(m.path, m.in_dim, m.out_dim) for m in plan_n.metas]
+    assert sig_o == sig_n, (
+        'elastic resume requires the same layer set (paths AND dims — a '
+        f'width change invalidates the statistics): {sig_o} != {sig_n}')
+    fresh = pre_new.init()
+    factors = {k: np.array(v) for k, v in fresh.factors.items()}
+    old = {k: np.asarray(v) for k, v in kfac_state.factors.items()}
+    for i, meta in enumerate(plan_o.metas):
+        ba_o, ra_o, bg_o, rg_o, _ = plan_o.layer_rows[i]
+        ba_n, ra_n, bg_n, rg_n, _ = plan_n.layer_rows[i]
+        da, dg = meta.in_dim, meta.out_dim
+        factors[str(ba_n)][ra_n, :da, :da] = old[str(ba_o)][ra_o, :da, :da]
+        factors[str(bg_n)][rg_n, :dg, :dg] = old[str(bg_o)][rg_o, :dg, :dg]
+    import jax.numpy as jnp
+    return fresh.replace(
+        step=jnp.asarray(np.asarray(kfac_state.step)),
+        factors={k: jnp.asarray(v) for k, v in factors.items()})
+
+
 def wait_for_checkpoints():
     """Block until all in-flight async saves are durable on disk."""
     if _ASYNC_CKPTR is not None:
