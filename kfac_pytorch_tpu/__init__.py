@@ -30,7 +30,8 @@ from kfac_pytorch_tpu import ops
 # (reference: kfac/__init__.py:8-16) plus the beyond-reference 'ekfac'
 # (George et al. 2018: per-example second moments in the joint
 # Kronecker eigenbasis replace the eigenvalue outer product).
-KFAC_VARIANTS = ('inverse', 'eigen', 'inverse_dp', 'eigen_dp', 'ekfac')
+KFAC_VARIANTS = ('inverse', 'eigen', 'inverse_dp', 'eigen_dp', 'ekfac',
+                 'ekfac_dp')
 
 
 def get_kfac_module(kfac='eigen_dp'):

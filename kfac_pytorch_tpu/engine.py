@@ -383,6 +383,85 @@ def update_ekfac_scales(plan, decomp, acts, gs, batch_averaged,
     return new
 
 
+def update_ekfac_scales_local(plan, decomp_local, acts, gs,
+                              batch_averaged, scales_prev, factor_decay,
+                              axis_name):
+    """Owner-local E-KFAC moments in the comm_pred layout ('ekfac_dp',
+    beyond reference): DP-KFAC's owner-local-statistics semantics
+    (reference inv_dp.py:60-95) applied to the per-example second
+    moments — zero scale communication, ever.
+
+    Uniform-SPMD construction: every device computes EVERY layer's
+    moment from its OWN captured rows (the same per-layer static loop
+    the factor stats use), projecting with the basis rows sitting at
+    the slot the layer occupies in this device's local decomposition
+    shard; a masked accumulation then keeps only the slots this device
+    actually owns. Unowned layers project through an arbitrary local
+    row — compute that is always discarded by the mask, the price of
+    static shapes (no data-dependent control flow under jit).
+
+    Returns ``{group-key: [K, dg, da]}`` local slot-ordered scales,
+    aligned with ``compute_pred_local``'s member order.
+    """
+    new = {}
+    for gi, pg in enumerate(plan.pred_groups):
+        K = pg.local_member.shape[1]
+        members = _local_table(pg.local_member, axis_name)       # [K]
+        valid = _local_table(pg.local_valid, axis_name)          # [K]
+        lra = _local_table(pg.local_row_a, axis_name)
+        lrg = _local_table(pg.local_row_g, axis_name)
+        slot_s = jnp.zeros((K, pg.dg, pg.da), jnp.float32)
+        for pos, i in enumerate(pg.layer_idx):
+            meta = plan.metas[int(i)]
+            a = capture.layer_act(acts, meta)
+            g = capture.layer_g(gs, meta)
+            if meta.kind == 'dense':
+                arows, grows, n = ops.layer_rows_dense(
+                    a, g, meta.use_bias, batch_averaged)
+            else:
+                arows, grows, n = ops.layer_rows_conv(
+                    a, g, meta.kernel_size, meta.strides, meta.padding,
+                    meta.use_bias, batch_averaged)
+            arows = jnp.pad(arows, ((0, 0), (0, pg.da - arows.shape[1])))
+            grows = jnp.pad(grows, ((0, 0), (0, pg.dg - grows.shape[1])))
+            # dummy pad slots can repeat a member index: restrict the
+            # selection to valid slots so exactly the owner slot (or
+            # nothing) is picked
+            sel = jnp.logical_and(members == pos, valid)         # [K]
+            ra = jnp.sum(jnp.where(sel, lra, 0))
+            rg = jnp.sum(jnp.where(sel, lrg, 0))
+            qa = decomp_local['evecs'][_key(pg.da)][ra]
+            qg = decomp_local['evecs'][_key(pg.dg)][rg]
+            s_i = ops.ekfac_scales(arows, grows, qa, qg, n)
+            slot_s = slot_s + jnp.where(sel[:, None, None], s_i[None], 0)
+        new[f'g{gi}'] = ops.update_running_avg(
+            slot_s, scales_prev[f'g{gi}'], factor_decay)
+    return new
+
+
+def rotate_ekfac_scales_local(plan, scales, evecs_prev_local,
+                              evecs_new_local, axis_name):
+    """Per-slot squared-overlap transport of owner-local scales across a
+    basis change (the comm_pred counterpart of rotate_ekfac_scales):
+    each local slot rotates by its OWN old/new basis rows."""
+    out = {}
+    for gi, pg in enumerate(plan.pred_groups):
+        lra = _local_table(pg.local_row_a, axis_name)
+        lrg = _local_table(pg.local_row_g, axis_name)
+        qa_o = jnp.take(evecs_prev_local[_key(pg.da)], lra, axis=0)
+        qg_o = jnp.take(evecs_prev_local[_key(pg.dg)], lrg, axis=0)
+        qa_n = jnp.take(evecs_new_local[_key(pg.da)], lra, axis=0)
+        qg_n = jnp.take(evecs_new_local[_key(pg.dg)], lrg, axis=0)
+        ra = jnp.einsum('kij,kil->kjl', qa_o, qa_n,
+                        precision=_PRED_PRECISION) ** 2
+        rg = jnp.einsum('kij,kil->kjl', qg_o, qg_n,
+                        precision=_PRED_PRECISION) ** 2
+        s = scales[f'g{gi}']
+        out[f'g{gi}'] = jnp.einsum(
+            'kji,kjl,klm->kim', rg, s, ra, precision=_PRED_PRECISION)
+    return out
+
+
 def rotate_ekfac_scales(plan, scales, evecs_prev, evecs_new):
     """Re-express stored E-KFAC scales after a basis change.
 
@@ -493,12 +572,14 @@ def compute_pred_replicated(plan, decomp, grad_mats, damping, method,
 
 
 def compute_pred_local(plan, decomp_local, grad_mats, damping, method,
-                       axis_name, communicate=True):
+                       axis_name, communicate=True, scales=None):
     """Owner-computes preconditioning + all-gather of the results
     (comm_pred mode — the DP-KFAC flagship path: only final preconditioned
-    gradients travel, reference inv_dp.py:126-138 + inv.py:164-175)."""
+    gradients travel, reference inv_dp.py:126-138 + inv.py:164-175).
+    ``scales``: owner-local slot-ordered E-KFAC moments
+    (update_ekfac_scales_local) replacing the Kronecker denominators."""
     preds = [None] * plan.num_layers
-    for pg in plan.pred_groups:
+    for gi, pg in enumerate(plan.pred_groups):
         gstack = _group_grad_stack(plan, pg, grad_mats)
         members = _local_table(pg.local_member, axis_name)
         g_loc = jnp.take(gstack, members, axis=0)
@@ -509,7 +590,9 @@ def compute_pred_local(plan, decomp_local, grad_mats, damping, method,
             da = jnp.take(decomp_local['evals'][_key(pg.da)], ra, axis=0)
             qg = jnp.take(decomp_local['evecs'][_key(pg.dg)], rg, axis=0)
             dg = jnp.take(decomp_local['evals'][_key(pg.dg)], rg, axis=0)
-            pred_loc = _pred_eigh(qg, dg, qa, da, g_loc, damping)
+            pred_loc = _pred_eigh(qg, dg, qa, da, g_loc, damping,
+                                  None if scales is None
+                                  else scales[f'g{gi}'])
         else:
             inva = jnp.take(decomp_local['invs'][_key(pg.da)], ra, axis=0)
             invg = jnp.take(decomp_local['invs'][_key(pg.dg)], rg, axis=0)

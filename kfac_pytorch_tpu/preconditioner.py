@@ -64,9 +64,14 @@ _VARIANTS = {
     'eigen_dp': dict(stats_reduce='local', method='eigh', comm_mode='pred'),
     # beyond reference: E-KFAC (George et al. 2018) — the eigen layout
     # plus per-example second moments in the joint eigenbasis replacing
-    # the Kronecker eigenvalue product (engine.update_ekfac_scales)
+    # the Kronecker eigenvalue product (engine.update_ekfac_scales);
+    # 'ekfac_dp' applies DP-KFAC's owner-local-statistics semantics to
+    # the moments too (engine.update_ekfac_scales_local — zero scale
+    # communication, composing with the comm_pred flagship layout)
     'ekfac': dict(stats_reduce='pmean', method='eigh',
                   comm_mode='inverse', ekfac=True),
+    'ekfac_dp': dict(stats_reduce='local', method='eigh',
+                     comm_mode='pred', ekfac=True),
 }
 
 
@@ -264,7 +269,19 @@ class KFAC:
         decomp = jax.tree.map(lambda _: dspec, self._decomp_structure())
         return KFACState(step=replicated, factors=factors, decomp=decomp)
 
-    def _zero_scales(self):
+    def _zero_scales(self, local=False):
+        # replicated layout: one row per group member; comm_pred layout:
+        # device-major local slots (K per device), like the factor rows.
+        # ``local=True`` builds the PER-DEVICE shape — required when the
+        # default is materialized inside the shard_map trace (the
+        # pre-ekfac-checkpoint fallback in step); the global shape is
+        # the host-side init()/state layout
+        if self.comm_mode == 'pred':
+            mult = 1 if local else self.plan.num_devices
+            return {f'g{gi}': jnp.zeros(
+                        (mult * pg.local_member.shape[1],
+                         pg.dg, pg.da), jnp.float32)
+                    for gi, pg in enumerate(self.plan.pred_groups)}
         return {f'g{gi}': jnp.zeros(
                     (len(pg.layer_idx), pg.dg, pg.da), jnp.float32)
                 for gi, pg in enumerate(self.plan.pred_groups)}
@@ -373,7 +390,7 @@ class KFAC:
             # crashing in the scale update/rotation
             scales_prev = decomp.get('scales')
             if scales_prev is None:
-                scales_prev = self._zero_scales()
+                scales_prev = self._zero_scales(local=True)
         if update_inverse:
             if self.method == 'eigh' and not update_basis:
                 # eigenvalue-only refresh in the retained eigenbasis
@@ -417,6 +434,15 @@ class KFAC:
                                 plan, scales_prev, decomp, new_decomp)
                     decomp = new_decomp
                 else:
+                    if self.ekfac:
+                        # comm_pred: rotate each local slot by its own
+                        # old/new basis rows (owner-local transport)
+                        with jax.named_scope('kfac.EkfacScales.rotate'):
+                            scales_prev = engine.rotate_ekfac_scales_local(
+                                plan, scales_prev,
+                                engine.local_evecs(plan, decomp, axis_name,
+                                                   'pred'),
+                                decomp_local['evecs'], axis_name)
                     decomp = decomp_local
         if self.ekfac:
             decomp = dict(decomp)
@@ -426,9 +452,16 @@ class KFAC:
                 reduce = ('local' if self.exclude_communicate_factor
                           else self.stats_reduce)
                 with jax.named_scope('kfac.EkfacScales'):
-                    decomp['scales'] = engine.update_ekfac_scales(
-                        plan, decomp, acts, gs, self.batch_averaged,
-                        scales_prev, self.factor_decay, reduce, axis_name)
+                    if self.comm_mode == 'pred':
+                        # owner-local moments: zero scale communication
+                        decomp['scales'] = engine.update_ekfac_scales_local(
+                            plan, decomp, acts, gs, self.batch_averaged,
+                            scales_prev, self.factor_decay, axis_name)
+                    else:
+                        decomp['scales'] = engine.update_ekfac_scales(
+                            plan, decomp, acts, gs, self.batch_averaged,
+                            scales_prev, self.factor_decay, reduce,
+                            axis_name)
 
         grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
         with jax.named_scope('kfac.Precondition'):
@@ -439,7 +472,8 @@ class KFAC:
             else:
                 preds = engine.compute_pred_local(
                     plan, decomp, grad_mats, damping, self.method, axis_name,
-                    communicate=not self.exclude_communicate_inverse)
+                    communicate=not self.exclude_communicate_inverse,
+                    scales=decomp.get('scales') if self.ekfac else None)
 
         new_grads = engine.preconditioned_grads(
             plan, grads, grad_mats, preds, lr, self.kl_clip,

@@ -135,8 +135,11 @@ def main():
     model_name = os.environ.get('COMM_COUNT_MODEL', 'resnet20')
     print(f'model={model_name} ndev={ndev} (counts from the compiled '
           'SPMD module)')
+    variants = tuple(os.environ.get(
+        'COMM_COUNT_VARIANTS',
+        'sgd eigen inverse eigen_dp inverse_dp').split())
     counts, volumes = {}, {}
-    for variant in ('sgd', 'eigen', 'inverse', 'eigen_dp', 'inverse_dp'):
+    for variant in variants:
         counts[variant], volumes[variant] = collective_counts(
             variant, ndev=ndev, model_name=model_name)
         print(f'{variant:>12}: ops {counts[variant]}  '
@@ -155,12 +158,18 @@ def main():
 
     # the ledger analog (reference scripts/time_breakdown.py:27): K-FAC
     # comm VOLUME beyond the SGD gradient-allreduce floor
+    if 'sgd' not in volumes:
+        return
     sgd_bytes = sum(volumes['sgd'].values())
     print(f'\nSGD gradient-allreduce floor: {sgd_bytes / 2**20:.2f} MiB')
-    for variant in ('eigen', 'inverse', 'eigen_dp', 'inverse_dp'):
+    for variant in variants:
+        if variant == 'sgd':
+            continue
         extra = sum(volumes[variant].values()) - sgd_bytes
         print(f'{variant:>12}: +{extra / 2**20:8.2f} MiB K-FAC comm per '
               'full factor+inverse step')
+    if 'eigen' not in volumes or 'eigen_dp' not in volumes:
+        return
     e, edp = (sum(volumes['eigen'].values()) - sgd_bytes,
               sum(volumes['eigen_dp'].values()) - sgd_bytes)
     if e > 0:

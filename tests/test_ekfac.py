@@ -259,6 +259,192 @@ def test_ekfac_accepts_pre_ekfac_checkpoint_state():
                for v in new_state.decomp['scales'].values())
 
 
+def test_ekfac_dp_world1_matches_ekfac():
+    """With one device the owner-local ('ekfac_dp') and replicated
+    ('ekfac') layouts see identical data and bases — the preconditioned
+    gradients must agree."""
+    x, y = _data(seed=17)
+    pre_r, model, variables = _make_pre('ekfac')
+    _, _, grads, acts, gs, _ = _capture_batch(model, variables, x, y)
+    want, _ = pre_r.step(pre_r.init(), grads, acts, gs)
+
+    pre_d, _, _ = _make_pre('ekfac_dp')
+    got, state_d = pre_d.step(pre_d.init(), grads, acts, gs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        got, want)
+    assert all(bool(jnp.any(v != 0))
+               for v in state_d.decomp['scales'].values())
+
+
+def test_ekfac_dp_uses_owner_local_scales():
+    """nd=2: layer i's scales (and factors) must come from the OWNER's
+    local shard only — host oracle recomputes the full E-KFAC pred from
+    per-shard captures, mirroring
+    tests/test_distributed.py::test_dp_uses_owner_local_stats."""
+    from flax import linen as flinen
+
+    ND = 2
+    decay, damping = 1.0, 0.01
+
+    class MLP2(flinen.Module):
+        @flinen.compact
+        def __call__(self, x, train=True):
+            x = flinen.relu(knn.Dense(7, name='fc1')(x))
+            return knn.Dense(DOUT, name='fc2')(x)
+
+    x, y = _data(seed=19)
+    model = MLP2()
+    variables = capture.init(model, jax.random.PRNGKey(0), x)
+    metas = capture.collect_layer_meta(model, variables, x)
+    pre = kfac.KFAC(variant='ekfac_dp', lr=0.1, damping=damping,
+                    fac_update_freq=1, kfac_update_freq=1,
+                    factor_decay=decay, kl_clip=None,
+                    num_devices=ND, axis_name='batch',
+                    bucket_fn=lambda d: d)
+    pre.setup(metas)
+
+    mesh = Mesh(np.array(jax.devices()[:ND]), ('batch',))
+    kspecs = pre.state_pspecs('batch')
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), kspecs, P('batch'), P('batch')),
+        out_specs=P())
+    def sharded(params, kstate, x, y):
+        _, _, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+            model, lambda out: _ce(out, y), {'params': params}, x,
+            axis_name='batch')
+        grads = kfac.parallel.average_grads(grads, 'batch')
+        new_grads, _ = pre.step(kstate, grads, acts, gs,
+                                axis_name='batch')
+        return new_grads
+
+    got = sharded(variables['params'], pre.init(), x, y)
+
+    # host oracle: per-shard captures; layer i owned round-robin
+    h = len(x) // ND
+    shard = []
+    for d in range(ND):
+        xs, ys = x[d * h:(d + 1) * h], y[d * h:(d + 1) * h]
+        _, _, sg, sa, sgs, _ = capture.value_and_grad_with_capture(
+            model, lambda out: _ce(out, ys), variables, xs)
+        shard.append((sg, sa, sgs))
+    grads_full = jax.tree.map(
+        lambda *g: sum(np.asarray(v) for v in g) / ND,
+        *[s[0] for s in shard])
+
+    for i, (name, meta) in enumerate(metas.items()):
+        owner = i % ND
+        _, sa, sgs = shard[owner]
+        a_loc = np.asarray(sa[name]['a'])
+        g_loc = np.asarray(sgs[name]['g'])
+        n_loc = a_loc.shape[0]
+        arows = np.concatenate(
+            [a_loc, np.ones((n_loc, 1), np.float32)], axis=1)
+        ghat = g_loc * n_loc
+        A = (arows.T @ arows) / n_loc
+        G = (ghat.T @ ghat) / n_loc
+        dA, QA = np.linalg.eigh(A)
+        dG, QG = np.linalg.eigh(G)
+        # owner-local E-KFAC moments from the owner's own rows
+        pa, pg = arows @ QA, ghat @ QG
+        s = (pg ** 2).T @ (pa ** 2) / n_loc
+        gm = np.concatenate(
+            [np.asarray(grads_full[name]['kernel']).T,
+             np.asarray(grads_full[name]['bias'])[:, None]], axis=1)
+        v2 = (QG.T @ gm @ QA) / (s + damping)
+        want = QG @ v2 @ QA.T
+        gk = np.concatenate([np.asarray(got[name]['kernel']).T,
+                             np.asarray(got[name]['bias'])[:, None]], 1)
+        np.testing.assert_allclose(gk, want, rtol=2e-3, atol=1e-4)
+
+
+def test_ekfac_dp_accepts_pre_ekfac_checkpoint_state_sharded():
+    """A pre-ekfac ('eigen_dp') state with no 'scales' key restored into
+    'ekfac_dp' at world size > 1 must step inside shard_map without
+    crashing OR silently broadcasting the wrong layout: the in-trace
+    zero-scales default must use the LOCAL slot count."""
+    ND = 2
+    x, y = _data(seed=29)
+    pre_e, model, variables = _make_pre('eigen_dp', num_devices=ND,
+                                        axis_name='batch')
+    pre_k, _, _ = _make_pre('ekfac_dp', num_devices=ND,
+                            axis_name='batch')
+    mesh = Mesh(np.array(jax.devices()[:ND]), ('batch',))
+    kspecs_e = pre_e.state_pspecs('batch')
+    kspecs_k = pre_k.state_pspecs('batch')
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), kspecs_e, P('batch'), P('batch')),
+        out_specs=(P(), kspecs_e))
+    def warm(params, kstate, x, y):
+        _, _, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+            model, lambda out: _ce(out, y), {'params': params}, x,
+            axis_name='batch')
+        grads = kfac.parallel.average_grads(grads, 'batch')
+        return pre_e.step(kstate, grads, acts, gs, axis_name='batch')
+
+    _, state_e = warm(variables['params'], pre_e.init(), x, y)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), kspecs_e, P('batch'), P('batch')),
+        out_specs=(P(), kspecs_k))
+    def resume(params, kstate, x, y):
+        _, _, grads, acts, gs, _ = capture.value_and_grad_with_capture(
+            model, lambda out: _ce(out, y), {'params': params}, x,
+            axis_name='batch')
+        grads = kfac.parallel.average_grads(grads, 'batch')
+        return pre_k.step(kstate, grads, acts, gs, axis_name='batch')
+
+    got, state_k = resume(variables['params'], state_e, x, y)
+    assert all(np.isfinite(np.asarray(v)).all()
+               for v in jax.tree.leaves(got))
+    # the out-specs round-trip pins the sharded GLOBAL scale layout
+    want_shapes = {k: v.shape
+                   for k, v in pre_k.init().decomp['scales'].items()}
+    got_shapes = {k: v.shape for k, v in state_k.decomp['scales'].items()}
+    assert got_shapes == want_shapes, (got_shapes, want_shapes)
+
+
+def test_ekfac_dp_trains_and_composes():
+    """ekfac_dp through build_train_step on the 4-device mesh with the
+    amortized basis: loss decreases, scales populate."""
+    from flax import linen as flinen
+
+    class MLP3(flinen.Module):
+        @flinen.compact
+        def __call__(self, x, train=True):
+            x = flinen.relu(knn.Dense(12, name='fc1')(x))
+            return knn.Dense(DOUT, name='head')(x)
+
+    ND = 4
+    x, y = _data(seed=23)
+    model = MLP3()
+    pre = kfac.KFAC(variant='ekfac_dp', lr=0.1, damping=0.01,
+                    fac_update_freq=1, kfac_update_freq=1,
+                    basis_update_freq=4, num_devices=ND,
+                    axis_name='batch')
+    tx = training.sgd(0.1, momentum=0.9)
+    state = training.init_train_state(model, tx, pre,
+                                      jax.random.PRNGKey(0), x)
+    mesh = Mesh(np.array(jax.devices()[:ND]), ('batch',))
+    step = training.build_train_step(
+        model, tx, pre, lambda o, b: _ce(o, b['label']),
+        axis_name='batch', mesh=mesh, donate=False)
+    losses = []
+    for _ in range(10):
+        state, m = step(state, {'input': x, 'label': y},
+                        lr=0.1, damping=0.01)
+        losses.append(float(m['loss']))
+    assert losses[-1] < losses[0], losses
+    assert all(bool(jnp.any(v != 0))
+               for v in state.kfac_state.decomp['scales'].values())
+
+
 def test_ekfac_rotation_exact_under_sign_flips():
     """Basis transport sanity: flipping eigenvector signs (the eigh
     gauge freedom) must leave the transported scales unchanged."""
