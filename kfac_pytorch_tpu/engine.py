@@ -329,6 +329,24 @@ def refresh_decomposition(plan, factors_local, decomp_prev, eps, axis_name,
     return {'evals': evals, 'evecs': evecs_local}
 
 
+def _layer_rows_padded(meta, acts, gs, batch_averaged, pg):
+    """This layer's factor-convention row matrices (ops.layer_rows_*),
+    feature-padded with zeros to the pred group's bucket dims — the one
+    shared row/padding contract of both E-KFAC moment estimators."""
+    a = capture.layer_act(acts, meta)
+    g = capture.layer_g(gs, meta)
+    if meta.kind == 'dense':
+        arows, grows, n = ops.layer_rows_dense(
+            a, g, meta.use_bias, batch_averaged)
+    else:
+        arows, grows, n = ops.layer_rows_conv(
+            a, g, meta.kernel_size, meta.strides, meta.padding,
+            meta.use_bias, batch_averaged)
+    arows = jnp.pad(arows, ((0, 0), (0, pg.da - arows.shape[1])))
+    grows = jnp.pad(grows, ((0, 0), (0, pg.dg - grows.shape[1])))
+    return arows, grows, n
+
+
 def update_ekfac_scales(plan, decomp, acts, gs, batch_averaged,
                         scales_prev, factor_decay, stats_reduce,
                         axis_name):
@@ -360,17 +378,8 @@ def update_ekfac_scales(plan, decomp, acts, gs, batch_averaged,
         member_scales = []
         for pos, i in enumerate(pg.layer_idx):
             meta = plan.metas[int(i)]
-            a = capture.layer_act(acts, meta)
-            g = capture.layer_g(gs, meta)
-            if meta.kind == 'dense':
-                arows, grows, n = ops.layer_rows_dense(
-                    a, g, meta.use_bias, batch_averaged)
-            else:
-                arows, grows, n = ops.layer_rows_conv(
-                    a, g, meta.kernel_size, meta.strides, meta.padding,
-                    meta.use_bias, batch_averaged)
-            arows = jnp.pad(arows, ((0, 0), (0, pg.da - arows.shape[1])))
-            grows = jnp.pad(grows, ((0, 0), (0, pg.dg - grows.shape[1])))
+            arows, grows, n = _layer_rows_padded(meta, acts, gs,
+                                                 batch_averaged, pg)
             qa = decomp['evecs'][_key(pg.da)][int(pg.row_a[pos])]
             qg = decomp['evecs'][_key(pg.dg)][int(pg.row_g[pos])]
             member_scales.append(ops.ekfac_scales(arows, grows, qa, qg, n))
@@ -413,17 +422,8 @@ def update_ekfac_scales_local(plan, decomp_local, acts, gs,
         slot_s = jnp.zeros((K, pg.dg, pg.da), jnp.float32)
         for pos, i in enumerate(pg.layer_idx):
             meta = plan.metas[int(i)]
-            a = capture.layer_act(acts, meta)
-            g = capture.layer_g(gs, meta)
-            if meta.kind == 'dense':
-                arows, grows, n = ops.layer_rows_dense(
-                    a, g, meta.use_bias, batch_averaged)
-            else:
-                arows, grows, n = ops.layer_rows_conv(
-                    a, g, meta.kernel_size, meta.strides, meta.padding,
-                    meta.use_bias, batch_averaged)
-            arows = jnp.pad(arows, ((0, 0), (0, pg.da - arows.shape[1])))
-            grows = jnp.pad(grows, ((0, 0), (0, pg.dg - grows.shape[1])))
+            arows, grows, n = _layer_rows_padded(meta, acts, gs,
+                                                 batch_averaged, pg)
             # dummy pad slots can repeat a member index: restrict the
             # selection to valid slots so exactly the owner slot (or
             # nothing) is picked

@@ -42,13 +42,25 @@ leg() {  # leg <name> <env...> -- <extra trainer args...>
     || echo "=== leg $name FAILED rc=$?"
 }
 
-leg sgd            kfac=0 --
-leg cold_eigen     kfac=1 kfac_name=eigen_dp --
-leg cold_chol      kfac=1 kfac_name=inverse_dp --
-leg warm_ns        kfac=1 kfac_name=inverse_dp -- --kfac-warm-start
-leg basis10        kfac=1 kfac_name=eigen_dp basis_freq=10 --
-leg warm_subspace  kfac=1 kfac_name=eigen_dp KFAC_EIGH_IMPL=subspace \
-    -- --kfac-warm-start
+# AB_LEGS=ekfac runs only the E-KFAC ladder (appended round 4); default
+# runs the original six legs
+if [ "${AB_LEGS:-}" != "ekfac" ]; then
+  leg sgd            kfac=0 --
+  leg cold_eigen     kfac=1 kfac_name=eigen_dp --
+  leg cold_chol      kfac=1 kfac_name=inverse_dp --
+  leg warm_ns        kfac=1 kfac_name=inverse_dp -- --kfac-warm-start
+  leg basis10        kfac=1 kfac_name=eigen_dp basis_freq=10 --
+  leg warm_subspace  kfac=1 kfac_name=eigen_dp KFAC_EIGH_IMPL=subspace \
+      -- --kfac-warm-start
+else
+  # E-KFAC on the real conv task: at the recipe damping, at its own
+  # larger lambda (the MLP sweep preferred ~10x — its denominators are
+  # exact second moments), and amortized-basis at that lambda
+  leg ekfac          kfac=1 kfac_name=ekfac_dp --
+  leg ekfac_d3       kfac=1 kfac_name=ekfac_dp -- --damping 0.3
+  leg ekfac_b10_d3   kfac=1 kfac_name=ekfac_dp basis_freq=10 \
+      -- --damping 0.3
+fi
 
 echo "=== digits-hard A/B complete $(date)"
 python scripts/parse_logs.py logs/cifar10_*digits_hard*.log 2>/dev/null \
