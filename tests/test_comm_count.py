@@ -19,7 +19,7 @@ def test_dp_comm_volume_below_mpd():
     from scripts.comm_count import collective_counts
 
     vols = {}
-    for variant in ('sgd', 'eigen', 'eigen_dp'):
+    for variant in ('sgd', 'eigen', 'eigen_dp', 'ekfac', 'ekfac_dp'):
         _, by_kind = collective_counts(variant, ndev=8,
                                        model=TinyCNN(batch_norm=False),
                                        hw=8)
@@ -34,3 +34,7 @@ def test_dp_comm_volume_below_mpd():
     extra_dp = vols['eigen_dp'] - vols['sgd']
     extra_mpd = vols['eigen'] - vols['sgd']
     assert extra_dp < 0.5 * extra_mpd, vols
+    # E-KFAC comm story (compiler-pinned): owner-local moments add ZERO
+    # bytes over eigen_dp; the MPD variant pays for its scales pmean
+    assert vols['ekfac_dp'] == vols['eigen_dp'], vols
+    assert vols['ekfac'] > vols['eigen'], vols
