@@ -25,6 +25,10 @@ def main():
     ap.add_argument('root', nargs='?', default='logs/tb_digits_hard')
     ap.add_argument('--val-n', type=int, default=600,
                     help='held-out set size (quantization = 1/N)')
+    ap.add_argument('--out', default=None,
+                    help='output png path (default: <root>_ab.png '
+                    'derived from the TB dir, so per-seed runs never '
+                    'overwrite each other)')
     args = ap.parse_args()
 
     legs = {}
@@ -71,8 +75,10 @@ def main():
                  f'{args.val_n} clean val)')
     ax.legend(loc='lower right', fontsize=8)
     ax.grid(alpha=0.3)
-    out = os.path.join(os.path.dirname(os.path.abspath(args.root)),
-                       'digits_hard_ab.png')
+    # derive the name from the TB dir so a second-seed summary cannot
+    # silently clobber the first's plot (it did once, round 4)
+    out = args.out or (os.path.abspath(args.root).rstrip('/')
+                       + '_ab.png')
     fig.savefig(out, dpi=120, bbox_inches='tight')
     print(f'\nwrote {out}')
 
