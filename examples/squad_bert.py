@@ -57,7 +57,8 @@ def parse_args():
                         'variants Newton-Schulz-iterate the previous '
                         'inverse')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
-    p.add_argument('--kfac-name', default='eigen_dp')
+    p.add_argument('--kfac-name', default='eigen_dp',
+                   choices=list(kfac.KFAC_VARIANTS))
     p.add_argument('--stat-decay', type=float, default=0.95)
     p.add_argument('--damping', type=float, default=0.003)
     p.add_argument('--kl-clip', type=float, default=0.001)

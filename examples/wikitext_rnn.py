@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from kfac_pytorch_tpu import training, utils
+from kfac_pytorch_tpu import KFAC_VARIANTS, training, utils
 from kfac_pytorch_tpu.models import rnn
 
 
@@ -51,7 +51,8 @@ def parse_args():
                    help='0 = SGD (reference-parity: its RNN K-FAC is '
                         'broken); N>0 preconditions the LSTM matmuls')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
-    p.add_argument('--kfac-name', default='eigen_dp')
+    p.add_argument('--kfac-name', default='eigen_dp',
+                   choices=list(KFAC_VARIANTS))
     p.add_argument('--damping', type=float, default=0.003)
     p.add_argument('--stat-decay', type=float, default=0.95)
     p.add_argument('--kl-clip', type=float, default=0.001)
