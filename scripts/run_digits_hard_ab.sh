@@ -42,9 +42,16 @@ leg() {  # leg <name> <env...> -- <extra trainer args...>
     || echo "=== leg $name FAILED rc=$?"
 }
 
-# AB_LEGS=ekfac runs only the E-KFAC ladder (appended round 4); default
-# runs the original six legs
-if [ "${AB_LEGS:-}" != "ekfac" ]; then
+# AB_LEGS=ekfac runs only the E-KFAC ladder (appended round 4);
+# AB_LEGS=trio runs the three-way amortization triangulation
+# (cold eigen / plain basis10 / E-KFAC-corrected basis10) for extra
+# seeds; default runs the original six legs
+if [ "${AB_LEGS:-}" = "trio" ]; then
+  leg cold_eigen     kfac=1 kfac_name=eigen_dp --
+  leg basis10        kfac=1 kfac_name=eigen_dp basis_freq=10 --
+  leg ekfac_b10_d3   kfac=1 kfac_name=ekfac_dp basis_freq=10 \
+      -- --damping 0.3
+elif [ "${AB_LEGS:-}" != "ekfac" ]; then
   leg sgd            kfac=0 --
   leg cold_eigen     kfac=1 kfac_name=eigen_dp --
   leg cold_chol      kfac=1 kfac_name=inverse_dp --
