@@ -397,6 +397,18 @@ def main():
     from kfac_pytorch_tpu.utils.platform import probe_backend
 
     _install_partial_emitter()
+    # the analytic perf model's predictions ride along BEFORE any backend
+    # contact: a tunnel-down round still emits falsifiable per-variant
+    # numbers (clearly labeled predicted_not_measured — VERDICT r4 #1).
+    # Pure arithmetic over committed inputs + fenced r2 chip constants;
+    # never allowed to break the bench (predict_block self-reports errors)
+    try:
+        from kfac_pytorch_tpu import perfmodel
+        PARTIAL['extra']['predicted'] = perfmodel.predict_block()
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        PARTIAL['extra']['predicted'] = {'predicted_not_measured': True,
+                                         'error': repr(e)}
     # overwrite any previous run's checkpoint file BEFORE probing: if this
     # run dies emit-less inside backend init, the queue must read an
     # honest null, not the prior run's numbers

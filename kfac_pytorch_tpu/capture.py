@@ -220,6 +220,16 @@ def check_local_mean_loss(loss, batch, axis_name):
     (outside shard_map, or ``check_vma=False`` — but beware:
     ``check_vma=False`` ALSO disables the cross-axis cotangent psums the
     capture relies on, the postmortem's second trap).
+
+    Caveat (ADVICE r4): only a FULLY cross-axis-reduced loss is detected.
+    A loss whose *denominator* was globally normalized while the
+    numerator still varies — e.g. the masked-LM pattern
+    ``local_token_loss_sum / psum(token_count)`` — keeps the batch's vma
+    through the varying numerator and passes this guard, yet it violates
+    the local-mean convention whenever shards hold unequal token counts
+    (each shard's cotangents are scaled by the *global* count instead of
+    its own). Normalize by the LOCAL count and let the engine's gradient
+    averaging handle the cross-shard mean.
     """
     if axis_name is None:
         return

@@ -75,13 +75,53 @@ _VARIANTS = {
 }
 
 
+_EKFAC_DAMPING_WARNED = False
+
+
+def _warn_ekfac_damping_once(damping):
+    """One-time heads-up that ekfac variants want their own damping.
+
+    The exact second-moment denominators are systematically >= the
+    Kronecker eigenvalue product (the eigen variants' denominators), so
+    a lambda tuned for 'eigen'/'eigen_dp' can under-damp ekfac — on the
+    NOTES r4 MLP ladder the preferred lambda was 10x the eigen recipe's,
+    while on conv the shared value worked. Fires once per process
+    (VERDICT r4 #4); silence with ``warnings.filterwarnings``.
+    """
+    global _EKFAC_DAMPING_WARNED
+    if _EKFAC_DAMPING_WARNED:
+        return
+    _EKFAC_DAMPING_WARNED = True
+    import warnings
+    warnings.warn(
+        f'ekfac variants replace the Kronecker eigenvalue product with '
+        f'exact (typically larger) second moments in the denominator — '
+        f'a damping calibrated for an eigen variant (got {damping}) may '
+        'be too small here. If this config was tuned on eigen/eigen_dp, '
+        'sweep damping upward (3x/10x) before judging ekfac; see the '
+        'KFAC docstring damping note and the NOTES r4 ladder.',
+        stacklevel=3)
+
+
 class KFAC:
     """Distributed K-FAC gradient preconditioner.
 
     Args mirror the reference constructor (kfac_preconditioner_base.py:66-99)
     plus the mesh placement knobs:
 
-      variant: one of 'inverse' | 'eigen' | 'inverse_dp' | 'eigen_dp'.
+      variant: one of 'inverse' | 'eigen' | 'inverse_dp' | 'eigen_dp'
+        (reference parity) or 'ekfac' | 'ekfac_dp' (beyond reference).
+        DAMPING NOTE for the ekfac variants: their denominators are
+        exact per-example second moments in the joint eigenbasis, which
+        are systematically >= the Kronecker eigenvalue product they
+        replace (Cauchy-Schwarz on the cross terms) — so a ``damping``
+        calibrated for an eigen variant can be too SMALL relative to
+        the ekfac spectrum. On an MLP task the preferred lambda was 10x
+        the eigen recipe's (NOTES r4 damping ladder: .832 at 0.3 vs
+        .678 at 0.03); on conv the shared recipe value worked. When
+        switching a tuned eigen config to ekfac, sweep damping upward
+        (3x/10x) before judging the variant; a one-time warning points
+        here (pinned by tests/test_warm_accuracy_gate.py's ladder).
       lr, damping, fac_update_freq, kfac_update_freq, kl_clip,
       factor_decay, exclude_vocabulary_size, hook_enabled, exclude_parts:
         reference semantics.
@@ -145,6 +185,8 @@ class KFAC:
         self.method = cfg['method']
         self.comm_mode = cfg['comm_mode']
         self.ekfac = cfg.get('ekfac', False)
+        if self.ekfac:
+            _warn_ekfac_damping_once(damping)
         self.lr = lr
         self.damping = damping
         self.fac_update_freq = fac_update_freq

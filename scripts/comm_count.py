@@ -61,6 +61,17 @@ def _payload_bytes(result_type, kind=''):
                   if not (s[1] == '' and s[0] in ('u32', 's32'))]
         if shapes and len(shapes) % 2 == 0:
             shapes = shapes[len(shapes) // 2:]
+        elif shapes:
+            # the alias/output halves failed to pair 1:1 — the full tuple
+            # gets counted, roughly doubling this op's volume (ADVICE r4:
+            # flag it so a silently-doubled variant is visible in the
+            # ledger instead of quietly inflating it)
+            if 'odd-async-tuple' not in _WARNED_DTYPES:
+                _WARNED_DTYPES.add('odd-async-tuple')
+                print(f'warning: async {kind} result tuple has odd '
+                      f'length {len(shapes)} — even alias/output split '
+                      'assumption failed; counting the FULL tuple (may '
+                      'double this op\'s bytes)', file=sys.stderr)
     total = 0
     for dt, dims in shapes:
         size = DTYPE_BYTES.get(dt)

@@ -32,8 +32,15 @@ def run_two_process(argv_fn, env, tag):
                 p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
-    lines = [[l for l in o.splitlines() if l.startswith(tag)][-1]
-             for o in outs]
+    lines = []
+    for i, o in enumerate(outs):
+        tagged = [l for l in o.splitlines() if l.startswith(tag)]
+        # a worker can exit 0 without ever reaching the tag print (e.g. a
+        # skipped drill body); indexing [-1] directly would surface that
+        # as an opaque IndexError with no worker output (ADVICE r4)
+        assert tagged, (f'worker {i} exited 0 but never printed a '
+                        f'{tag!r} line; output tail: {o[-2000:]}')
+        lines.append(tagged[-1])
     assert lines[0] == lines[1], lines
     return outs
 
