@@ -195,6 +195,9 @@ def main():
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer
     tb = maybe_writer(args.tb_dir)
     guard = utils.PreemptionGuard()
+    # health-guard event log: skipped batches / ladder escalations surface
+    # as WARNINGs at the step they happen, plus a per-epoch summary suffix
+    monitor = utils.HealthMonitor(log, state=state)
     lr_now = args.base_lr
     for epoch in range(args.epochs):
         train_loss = utils.Metric('train_loss')
@@ -207,6 +210,7 @@ def main():
             state, m = step(state, batch, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             train_loss.update(m['loss'], len(batch['label']))
+            monitor.update(m, step=int(state.step) - 1)
         if guard.should_stop():
             # preemption grace window: save the live state and exit clean.
             # The epoch is incomplete — tag the checkpoint with the LAST
@@ -238,8 +242,10 @@ def main():
         # and reuse the values in the rank-0-only tb block below
         tl, vl_avg, va_avg = (train_loss.sync().avg, val_loss.sync().avg,
                               val_acc.sync().avg)
+        from kfac_pytorch_tpu.utils.runlog import health_suffix
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)', epoch, tl, vl_avg, va_avg, time.time() - t0)
+                 '(%.1fs)%s', epoch, tl, vl_avg, va_avg, time.time() - t0,
+                 health_suffix(monitor.epoch_flush()))
         log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)

@@ -170,12 +170,14 @@ def main():
             update_freq_alpha=args.kfac_update_freq_alpha,
             update_freq_schedule=args.kfac_update_freq_decay)
 
-    # auto-resume (reference: pytorch_imagenet_resnet.py:162-167,305-312)
+    # auto-resume (reference: pytorch_imagenet_resnet.py:162-167,305-312),
+    # hardened: an unreadable newest checkpoint (truncated write, storage
+    # corruption) falls back to the next-older epoch instead of crashing
     start_epoch = 0
-    resume = utils.find_resume_epoch(args.checkpoint_format, args.epochs)
+    restored, resume = utils.auto_resume(args.checkpoint_format,
+                                         args.epochs, state)
     if resume is not None:
-        state = utils.restore_checkpoint(args.checkpoint_format, resume,
-                                         state)
+        state = restored
         start_epoch = resume + 1
         if scheduler is not None:
             scheduler.step(start_epoch)
@@ -207,6 +209,7 @@ def main():
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer
     tb = maybe_writer(args.tb_dir)
     guard = utils.PreemptionGuard()
+    monitor = utils.HealthMonitor(log, state=state)
     lr_now = args.base_lr
     for epoch in range(start_epoch, args.epochs):
         t0 = time.time()
@@ -220,6 +223,7 @@ def main():
             state, m = step(state, b, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             tm.update(m['loss'])
+            monitor.update(m, step=int(state.step) - 1)
         if guard.should_stop():
             # preemption grace window: save the live state and exit clean.
             # Tag with the LAST completed epoch: auto-resume then replays
@@ -245,8 +249,10 @@ def main():
         # sync() is a cross-process collective — call it on ALL ranks here
         # and reuse the values in the rank-0-only tb block below
         tl, vl_avg, va_avg = (tm.sync().avg, vl.sync().avg, va.sync().avg)
+        from kfac_pytorch_tpu.utils.runlog import health_suffix
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)', epoch, tl, vl_avg, va_avg, time.time() - t0)
+                 '(%.1fs)%s', epoch, tl, vl_avg, va_avg, time.time() - t0,
+                 health_suffix(monitor.epoch_flush()))
         log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)

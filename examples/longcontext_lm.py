@@ -207,6 +207,7 @@ def main():
     rng = np.random.RandomState(args.seed)
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
+    monitor = metrics.HealthMonitor(log, state=state)
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
         loss_m = metrics.Metric('loss')
@@ -219,6 +220,7 @@ def main():
             # float() pulls the loss to the host — the real execution
             # fence (block_until_ready does not fence on the tunnel)
             loss_m.update(float(m['loss']))
+            monitor.update(m, step=int(state.step) - 1)
             if args.speed:
                 if i == 4:  # measure idle round-trip once, post-fence
                     from kfac_pytorch_tpu.utils import profiling
@@ -239,8 +241,10 @@ def main():
             val_m.update(float(eval_step(state.params, vb)))
         ppl = math.exp(min(loss_m.avg, 20))
         vppl = math.exp(min(val_m.avg, 20))
-        log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)', epoch,
-                 ppl, vppl, time.perf_counter() - t0)
+        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)%s', epoch,
+                 ppl, vppl, time.perf_counter() - t0,
+                 health_suffix(monitor.epoch_flush()))
         if tb is not None:
             tb.add_scalar('train/ppl', ppl, epoch)
             tb.add_scalar('val/ppl', vppl, epoch)

@@ -250,6 +250,8 @@ def main():
         wrapped, tx, precond, loss_fn, axis_name=axis, mesh=mesh,
         dropout_seed=args.seed + 2)
 
+    monitor = utils.HealthMonitor(log, state=state)
+
     def run_epoch(state, epoch):
         m = utils.Metric('loss')
         n = len(train_src) // args.batch_size
@@ -262,6 +264,7 @@ def main():
             state, metrics = step(state, batch, lr=args.base_lr,
                                   damping=args.damping if precond else 0.0)
             m.update(metrics['loss'])
+            monitor.update(metrics, step=int(state.step) - 1)
         return state, m.avg
 
     if args.speed:
@@ -296,8 +299,10 @@ def main():
             hyps.append(h)
             refs.append(r)
         score = translator.bleu(hyps, refs)
-        log.info('epoch %d: train_loss %.4f BLEU %.2f (%.1fs)',
-                 epoch, train_loss, score, time.time() - t0)
+        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        log.info('epoch %d: train_loss %.4f BLEU %.2f (%.1fs)%s',
+                 epoch, train_loss, score, time.time() - t0,
+                 health_suffix(monitor.epoch_flush()))
         if tb is not None:
             tb.add_scalar('train/loss', train_loss, epoch)
             tb.add_scalar('val/BLEU', score, epoch)

@@ -213,6 +213,7 @@ def main():
 
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
+    monitor = utils.HealthMonitor(log, state=state)
     for epoch in range(args.epochs):
         t0 = time.time()
         m = utils.Metric('loss')
@@ -227,13 +228,16 @@ def main():
             state, metrics = step(state, batch, lr=args.base_lr,
                                   damping=args.damping if precond else 0.0)
             m.update(metrics['loss'])
+            monitor.update(metrics, step=int(state.step) - 1)
         ps, pe = eval_step(state.params,
                            (jnp.asarray(vids), jnp.asarray(vtypes),
                             jnp.asarray(vmask)))
         f1, em = squad_f1_em(list(zip(np.asarray(ps), np.asarray(pe))),
                              list(zip(vstarts, vends)), vids)
-        log.info('epoch %d: loss %.4f F1 %.2f EM %.2f (%.1fs)',
-                 epoch, m.avg, f1, em, time.time() - t0)
+        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        log.info('epoch %d: loss %.4f F1 %.2f EM %.2f (%.1fs)%s',
+                 epoch, m.avg, f1, em, time.time() - t0,
+                 health_suffix(monitor.epoch_flush()))
         if tb is not None:
             tb.add_scalar('train/loss', m.avg, epoch)
             tb.add_scalar('val/F1', f1, epoch)

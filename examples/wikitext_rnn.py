@@ -157,6 +157,7 @@ def main():
 
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
+    monitor = utils.HealthMonitor(log, state=state)
     for epoch in range(args.epochs):
         t0 = time.time()
         m = utils.Metric('loss')
@@ -169,6 +170,7 @@ def main():
             state, metrics = step(state, batch, lr=args.base_lr,
                                   damping=args.damping)
             m.update(metrics['loss'])
+            monitor.update(metrics, step=int(state.step) - 1)
         vm = utils.Metric('val')
         for i in range((val_data.shape[1] - 1) // args.bptt):
             s = i * args.bptt
@@ -177,8 +179,10 @@ def main():
             vm.update(eval_step(state.params, x, y))
         ppl = math.exp(min(m.avg, 20))
         vppl = math.exp(min(vm.avg, 20))
-        log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)', epoch,
-                 ppl, vppl, time.time() - t0)
+        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)%s', epoch,
+                 ppl, vppl, time.time() - t0,
+                 health_suffix(monitor.epoch_flush()))
         if tb is not None:
             tb.add_scalar('train/ppl', ppl, epoch)
             tb.add_scalar('val/ppl', vppl, epoch)

@@ -20,9 +20,14 @@ TPU-first:
   (reference: packages/tcmm/src/communicator.cpp:75-117).
 """
 
+from kfac_pytorch_tpu import compat as _compat
+_compat.install()  # jax.shard_map on older jax (see compat.py)
+
 from kfac_pytorch_tpu.preconditioner import KFAC, KFACHyperParams, KFACState
 from kfac_pytorch_tpu.scheduler import KFACParamScheduler
+from kfac_pytorch_tpu.health import HealthConfig, HealthState
 from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu import faults
 from kfac_pytorch_tpu import nn
 from kfac_pytorch_tpu import ops
 

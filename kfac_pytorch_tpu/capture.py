@@ -200,6 +200,24 @@ def apply_with_capture(model, variables, *args, taps=None, mutable=(),
     return out, acts, mutated
 
 
+def all_finite(*trees):
+    """Scalar bool: every inexact leaf of every tree is finite.
+
+    The reduction feeding the health guard's batch screen (health.py):
+    one fused all-reduce over the loss, gradients and captured (a, g)
+    pytrees — integer/bool leaves are skipped (trivially finite), empty
+    trees are healthy by definition.
+    """
+    checks = []
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+                checks.append(jnp.all(jnp.isfinite(leaf)))
+    if not checks:
+        return jnp.ones((), bool)
+    return jnp.all(jnp.stack(checks))
+
+
 def check_local_mean_loss(loss, batch, axis_name):
     """Trace-time guard for the LOCAL-mean loss convention (free: reads
     avals only, compiles to nothing).

@@ -492,6 +492,91 @@ def rotate_ekfac_scales(plan, scales, evecs_prev, evecs_new):
     return out
 
 
+def _rows_finite(x):
+    """[rows, ...] -> [rows] bool: row contains no non-finite entry."""
+    return jnp.all(jnp.isfinite(x), axis=tuple(range(1, x.ndim)))
+
+
+def where_finite_rows(new, prev, reinit_identity=False):
+    """Per-leading-row non-finite screen over a ``{key: [rows, ...]}``
+    dict: rows of ``new`` containing any NaN/Inf are replaced by the
+    matching ``prev`` row. With ``reinit_identity=True`` a row whose
+    ``prev`` is ALSO non-finite re-initializes to the identity instead —
+    the factor-EMA heal path: a silently-corrupted stored factor block
+    resets to its init() value on the next factor update and
+    re-accumulates from fresh statistics, rather than staying NaN for
+    the rest of the run."""
+    out = {}
+    for key, n in new.items():
+        p = prev[key]
+        good = _rows_finite(n)
+        fb = p
+        if reinit_identity:
+            eye = jnp.eye(n.shape[-1], dtype=n.dtype)
+            pgood = _rows_finite(p)
+            fb = jnp.where(pgood[:, None, None], p, eye[None])
+        good = good.reshape(good.shape + (1,) * (n.ndim - 1))
+        out[key] = jnp.where(good, n, fb)
+    return out
+
+
+def local_decomposition(plan, decomp, axis_name, comm_mode, method):
+    """This device's rows of a stored decomposition, RAW (unlike
+    ``local_evecs`` no zero->identity substitution — the guard below
+    does its own cold handling)."""
+    if method == 'eigh':
+        return {'evals': _local_rows(plan, decomp['evals'], axis_name,
+                                     comm_mode),
+                'evecs': _local_rows(plan, decomp['evecs'], axis_name,
+                                     comm_mode)}
+    return {'invs': _local_rows(plan, decomp['invs'], axis_name, comm_mode)}
+
+
+def guard_decomposition(decomp_new, decomp_prev, method):
+    """Non-finite screen over a freshly-computed decomposition: per row,
+    fall back to the last good decomposition, or to the identity when no
+    good one exists yet (all-zero cold state).
+
+    An eigh/Cholesky blowup (ill-conditioned factor, injected fault)
+    then degrades that layer to its previous — still curvature-bearing —
+    preconditioner instead of poisoning every subsequent step; a cold
+    blowup degrades to the identity, i.e. plain gradient pass-through
+    scaled by ``1/(1+damping)``. Pure ``jnp.where`` selects: the healthy
+    path's output is bit-identical to the unguarded computation.
+
+    Layouts must match between ``decomp_new`` and ``decomp_prev`` (both
+    local rows, or both gathered/replicated). Only the decomposition
+    keys of ``decomp_new`` are consulted — extra state keys (E-KFAC
+    scales) are screened separately by :func:`where_finite_rows`.
+    """
+    if method == 'eigh':
+        out_d, out_q = {}, {}
+        for key in decomp_new['evecs']:
+            dn, qn = decomp_new['evals'][key], decomp_new['evecs'][key]
+            dp, qp = decomp_prev['evals'][key], decomp_prev['evecs'][key]
+            good = jnp.logical_and(_rows_finite(dn), _rows_finite(qn))
+            cold = jnp.logical_not(jnp.any(qp != 0, axis=(-2, -1)))
+            eye = jnp.eye(qn.shape[-1], dtype=qn.dtype)
+            fb_q = jnp.where(cold[:, None, None], eye[None], qp)
+            fb_d = jnp.where(cold[:, None], jnp.ones_like(dp), dp)
+            out_d[key] = jnp.where(good[:, None], dn, fb_d)
+            out_q[key] = jnp.where(good[:, None, None], qn, fb_q)
+        out = dict(decomp_new)
+        out['evals'], out['evecs'] = out_d, out_q
+        return out
+    out_i = {}
+    for key, xn in decomp_new['invs'].items():
+        xp = decomp_prev['invs'][key]
+        good = _rows_finite(xn)
+        cold = jnp.logical_not(jnp.any(xp != 0, axis=(-2, -1)))
+        eye = jnp.eye(xn.shape[-1], dtype=xn.dtype)
+        fb = jnp.where(cold[:, None, None], eye[None], xp)
+        out_i[key] = jnp.where(good[:, None, None], xn, fb)
+    out = dict(decomp_new)
+    out['invs'] = out_i
+    return out
+
+
 def gather_decomposition(plan, decomp_local, axis_name, communicate=True):
     """All-gather decomposition rows to every device (comm_inverse mode).
 

@@ -1,0 +1,179 @@
+"""Deterministic fault injection for chaos-testing the health subsystem.
+
+Faults are selected by environment variables, read ONCE at build time
+(``KFAC.__init__`` / ``training.build_train_step``), so the healthy path
+traces exactly the code it always traced and a configured fault fires on
+an exact step of the run — reproducible down to the bit, which is what
+the chaos drills (tests/test_health.py, tests/test_faults.py) assert.
+
+In-jit faults compare the traced step counter against a static step
+list, so enabling one never adds compiled step variants or host syncs:
+
+  KFAC_FAULT_NAN_GRAD_STEP   NaN gradients at the given step(s)
+  KFAC_FAULT_INF_GRAD_STEP   Inf gradients at the given step(s)
+  KFAC_FAULT_STATS_STEP      NaN captured (a, g) statistics — exercises
+                             the trainer's factor-statistics screen
+  KFAC_FAULT_FACTOR_STEP     corrupt the leading stored factor block
+                             AFTER the EMA guard — a silent-data-
+                             corruption drill for the decomposition
+                             guard + identity re-init heal path
+  KFAC_FAULT_EIGH_STEP       non-finite decomposition output ("eigh
+                             blowup") — exercises engine.guard_decomposition
+
+Step lists accept ``"7"``, ``"3,5,9"`` and half-open ranges ``"4:8"``.
+
+Host-side faults:
+
+  KFAC_FAULT_SIGTERM_STEP    deliver SIGTERM to this process at the
+                             given step (PreemptionGuard drill)
+  KFAC_FAULT_CKPT            'truncate' -> the pickle checkpoint writes
+                             half its bytes to the FINAL path (a crash
+                             mid-save, pre-atomic-rename behavior);
+                             'fail' -> the write dies after a partial
+                             tmp file (the atomic path must leave no
+                             final file behind)
+"""
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+ENV_NAN_GRAD = 'KFAC_FAULT_NAN_GRAD_STEP'
+ENV_INF_GRAD = 'KFAC_FAULT_INF_GRAD_STEP'
+ENV_STATS = 'KFAC_FAULT_STATS_STEP'
+ENV_FACTOR = 'KFAC_FAULT_FACTOR_STEP'
+ENV_EIGH = 'KFAC_FAULT_EIGH_STEP'
+ENV_SIGTERM = 'KFAC_FAULT_SIGTERM_STEP'
+ENV_CKPT = 'KFAC_FAULT_CKPT'
+
+
+def parse_steps(spec: Optional[str]) -> Tuple[int, ...]:
+    """``"7"`` -> (7,); ``"3,5"`` -> (3, 5); ``"4:8"`` -> (4, 5, 6, 7)."""
+    if not spec:
+        return ()
+    out = []
+    for part in spec.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        if ':' in part:
+            lo, hi = part.split(':')
+            out.extend(range(int(lo), int(hi)))
+        else:
+            out.append(int(part))
+    return tuple(sorted(set(out)))
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    nan_grad_steps: Tuple[int, ...] = ()
+    inf_grad_steps: Tuple[int, ...] = ()
+    stats_steps: Tuple[int, ...] = ()
+    factor_steps: Tuple[int, ...] = ()
+    eigh_steps: Tuple[int, ...] = ()
+    sigterm_step: Optional[int] = None
+    ckpt_mode: Optional[str] = None
+
+    @property
+    def any_injit(self) -> bool:
+        return bool(self.nan_grad_steps or self.inf_grad_steps
+                    or self.stats_steps or self.factor_steps
+                    or self.eigh_steps)
+
+
+def from_env() -> FaultConfig:
+    """Snapshot the fault environment (call at build/setup time)."""
+    sig = os.environ.get(ENV_SIGTERM)
+    mode = os.environ.get(ENV_CKPT) or None
+    if mode is not None and mode not in ('truncate', 'fail'):
+        raise ValueError(f'{ENV_CKPT} must be "truncate" or "fail", '
+                         f'got {mode!r}')
+    return FaultConfig(
+        nan_grad_steps=parse_steps(os.environ.get(ENV_NAN_GRAD)),
+        inf_grad_steps=parse_steps(os.environ.get(ENV_INF_GRAD)),
+        stats_steps=parse_steps(os.environ.get(ENV_STATS)),
+        factor_steps=parse_steps(os.environ.get(ENV_FACTOR)),
+        eigh_steps=parse_steps(os.environ.get(ENV_EIGH)),
+        sigterm_step=int(sig) if sig else None,
+        ckpt_mode=mode)
+
+
+def _hit(steps: Tuple[int, ...], step):
+    """Traced scalar bool: does the step counter match the static list?"""
+    h = jnp.zeros((), bool)
+    for s in steps:
+        h = jnp.logical_or(h, step == s)
+    return h
+
+
+def _poison(tree, hit, value):
+    def leaf(x):
+        if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+            return x
+        return jnp.where(hit, jnp.asarray(value, jnp.asarray(x).dtype), x)
+    return jax.tree.map(leaf, tree)
+
+
+def corrupt_grads(cfg: FaultConfig, step, grads):
+    """NaN/Inf gradient injection at the configured step(s)."""
+    if cfg.nan_grad_steps:
+        grads = _poison(grads, _hit(cfg.nan_grad_steps, step), jnp.nan)
+    if cfg.inf_grad_steps:
+        grads = _poison(grads, _hit(cfg.inf_grad_steps, step), jnp.inf)
+    return grads
+
+
+def corrupt_captured(cfg: FaultConfig, step, acts, gs):
+    """NaN injection into the captured (a, g) statistics."""
+    if cfg.stats_steps and acts is not None:
+        hit = _hit(cfg.stats_steps, step)
+        acts = _poison(acts, hit, jnp.nan)
+        gs = _poison(gs, hit, jnp.nan)
+    return acts, gs
+
+
+def corrupt_factors(cfg: FaultConfig, step, factors):
+    """Corrupt the LEADING row of every factor bucket (one bad block per
+    bucket — the per-row guard granularity is the point of the drill)."""
+    if not cfg.factor_steps:
+        return factors
+    hit = _hit(cfg.factor_steps, step)
+    return {k: v.at[0].set(jnp.where(hit, jnp.nan, v[0]))
+            for k, v in factors.items()}
+
+
+def corrupt_decomposition(cfg: FaultConfig, step, decomp):
+    """Non-finite decomposition output (simulated eigh blowup)."""
+    if not cfg.eigh_steps:
+        return decomp
+    return _poison(decomp, _hit(cfg.eigh_steps, step), jnp.nan)
+
+
+_SIGTERM_FIRED = False
+
+
+def reset_sigterm_fault():
+    """Re-arm the one-shot SIGTERM fault (test isolation)."""
+    global _SIGTERM_FIRED
+    _SIGTERM_FIRED = False
+
+
+def maybe_sigterm(cfg: Optional[FaultConfig], step: int) -> None:
+    """Host-side: deliver SIGTERM to this process once, at the
+    configured step (the PreemptionGuard chaos drill)."""
+    global _SIGTERM_FIRED
+    if (cfg is None or cfg.sigterm_step is None or _SIGTERM_FIRED
+            or step != cfg.sigterm_step):
+        return
+    _SIGTERM_FIRED = True
+    import signal
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def checkpoint_fault_mode() -> Optional[str]:
+    """Live read of the checkpoint-write fault (the save path consults
+    it per call so a drill can toggle it between epochs)."""
+    return os.environ.get(ENV_CKPT) or None

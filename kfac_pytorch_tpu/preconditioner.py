@@ -31,7 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from kfac_pytorch_tpu import engine
+from kfac_pytorch_tpu import engine, faults
+from kfac_pytorch_tpu import health as health_lib
 from kfac_pytorch_tpu.plan import build_plan, default_bucket_fn
 
 
@@ -163,6 +164,16 @@ class KFAC:
         ones — the chained basis Q <- Q @ V' accumulates ~1e-7
         orthogonality error per warm full, and the periodic cold full
         resets it. Must be a positive int.
+      health: the numerical-health guard (beyond reference, health.py).
+        True (default) enables the in-engine screens with the default
+        ladder: factor-EMA rows and decomposition rows that come back
+        non-finite fall back to the last good value (identity when
+        cold), so one blown eigh/Cholesky can never poison the state.
+        Pass a ``health.HealthConfig`` to tune the damping-escalation
+        ladder the trainer drives (escalate_after / damping_factor /
+        max_rungs / recover_after), or False to disable every screen —
+        the guards are pure pass-through selects when the inputs are
+        finite, so disabling only buys back their (tiny) compile cost.
     """
 
     def __init__(self, variant='eigen_dp', lr=0.1, damping=0.001,
@@ -173,7 +184,7 @@ class KFAC:
                  num_devices=1, axis_name=None, assignment='round_robin',
                  distribute_layer_factors=None, bucket_fn=None, eps=1e-10,
                  basis_update_freq=None, warm_start_basis=False,
-                 warm_sweeps=None, cold_restart_every=50):
+                 warm_sweeps=None, cold_restart_every=50, health=True):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -236,6 +247,10 @@ class KFAC:
             raise ValueError('cold_restart_every must be a positive int '
                              f'(got {cold_restart_every!r})')
         self.cold_restart_every = cold_restart_every
+        self.health = health_lib.resolve(health)
+        # deterministic fault injection (chaos tests): the env snapshot
+        # happens here, at construction, so the traced step is static
+        self._faults = faults.from_env()
         # exclude_parts ablation flags (kfac_preconditioner_base.py:96-99)
         self.exclude_communicate_inverse = 'CommunicateInverse' in exclude_parts
         self.exclude_compute_inverse = 'ComputeInverse' in exclude_parts
@@ -411,6 +426,19 @@ class KFAC:
                 factors = engine.update_factors(
                     plan, factors, stats, self.factor_decay, reduce,
                     axis_name)
+            if self.health is not None:
+                # non-finite EMA rows keep the last good factor; a row
+                # whose STORED value is already corrupt (silent data
+                # corruption) re-initializes to the identity and
+                # re-accumulates — pass-through when everything is finite
+                with jax.named_scope('kfac.HealthGuard.factors'):
+                    factors = engine.where_finite_rows(
+                        factors, state.factors, reinit_identity=True)
+            # SDC drill: corrupt a stored factor block AFTER the guard,
+            # so the corruption lands in the state exactly as a flipped
+            # bit would (tests/test_faults.py heal drill)
+            factors = faults.corrupt_factors(self._faults, state.step,
+                                             factors)
 
         if factors_only:
             # accumulate statistics but leave gradients untouched — used
@@ -436,11 +464,16 @@ class KFAC:
         if update_inverse:
             if self.method == 'eigh' and not update_basis:
                 # eigenvalue-only refresh in the retained eigenbasis
+                decomp_prev = decomp
                 with jax.named_scope('kfac.ComputeInverse.refresh'):
                     decomp = engine.refresh_decomposition(
-                        plan, factors, decomp, self.eps, axis_name,
+                        plan, factors, decomp_prev, self.eps, axis_name,
                         self.comm_mode,
                         communicate=not self.exclude_communicate_inverse)
+                if self.health is not None:
+                    with jax.named_scope('kfac.HealthGuard.decomp'):
+                        decomp = engine.guard_decomposition(
+                            decomp, decomp_prev, 'eigh')
                 # basis unchanged -> stored moments stay valid as-is
             else:
                 basis_local = invs_prev = None
@@ -461,6 +494,23 @@ class KFAC:
                         axis_name, basis_local=basis_local,
                         warm_sweeps=self.warm_sweeps,
                         invs_prev_local=invs_prev)
+                # chaos drill: simulated eigh/Cholesky blowup, injected
+                # BEFORE the guard so the guard is what survives it
+                decomp_local = faults.corrupt_decomposition(
+                    self._faults, state.step, decomp_local)
+                if self.health is not None:
+                    # a non-finite decomposition row falls back to the
+                    # last good one (identity when cold) instead of
+                    # poisoning every later preconditioned gradient;
+                    # guarding PRE-gather/rotation keeps the E-KFAC
+                    # moment transport on a finite basis too
+                    with jax.named_scope('kfac.HealthGuard.decomp'):
+                        decomp_local = engine.guard_decomposition(
+                            decomp_local,
+                            engine.local_decomposition(
+                                plan, decomp, axis_name, self.comm_mode,
+                                self.method),
+                            self.method)
                 if self.comm_mode == 'inverse':
                     with jax.named_scope('kfac.CommunicateInverse'):
                         new_decomp = engine.gather_decomposition(
@@ -504,6 +554,13 @@ class KFAC:
                             plan, decomp, acts, gs, self.batch_averaged,
                             scales_prev, self.factor_decay, reduce,
                             axis_name)
+                if self.health is not None:
+                    # non-finite moment rows keep the (rotated) previous
+                    # moments; the pred path's zero-validity guard covers
+                    # the cold case already
+                    with jax.named_scope('kfac.HealthGuard.scales'):
+                        decomp['scales'] = engine.where_finite_rows(
+                            decomp['scales'], scales_prev)
 
         grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
         with jax.named_scope('kfac.Precondition'):

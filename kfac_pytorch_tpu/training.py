@@ -26,7 +26,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu import capture, faults
+from kfac_pytorch_tpu import health as health_lib
 from kfac_pytorch_tpu.parallel import collectives as coll
 from kfac_pytorch_tpu.preconditioner import KFACHyperParams
 
@@ -37,6 +38,10 @@ class TrainState(flax.struct.PyTreeNode):
     opt_state: Any
     kfac_state: Any
     extra_vars: Any  # batch_stats etc. (non-param collections)
+    # numerical-health counters (health.HealthState) — None when the
+    # guard is disabled; defaulted so pre-health TrainState constructions
+    # (and checkpoints) keep working unchanged
+    health: Any = None
 
 
 def sgd(lr_schedule, momentum=0.9, weight_decay=0.0, nesterov=False):
@@ -72,7 +77,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      extra_mutable=(), sync_extra_vars=True, donate=True,
                      dropout_seed=None, batch_specs=None, check_vma=None,
                      fisher_type='Femp', fisher_loss_fn=None,
-                     fisher_sample_fn=None, fisher_seed=0):
+                     fisher_sample_fn=None, fisher_seed=0, health='auto'):
     """Build the per-iteration function family.
 
     Args:
@@ -129,6 +134,21 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         categorical). Default: ``utils.losses.sample_pseudo_labels``.
       fisher_seed: base seed for the pseudo-label sampler (folded with the
         step counter and, under data parallelism, the device index).
+      health: the in-jit numerical-health guard (health.py). 'auto'
+        (default) inherits the preconditioner's ``health`` config (off
+        for the pure-SGD baseline); True/False/HealthConfig override it
+        explicitly — pass ``health=True`` to give a precond-less SGD run
+        the bad-batch skip too. When enabled, the step screens the loss,
+        gradients and captured factor statistics for NaN/Inf INSIDE the
+        jitted program: a bad batch skips the optimizer AND factor-EMA
+        updates via ``lax.cond`` (params/opt_state/m_A/m_G stay bit-
+        identical to a schedule that never contained the batch), repeated
+        failures climb a damping-escalation ladder and finally degrade
+        the step to plain SGD until recovery (see health.HealthConfig).
+        Metrics gain ``health/*`` counters (utils.metrics.HealthMonitor
+        consumes them). The guard adds no compiled step variants and no
+        per-step host sync: the skip decision is a replicated on-device
+        scalar (one extra psum under a mesh).
 
     Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
     dispatches between up to four compiled variants using the
@@ -137,6 +157,14 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     if fisher_type not in ('Femp', 'F1mc'):
         raise ValueError(f'fisher_type must be Femp or F1mc, '
                          f'got {fisher_type!r}')
+    if health == 'auto':
+        health_cfg = getattr(precond, 'health', None)
+    else:
+        health_cfg = health_lib.resolve(health)
+    # deterministic chaos faults (faults.py): the env snapshot happens
+    # once, here, so the traced fault steps are static — enabling a fault
+    # never changes the compiled-variant count or adds host syncs
+    fault_cfg = faults.from_env()
     if fisher_loss_fn is None:
         def fisher_loss_fn(outputs, pseudo_labels):
             return optax.softmax_cross_entropy_with_integer_labels(
@@ -202,33 +230,96 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             # loss would double-normalize the update
             capture.check_local_mean_loss(loss, batch, axis_name)
 
+        # chaos faults fire BEFORE the health screen — the screen is what
+        # is being drilled (pass-through unless env-configured)
+        grads = faults.corrupt_grads(fault_cfg, state.step, grads)
+        acts, gs = faults.corrupt_captured(fault_cfg, state.step, acts, gs)
+
+        loss_local = loss
         grads = coll.average_grads(grads, axis_name)
         loss = coll.pmean(loss, axis_name)
 
-        kfac_state = state.kfac_state
-        if precond is not None:
-            grads, kfac_state = precond.step(
-                kfac_state, grads, acts, gs, hyper=hyper,
-                update_factors=update_factors,
-                update_inverse=update_inverse, update_basis=update_basis,
-                warm_basis=warm_basis, factors_only=factors_only,
-                axis_name=axis_name)
+        def apply_update(hstate):
+            """The normal K-FAC + optimizer update (the only path when
+            the health guard is off; the lax.cond true-branch otherwise).
+            """
+            kfac_state = state.kfac_state
+            new_grads = grads
+            precond_ok = jnp.ones((), bool)
+            if precond is not None:
+                h = hyper
+                if health_cfg is not None:
+                    # damping-escalation ladder: rung r multiplies the
+                    # damping fed to decomposition + preconditioning
+                    h = hyper.replace(damping=health_lib.effective_damping(
+                        hstate, hyper.damping, health_cfg))
+                pgrads, kfac_state = precond.step(
+                    kfac_state, grads, acts, gs, hyper=h,
+                    update_factors=update_factors,
+                    update_inverse=update_inverse,
+                    update_basis=update_basis,
+                    warm_basis=warm_basis, factors_only=factors_only,
+                    axis_name=axis_name)
+                if health_cfg is None:
+                    new_grads = pgrads
+                else:
+                    # a non-finite preconditioner output (or the ladder's
+                    # top rung) degrades THIS step to raw SGD gradients;
+                    # factor statistics above still accumulated
+                    precond_ok = capture.all_finite(pgrads)
+                    use_precond = jnp.logical_and(
+                        precond_ok,
+                        jnp.logical_not(
+                            health_lib.degraded(hstate, health_cfg)))
+                    new_grads = jax.tree.map(
+                        lambda p, r: jnp.where(use_precond, p, r),
+                        pgrads, grads)
 
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+            updates, opt_state = tx.update(new_grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
 
-        extra_vars = dict(state.extra_vars)
-        for k in extra_mutable:
-            if k in mutated:
-                v = mutated[k]
-                if sync_extra_vars:
-                    v = coll.pmean(v, axis_name)
-                extra_vars[k] = v
+            extra_vars = dict(state.extra_vars)
+            for k in extra_mutable:
+                if k in mutated:
+                    v = mutated[k]
+                    if sync_extra_vars:
+                        v = coll.pmean(v, axis_name)
+                    extra_vars[k] = v
 
-        new_state = state.replace(step=state.step + 1, params=params,
-                                  opt_state=opt_state, kfac_state=kfac_state,
-                                  extra_vars=extra_vars)
-        return new_state, {'loss': loss}
+            if health_cfg is not None:
+                hstate = health_lib.on_good_batch(hstate, health_cfg,
+                                                  precond_ok)
+            return state.replace(step=state.step + 1, params=params,
+                                 opt_state=opt_state,
+                                 kfac_state=kfac_state,
+                                 extra_vars=extra_vars, health=hstate)
+
+        if health_cfg is None:
+            return apply_update(state.health), {'loss': loss}
+
+        def skip_update(hstate):
+            """Bad batch: params, opt_state, factor EMAs and extra_vars
+            stay bit-exactly as if the batch never happened; only the
+            step counters and health counters advance."""
+            kfac_state = state.kfac_state
+            if kfac_state is not None:
+                # keep KFACState.step in lockstep with TrainState.step so
+                # in-engine fault steps stay aligned with trainer steps
+                kfac_state = kfac_state.replace(step=kfac_state.step + 1)
+            return state.replace(
+                step=state.step + 1, kfac_state=kfac_state,
+                health=health_lib.on_bad_batch(hstate, health_cfg))
+
+        # one replicated scalar decides the branch — no host sync, and
+        # every device agrees (batch_ok psums the per-shard bad flags)
+        ok = health_lib.batch_ok(axis_name, grads, loss_local, acts, gs)
+        new_state = jax.lax.cond(ok, apply_update, skip_update,
+                                 state.health)
+        mets = {'loss': loss}
+        mets.update({'health/' + k: v for k, v in
+                     health_lib.metrics(new_state.health, ok).items()})
+        return new_state, mets
 
     state_specs_cache = {}
 
@@ -243,8 +334,10 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             return jax.jit(fn, donate_argnums=(0,) if donate else ())
         kspecs = (precond.state_pspecs(axis_name) if precond is not None
                   else P())
+        # health counters are replicated scalars (P() matches the empty
+        # subtree too when the guard is off)
         sspecs = TrainState(step=P(), params=P(), opt_state=P(),
-                            kfac_state=kspecs, extra_vars=P())
+                            kfac_state=kspecs, extra_vars=P(), health=P())
         bspecs = P(axis_name) if batch_specs is None else batch_specs
         from .parallel.ring_attention import interpreted_attention_active
         vma = (not interpreted_attention_active() if check_vma is None
@@ -261,6 +354,14 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
 
     def step_fn(state, batch, lr=None, damping=None):
         step = int(state.step)
+        # PreemptionGuard chaos drill: deliver SIGTERM to ourselves once,
+        # at the configured step (no-op unless env-configured)
+        faults.maybe_sigterm(fault_cfg, step)
+        if health_cfg is not None and state.health is None:
+            # one-time upgrade of a pre-health TrainState (old checkpoint
+            # or a hand-built state): done host-side BEFORE the jitted
+            # call so every variant only ever sees one state structure
+            state = state.replace(health=health_lib.HealthState.init())
         if 'yes' not in seen_inverse:
             # one-time: a restored checkpoint may already carry a
             # decomposition (utils/checkpoint.py include_kfac=True)
@@ -344,9 +445,15 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     return step_fn
 
 
-def init_train_state(model, tx, precond, rng, sample_input):
+def init_train_state(model, tx, precond, rng, sample_input, health='auto'):
     """Initialize params, optimizer and K-FAC state (plus discovery of the
-    capture layer metadata if the preconditioner isn't set up yet)."""
+    capture layer metadata if the preconditioner isn't set up yet).
+
+    ``health`` mirrors build_train_step's argument: 'auto' seeds the
+    HealthState counters iff the preconditioner's guard is on; pass
+    True/False/HealthConfig to override (match what the step uses —
+    step_fn upgrades a missing HealthState on first call anyway).
+    """
     # provide a dropout stream too: models that train with dropout (LSTM,
     # transformer) request it at init since their __call__ defaults to
     # train=True
@@ -361,6 +468,12 @@ def init_train_state(model, tx, precond, rng, sample_input):
                 rngs={'dropout': jax.random.fold_in(rng, 2)})
             precond.setup(metas)
         kfac_state = precond.init()
+    if health == 'auto':
+        health_cfg = getattr(precond, 'health', None)
+    else:
+        health_cfg = health_lib.resolve(health)
+    hstate = (health_lib.HealthState.init() if health_cfg is not None
+              else None)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       opt_state=tx.init(params), kfac_state=kfac_state,
-                      extra_vars=variables)
+                      extra_vars=variables, health=hstate)

@@ -1,21 +1,23 @@
 """Harness utilities — parity with the reference's examples/utils.py."""
 
-from kfac_pytorch_tpu.utils.metrics import Metric, accuracy
+from kfac_pytorch_tpu.utils.metrics import Metric, HealthMonitor, accuracy
 from kfac_pytorch_tpu.utils.lr import (
     warmup_multistep, polynomial_decay, inverse_sqrt)
 from kfac_pytorch_tpu.utils.losses import (
     label_smoothing_cross_entropy, sample_pseudo_labels)
 from kfac_pytorch_tpu.utils.checkpoint import (
-    save_checkpoint, restore_checkpoint, find_resume_epoch,
+    save_checkpoint, restore_checkpoint, find_resume_epoch, auto_resume,
     PreemptionGuard, wait_for_checkpoints, prune_checkpoints,
     reshard_kfac_state)
 from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
 __all__ = [
-    'Metric', 'accuracy', 'warmup_multistep', 'polynomial_decay',
+    'Metric', 'HealthMonitor', 'accuracy', 'warmup_multistep',
+    'polynomial_decay',
     'inverse_sqrt', 'label_smoothing_cross_entropy', 'sample_pseudo_labels',
     'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',
+    'auto_resume',
     'PreemptionGuard', 'wait_for_checkpoints', 'prune_checkpoints',
     'reshard_kfac_state',
     'trace', 'time_steps', 'exclude_parts_breakdown',

@@ -71,13 +71,45 @@ def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True):
         _ASYNC_CKPTR.save(path, payload, force=True)
         if block:
             _ASYNC_CKPTR.wait_until_finished()
-    else:  # pragma: no cover
+    else:
         if jax.process_index() != 0:
             return
         os.makedirs(base_dir, exist_ok=True)
         import pickle
-        with open(path + '.pkl', 'wb') as f:
-            pickle.dump(jax.tree.map(np.asarray, payload), f)
+
+        from kfac_pytorch_tpu import faults as _faults
+        blob = pickle.dumps(jax.tree.map(np.asarray, payload))
+        final, tmp = path + '.pkl', path + '.pkl.tmp'
+        fault = _faults.checkpoint_fault_mode()
+        if fault:
+            # loud by design: a drill env var leaking into a real run
+            # must be visible in its logs, not discovered at next resume
+            import logging
+            logging.getLogger(__name__).warning(
+                'CHAOS FAULT ACTIVE: %s=%s — deliberately corrupting the '
+                'checkpoint write for epoch %s', _faults.ENV_CKPT, fault,
+                epoch)
+        if fault == 'truncate':
+            # chaos drill: the PRE-atomic behavior — a crash mid-write
+            # leaves a truncated file under the final name, which
+            # find_resume_epoch happily selects (auto_resume must then
+            # fall back to the next-older epoch)
+            with open(final, 'wb') as f:
+                f.write(blob[:max(1, len(blob) // 2)])
+            return
+        # atomic: full write to a tmp name, fsync, then rename — a crash
+        # at any point leaves either the old file or the new one, never a
+        # truncated final file
+        with open(tmp, 'wb') as f:
+            if fault == 'fail':
+                f.write(blob[:max(1, len(blob) // 2)])
+                f.flush()
+                raise OSError('injected checkpoint write failure '
+                              f'({_faults.ENV_CKPT}=fail)')
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
 
 
 def reshard_kfac_state(pre_old, pre_new, kfac_state):
@@ -175,9 +207,51 @@ def restore_checkpoint(base_dir, epoch, target_state):
     if _HAS_ORBAX and os.path.isdir(path):
         ckptr = ocp.StandardCheckpointer()
         return ckptr.restore(path, target_state)
-    import pickle  # pragma: no cover
+    import pickle
     with open(path + '.pkl', 'rb') as f:
         return pickle.load(f)
+
+
+def auto_resume(base_dir, max_epoch, target_state):
+    """Corruption-tolerant auto-resume: ``(restored_state, epoch)``, or
+    ``(None, None)`` when nothing restorable exists.
+
+    Extends the reference's scan-downward resume
+    (pytorch_imagenet_resnet.py:162-167) to UNREADABLE checkpoints: where
+    a bare ``restore_checkpoint(find_resume_epoch(...))`` crashes the run
+    on a truncated/corrupt file (e.g. a non-atomic write interrupted
+    mid-save, or silent storage corruption), this keeps scanning to the
+    next-older epoch — the same degrade-don't-die posture the in-jit
+    health guard (health.py) applies to numerical blowups. Every skipped
+    epoch is logged as a warning with the failure attached.
+    """
+    import logging
+    log = logging.getLogger(__name__)
+    epoch = find_resume_epoch(base_dir, max_epoch)
+    while epoch is not None:
+        try:
+            return restore_checkpoint(base_dir, epoch, target_state), epoch
+        except Exception:  # noqa: BLE001 — any unreadable ckpt: scan on
+            # NOT necessarily corruption: a checkpoint from pre-health
+            # code has no TrainState.health subtree and orbax rejects the
+            # structure mismatch. Retry against a health-less target —
+            # the trainer upgrades a None HealthState host-side on the
+            # first step (training.py), so the restored run is whole.
+            if getattr(target_state, 'health', None) is not None:
+                try:
+                    restored = restore_checkpoint(
+                        base_dir, epoch, target_state.replace(health=None))
+                    log.info('checkpoint-%d predates the health guard '
+                             '(no HealthState); counters start fresh',
+                             epoch)
+                    return restored, epoch
+                except Exception:  # noqa: BLE001 — genuinely unreadable
+                    pass
+            log.warning(
+                'checkpoint-%d in %s is unreadable; falling back to the '
+                'next-older epoch', epoch, base_dir, exc_info=True)
+        epoch = find_resume_epoch(base_dir, epoch - 1) if epoch > 0 else None
+    return None, None
 
 
 class PreemptionGuard:
@@ -216,6 +290,24 @@ class PreemptionGuard:
         prev = self._prev.get(signum)
         if callable(prev):
             prev(signum, frame)
+
+    def uninstall(self):
+        """Put back the handlers that were installed before this guard.
+
+        Without this every construction chains another handler for
+        process lifetime — harmless for one trainer, but it leaks across
+        tests and long-lived drivers (each leaked guard keeps its whole
+        trainer state reachable, and a later SIGTERM still flips a flag
+        nobody polls). Idempotent; un-nesting guards out of construction
+        order restores each signal to what THIS guard saw, which may drop
+        a later guard's handler — uninstall in reverse order.
+        """
+        import signal as _signal
+        for s, prev in self._prev.items():
+            # a None previous handler means "not installed from Python"
+            # (signal.getsignal convention) — restore the default
+            _signal.signal(s, prev if prev is not None else _signal.SIG_DFL)
+        self._prev = {}
 
     @property
     def triggered(self):

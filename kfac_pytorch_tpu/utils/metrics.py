@@ -7,8 +7,81 @@ are already replicated, so the accumulator is a plain weighted host
 average — the collective happened on-device.
 """
 
+import logging
+
 import jax.numpy as jnp
 import numpy as np
+
+
+class HealthMonitor:
+    """Host-side consumer of the step metrics' ``health/*`` counters
+    (beyond reference — the in-jit guard lives in health.py).
+
+    The jitted step returns CUMULATIVE on-device counters (total skipped
+    batches, total raw-SGD fallbacks, current ladder rung); the monitor
+    diffs them between ``update`` calls and logs a WARNING the moment
+    something happens — a skipped batch, a ladder escalation, the
+    degraded-SGD mode engaging, recovery — so run logs carry the event at
+    the step it occurred, not just the end-of-run totals. ``epoch_flush``
+    returns (and resets) per-epoch deltas for the epoch summary line
+    (runlog.health_suffix formats them).
+
+    Reading the counters costs no extra device sync in practice: the
+    trainers already block on ``float(metrics['loss'])`` every step, so
+    the health scalars ride along with an already-materialized result.
+    """
+
+    def __init__(self, log=None, state=None):
+        """``state``: pass the (possibly restored) TrainState so the
+        baseline starts from ITS cumulative counters — without it, a
+        resumed run's first update would re-announce every pre-resume
+        skip as if it just happened."""
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self.skipped = 0      # cumulative, mirrors the device counter
+        self.fallbacks = 0
+        self.rung = 0
+        h = getattr(state, 'health', None)
+        if h is not None:
+            self.skipped = int(h.skipped)
+            self.fallbacks = int(h.fallbacks)
+            self.rung = int(h.rung)
+        self._epoch = {'skipped': 0, 'fallbacks': 0, 'max_rung': 0}
+
+    def update(self, metrics, step=None):
+        """Consume one step's metrics dict; no-op without health/*."""
+        if 'health/skipped' not in metrics:
+            return
+        at = '' if step is None else f' at step {step}'
+        skipped = int(metrics['health/skipped'])
+        fallbacks = int(metrics['health/fallbacks'])
+        rung = int(metrics['health/rung'])
+        if skipped > self.skipped:
+            self._epoch['skipped'] += skipped - self.skipped
+            self.log.warning(
+                'health: non-finite batch skipped%s (total %d) — params '
+                'and factor EMAs untouched', at, skipped)
+        if fallbacks > self.fallbacks:
+            self._epoch['fallbacks'] += fallbacks - self.fallbacks
+            self.log.warning(
+                'health: non-finite preconditioner output%s — raw-SGD '
+                'gradients used for this step (total %d)', at, fallbacks)
+        if rung > self.rung:
+            self.log.warning(
+                'health: damping-escalation ladder climbed to rung %d%s',
+                rung, at)
+        elif rung < self.rung:
+            self.log.info(
+                'health: recovered%s — damping ladder reset to rung %d',
+                at, rung)
+        self._epoch['max_rung'] = max(self._epoch['max_rung'], rung)
+        self.skipped, self.fallbacks, self.rung = skipped, fallbacks, rung
+
+    def epoch_flush(self):
+        """Per-epoch deltas ``{skipped, fallbacks, max_rung}``; resets the
+        epoch accumulators (cumulative totals keep running)."""
+        out, self._epoch = self._epoch, {'skipped': 0, 'fallbacks': 0,
+                                         'max_rung': 0}
+        return out
 
 
 class Metric:

@@ -99,6 +99,8 @@ def force_host_platform(platform=None, n_devices=None):
                 jax.config.update('jax_num_cpu_devices', n_devices)
             except RuntimeError:
                 pass  # already initialized; XLA_FLAGS may still have taken
+            except AttributeError:
+                pass  # pre-0.5 jax: XLA_FLAGS above is the only mechanism
     if not platform:
         return True  # nothing to verify without forcing a platform init
     try:

@@ -44,3 +44,19 @@ def setup_run_logging(log_dir, *parts, unique=True, process_id=None):
         level=logging.INFO, format='%(asctime)s %(message)s', force=True,
         handlers=handlers)
     return logging.getLogger(), path
+
+
+def health_suffix(epoch_counts):
+    """Format an epoch's health-guard deltas for the per-epoch log line.
+
+    ``epoch_counts`` is ``metrics.HealthMonitor.epoch_flush()``'s dict.
+    A clean epoch formats to '' so the common case stays the familiar
+    reference-style line; an unhealthy one appends e.g.
+    `` [health: skipped=2 sgd_fallbacks=1 max_rung=1]`` — grep run logs
+    for ``[health:`` to find every epoch that hit the guard.
+    """
+    if not epoch_counts or not any(epoch_counts.values()):
+        return ''
+    return (' [health: skipped=%d sgd_fallbacks=%d max_rung=%d]'
+            % (epoch_counts['skipped'], epoch_counts['fallbacks'],
+               epoch_counts['max_rung']))

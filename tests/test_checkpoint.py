@@ -128,6 +128,85 @@ def test_preemption_guard_saves_and_exits(tmp_path):
     assert int(restored.step) > 0
 
 
+def test_prune_and_find_mixed_layouts(tmp_path):
+    """Retention x resume scanning on a directory holding BOTH orbax-style
+    checkpoint dirs and pickle-fallback ``.pkl`` files (a run that crossed
+    an environment change)."""
+    import os
+
+    from kfac_pytorch_tpu.utils.checkpoint import (find_resume_epoch,
+                                                   prune_checkpoints)
+    (tmp_path / 'checkpoint-0').mkdir()
+    (tmp_path / 'checkpoint-1.pkl').write_bytes(b'x')
+    (tmp_path / 'checkpoint-2').mkdir()
+    (tmp_path / 'checkpoint-3.pkl').write_bytes(b'x')
+    # a stale atomic-write tmp file must be invisible to both
+    (tmp_path / 'checkpoint-4.pkl.tmp').write_bytes(b'x')
+    assert find_resume_epoch(tmp_path, 10) == 3
+    assert find_resume_epoch(tmp_path, 2) == 2
+    prune_checkpoints(str(tmp_path), 2)
+    assert sorted(os.listdir(tmp_path)) == [
+        'checkpoint-2', 'checkpoint-3.pkl', 'checkpoint-4.pkl.tmp']
+    assert find_resume_epoch(tmp_path, 10) == 3
+    # retention removes dir and pkl layouts alike
+    prune_checkpoints(str(tmp_path), 1)
+    assert not (tmp_path / 'checkpoint-2').exists()
+    assert find_resume_epoch(tmp_path, 10) == 3
+    assert (tmp_path / 'checkpoint-4.pkl.tmp').exists()
+
+
+def test_pkl_save_is_atomic(tmp_path, monkeypatch):
+    """The pickle fallback writes tmp-then-rename: after a successful save
+    no ``.pkl.tmp`` residue exists and the file round-trips."""
+    import numpy as _np
+
+    monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
+    payload = {'w': _np.arange(16, dtype=_np.float32)}
+    checkpoint.save_checkpoint(tmp_path, 7, payload)
+    assert (tmp_path / 'checkpoint-7.pkl').exists()
+    assert not (tmp_path / 'checkpoint-7.pkl.tmp').exists()
+    restored = checkpoint.restore_checkpoint(tmp_path, 7, payload)
+    _np.testing.assert_array_equal(restored['w'], payload['w'])
+
+
+def test_auto_resume_restores_pre_health_checkpoint(tmp_path,
+                                                    trained_state):
+    """A checkpoint written before the health guard existed (no
+    ``TrainState.health`` subtree) must still auto-resume: the structure
+    mismatch is NOT corruption — auto_resume retries against a
+    health-less target and the trainer re-seeds the counters."""
+    old_state = trained_state.replace(health=None)
+    checkpoint.save_checkpoint(tmp_path, 4, old_state)
+    target = jax.tree.map(np.zeros_like, trained_state)
+    assert target.health is not None  # current-code skeleton HAS the leaf
+    restored, epoch = checkpoint.auto_resume(tmp_path, 10, target)
+    assert epoch == 4
+    assert restored.health is None  # step_fn upgrades on first call
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(restored.params)[0]),
+        np.asarray(jax.tree.leaves(old_state.params)[0]))
+
+
+def test_preemption_guard_uninstall():
+    """uninstall() restores the previously-installed handlers: no chained
+    guard leaks across constructions (tests / long-lived drivers)."""
+    import signal
+
+    before = signal.getsignal(signal.SIGTERM)
+    g1 = checkpoint.PreemptionGuard()
+    assert signal.getsignal(signal.SIGTERM) == g1._handler
+    g2 = checkpoint.PreemptionGuard()
+    assert signal.getsignal(signal.SIGTERM) == g2._handler
+    # un-nest in reverse order: each uninstall restores what it displaced
+    g2.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == g1._handler
+    g1.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == before
+    # idempotent: a second uninstall is a no-op
+    g1.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
 def test_prune_checkpoints(tmp_path):
     """Retention keeps the N newest epochs, ignores orbax tmp dirs and
     foreign names, and is a no-op with keep=0/None."""

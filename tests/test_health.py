@@ -1,0 +1,203 @@
+"""Numerical-health guard chaos drills (beyond reference, health.py).
+
+The acceptance drill: with a NaN-gradient fault injected at step k,
+training runs to completion with finite loss, and params/opt_state and
+the K-FAC factor state are BIT-identical to a run whose data schedule
+simply skipped batch k — the EMA is uncontaminated and the trajectory
+never forks. Plus: ladder escalation/degrade/recover semantics, and the
+no-new-compiled-variants guarantee on the healthy path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import faults, training
+from kfac_pytorch_tpu import health as health_lib
+from kfac_pytorch_tpu.utils.metrics import HealthMonitor
+from kfac_pytorch_tpu.utils.runlog import health_suffix
+
+from tests.helpers import TinyCNN
+
+
+def _batches(n_batches, n=8, hw=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{'input': jnp.asarray(rng.randn(n, hw, hw, 3), jnp.float32),
+             'label': jnp.asarray(rng.randint(0, 10, n))}
+            for _ in range(n_batches)]
+
+
+def _ce(outputs, batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, batch['label']).mean()
+
+
+def _run(batches, health=True):
+    """Fresh model/precond/state, one step per batch; returns the final
+    state, the per-step metrics and the step_fn (variant introspection)."""
+    model = TinyCNN()
+    precond = kfac.KFAC(variant='eigen_dp', lr=0.05, damping=0.003,
+                        fac_update_freq=1, kfac_update_freq=1,
+                        num_devices=1, axis_name=None, health=health)
+    tx = training.sgd(0.05, momentum=0.9)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0),
+                                      batches[0]['input'])
+    step = training.build_train_step(model, tx, precond, _ce)
+    mets = []
+    for b in batches:
+        state, m = step(state, b, lr=0.05, damping=0.003)
+        mets.append({k: float(v) for k, v in m.items()})
+    return state, mets, step
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_nan_batch_skips_update_and_ema(monkeypatch):
+    """The acceptance chaos drill: NaN gradients at step 2 -> that batch
+    is skipped in-jit, the run finishes finite, and params/opt_state/
+    factors/decomp are BIT-identical to a run whose schedule never
+    contained batch 2."""
+    batches = _batches(5)
+    monkeypatch.setenv(faults.ENV_NAN_GRAD, '2')
+    faulted, mets, _ = _run(batches)
+    monkeypatch.delenv(faults.ENV_NAN_GRAD)
+    control, cmets, _ = _run(batches[:2] + batches[3:])
+
+    # the fault fired exactly once, at step 2, and every loss is finite
+    assert [m['health/ok'] for m in mets] == [1, 1, 0, 1, 1]
+    assert mets[-1]['health/skipped'] == 1
+    assert all(np.isfinite(m['loss']) for m in mets)
+    # an isolated failure must not climb the damping ladder (that would
+    # fork the post-skip trajectory from the control run)
+    assert mets[-1]['health/rung'] == 0
+
+    _assert_trees_equal(faulted.params, control.params)
+    _assert_trees_equal(faulted.opt_state, control.opt_state)
+    _assert_trees_equal(faulted.kfac_state.factors,
+                        control.kfac_state.factors)
+    _assert_trees_equal(faulted.kfac_state.decomp, control.kfac_state.decomp)
+    # only the counters differ: the faulted run saw one more batch
+    assert int(faulted.step) == 5 and int(control.step) == 4
+    assert int(faulted.kfac_state.step) == 5
+
+
+def test_consecutive_failures_climb_ladder_then_recover(monkeypatch):
+    """4 consecutive bad batches: the ladder climbs to the top rung
+    (degraded SGD), healthy steps then reset it after recover_after."""
+    cfg = health_lib.HealthConfig(escalate_after=2, damping_factor=10.0,
+                                  max_rungs=2, recover_after=2)
+    monkeypatch.setenv(faults.ENV_NAN_GRAD, '2:6')
+    batches = _batches(10, seed=1)
+    state, mets, _ = _run(batches, health=cfg)
+
+    assert [m['health/ok'] for m in mets] == [1, 1, 0, 0, 0, 0, 1, 1, 1, 1]
+    assert mets[-1]['health/skipped'] == 4
+    # rung after each step: 1st failure doesn't escalate, 2nd does, top
+    # rung holds through the streak AND through the first healthy step,
+    # then recover_after healthy steps reset it
+    assert [m['health/rung'] for m in mets] == [0, 0, 0, 1, 2, 2, 2, 0, 0, 0]
+    assert all(np.isfinite(m['loss']) for m in mets)
+    for leaf in jax.tree.leaves(state.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_transition_functions():
+    """Pure-function semantics of the ladder state machine."""
+    cfg = health_lib.HealthConfig(escalate_after=2, damping_factor=10.0,
+                                  max_rungs=3, recover_after=2)
+    h = health_lib.HealthState.init()
+    h = health_lib.on_bad_batch(h, cfg)
+    assert int(h.bad_streak) == 1 and int(h.rung) == 0
+    h = health_lib.on_bad_batch(h, cfg)
+    assert int(h.rung) == 1 and int(h.skipped) == 2
+    # non-finite preconditioner output escalates like a skipped batch
+    h = health_lib.on_good_batch(h, cfg, jnp.asarray(False))
+    assert int(h.rung) == 2 and int(h.fallbacks) == 1
+    assert float(health_lib.effective_damping(h, 0.003, cfg)) == (
+        pytest.approx(0.3))
+    assert not bool(health_lib.degraded(h, cfg))
+    h = health_lib.on_bad_batch(h, cfg)
+    assert int(h.rung) == 3 and bool(health_lib.degraded(h, cfg))
+    # rung saturates at max_rungs
+    h = health_lib.on_bad_batch(h, cfg)
+    assert int(h.rung) == 3
+    # recovery: recover_after consecutive healthy steps reset the ladder
+    h = health_lib.on_good_batch(h, cfg, jnp.asarray(True))
+    assert int(h.rung) == 3 and int(h.bad_streak) == 0
+    h = health_lib.on_good_batch(h, cfg, jnp.asarray(True))
+    assert int(h.rung) == 0 and int(h.good_streak) == 2
+
+
+def test_healthy_path_compiles_same_variant_count(monkeypatch):
+    """The guard adds no compiled step variants: same dispatch keys with
+    health on, health off, and health on + a configured (unfired) fault."""
+    batches = _batches(4, seed=2)
+    _, _, step_on = _run(batches, health=True)
+    _, _, step_off = _run(batches, health=False)
+    assert set(step_on.variants) == set(step_off.variants)
+    monkeypatch.setenv(faults.ENV_NAN_GRAD, '100')  # never fires in 4 steps
+    _, mets, step_armed = _run(batches, health=True)
+    assert set(step_armed.variants) == set(step_on.variants)
+    assert all(m['health/ok'] == 1 for m in mets)
+
+
+def test_stats_fault_triggers_skip(monkeypatch):
+    """NaN captured (a, g) statistics with FINITE gradients still skip the
+    batch — the screen covers the factor statistics, not just grads."""
+    monkeypatch.setenv(faults.ENV_STATS, '1')
+    batches = _batches(3, seed=3)
+    state, mets, _ = _run(batches)
+    assert [m['health/ok'] for m in mets] == [1, 0, 1]
+    assert mets[-1]['health/skipped'] == 1
+    for leaf in jax.tree.leaves(state.kfac_state.factors):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_guard_off_nan_contaminates(monkeypatch):
+    """Negative control: with health=False the same injected batch
+    permanently poisons params — the guard is what prevents it."""
+    monkeypatch.setenv(faults.ENV_NAN_GRAD, '1')
+    batches = _batches(3, seed=4)
+    state, mets, _ = _run(batches, health=False)
+    assert not any(k.startswith('health/') for k in mets[0])
+    assert state.health is None
+    bad = any(not np.all(np.isfinite(np.asarray(leaf)))
+              for leaf in jax.tree.leaves(state.params))
+    assert bad, 'NaN batch should contaminate an unguarded run'
+
+
+def test_health_monitor_and_suffix():
+    """Host-side monitor: diffs cumulative counters, counts per-epoch
+    deltas, formats the run-log suffix (empty when clean)."""
+    mon = HealthMonitor()
+    mon.update({'health/ok': 1, 'health/skipped': 0, 'health/fallbacks': 0,
+                'health/rung': 0, 'health/bad_streak': 0})
+    assert health_suffix(mon.epoch_flush()) == ''
+    mon.update({'health/ok': 0, 'health/skipped': 2, 'health/fallbacks': 1,
+                'health/rung': 1, 'health/bad_streak': 2})
+    s = health_suffix(mon.epoch_flush())
+    assert s == ' [health: skipped=2 sgd_fallbacks=1 max_rung=1]'
+    # flush reset the epoch accumulators; cumulative totals keep running
+    assert health_suffix(mon.epoch_flush()) == ''
+    assert mon.skipped == 2 and mon.fallbacks == 1
+    # metrics without health/* are a no-op (guard disabled)
+    mon.update({'loss': 1.0})
+
+
+def test_resolve():
+    assert health_lib.resolve(True) == health_lib.HealthConfig()
+    assert health_lib.resolve(False) is None
+    assert health_lib.resolve(None) is None
+    cfg = health_lib.HealthConfig(max_rungs=5)
+    assert health_lib.resolve(cfg) is cfg
+    with pytest.raises(TypeError):
+        health_lib.resolve('yes')
