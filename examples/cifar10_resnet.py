@@ -16,6 +16,7 @@ Multi-device mesh:
 """
 
 import argparse
+import contextlib
 import os
 import sys
 import time
@@ -96,6 +97,22 @@ def parse_args():
     p.add_argument('--keep-checkpoints', type=int, default=0,
                    help='retain only the N newest checkpoints '
                         '(0 = keep all, reference behavior)')
+    # resilient runtime (kfac_pytorch_tpu/resilience/)
+    p.add_argument('--resume', action='store_true',
+                   help='auto-resume from the newest readable checkpoint '
+                        'in --checkpoint-dir (scan-downward; what a '
+                        'kfac-supervise relaunch relies on)')
+    p.add_argument('--step-deadline', type=float, default=0,
+                   help='seconds a single step may block before the '
+                        'watchdog dumps all-thread stacks and exits '
+                        'rc=114 for the supervisor (0 = off)')
+    p.add_argument('--straggler-budget', type=float, default=0,
+                   help='seconds/step EMA budget; above it the K-FAC '
+                        'update freqs stretch until the host recovers '
+                        '(0 = off)')
+    p.add_argument('--io-retries', type=int, default=3,
+                   help='retry budget for checkpoint I/O and next-batch '
+                        'transients (0 = fail fast)')
     return p.parse_args()
 
 
@@ -167,11 +184,38 @@ def main():
     sample = jnp.zeros((args.batch_size, 32, 32, 3), jnp.float32)
     state = training.init_train_state(model, tx, precond,
                                       jax.random.PRNGKey(args.seed), sample)
+
+    # resilient runtime: retrying I/O, auto-resume, step watchdog,
+    # straggler-driven freq degradation (kfac_pytorch_tpu/resilience/)
+    from kfac_pytorch_tpu import resilience
+    io_retry = (resilience.RetryPolicy(attempts=args.io_retries + 1)
+                if args.io_retries > 0 else None)
+    start_epoch = 0
+    if args.resume and args.checkpoint_dir:
+        restored, resume = utils.auto_resume(args.checkpoint_dir,
+                                             args.epochs, state,
+                                             retry=io_retry)
+        if resume is not None:
+            state = restored
+            start_epoch = resume + 1
+            if scheduler is not None:
+                scheduler.step(start_epoch)
+            log.info('resumed from checkpoint-%d (step %d)', resume,
+                     int(state.step))
+    governor = None
+    if args.straggler_budget > 0 and precond is not None:
+        governor = resilience.StragglerGovernor(
+            precond, args.straggler_budget, log=log)
+    watchdog = None
+    if args.step_deadline > 0:
+        watchdog = resilience.StepWatchdog(args.step_deadline, log=log)
+
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
                                      extra_mutable=('batch_stats',),
                                      fisher_type=args.kfac_type,
-                                     fisher_seed=args.seed)
+                                     fisher_seed=args.seed,
+                                     straggler=governor)
 
     @jax.jit
     def eval_step(params, extra_vars, batch):
@@ -199,33 +243,45 @@ def main():
     # as WARNINGs at the step they happen, plus a per-epoch summary suffix
     monitor = utils.HealthMonitor(log, state=state)
     lr_now = args.base_lr
-    for epoch in range(args.epochs):
+    res_prev = {}
+    for epoch in range(start_epoch, args.epochs):
         train_loss = utils.Metric('train_loss')
         t0 = time.time()
-        for batch in train_loader.epoch():
+        for batch in train_loader.epoch(retry=io_retry):
             if guard.should_stop(int(state.step)):
                 break
             batch = {k: jnp.asarray(v) for k, v in batch.items()}
             lr_now = float(lr_fn(int(state.step)))
+            if watchdog is not None:
+                watchdog.arm(tag=f'step {int(state.step)}')
             state, m = step(state, batch, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             train_loss.update(m['loss'], len(batch['label']))
+            if watchdog is not None:
+                # the float() above materialized the step result: the
+                # blocking window the deadline covers is over
+                watchdog.disarm()
             monitor.update(m, step=int(state.step) - 1)
         if guard.should_stop():
             # preemption grace window: save the live state and exit clean.
             # The epoch is incomplete — tag the checkpoint with the LAST
             # completed epoch so a resume replays the interrupted one
             # (at-least-once; the step counter keeps the lr schedule exact).
+            # The final blocking save legitimately exceeds any step
+            # deadline: keep the watchdog disarmed for its whole duration.
             tag = max(epoch - 1, 0)
-            if args.checkpoint_dir:
-                utils.save_checkpoint(args.checkpoint_dir, tag, state)
-                log.info('preempted in epoch %d (step %d): state saved as '
-                         'checkpoint-%d, exiting', epoch, int(state.step),
-                         tag)
-            else:
-                log.info('preempted in epoch %d (step %d): no '
-                         '--checkpoint-dir configured, state lost', epoch,
-                         int(state.step))
+            with (watchdog.paused() if watchdog is not None
+                  else contextlib.nullcontext()):
+                if args.checkpoint_dir:
+                    utils.save_checkpoint(args.checkpoint_dir, tag, state,
+                                          retry=io_retry)
+                    log.info('preempted in epoch %d (step %d): state saved '
+                             'as checkpoint-%d, exiting', epoch,
+                             int(state.step), tag)
+                else:
+                    log.info('preempted in epoch %d (step %d): no '
+                             '--checkpoint-dir configured, state lost',
+                             epoch, int(state.step))
             return
         val_loss = utils.Metric('val_loss')
         val_acc = utils.Metric('val_acc')
@@ -242,17 +298,24 @@ def main():
         # and reuse the values in the rank-0-only tb block below
         tl, vl_avg, va_avg = (train_loss.sync().avg, val_loss.sync().avg,
                               val_acc.sync().avg)
-        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        from kfac_pytorch_tpu.utils.runlog import (counter_deltas,
+                                                   health_suffix,
+                                                   resilience_suffix)
+        res_now = resilience.counters.snapshot()
+        if governor is not None:
+            res_now.update(governor.counts())
+        res_delta, res_prev = counter_deltas(res_now, res_prev), res_now
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)%s', epoch, tl, vl_avg, va_avg, time.time() - t0,
-                 health_suffix(monitor.epoch_flush()))
+                 '(%.1fs)%s%s', epoch, tl, vl_avg, va_avg, time.time() - t0,
+                 health_suffix(monitor.epoch_flush()),
+                 resilience_suffix(res_delta))
         log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)
         if args.checkpoint_dir:
             # async: the write hides behind the next epoch's compute
             utils.save_checkpoint(args.checkpoint_dir, epoch, state,
-                                  block=False)
+                                  block=False, retry=io_retry)
             if args.keep_checkpoints:
                 # the PREVIOUS save is durable (save waits on it first)
                 utils.prune_checkpoints(args.checkpoint_dir,
@@ -266,6 +329,8 @@ def main():
     utils.wait_for_checkpoints()
     if args.checkpoint_dir and args.keep_checkpoints:
         utils.prune_checkpoints(args.checkpoint_dir, args.keep_checkpoints)
+    if watchdog is not None:
+        watchdog.stop()
 
 
 if __name__ == '__main__':

@@ -87,6 +87,18 @@ def parse_args():
                    help='retain only the N newest checkpoints '
                         '(0 = keep all, reference behavior)')
     p.add_argument('--synthetic-size', type=int, default=1024)
+    # resilient runtime (kfac_pytorch_tpu/resilience/)
+    p.add_argument('--step-deadline', type=float, default=0,
+                   help='seconds a single step may block before the '
+                        'watchdog dumps all-thread stacks and exits '
+                        'rc=114 for the supervisor (0 = off)')
+    p.add_argument('--straggler-budget', type=float, default=0,
+                   help='seconds/step EMA budget; above it the K-FAC '
+                        'update freqs stretch until the host recovers '
+                        '(0 = off)')
+    p.add_argument('--io-retries', type=int, default=3,
+                   help='retry budget for checkpoint I/O and next-batch '
+                        'transients (0 = fail fast)')
     return p.parse_args()
 
 
@@ -170,12 +182,27 @@ def main():
             update_freq_alpha=args.kfac_update_freq_alpha,
             update_freq_schedule=args.kfac_update_freq_decay)
 
+    # resilient runtime (kfac_pytorch_tpu/resilience/): retrying I/O,
+    # step watchdog, straggler-driven freq degradation
+    from kfac_pytorch_tpu import resilience
+    io_retry = (resilience.RetryPolicy(attempts=args.io_retries + 1)
+                if args.io_retries > 0 else None)
+    governor = None
+    if args.straggler_budget > 0 and precond is not None:
+        governor = resilience.StragglerGovernor(
+            precond, args.straggler_budget, log=log)
+    watchdog = None
+    if args.step_deadline > 0:
+        watchdog = resilience.StepWatchdog(args.step_deadline, log=log)
+
     # auto-resume (reference: pytorch_imagenet_resnet.py:162-167,305-312),
     # hardened: an unreadable newest checkpoint (truncated write, storage
-    # corruption) falls back to the next-older epoch instead of crashing
+    # corruption) falls back to the next-older epoch instead of crashing;
+    # a TRANSIENT read failure retries in place (io_retry)
     start_epoch = 0
     restored, resume = utils.auto_resume(args.checkpoint_format,
-                                         args.epochs, state)
+                                         args.epochs, state,
+                                         retry=io_retry)
     if resume is not None:
         state = restored
         start_epoch = resume + 1
@@ -185,7 +212,8 @@ def main():
 
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
-                                     extra_mutable=('batch_stats',))
+                                     extra_mutable=('batch_stats',),
+                                     straggler=governor)
 
     @jax.jit
     def eval_step(params, extra_vars, batch):
@@ -210,28 +238,39 @@ def main():
     tb = maybe_writer(args.tb_dir)
     guard = utils.PreemptionGuard()
     monitor = utils.HealthMonitor(log, state=state)
+    res_prev = {}
     lr_now = args.base_lr
     for epoch in range(start_epoch, args.epochs):
         t0 = time.time()
         tm = utils.Metric('train_loss')
-        for batch in train_loader.epoch():
+        for batch in train_loader.epoch(retry=io_retry):
             if guard.should_stop(int(state.step)):
                 break
             b = {'input': jnp.asarray(batch['input'], dtype),
                  'label': jnp.asarray(batch['label'])}
             lr_now = float(lr_fn(int(state.step)))
+            if watchdog is not None:
+                watchdog.arm(tag=f'step {int(state.step)}')
             state, m = step(state, b, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             tm.update(m['loss'])
+            if watchdog is not None:
+                watchdog.disarm()
             monitor.update(m, step=int(state.step) - 1)
         if guard.should_stop():
             # preemption grace window: save the live state and exit clean.
             # Tag with the LAST completed epoch: auto-resume then replays
             # the interrupted epoch instead of skipping its tail and
             # advancing the KFAC scheduler early (at-least-once; the step
-            # counter keeps the lr schedule exact).
+            # counter keeps the lr schedule exact). The final blocking
+            # save legitimately exceeds any step deadline — keep the
+            # watchdog disarmed for its whole duration.
             tag = max(epoch - 1, 0)
-            utils.save_checkpoint(args.checkpoint_format, tag, state)
+            import contextlib
+            with (watchdog.paused() if watchdog is not None
+                  else contextlib.nullcontext()):
+                utils.save_checkpoint(args.checkpoint_format, tag, state,
+                                      retry=io_retry)
             log.info('preempted in epoch %d (step %d): state saved as '
                      'checkpoint-%d, exiting', epoch, int(state.step), tag)
             return
@@ -249,16 +288,23 @@ def main():
         # sync() is a cross-process collective — call it on ALL ranks here
         # and reuse the values in the rank-0-only tb block below
         tl, vl_avg, va_avg = (tm.sync().avg, vl.sync().avg, va.sync().avg)
-        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        from kfac_pytorch_tpu.utils.runlog import (counter_deltas,
+                                                   health_suffix,
+                                                   resilience_suffix)
+        res_now = resilience.counters.snapshot()
+        if governor is not None:
+            res_now.update(governor.counts())
+        res_delta, res_prev = counter_deltas(res_now, res_prev), res_now
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)%s', epoch, tl, vl_avg, va_avg, time.time() - t0,
-                 health_suffix(monitor.epoch_flush()))
+                 '(%.1fs)%s%s', epoch, tl, vl_avg, va_avg, time.time() - t0,
+                 health_suffix(monitor.epoch_flush()),
+                 resilience_suffix(res_delta))
         log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)
         # async: the write hides behind the next epoch's compute
         utils.save_checkpoint(args.checkpoint_format, epoch, state,
-                              block=False)
+                              block=False, retry=io_retry)
         if args.keep_checkpoints:
             # the PREVIOUS save is durable (save waits on it), so pruning
             # can never touch an in-flight write
@@ -274,6 +320,8 @@ def main():
     if args.keep_checkpoints:
         utils.prune_checkpoints(args.checkpoint_format,
                                 args.keep_checkpoints)
+    if watchdog is not None:
+        watchdog.stop()
 
 
 if __name__ == '__main__':

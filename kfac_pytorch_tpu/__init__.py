@@ -30,6 +30,7 @@ from kfac_pytorch_tpu import capture
 from kfac_pytorch_tpu import faults
 from kfac_pytorch_tpu import nn
 from kfac_pytorch_tpu import ops
+from kfac_pytorch_tpu import resilience
 
 # Variant registry, mirroring the reference factory surface
 # (reference: kfac/__init__.py:8-16) plus the beyond-reference 'ekfac'

@@ -230,15 +230,33 @@ class Loader:
         self.shard_index, self.shard_count = shard
         self.steps_per_epoch = len(x) // (batch_size * self.shard_count)
 
-    def epoch(self, prefetch_depth=2):
+    def epoch(self, prefetch_depth=2, retry=None):
         """One epoch of batches, assembled ``prefetch_depth`` ahead on a
         background thread (:func:`prefetch`; 0 = synchronous). The batch
         sequence is identical at any depth: each epoch draws a child RNG
-        from the persistent stream exactly once up front, so how far the
-        producer has run ahead (or where the consumer abandoned the
-        epoch) cannot perturb later epochs' randomness."""
-        epoch_rng = np.random.RandomState(self.rng.randint(1 << 31))
-        return prefetch(self._epoch_sync(epoch_rng), depth=prefetch_depth)
+        SEED from the persistent stream exactly once up front, so how far
+        the producer has run ahead (or where the consumer abandoned the
+        epoch) cannot perturb later epochs' randomness.
+
+        ``retry``: an optional ``resilience.RetryPolicy`` for the
+        next-batch path — a transient producer failure (flaky storage
+        read, injected ``KFAC_FAULT_DATA_STEP`` EIO) rebuilds the epoch
+        pipeline from the SAME seed and fast-forwards past the batches
+        already delivered, so the consumer sees the exact unfaulted
+        sequence (``resilience.retry.resumable_iter``). A persistent
+        failure still raises once the policy is exhausted.
+        """
+        seed = self.rng.randint(1 << 31)
+
+        def make():
+            return prefetch(self._epoch_sync(np.random.RandomState(seed)),
+                            depth=prefetch_depth)
+
+        if retry is None:
+            return make()
+        from kfac_pytorch_tpu.resilience.retry import resumable_iter
+        return PrefetchIterator(resumable_iter(make, policy=retry,
+                                               label='next-batch'))
 
     def _epoch_sync(self, rng):
         idx = np.arange(len(self.x))
@@ -246,7 +264,12 @@ class Loader:
             rng.shuffle(idx)
         per = len(self.x) // self.shard_count
         idx = idx[self.shard_index * per:(self.shard_index + 1) * per]
+        from kfac_pytorch_tpu import faults
         for s in range(self.steps_per_epoch):
+            if os.environ.get(faults.ENV_DATA):
+                # chaos drill: one transient EIO out of the producer at
+                # the configured batch index (faults.maybe_data_fault)
+                faults.maybe_data_fault(s)
             sel = idx[s * self.batch_size:(s + 1) * self.batch_size]
             bx = _normalize(self.x[sel])
             if self.train and self.augment is not None:

@@ -31,10 +31,37 @@ Host-side faults:
                              mid-save, pre-atomic-rename behavior);
                              'fail' -> the write dies after a partial
                              tmp file (the atomic path must leave no
-                             final file behind)
+                             final file behind);
+                             'eio_once' -> the FIRST write raises a
+                             transient EIO, later ones succeed (the
+                             retry-policy drill)
+  KFAC_FAULT_HANG_STEP       block the host forever at this step (the
+                             step-watchdog drill)
+  KFAC_FAULT_SLOW_STEP       sleep KFAC_FAULT_SLOW_SECS (default 1.0)
+                             per listed step (the straggler-governor
+                             drill; step-list syntax)
+  KFAC_FAULT_CRASH_STEP      die at this step: KFAC_FAULT_CRASH_MODE
+                             'exit' (default, os._exit(CRASH_RC=113))
+                             or 'sigkill' (SIGKILL to self — the
+                             supervisor restart drill)
+  KFAC_FAULT_DATA_STEP       the data loader raises a transient EIO at
+                             this batch index, once (next-batch retry
+                             drill)
+  KFAC_FAULT_ONCE_DIR        directory of cross-RESTART one-shot
+                             tokens: with it set, hang/crash faults
+                             fire only in the first process that
+                             reaches them, so a supervised relaunch
+                             runs clean (without it a restarted trainer
+                             replaying the faulted step would fault
+                             again, forever)
+
+``from_env`` is STRICT: any ``KFAC_FAULT_*`` variable it does not know,
+or a malformed step spec, raises ``ValueError`` at build time — a typo'd
+drill must fail loudly, not pass vacuously with the fault never armed.
 """
 
 import dataclasses
+import errno
 import os
 from typing import Optional, Tuple
 
@@ -48,9 +75,26 @@ ENV_FACTOR = 'KFAC_FAULT_FACTOR_STEP'
 ENV_EIGH = 'KFAC_FAULT_EIGH_STEP'
 ENV_SIGTERM = 'KFAC_FAULT_SIGTERM_STEP'
 ENV_CKPT = 'KFAC_FAULT_CKPT'
+ENV_HANG = 'KFAC_FAULT_HANG_STEP'
+ENV_SLOW = 'KFAC_FAULT_SLOW_STEP'
+ENV_SLOW_SECS = 'KFAC_FAULT_SLOW_SECS'
+ENV_CRASH = 'KFAC_FAULT_CRASH_STEP'
+ENV_CRASH_MODE = 'KFAC_FAULT_CRASH_MODE'
+ENV_DATA = 'KFAC_FAULT_DATA_STEP'
+ENV_ONCE_DIR = 'KFAC_FAULT_ONCE_DIR'
+
+KNOWN_ENVS = frozenset({
+    ENV_NAN_GRAD, ENV_INF_GRAD, ENV_STATS, ENV_FACTOR, ENV_EIGH,
+    ENV_SIGTERM, ENV_CKPT, ENV_HANG, ENV_SLOW, ENV_SLOW_SECS, ENV_CRASH,
+    ENV_CRASH_MODE, ENV_DATA, ENV_ONCE_DIR,
+})
+
+# rc of the 'exit'-mode crash fault: distinct from Python's generic 1
+# and from the watchdog's RC_HANG (114) so supervisor logs attribute it
+CRASH_RC = 113
 
 
-def parse_steps(spec: Optional[str]) -> Tuple[int, ...]:
+def parse_steps(spec: Optional[str], env: str = '?') -> Tuple[int, ...]:
     """``"7"`` -> (7,); ``"3,5"`` -> (3, 5); ``"4:8"`` -> (4, 5, 6, 7)."""
     if not spec:
         return ()
@@ -59,11 +103,16 @@ def parse_steps(spec: Optional[str]) -> Tuple[int, ...]:
         part = part.strip()
         if not part:
             continue
-        if ':' in part:
-            lo, hi = part.split(':')
-            out.extend(range(int(lo), int(hi)))
-        else:
-            out.append(int(part))
+        try:
+            if ':' in part:
+                lo, hi = part.split(':')
+                out.extend(range(int(lo), int(hi)))
+            else:
+                out.append(int(part))
+        except ValueError:
+            raise ValueError(
+                f'{env}: malformed step spec {spec!r} (part {part!r}); '
+                'accepted: "7", "3,5,9", "4:8"') from None
     return tuple(sorted(set(out)))
 
 
@@ -76,6 +125,12 @@ class FaultConfig:
     eigh_steps: Tuple[int, ...] = ()
     sigterm_step: Optional[int] = None
     ckpt_mode: Optional[str] = None
+    hang_step: Optional[int] = None
+    slow_steps: Tuple[int, ...] = ()
+    slow_secs: float = 1.0
+    crash_step: Optional[int] = None
+    crash_mode: str = 'exit'
+    data_step: Optional[int] = None
 
     @property
     def any_injit(self) -> bool:
@@ -84,21 +139,64 @@ class FaultConfig:
                     or self.eigh_steps)
 
 
+def _int_env(env: str) -> Optional[int]:
+    raw = os.environ.get(env)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f'{env} must be an integer step, '
+                         f'got {raw!r}') from None
+
+
+def _float_env(env: str, default: float) -> float:
+    raw = os.environ.get(env)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f'{env} must be a number of seconds, '
+                         f'got {raw!r}') from None
+
+
 def from_env() -> FaultConfig:
-    """Snapshot the fault environment (call at build/setup time)."""
-    sig = os.environ.get(ENV_SIGTERM)
+    """Snapshot the fault environment (call at build/setup time).
+
+    Strict: unknown ``KFAC_FAULT_*`` names and malformed values raise —
+    a chaos drill whose fault silently never arms proves nothing.
+    """
+    unknown = sorted(k for k in os.environ
+                     if k.startswith('KFAC_FAULT_') and k not in KNOWN_ENVS)
+    if unknown:
+        raise ValueError(
+            f'unrecognized fault env var(s) {unknown}; known: '
+            f'{sorted(KNOWN_ENVS)}')
     mode = os.environ.get(ENV_CKPT) or None
-    if mode is not None and mode not in ('truncate', 'fail'):
-        raise ValueError(f'{ENV_CKPT} must be "truncate" or "fail", '
-                         f'got {mode!r}')
+    if mode is not None and mode not in ('truncate', 'fail', 'eio_once'):
+        raise ValueError(f'{ENV_CKPT} must be "truncate", "fail" or '
+                         f'"eio_once", got {mode!r}')
+    crash_mode = os.environ.get(ENV_CRASH_MODE) or 'exit'
+    if crash_mode not in ('exit', 'sigkill'):
+        raise ValueError(f'{ENV_CRASH_MODE} must be "exit" or "sigkill", '
+                         f'got {crash_mode!r}')
     return FaultConfig(
-        nan_grad_steps=parse_steps(os.environ.get(ENV_NAN_GRAD)),
-        inf_grad_steps=parse_steps(os.environ.get(ENV_INF_GRAD)),
-        stats_steps=parse_steps(os.environ.get(ENV_STATS)),
-        factor_steps=parse_steps(os.environ.get(ENV_FACTOR)),
-        eigh_steps=parse_steps(os.environ.get(ENV_EIGH)),
-        sigterm_step=int(sig) if sig else None,
-        ckpt_mode=mode)
+        nan_grad_steps=parse_steps(os.environ.get(ENV_NAN_GRAD),
+                                   ENV_NAN_GRAD),
+        inf_grad_steps=parse_steps(os.environ.get(ENV_INF_GRAD),
+                                   ENV_INF_GRAD),
+        stats_steps=parse_steps(os.environ.get(ENV_STATS), ENV_STATS),
+        factor_steps=parse_steps(os.environ.get(ENV_FACTOR), ENV_FACTOR),
+        eigh_steps=parse_steps(os.environ.get(ENV_EIGH), ENV_EIGH),
+        sigterm_step=_int_env(ENV_SIGTERM),
+        ckpt_mode=mode,
+        hang_step=_int_env(ENV_HANG),
+        slow_steps=parse_steps(os.environ.get(ENV_SLOW), ENV_SLOW),
+        slow_secs=_float_env(ENV_SLOW_SECS, 1.0),
+        crash_step=_int_env(ENV_CRASH),
+        crash_mode=crash_mode,
+        data_step=_int_env(ENV_DATA))
 
 
 def _hit(steps: Tuple[int, ...], step):
@@ -177,3 +275,116 @@ def checkpoint_fault_mode() -> Optional[str]:
     """Live read of the checkpoint-write fault (the save path consults
     it per call so a drill can toggle it between epochs)."""
     return os.environ.get(ENV_CKPT) or None
+
+
+def _claim_once(name: str) -> bool:
+    """Cross-restart one-shot latch: True iff THIS process should fire
+    the fault. With KFAC_FAULT_ONCE_DIR set, the first process to reach
+    the fault atomically creates a token file and fires; a supervised
+    relaunch replaying the same step finds the token and runs clean.
+    Without the dir the fault fires every time (in-process latches still
+    apply where documented)."""
+    once_dir = os.environ.get(ENV_ONCE_DIR)
+    if not once_dir:
+        return True
+    os.makedirs(once_dir, exist_ok=True)
+    try:
+        fd = os.open(os.path.join(once_dir, f'fired-{name}'),
+                     os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    os.close(fd)
+    return True
+
+
+def maybe_hang(cfg: Optional[FaultConfig], step: int) -> None:
+    """Host-side: block forever at the configured step (the step-
+    watchdog drill — only the watchdog's rc-114 abort ends this)."""
+    if cfg is None or cfg.hang_step is None or step != cfg.hang_step:
+        return
+    if not _claim_once(f'hang-{step}'):
+        return
+    import logging
+    import time as _time
+    logging.getLogger(__name__).warning(
+        'CHAOS FAULT ACTIVE: %s=%d — hanging this host now', ENV_HANG,
+        step)
+    while True:  # pragma: no cover — the watchdog kills the process
+        _time.sleep(3600)
+
+
+def maybe_crash(cfg: Optional[FaultConfig], step: int) -> None:
+    """Host-side: die at the configured step — 'exit' via
+    ``os._exit(CRASH_RC)``, 'sigkill' via SIGKILL to self (the
+    supervisor restart drill; neither runs any cleanup, by design)."""
+    if cfg is None or cfg.crash_step is None or step != cfg.crash_step:
+        return
+    if not _claim_once(f'crash-{step}'):
+        return
+    import logging
+    logging.getLogger(__name__).warning(
+        'CHAOS FAULT ACTIVE: %s=%d mode=%s — killing this host now',
+        ENV_CRASH, step, cfg.crash_mode)
+    for h in logging.getLogger().handlers:
+        try:
+            h.flush()
+        except Exception:  # noqa: BLE001 — dying anyway
+            pass
+    if cfg.crash_mode == 'sigkill':
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    os._exit(CRASH_RC)
+
+
+def maybe_slow(cfg: Optional[FaultConfig], step: int, sleep=None) -> None:
+    """Host-side: sleep ``slow_secs`` at each configured step (the
+    straggler drill). ``sleep`` is injectable so a ManualClock makes the
+    drill wall-clock-free."""
+    if cfg is None or not cfg.slow_steps or step not in cfg.slow_steps:
+        return
+    if sleep is None:
+        import time as _time
+        sleep = _time.sleep
+    sleep(cfg.slow_secs)
+
+
+_DATA_FIRED = False
+
+
+def reset_data_fault():
+    """Re-arm the one-shot data fault (test isolation)."""
+    global _DATA_FIRED
+    _DATA_FIRED = False
+
+
+def maybe_data_fault(index: int) -> None:
+    """Host-side, live-read: raise a TRANSIENT EIO from the data loader
+    at the configured batch index, once per process — the next-batch
+    retry path must rebuild the epoch iterator and deliver the exact
+    unfaulted batch sequence."""
+    global _DATA_FIRED
+    spec = os.environ.get(ENV_DATA)
+    if not spec or _DATA_FIRED or index != int(spec):
+        return
+    _DATA_FIRED = True
+    raise OSError(errno.EIO, 'injected transient data-loader fault '
+                             f'({ENV_DATA}={index})')
+
+
+_CKPT_EIO_FIRED = False
+
+
+def reset_ckpt_fault():
+    """Re-arm the one-shot eio_once checkpoint fault (test isolation)."""
+    global _CKPT_EIO_FIRED
+    _CKPT_EIO_FIRED = False
+
+
+def claim_ckpt_eio_once() -> bool:
+    """True iff the 'eio_once' transient should fire for THIS save call
+    (one-shot per process)."""
+    global _CKPT_EIO_FIRED
+    if _CKPT_EIO_FIRED:
+        return False
+    _CKPT_EIO_FIRED = True
+    return True

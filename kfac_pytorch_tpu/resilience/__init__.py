@@ -1,0 +1,81 @@
+"""Process-level resilience: the run survives what the math cannot fix.
+
+health.py (PR 1) made the *numerics* self-healing — a NaN batch, a
+blown-up eigh, a corrupted factor block all degrade gracefully inside
+the jitted step. This package hardens the *process* around that step,
+because a production K-FAC run (ROADMAP north star) dies far more often
+from the boring layer: a hung XLA collective, a stalled data pipeline, a
+flaky checkpoint filesystem, a preempted or crashed host, one slow
+worker dragging every ICI collective.
+
+Four cooperating pieces, each usable alone:
+
+- :mod:`retry` — timeout/retry/backoff-with-jitter for transient I/O
+  (checkpoint save/restore, next-batch), with an injectable clock so
+  tests pin attempt counts and delay bounds without sleeping.
+- :mod:`watchdog` — a per-step deadline on the blocking train-step call;
+  on expiry it dumps every thread's stack into the run log and exits
+  with the distinct :data:`RC_HANG` so a supervisor can tell "hung"
+  from "crashed".
+- :mod:`supervisor` — the ``kfac-supervise`` console entry: relaunches
+  the trainer subprocess on crash/hang up to ``--max-restarts`` with
+  exponential backoff; the trainer's own ``auto_resume`` path turns the
+  restart into a resume.
+- :mod:`straggler` — an EMA of host step time that stretches
+  ``kfac_update_freq``/``fac_update_freq`` through the existing
+  host-side freq gating when a step-time budget is exceeded (and
+  restores them on recovery): one slow host costs preconditioner
+  freshness, not throughput.
+
+Restart/hang/retry events all land in :data:`counters`, surfaced in
+run-log epoch lines via ``utils.runlog.resilience_suffix``.
+"""
+
+import threading
+
+
+class Counters:
+    """Tiny process-global event counter shared by the resilience pieces
+    (retry attempts, watchdog trips, straggler degrades, ...).
+
+    Thread-safe because the watchdog and the retrying data producer
+    increment from background threads while the trainer reads snapshots.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+
+    def bump(self, name, by=1):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def get(self, name):
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self):
+        """Test isolation: forget everything."""
+        with self._lock:
+            self._counts.clear()
+
+
+counters = Counters()
+
+from kfac_pytorch_tpu.resilience.retry import (  # noqa: E402
+    ManualClock, RetryError, RetryPolicy, call_with_retry, resumable_iter)
+from kfac_pytorch_tpu.resilience.watchdog import (  # noqa: E402
+    RC_HANG, StepWatchdog)
+from kfac_pytorch_tpu.resilience.supervisor import Supervisor  # noqa: E402
+from kfac_pytorch_tpu.resilience.straggler import (  # noqa: E402
+    StragglerGovernor)
+
+__all__ = [
+    'Counters', 'counters', 'ManualClock', 'RetryError', 'RetryPolicy',
+    'call_with_retry', 'resumable_iter', 'RC_HANG', 'StepWatchdog',
+    'Supervisor', 'StragglerGovernor',
+]

@@ -1,0 +1,186 @@
+"""Timeout/retry/backoff-with-jitter for transient host-side failures.
+
+One policy object, two consumers:
+
+- :func:`call_with_retry` — a single idempotent call (checkpoint
+  save/restore — the save is atomic-tmp-rename, so replaying it is
+  safe).
+- :func:`resumable_iter` — an iterator whose producer can die mid-epoch
+  (the next-batch path): the broken iterator is rebuilt from scratch and
+  fast-forwarded past the batches already delivered, so the consumer
+  sees exactly the sequence an unfaulted epoch would have produced.
+
+Everything time-shaped is injectable: the clock (monotonic + sleep) and
+the jitter RNG, so unit tests pin attempt counts, delay bounds and
+deadline behavior without ever sleeping for real
+(tests/test_resilience.py).
+"""
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Optional, Tuple
+
+from kfac_pytorch_tpu import resilience as _res
+
+log = logging.getLogger(__name__)
+
+
+class RetryError(RuntimeError):
+    """Raise from an ``on_retry`` callback to abort further retries; the
+    helper re-raises the ORIGINAL failure, not this marker."""
+
+
+class _RealClock:
+    monotonic = staticmethod(time.monotonic)
+    sleep = staticmethod(time.sleep)
+
+
+REAL_CLOCK = _RealClock()
+
+
+class ManualClock:
+    """Deterministic clock for tests: ``sleep`` advances ``monotonic``
+    instantly and records every requested delay."""
+
+    def __init__(self, start=0.0):
+        self.now = float(start)
+        self.sleeps = []
+
+    def monotonic(self):
+        return self.now
+
+    def sleep(self, seconds):
+        self.sleeps.append(float(seconds))
+        self.now += float(seconds)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """``attempts`` total tries; retry ``k`` (0-based) backs off
+    ``base_delay * multiplier**k`` capped at ``max_delay``, jittered
+    uniformly into ``[d*(1-jitter), d*(1+jitter)]`` (decorrelates a
+    thundering herd of hosts hitting shared storage in lockstep).
+    ``deadline`` bounds the WHOLE affair — a retry whose backoff would
+    land past ``deadline`` seconds after the first attempt is not taken.
+    """
+    attempts: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    deadline: Optional[float] = None
+    retry_on: Tuple[type, ...] = (OSError, TimeoutError)
+
+    def delay(self, k, rng):
+        d = min(self.max_delay, self.base_delay * self.multiplier ** k)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(0.0, d)
+
+
+def call_with_retry(fn, *, policy=None, clock=None, rng=None,
+                    on_retry: Optional[Callable] = None, label=None,
+                    counter='io_retries'):
+    """Call ``fn()`` under ``policy``; re-raise the LAST underlying
+    exception once attempts (or the deadline) are exhausted, so callers'
+    existing ``except OSError`` semantics survive the wrapping.
+
+    ``on_retry(exc, attempt, delay)`` fires before each backoff sleep;
+    raising :class:`RetryError` from it aborts retrying (the original
+    failure propagates). Each retry bumps ``resilience.counters`` under
+    ``counter``.
+    """
+    policy = policy or RetryPolicy()
+    clock = clock or REAL_CLOCK
+    rng = rng or random
+    start = clock.monotonic()
+    for attempt in range(policy.attempts):
+        try:
+            return fn()
+        except policy.retry_on as e:
+            last = attempt == policy.attempts - 1
+            delay = policy.delay(attempt, rng)
+            over = (policy.deadline is not None and
+                    clock.monotonic() + delay - start > policy.deadline)
+            if last or over:
+                raise
+            _res.counters.bump(counter)
+            log.warning('retry %d/%d%s in %.2fs after: %s',
+                        attempt + 1, policy.attempts - 1,
+                        f' ({label})' if label else '', delay, e)
+            if on_retry is not None:
+                try:
+                    on_retry(e, attempt, delay)
+                except RetryError:
+                    raise e from None
+            clock.sleep(delay)
+    raise RetryError('RetryPolicy.attempts must be >= 1, got '
+                     f'{policy.attempts}')
+
+
+def resumable_iter(make_iter, *, policy=None, clock=None, rng=None,
+                   label=None, counter='data_retries'):
+    """Generator over ``make_iter()`` that survives transient producer
+    death.
+
+    A generator that raises is dead (CPython will not resume it), so on
+    a retryable failure the whole iterator is REBUILT and fast-forwarded
+    past the ``delivered`` items the consumer already saw. Correct only
+    when ``make_iter()`` replays the identical sequence each call — the
+    Loader's resilient epoch path draws its epoch RNG seed once up front
+    for exactly this reason (data.py). The retry budget is shared across
+    the iterator's whole lifetime, not per item.
+    """
+    policy = policy or RetryPolicy()
+    clock = clock or REAL_CLOCK
+    rng = rng or random
+    delivered = 0
+    failures = 0
+    start = clock.monotonic()
+    it = None
+    try:
+        while True:
+            try:
+                # the rebuild AND the fast-forward replay live inside
+                # the same try as the next(): a still-flaky producer
+                # failing again mid-replay draws from the same retry
+                # budget instead of escaping uncaught
+                if it is None:
+                    it = make_iter()
+                    for _ in range(delivered):
+                        next(it)
+                item = next(it)
+            except StopIteration:
+                return
+            except policy.retry_on as e:
+                failures += 1
+                delay = policy.delay(failures - 1, rng)
+                over = (policy.deadline is not None and
+                        clock.monotonic() + delay - start > policy.deadline)
+                if failures >= policy.attempts or over:
+                    raise
+                _res.counters.bump(counter)
+                log.warning(
+                    'next-batch retry %d/%d%s in %.2fs (rebuilding the '
+                    'iterator, skipping %d delivered batches) after: %s',
+                    failures, policy.attempts - 1,
+                    f' ({label})' if label else '', delay, delivered, e)
+                clock.sleep(delay)
+                _close(it)
+                it = None
+                continue
+            delivered += 1
+            yield item
+    finally:
+        _close(it)
+
+
+def _close(it):
+    close = getattr(it, 'close', None)
+    if callable(close):
+        try:
+            close()
+        except Exception:  # noqa: BLE001 — already tearing down
+            pass

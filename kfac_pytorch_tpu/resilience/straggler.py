@@ -1,0 +1,150 @@
+"""Straggler-driven graceful degradation of preconditioner freshness.
+
+Distributed K-FAC's wall-clock win (PAPER.md) rests on amortizing factor
+and inverse updates over ``fac_update_freq``/``kfac_update_freq`` steps.
+Those update steps are also the EXPENSIVE steps — so when a host starts
+running slow (thermal throttle, noisy neighbor, degraded NIC), the
+cheapest real lever is the one the trainer already has: stretch the
+update frequencies through the existing host-side freq gating
+(``training.step_fn`` consults ``precond.should_update_*`` every step)
+and win the amortization back. Preconditioner freshness degrades; step
+throughput — and every peer blocked on this host's collectives — does
+not.
+
+The governor keeps an EMA of observed host step time. EMA above
+``budget`` seconds: climb one stretch level (freqs × ``stretch`` per
+level, capped at ``max_level``). EMA back under
+``budget * recover_fraction``: restore the saved frequencies entirely.
+Same shape as health.py's damping ladder, one level up the stack.
+
+Clock and sleep are injectable so the chaos drill
+(``KFAC_FAULT_SLOW_STEP`` + a ManualClock) is deterministic — no
+wall-clock in the loop at all.
+"""
+
+import logging
+import time
+
+from kfac_pytorch_tpu import resilience as _res
+
+log = logging.getLogger(__name__)
+
+
+class StragglerGovernor:
+    """Observe host step times; stretch/restore K-FAC update freqs.
+
+    Args:
+      precond: the ``KFAC`` instance whose ``fac_update_freq`` /
+        ``kfac_update_freq`` attributes gate the compiled variants.
+      budget: seconds per step above which this host is a straggler.
+      decay: EMA decay (higher = slower to react, harder to fool with
+        one unlucky step).
+      stretch: per-level frequency multiplier.
+      max_level: ladder height (total stretch ≤ stretch**max_level).
+      recover_fraction: recovery hysteresis — restore only once the EMA
+        is comfortably back under budget, or a host hovering at the
+        budget flaps between levels every few steps.
+      warmup: steps to observe before ever degrading (the first steps
+        carry compilation).
+    """
+
+    def __init__(self, precond, budget, *, decay=0.9, stretch=2,
+                 max_level=3, recover_fraction=0.7, warmup=3,
+                 clock=time.monotonic, sleep=time.sleep, log=None):
+        if budget <= 0:
+            raise ValueError(f'budget must be > 0, got {budget}')
+        self.precond = precond
+        self.budget = float(budget)
+        self.decay = float(decay)
+        self.stretch = int(stretch)
+        self.max_level = int(max_level)
+        self.recover_fraction = float(recover_fraction)
+        self.warmup = int(warmup)
+        self.clock = clock
+        self.sleep = sleep
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self.ema = None
+        self.level = 0
+        self.degrades = 0
+        self.recoveries = 0
+        self._seen = 0
+        self._last = None
+        self._saved = None    # (fac, kfac) freqs at level 0
+        self._applied = None  # what WE last set (scheduler-collision check)
+
+    # -- measurement ------------------------------------------------------
+
+    def tick(self, step=None):
+        """Call once at the top of every host step: measures the
+        inter-arrival time since the previous tick (which includes the
+        blocking metric read and next-batch assembly — the full host
+        step, not just dispatch) and feeds :meth:`observe`."""
+        now = self.clock()
+        if self._last is not None:
+            self.observe(now - self._last, step=step)
+        self._last = now
+
+    def observe(self, dt, step=None):
+        self._seen += 1
+        self.ema = (dt if self.ema is None
+                    else self.decay * self.ema + (1 - self.decay) * dt)
+        if self._seen <= self.warmup:
+            return
+        if self.ema > self.budget and self.level < self.max_level:
+            self._degrade(step)
+        elif self.level and self.ema < self.budget * self.recover_fraction:
+            self._recover(step)
+
+    # -- the ladder -------------------------------------------------------
+
+    def _freqs(self):
+        return (self.precond.fac_update_freq, self.precond.kfac_update_freq)
+
+    def _degrade(self, step):
+        if self.level == 0:
+            self._saved = self._freqs()
+        elif self._applied is not None and self._freqs() != self._applied:
+            # someone else (KFACParamScheduler's epoch step) rewrote the
+            # freqs under us: treat the current values as the new base
+            self._saved = self._freqs()
+            self.level = 0
+        self.level += 1
+        self.degrades += 1
+        _res.counters.bump('straggler_degrades')
+        factor = self.stretch ** self.level
+        self._applied = (max(1, self._saved[0] * factor),
+                         max(1, self._saved[1] * factor))
+        (self.precond.fac_update_freq,
+         self.precond.kfac_update_freq) = self._applied
+        self.log.warning(
+            'straggler: step-time EMA %.3fs over budget %.3fs%s — '
+            'stretching update freqs to fac=%d kfac=%d (level %d/%d)',
+            self.ema, self.budget,
+            f' at step {step}' if step is not None else '',
+            self._applied[0], self._applied[1], self.level, self.max_level)
+
+    def _recover(self, step):
+        if self._applied is not None and self._freqs() == self._applied:
+            (self.precond.fac_update_freq,
+             self.precond.kfac_update_freq) = self._saved
+            self.log.info(
+                'straggler: recovered (EMA %.3fs)%s — update freqs '
+                'restored to fac=%d kfac=%d', self.ema,
+                f' at step {step}' if step is not None else '',
+                self._saved[0], self._saved[1])
+        else:
+            # the scheduler re-based the freqs while we were degraded;
+            # its values are authoritative — just stand down
+            self.log.info(
+                'straggler: recovered (EMA %.3fs) — freqs were re-based '
+                'externally, leaving fac=%d kfac=%d', self.ema,
+                *self._freqs())
+        self.level = 0
+        self.recoveries += 1
+        self._applied = None
+        _res.counters.bump('straggler_recoveries')
+
+    def counts(self):
+        return {'straggler_level': self.level,
+                'straggler_degrades': self.degrades,
+                'straggler_recoveries': self.recoveries}

@@ -1,0 +1,184 @@
+"""``kfac-supervise`` — relaunch a crashed or hung trainer until it
+finishes.
+
+The trainer already knows how to RESUME (``utils.checkpoint.auto_resume``
+scans checkpoints downward past unreadable ones; the step counter keeps
+the LR/K-FAC schedule exact). What nothing did until now is RESTART it:
+a SIGKILLed host process, an uncaught exception, or a watchdog hang
+abort (rc :data:`~kfac_pytorch_tpu.resilience.watchdog.RC_HANG`) simply
+ended the run. The supervisor closes that loop::
+
+    kfac-supervise --max-restarts 5 -- \\
+        python examples/cifar10_resnet.py --checkpoint-dir ckpts ...
+
+Exit-code protocol (the whole contract between trainer and supervisor):
+
+- ``0``            — done (including clean preemption exits): stop.
+- ``RC_HANG`` (114)— the step watchdog aborted a hang: restart, counted
+                     separately (``hangs``) because repeated hangs point
+                     at a peer/network problem, not this process.
+- negative / other — crash (signal death reports negative returncodes
+                     via ``Popen``): restart, counted as ``crashes``.
+
+Restarts back off exponentially with jitter so a crash-looping fleet
+does not hammer shared storage in lockstep. Counters are logged after
+every child exit in the same ``[resilience: ...]`` form the trainers'
+epoch lines use (``utils.runlog.resilience_suffix``), so one grep
+surfaces both sides of an incident.
+"""
+
+import argparse
+import logging
+import random
+import signal as _signal
+import subprocess
+import sys
+
+from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK, RetryPolicy
+from kfac_pytorch_tpu.resilience.watchdog import RC_HANG
+
+log = logging.getLogger(__name__)
+
+
+class Supervisor:
+    """Run ``argv`` as a child process, restarting on crash/hang.
+
+    ``clock``/``rng``/``popen`` are injectable for tests; ``stop_rcs``
+    lists nonzero codes that should propagate instead of restarting
+    (e.g. a config-error code a wrapper script reserves).
+    """
+
+    def __init__(self, argv, *, max_restarts=3, backoff_base=1.0,
+                 backoff_max=60.0, jitter=0.5, stop_rcs=(), env=None,
+                 clock=None, rng=None, popen=subprocess.Popen, log=None):
+        self.argv = list(argv)
+        self.max_restarts = max_restarts
+        self.backoff = RetryPolicy(attempts=max(2, max_restarts + 1),
+                                   base_delay=backoff_base,
+                                   max_delay=backoff_max, jitter=jitter)
+        self.stop_rcs = frozenset(stop_rcs)
+        self.env = env
+        self.clock = clock or REAL_CLOCK
+        self.rng = rng or random
+        self.popen = popen
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self.restarts = 0
+        self.crashes = 0
+        self.hangs = 0
+        self.child = None
+        self._terminating = False
+
+    def counts(self):
+        return {'restarts': self.restarts, 'crashes': self.crashes,
+                'hangs': self.hangs}
+
+    def _forward_signal(self, signum, frame):
+        """SIGTERM/SIGINT to the supervisor (it is the process the
+        platform signals under KFAC_SUPERVISE=1) must reach the TRAINER,
+        whose PreemptionGuard owns the grace-window checkpoint — and
+        must stop the restart loop, not count as a crash."""
+        self._terminating = True
+        child = self.child
+        if child is not None and child.poll() is None:
+            self.log.warning(
+                'supervisor: received signal %d — forwarding to trainer '
+                'pid %d and stopping after it exits', signum, child.pid)
+            child.send_signal(signum)
+
+    def _classify(self, rc):
+        if rc == RC_HANG:
+            self.hangs += 1
+            return 'hang (watchdog abort)'
+        self.crashes += 1
+        return f'killed by signal {-rc}' if rc < 0 else 'crash'
+
+    def run(self):
+        """Supervise until the child exits 0, a stop rc appears, or the
+        restart budget is spent. Returns the final child rc."""
+        from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        prev_handlers = {}
+        try:
+            for s in (_signal.SIGTERM, _signal.SIGINT):
+                prev_handlers[s] = _signal.signal(s, self._forward_signal)
+        except ValueError:  # pragma: no cover — non-main thread (tests)
+            prev_handlers = {}
+        try:
+            return self._run_loop(resilience_suffix)
+        finally:
+            for s, h in prev_handlers.items():
+                _signal.signal(s, h if h is not None else _signal.SIG_DFL)
+
+    def _run_loop(self, resilience_suffix):
+        while True:
+            self.log.info('supervisor: launching: %s',
+                          ' '.join(self.argv))
+            self.child = self.popen(self.argv, env=self.env)
+            rc = self.child.wait()
+            if self._terminating:
+                self.log.info(
+                    'supervisor: trainer exited rc=%d after forwarded '
+                    'signal — preemption shutdown, not restarting%s', rc,
+                    resilience_suffix(self.counts()))
+                return rc
+            if rc == 0:
+                self.log.info('supervisor: trainer finished cleanly%s',
+                              resilience_suffix(self.counts()))
+                return 0
+            if rc in self.stop_rcs:
+                self.log.warning(
+                    'supervisor: trainer exited rc=%d (configured stop '
+                    'code) — not restarting%s', rc,
+                    resilience_suffix(self.counts()))
+                return rc
+            why = self._classify(rc)
+            if self.restarts >= self.max_restarts:
+                self.log.error(
+                    'supervisor: trainer exited rc=%d (%s) and the '
+                    'restart budget (%d) is spent — giving up%s', rc, why,
+                    self.max_restarts, resilience_suffix(self.counts()))
+                return rc
+            delay = self.backoff.delay(self.restarts, self.rng)
+            self.restarts += 1
+            self.log.warning(
+                'supervisor: trainer exited rc=%d (%s) — restart %d/%d '
+                'in %.2fs%s', rc, why, self.restarts, self.max_restarts,
+                delay, resilience_suffix(self.counts()))
+            self.clock.sleep(delay)
+            if self._terminating:  # signal arrived during the backoff
+                return rc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='kfac-supervise',
+        description='Restart a crashed/hung K-FAC trainer until it '
+                    'finishes; the trainer resumes itself via its '
+                    'auto_resume checkpoint path.')
+    p.add_argument('--max-restarts', type=int, default=3)
+    p.add_argument('--backoff-base', type=float, default=1.0,
+                   help='first restart delay (seconds); doubles per '
+                        'restart with +/-50%% jitter')
+    p.add_argument('--backoff-max', type=float, default=60.0)
+    p.add_argument('--stop-rc', type=int, action='append', default=[],
+                   help='nonzero exit code(s) to propagate without '
+                        'restarting (repeatable)')
+    p.add_argument('command', nargs=argparse.REMAINDER,
+                   help='trainer command (prefix with -- to separate)')
+    args = p.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == '--':
+        cmd = cmd[1:]
+    if not cmd:
+        p.error('no trainer command given (kfac-supervise [opts] -- cmd)')
+    if not logging.getLogger().handlers:
+        logging.basicConfig(level=logging.INFO,
+                            format='%(asctime)s %(message)s')
+    sup = Supervisor(cmd, max_restarts=args.max_restarts,
+                     backoff_base=args.backoff_base,
+                     backoff_max=args.backoff_max,
+                     stop_rcs=args.stop_rc)
+    return sup.run()
+
+
+if __name__ == '__main__':
+    sys.exit(main())

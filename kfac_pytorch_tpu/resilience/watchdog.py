@@ -1,0 +1,178 @@
+"""Per-step deadline watchdog: a hung step dies loudly, not silently.
+
+A wedged XLA collective (one host dropped out of a psum), a deadlocked
+data producer or a stuck filesystem all present the same way: the
+blocking train-step call simply never returns, and the run burns its
+reservation doing nothing. The watchdog is a background thread armed
+around that blocking call; if the deadline passes while armed it dumps
+EVERY thread's stack into the run log (the post-mortem a hang otherwise
+destroys), flushes the log handlers, and exits the process with
+:data:`RC_HANG` — a return code the supervisor distinguishes from a
+crash so it can count hangs separately and restart.
+
+The expiry action is injectable (``action=``) so unit tests observe the
+trip without dying; the default is the real ``os._exit``.
+"""
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+
+from kfac_pytorch_tpu import resilience as _res
+
+log = logging.getLogger(__name__)
+
+# Distinct "the step hung" return code. Deliberately outside the shell's
+# reserved 126-165 band and unlike any Python default (1) or signal
+# death (128+n / negative waitpid): the supervisor keys restart
+# classification off it, and scripts can too.
+RC_HANG = 114
+
+
+def format_all_stacks():
+    """One string with every live thread's stack (names resolved), the
+    payload of the hang post-mortem."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f'--- thread {names.get(tid, "?")} (ident {tid}) ---')
+        out.append(''.join(traceback.format_stack(frame)).rstrip())
+    return '\n'.join(out)
+
+
+class StepWatchdog:
+    """Arm/disarm a deadline around each blocking step call.
+
+    ``arm()`` starts (or extends) the countdown; ``disarm()`` cancels
+    it. While disarmed the monitor thread just waits — a watchdog left
+    disarmed costs nothing. ``watching()`` wraps both around a block;
+    ``paused()`` temporarily disarms (the PreemptionGuard's final
+    blocking checkpoint save legitimately exceeds any step deadline and
+    must not trip it).
+
+    On expiry: dump all-thread stacks via logging (ERROR), flush every
+    root handler so the tail survives the abort, bump
+    ``resilience.counters['watchdog_trips']``, then run ``action`` —
+    default ``os._exit(rc)`` (``sys.exit`` would only kill the watchdog
+    thread, and the hung main thread by definition cannot run cleanup).
+    """
+
+    def __init__(self, deadline, *, rc=RC_HANG, action=None, log=None,
+                 clock=time.monotonic, poll=0.25):
+        if deadline <= 0:
+            raise ValueError(f'deadline must be > 0, got {deadline}')
+        self.deadline = float(deadline)
+        self.rc = rc
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self._action = action
+        self._clock = clock
+        self._poll = poll
+        self._cond = threading.Condition()
+        self._deadline_at = None   # None = disarmed
+        self._tag = None
+        self._stopped = False
+        self._pause_depth = 0
+        self._thread = None
+
+    # -- arm/disarm -------------------------------------------------------
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name='kfac-step-watchdog')
+            self._thread.start()
+
+    def arm(self, tag=None):
+        """Start the countdown (re-arming extends it)."""
+        with self._cond:
+            if self._pause_depth:
+                return
+            self._deadline_at = self._clock() + self.deadline
+            self._tag = tag
+            self._ensure_thread()
+            self._cond.notify_all()
+
+    def disarm(self):
+        with self._cond:
+            self._deadline_at = None
+            self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def watching(self, tag=None):
+        self.arm(tag)
+        try:
+            yield
+        finally:
+            self.disarm()
+
+    @contextlib.contextmanager
+    def paused(self):
+        """Disarm for a legitimately-slow section (final blocking
+        checkpoint save in the preemption grace window). Re-entrant;
+        arm() calls inside are ignored."""
+        with self._cond:
+            self._pause_depth += 1
+            was, self._deadline_at = self._deadline_at, None
+            self._cond.notify_all()
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._pause_depth -= 1
+                # do NOT restore the old countdown: whatever deadline the
+                # paused section interrupted is stale by construction
+                del was
+
+    def stop(self):
+        """Shut the monitor thread down (tests / clean trainer exit)."""
+        with self._cond:
+            self._stopped = True
+            self._deadline_at = None
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- monitor ----------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cond:
+                if self._stopped:
+                    return
+                if self._deadline_at is None:
+                    self._cond.wait()
+                    continue
+                remaining = self._deadline_at - self._clock()
+                if remaining > 0:
+                    self._cond.wait(timeout=min(remaining, self._poll))
+                    continue
+                # expired while armed
+                tag = self._tag
+                self._deadline_at = None
+            self._expire(tag)
+            if self._action is not None:
+                return  # injected action (tests): one trip, then retire
+
+    def _expire(self, tag):
+        _res.counters.bump('watchdog_trips')
+        self.log.error(
+            'watchdog: step deadline exceeded (%.1fs%s) — dumping all '
+            'thread stacks and exiting rc=%d so the supervisor can '
+            'restart this trainer\n%s',
+            self.deadline, f', {tag}' if tag else '', self.rc,
+            format_all_stacks())
+        # the run log must carry the dump: flush every handler before the
+        # hard exit (os._exit skips atexit and io finalizers by design)
+        for h in logging.getLogger().handlers:
+            try:
+                h.flush()
+            except Exception:  # noqa: BLE001 — dying anyway
+                pass
+        if self._action is not None:
+            self._action()
+        else:  # pragma: no cover — exercised by the subprocess chaos drill
+            os._exit(self.rc)

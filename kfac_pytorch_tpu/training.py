@@ -77,7 +77,8 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      extra_mutable=(), sync_extra_vars=True, donate=True,
                      dropout_seed=None, batch_specs=None, check_vma=None,
                      fisher_type='Femp', fisher_loss_fn=None,
-                     fisher_sample_fn=None, fisher_seed=0, health='auto'):
+                     fisher_sample_fn=None, fisher_seed=0, health='auto',
+                     straggler=None):
     """Build the per-iteration function family.
 
     Args:
@@ -149,6 +150,15 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         consumes them). The guard adds no compiled step variants and no
         per-step host sync: the skip decision is a replicated on-device
         scalar (one extra psum under a mesh).
+      straggler: a ``resilience.StragglerGovernor`` (or None). When set,
+        every host step ticks the governor with the inter-arrival time
+        of step_fn calls — which includes the caller's blocking metric
+        read and next-batch assembly, i.e. the true host step — and a
+        sustained over-budget EMA stretches the preconditioner's
+        ``fac_update_freq``/``kfac_update_freq`` through the same
+        host-side freq gating the scheduler uses (restored on
+        recovery): a slow host degrades preconditioner freshness
+        instead of throughput.
 
     Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
     dispatches between up to four compiled variants using the
@@ -354,9 +364,20 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
 
     def step_fn(state, batch, lr=None, damping=None):
         step = int(state.step)
-        # PreemptionGuard chaos drill: deliver SIGTERM to ourselves once,
-        # at the configured step (no-op unless env-configured)
+        # straggler governor: measure the inter-arrival of host steps
+        # (tick BEFORE the fault hooks so an injected slow step lands in
+        # the NEXT tick's interval, like any real stall would)
+        if straggler is not None:
+            straggler.tick(step)
+        # host-side chaos drills (all no-ops unless env-configured):
+        # SIGTERM (PreemptionGuard), crash (supervisor restart), hang
+        # (step watchdog), slow (straggler governor)
         faults.maybe_sigterm(fault_cfg, step)
+        faults.maybe_crash(fault_cfg, step)
+        faults.maybe_hang(fault_cfg, step)
+        faults.maybe_slow(fault_cfg, step,
+                          sleep=(straggler.sleep if straggler is not None
+                                 else None))
         if health_cfg is not None and state.health is None:
             # one-time upgrade of a pre-health TrainState (old checkpoint
             # or a hand-built state): done host-side BEFORE the jitted

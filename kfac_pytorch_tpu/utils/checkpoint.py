@@ -30,7 +30,8 @@ def _ckpt_dir(base, epoch):
 _ASYNC_CKPTR = None  # lazily-created persistent checkpointer (async saves)
 
 
-def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True):
+def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True,
+                    retry=None):
     """Write one checkpoint (one copy on disk — the reference's rank-0
     torch.save semantics, examples/utils.py:11-18).
 
@@ -40,12 +41,33 @@ def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True):
     torch.save). Call :func:`wait_for_checkpoints` before process exit
     (and before acting on a just-saved preemption checkpoint).
 
+    ``retry``: an optional ``resilience.RetryPolicy`` — a transient
+    write failure (flaky NFS/GCS mount returning EIO) is retried with
+    backoff instead of ending the run. Safe to replay: the pickle path
+    is atomic tmp+rename and orbax's ``force=True`` overwrites. A
+    PERSISTENT failure still raises the underlying ``OSError`` once the
+    policy is exhausted. Single-process/pickle only for now — under the
+    orbax multi-process barrier a lone rank replaying the save would
+    desynchronize the barrier, so multi-process runs should keep
+    ``retry=None`` there.
+
     Multi-process note: on the orbax path EVERY process must call this —
     orbax's save opens with a global process barrier and coordinates who
     writes what (single-file rank-0 output is an orbax detail, not an
     early-return here; an early return would strand the other ranks in
     the barrier). The pickle fallback is genuinely rank-0-only.
     """
+    if retry is not None:
+        from kfac_pytorch_tpu.resilience.retry import call_with_retry
+        return call_with_retry(
+            lambda: _save_checkpoint_once(base_dir, epoch, state,
+                                          include_kfac, block),
+            policy=retry, label=f'save checkpoint-{epoch}')
+    return _save_checkpoint_once(base_dir, epoch, state, include_kfac,
+                                 block)
+
+
+def _save_checkpoint_once(base_dir, epoch, state, include_kfac, block):
     payload = state
     if not include_kfac:
         payload = state.replace(kfac_state=None)
@@ -81,6 +103,20 @@ def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True):
         blob = pickle.dumps(jax.tree.map(np.asarray, payload))
         final, tmp = path + '.pkl', path + '.pkl.tmp'
         fault = _faults.checkpoint_fault_mode()
+        if fault == 'eio_once':
+            # transient-storage drill: the FIRST write attempt dies with
+            # EIO before touching disk; a retry policy turns this into a
+            # logged hiccup, no policy into the crash it used to be
+            if _faults.claim_ckpt_eio_once():
+                import errno
+                import logging
+                logging.getLogger(__name__).warning(
+                    'CHAOS FAULT ACTIVE: %s=eio_once — failing this '
+                    'checkpoint write once', _faults.ENV_CKPT)
+                raise OSError(errno.EIO,
+                              'injected transient checkpoint write '
+                              f'failure ({_faults.ENV_CKPT}=eio_once)')
+            fault = None
         if fault:
             # loud by design: a drill env var leaking into a real run
             # must be visible in its logs, not discovered at next resume
@@ -201,8 +237,20 @@ def find_resume_epoch(base_dir, max_epoch):
     return None
 
 
-def restore_checkpoint(base_dir, epoch, target_state):
-    """Restore into the structure of ``target_state``."""
+def restore_checkpoint(base_dir, epoch, target_state, retry=None):
+    """Restore into the structure of ``target_state``. ``retry``: an
+    optional ``resilience.RetryPolicy`` for transient read failures (a
+    corrupt/truncated file fails identically every attempt and still
+    raises — that case belongs to :func:`auto_resume`'s scan-downward)."""
+    if retry is not None:
+        from kfac_pytorch_tpu.resilience.retry import call_with_retry
+        return call_with_retry(
+            lambda: _restore_checkpoint_once(base_dir, epoch, target_state),
+            policy=retry, label=f'restore checkpoint-{epoch}')
+    return _restore_checkpoint_once(base_dir, epoch, target_state)
+
+
+def _restore_checkpoint_once(base_dir, epoch, target_state):
     path = _ckpt_dir(base_dir, epoch)
     if _HAS_ORBAX and os.path.isdir(path):
         ckptr = ocp.StandardCheckpointer()
@@ -212,9 +260,13 @@ def restore_checkpoint(base_dir, epoch, target_state):
         return pickle.load(f)
 
 
-def auto_resume(base_dir, max_epoch, target_state):
+def auto_resume(base_dir, max_epoch, target_state, retry=None):
     """Corruption-tolerant auto-resume: ``(restored_state, epoch)``, or
-    ``(None, None)`` when nothing restorable exists.
+    ``(None, None)`` when nothing restorable exists. ``retry`` (a
+    ``resilience.RetryPolicy``) is applied per restore attempt, so a
+    TRANSIENT read hiccup on the newest checkpoint is retried in place
+    rather than silently costing an epoch of progress to the
+    scan-downward.
 
     Extends the reference's scan-downward resume
     (pytorch_imagenet_resnet.py:162-167) to UNREADABLE checkpoints: where
@@ -230,7 +282,8 @@ def auto_resume(base_dir, max_epoch, target_state):
     epoch = find_resume_epoch(base_dir, max_epoch)
     while epoch is not None:
         try:
-            return restore_checkpoint(base_dir, epoch, target_state), epoch
+            return (restore_checkpoint(base_dir, epoch, target_state,
+                                       retry=retry), epoch)
         except Exception:  # noqa: BLE001 — any unreadable ckpt: scan on
             # NOT necessarily corruption: a checkpoint from pre-health
             # code has no TrainState.health subtree and orbax rejects the
@@ -240,7 +293,8 @@ def auto_resume(base_dir, max_epoch, target_state):
             if getattr(target_state, 'health', None) is not None:
                 try:
                     restored = restore_checkpoint(
-                        base_dir, epoch, target_state.replace(health=None))
+                        base_dir, epoch, target_state.replace(health=None),
+                        retry=retry)
                     log.info('checkpoint-%d predates the health guard '
                              '(no HealthState); counters start fresh',
                              epoch)

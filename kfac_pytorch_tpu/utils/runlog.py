@@ -9,9 +9,74 @@ into one ambiguous stream (``scripts/parse_logs.py`` treats each file as
 one run and keys its tables off the filename).
 """
 
+import atexit
 import logging
 import os
+import signal
 import time
+
+_FLUSH_HOOKS_INSTALLED = False
+_PREV_SIGTERM = None
+
+
+def flush_all_handlers():
+    """Flush every root-logger handler (best-effort)."""
+    for h in logging.getLogger().handlers:
+        try:
+            h.flush()
+        except Exception:  # noqa: BLE001 — flushing is best-effort
+            pass
+
+
+def _sigterm_flush(signum, frame):
+    """Flush the run log, then get out of the signal's way.
+
+    Chain-aware: when a PreemptionGuard (or anything else) installed its
+    handler OVER this one and is calling us as its chained predecessor,
+    we only flush — the cooperative shutdown above us owns the exit.
+    When WE are still the installed handler (no guard), flushing and
+    returning would silently neuter SIGTERM, so restore whatever was
+    here before us and re-deliver the signal — the process dies exactly
+    as it would have, minus the lost log tail.
+    """
+    flush_all_handlers()
+    if signal.getsignal(signum) is _sigterm_flush:
+        prev = _PREV_SIGTERM
+        signal.signal(signum,
+                      prev if callable(prev) or prev in (
+                          signal.SIG_IGN,) else signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_flush_hooks():
+    """Idempotent: atexit + SIGTERM flush of the run-log handlers, so a
+    crash, preemption or watchdog abort cannot lose the tail of the run
+    log the supervisor needs for diagnosis. Called by
+    :func:`setup_run_logging`; safe to call directly from bespoke
+    trainers."""
+    global _FLUSH_HOOKS_INSTALLED, _PREV_SIGTERM
+    if _FLUSH_HOOKS_INSTALLED:
+        return
+    _FLUSH_HOOKS_INSTALLED = True
+    atexit.register(flush_all_handlers)
+    try:
+        _PREV_SIGTERM = signal.signal(signal.SIGTERM, _sigterm_flush)
+    except ValueError:  # pragma: no cover — non-main thread: atexit only
+        pass
+
+
+def uninstall_flush_hooks():
+    """Undo :func:`install_flush_hooks` (test isolation)."""
+    global _FLUSH_HOOKS_INSTALLED, _PREV_SIGTERM
+    if not _FLUSH_HOOKS_INSTALLED:
+        return
+    _FLUSH_HOOKS_INSTALLED = False
+    atexit.unregister(flush_all_handlers)
+    if signal.getsignal(signal.SIGTERM) is _sigterm_flush:
+        signal.signal(signal.SIGTERM,
+                      _PREV_SIGTERM if _PREV_SIGTERM is not None
+                      else signal.SIG_DFL)
+    _PREV_SIGTERM = None
 
 
 def setup_run_logging(log_dir, *parts, unique=True, process_id=None):
@@ -43,6 +108,9 @@ def setup_run_logging(log_dir, *parts, unique=True, process_id=None):
     logging.basicConfig(
         level=logging.INFO, format='%(asctime)s %(message)s', force=True,
         handlers=handlers)
+    # a crash/preemption/watchdog abort must not lose the log tail the
+    # supervisor diagnoses from
+    install_flush_hooks()
     return logging.getLogger(), path
 
 
@@ -60,3 +128,32 @@ def health_suffix(epoch_counts):
     return (' [health: skipped=%d sgd_fallbacks=%d max_rung=%d]'
             % (epoch_counts['skipped'], epoch_counts['fallbacks'],
                epoch_counts['max_rung']))
+
+
+def counter_deltas(now, prev):
+    """Per-epoch view of cumulative resilience counters: ``now - prev``
+    per key, except ``*_level`` keys which are gauges (current ladder
+    position, not an event count) and pass through. Feed consecutive
+    ``resilience.counters.snapshot()``s (plus ``governor.counts()``) so
+    each epoch line reports what happened THAT epoch — matching
+    ``health_suffix``'s per-epoch-delta semantics on the same line."""
+    return {k: (v if k.endswith('_level') else v - prev.get(k, 0))
+            for k, v in now.items()}
+
+
+def resilience_suffix(counts):
+    """Format process-resilience counters for a log line.
+
+    ``counts`` is any {name: int} dict — per-epoch deltas from
+    :func:`counter_deltas` (what the example trainers log), a
+    supervisor's cumulative ``counts()``, or their union. All-zero (the
+    healthy common case) formats to '' so clean runs keep the familiar
+    line; otherwise e.g. `` [resilience: io_retries=2
+    watchdog_trips=1]`` — grep run logs for ``[resilience:`` to find
+    every epoch (and every supervisor event) where the process layer
+    had to act.
+    """
+    if not counts or not any(counts.values()):
+        return ''
+    body = ' '.join(f'{k}={v}' for k, v in sorted(counts.items()) if v)
+    return f' [resilience: {body}]'

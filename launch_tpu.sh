@@ -46,4 +46,18 @@ if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi
 
+# Resilient-runtime wrapper: KFAC_SUPERVISE=1 runs the trainer under the
+# kfac-supervise restart loop (kfac_pytorch_tpu/resilience/supervisor.py)
+# — a crash (nonzero rc / signal death) or a step-watchdog hang abort
+# (rc 114) relaunches the trainer up to KFAC_MAX_RESTARTS times with
+# exponential backoff; the trainer resumes via its auto_resume
+# checkpoint path. Give the trainer a --checkpoint-dir/--resume (cifar)
+# or --checkpoint-format (imagenet, always on) or restarts start over.
+if [ -n "$KFAC_SUPERVISE" ]; then
+  exec "${PY:-python}" -m kfac_pytorch_tpu.resilience.supervisor \
+    --max-restarts "${KFAC_MAX_RESTARTS:-3}" \
+    --backoff-base "${KFAC_RESTART_BACKOFF:-2}" \
+    -- "${PY:-python}" "$script" "$@"
+fi
+
 exec "${PY:-python}" "$script" "$@"

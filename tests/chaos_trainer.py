@@ -1,0 +1,120 @@
+"""Miniature REAL trainer for the supervisor/watchdog chaos drills.
+
+A full example trainer (resnet32) is too slow to relaunch repeatedly in
+a test, so this is the smallest program that still exercises every
+resilience path end-to-end with the REAL components: TinyCNN + K-FAC
+preconditioner, the real ``build_train_step`` (so the env-driven
+hang/crash/slow faults fire exactly where they would in production),
+per-epoch ``save_checkpoint`` and ``auto_resume`` (so a supervised
+relaunch genuinely resumes), the step watchdog, the retrying I/O path,
+and the straggler governor.
+
+Protocol with tests/test_chaos.py (stdout, line-oriented):
+  ``EPOCH <e> step=<s> loss=<l>``  after each epoch (post-checkpoint)
+  ``DONE final_step=<s> epochs=<e>``  on clean completion
+The DONE line is the schedule-equivalence assertion: a SIGKILLed /
+hung / restarted run must end with the same line as an uninterrupted
+one.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+import jax
+import numpy as np
+import optax
+
+jax.config.update('jax_platforms', 'cpu')
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import data as kdata
+from kfac_pytorch_tpu import resilience, training
+from kfac_pytorch_tpu.models.tiny import TinyCNN
+from kfac_pytorch_tpu.utils import checkpoint
+from kfac_pytorch_tpu.utils.runlog import install_flush_hooks
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=3)
+    p.add_argument('--batch-size', type=int, default=8)
+    p.add_argument('--num-examples', type=int, default=32)
+    p.add_argument('--checkpoint-dir', required=True)
+    p.add_argument('--step-deadline', type=float, default=0)
+    p.add_argument('--straggler-budget', type=float, default=0)
+    p.add_argument('--io-retries', type=int, default=3)
+    p.add_argument('--seed', type=int, default=0)
+    args = p.parse_args()
+
+    import logging
+    logging.basicConfig(level=logging.INFO, format='%(message)s',
+                        stream=sys.stdout, force=True)
+    install_flush_hooks()
+
+    x, y = kdata.synthetic_classification(
+        args.num_examples, (8, 8, 3), 10, seed=args.seed)
+    loader = kdata.Loader(x, y, args.batch_size, train=True,
+                          seed=args.seed, shard=(0, 1))
+
+    model = TinyCNN()
+    precond = kfac.KFAC(variant='eigen', lr=0.05, damping=0.003,
+                        fac_update_freq=1, kfac_update_freq=2,
+                        num_devices=1, axis_name=None)
+    tx = training.sgd(0.05, momentum=0.9)
+    state = training.init_train_state(
+        model, tx, precond, jax.random.PRNGKey(args.seed),
+        np.zeros((args.batch_size, 8, 8, 3), np.float32))
+
+    io_retry = (resilience.RetryPolicy(attempts=args.io_retries + 1,
+                                       base_delay=0.05)
+                if args.io_retries > 0 else None)
+    start_epoch = 0
+    restored, resume = checkpoint.auto_resume(args.checkpoint_dir,
+                                              args.epochs, state,
+                                              retry=io_retry)
+    if resume is not None:
+        state = restored
+        start_epoch = resume + 1
+        print(f'RESUMED from=checkpoint-{resume} step={int(state.step)}',
+              flush=True)
+
+    governor = None
+    if args.straggler_budget > 0:
+        governor = resilience.StragglerGovernor(precond,
+                                                args.straggler_budget)
+    watchdog = None
+    if args.step_deadline > 0:
+        watchdog = resilience.StepWatchdog(args.step_deadline)
+
+    def loss_fn(outputs, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, batch['label']).mean()
+
+    step = training.build_train_step(model, tx, precond, loss_fn,
+                                     straggler=governor)
+    loss = float('nan')
+    for epoch in range(start_epoch, args.epochs):
+        for batch in loader.epoch(retry=io_retry):
+            if watchdog is not None:
+                watchdog.arm(tag=f'step {int(state.step)}')
+            state, m = step(state, batch, lr=0.05, damping=0.003)
+            loss = float(m['loss'])  # blocking read, inside the deadline
+            if watchdog is not None:
+                watchdog.disarm()
+        checkpoint.save_checkpoint(args.checkpoint_dir, epoch, state,
+                                   retry=io_retry)
+        print(f'EPOCH {epoch} step={int(state.step)} loss={loss:.4f}',
+              flush=True)
+    checkpoint.wait_for_checkpoints()
+    if watchdog is not None:
+        watchdog.stop()
+    print(f'DONE final_step={int(state.step)} epochs={args.epochs}',
+          flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,104 @@
+"""Supervisor/watchdog chaos drills with REAL subprocesses (``-m slow``).
+
+Each drill runs tests/chaos_trainer.py (a miniature real K-FAC trainer
+with per-epoch checkpoints and auto-resume) under the kfac-supervise
+restart loop, injects a process-level fault via ``KFAC_FAULT_*`` envs —
+a SIGKILL mid-epoch, a step hang — and asserts the supervised run
+completes with the SAME final schedule line (``DONE final_step=...``)
+as an uninterrupted control run. ``KFAC_FAULT_ONCE_DIR`` makes each
+fault fire exactly once across restarts, so the drills are
+deterministic; the only real time in play is the generous watchdog
+deadline the hang drill must actually exceed.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, 'tests', 'chaos_trainer.py')
+
+
+def _env(**extra):
+    """Clean fault env (no stray KFAC_FAULT_* leaks into the strict
+    from_env) + forced CPU platform for the subprocesses."""
+    base = {k: v for k, v in os.environ.items()
+            if not k.startswith('KFAC_FAULT_')}
+    base['JAX_PLATFORMS'] = 'cpu'
+    base.update(extra)
+    return base
+
+
+def _run(cmd, env, timeout=540):
+    p = subprocess.run(cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, text=True,
+                       timeout=timeout)
+    return p.returncode, p.stdout
+
+
+def _trainer_cmd(ckpt_dir, *extra):
+    return [sys.executable, TRAINER, '--epochs', '3',
+            '--checkpoint-dir', str(ckpt_dir), *extra]
+
+
+def _supervise_cmd(ckpt_dir, *extra, max_restarts=2):
+    return [sys.executable, '-m',
+            'kfac_pytorch_tpu.resilience.supervisor',
+            '--max-restarts', str(max_restarts),
+            '--backoff-base', '0.2', '--',
+            *_trainer_cmd(ckpt_dir, *extra)]
+
+
+def _done_line(out):
+    lines = [l for l in out.splitlines() if l.startswith('DONE ')]
+    assert lines, f'no DONE line; output tail: {out[-3000:]}'
+    return lines[-1]
+
+
+def _control_done(tmp_path):
+    rc, out = _run(_trainer_cmd(tmp_path / 'ckpt_control'), _env())
+    assert rc == 0, out[-3000:]
+    return _done_line(out)
+
+
+def test_supervisor_resumes_after_sigkill_to_schedule_equivalence(
+        tmp_path):
+    """SIGKILL the real trainer mid-epoch-1 (env-driven, one-shot across
+    restarts): the supervisor observes signal death, relaunches, the
+    trainer auto-resumes from checkpoint-0 and completes the SAME epoch
+    schedule as an uninterrupted run."""
+    control = _control_done(tmp_path)
+    env = _env(KFAC_FAULT_CRASH_STEP='5',
+               KFAC_FAULT_CRASH_MODE='sigkill',
+               KFAC_FAULT_ONCE_DIR=str(tmp_path / 'once'))
+    rc, out = _run(_supervise_cmd(tmp_path / 'ckpt'), env)
+    assert rc == 0, out[-3000:]
+    assert 'killed by signal 9' in out
+    assert 'restart 1/2' in out
+    assert 'RESUMED from=checkpoint-0' in out
+    assert _done_line(out) == control
+
+
+def test_step_hang_trips_watchdog_dumps_stacks_and_restarts(tmp_path):
+    """Hang the real trainer at step 5: the armed watchdog trips within
+    its (generous, real) deadline, writes an all-thread stack dump into
+    the log, exits rc=114; the supervisor classifies the hang,
+    relaunches, and the resumed run completes the control schedule."""
+    control = _control_done(tmp_path)
+    env = _env(KFAC_FAULT_HANG_STEP='5',
+               KFAC_FAULT_ONCE_DIR=str(tmp_path / 'once'))
+    rc, out = _run(_supervise_cmd(tmp_path / 'ckpt',
+                                  '--step-deadline', '40'), env)
+    assert rc == 0, out[-3000:]
+    # the watchdog post-mortem made it into the captured run log
+    assert 'watchdog: step deadline exceeded' in out
+    assert 'MainThread' in out          # the all-thread stack dump
+    assert 'maybe_hang' in out          # ...naming the hung frame
+    # the supervisor saw the distinct hang rc, not a generic crash
+    assert 'hang (watchdog abort)' in out
+    assert 'RESUMED from=checkpoint-0' in out
+    assert _done_line(out) == control

@@ -69,9 +69,62 @@ def test_from_env_validation(monkeypatch):
         faults.from_env()
     monkeypatch.setenv(faults.ENV_CKPT, 'truncate')
     assert faults.from_env().ckpt_mode == 'truncate'
+    monkeypatch.setenv(faults.ENV_CKPT, 'eio_once')
+    assert faults.from_env().ckpt_mode == 'eio_once'
     monkeypatch.delenv(faults.ENV_CKPT)
     assert faults.from_env() == faults.FaultConfig()
     assert not faults.from_env().any_injit
+
+
+def test_from_env_rejects_unknown_fault_vars(monkeypatch):
+    """A typo'd drill variable must fail the build loudly — a chaos test
+    whose fault never armed would otherwise pass vacuously."""
+    monkeypatch.setenv('KFAC_FAULT_NAN_GRAD_STEPS', '3')  # plural typo
+    with pytest.raises(ValueError, match='NAN_GRAD_STEPS'):
+        faults.from_env()
+
+
+def test_from_env_rejects_malformed_specs(monkeypatch):
+    monkeypatch.setenv(faults.ENV_EIGH, '3:x')
+    with pytest.raises(ValueError, match='malformed step spec'):
+        faults.from_env()
+    monkeypatch.delenv(faults.ENV_EIGH)
+    monkeypatch.setenv(faults.ENV_HANG, 'seven')
+    with pytest.raises(ValueError, match=faults.ENV_HANG):
+        faults.from_env()
+    monkeypatch.delenv(faults.ENV_HANG)
+    monkeypatch.setenv(faults.ENV_SLOW_SECS, 'fast')
+    with pytest.raises(ValueError, match=faults.ENV_SLOW_SECS):
+        faults.from_env()
+    monkeypatch.delenv(faults.ENV_SLOW_SECS)
+    monkeypatch.setenv(faults.ENV_CRASH_MODE, 'sigsegv')
+    with pytest.raises(ValueError, match=faults.ENV_CRASH_MODE):
+        faults.from_env()
+
+
+def test_maybe_slow_uses_injected_sleep(monkeypatch):
+    monkeypatch.setenv(faults.ENV_SLOW, '2,4')
+    monkeypatch.setenv(faults.ENV_SLOW_SECS, '3.5')
+    cfg = faults.from_env()
+    slept = []
+    for s in range(6):
+        faults.maybe_slow(cfg, s, sleep=slept.append)
+    assert slept == [3.5, 3.5]
+
+
+def test_once_dir_latch_fires_exactly_once_across_processes(tmp_path,
+                                                            monkeypatch):
+    """The cross-RESTART latch: the first claimant wins, every later
+    claim (same step, e.g. a supervised relaunch replaying the faulted
+    epoch) is refused — this is what makes the supervisor chaos drills
+    terminate."""
+    monkeypatch.setenv(faults.ENV_ONCE_DIR, str(tmp_path))
+    assert faults._claim_once('crash-5')
+    assert not faults._claim_once('crash-5')
+    assert faults._claim_once('hang-5')  # distinct fault, own token
+    monkeypatch.delenv(faults.ENV_ONCE_DIR)
+    # without the dir the latch always fires
+    assert faults._claim_once('crash-5')
 
 
 def test_eigh_blowup_falls_back_to_identity_then_recovers(monkeypatch):

@@ -45,6 +45,22 @@ def test_pod_preset_injects_num_devices(tmp_path):
     assert 'no such mesh preset' in bad.stderr
 
 
+def test_supervise_mode_wraps_trainer_in_supervisor(tmp_path):
+    """KFAC_SUPERVISE=1 routes the trainer through the kfac-supervise
+    restart loop (resilience/supervisor.py) instead of exec'ing it
+    directly."""
+    dump = tmp_path / 'argdump.py'
+    dump.write_text('print("CHILD RAN")\n')
+    out = subprocess.run(
+        ['bash', LAUNCHER, str(dump), '--flag'],
+        env=_clean_env(KFAC_SUPERVISE='1', KFAC_MAX_RESTARTS='0',
+                       JAX_PLATFORMS='cpu'),
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert 'CHILD RAN' in out.stdout
+    assert 'supervisor: launching' in (out.stdout + out.stderr)
+
+
 _WORKER = '''
 import os
 os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'

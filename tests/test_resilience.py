@@ -1,0 +1,574 @@
+"""Resilient-runtime unit drills (kfac_pytorch_tpu/resilience/).
+
+Everything here is wall-clock-free or sub-second: retry/backoff under a
+ManualClock, the watchdog with an injected expiry action, the straggler
+governor driven by the deterministic slow-step fault, the supervisor
+restart loop on trivial children, and the transient-checkpoint /
+next-batch retry paths. The multi-minute subprocess drills (real
+SIGKILL, real hang) live in tests/test_chaos.py behind ``-m slow``.
+"""
+
+import os
+import random
+import signal
+import sys
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import kfac_pytorch_tpu as kfac
+from kfac_pytorch_tpu import data as kdata
+from kfac_pytorch_tpu import faults, resilience, training
+from kfac_pytorch_tpu.resilience import retry as retry_mod
+from kfac_pytorch_tpu.resilience.retry import ManualClock, RetryPolicy
+from kfac_pytorch_tpu.resilience.straggler import StragglerGovernor
+from kfac_pytorch_tpu.resilience.supervisor import Supervisor
+from kfac_pytorch_tpu.resilience.watchdog import RC_HANG, StepWatchdog
+from kfac_pytorch_tpu.utils import checkpoint, runlog
+
+from tests.helpers import TinyCNN
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    resilience.counters.reset()
+    yield
+    resilience.counters.reset()
+
+
+# ---------------------------------------------------------------------------
+# retry: attempts, jitter bounds, deadline — all on the fake clock
+# ---------------------------------------------------------------------------
+
+def test_retry_attempt_count_and_jitter_bounds():
+    clock = ManualClock()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise OSError('transient')
+        return 'ok'
+
+    pol = RetryPolicy(attempts=5, base_delay=1.0, multiplier=2.0,
+                      jitter=0.5, max_delay=100.0)
+    out = retry_mod.call_with_retry(flaky, policy=pol, clock=clock,
+                                    rng=random.Random(0))
+    assert out == 'ok'
+    assert len(calls) == 4          # 3 failures + 1 success
+    assert len(clock.sleeps) == 3   # one backoff per retry
+    for k, s in enumerate(clock.sleeps):
+        nominal = 1.0 * 2.0 ** k
+        assert nominal * 0.5 <= s <= nominal * 1.5, (k, s)
+    assert resilience.counters.get('io_retries') == 3
+
+
+def test_retry_exhaustion_reraises_last_exception():
+    clock = ManualClock()
+
+    def always():
+        raise OSError('persistent')
+
+    with pytest.raises(OSError, match='persistent'):
+        retry_mod.call_with_retry(
+            always, policy=RetryPolicy(attempts=3, base_delay=0.1),
+            clock=clock, rng=random.Random(0))
+    assert len(clock.sleeps) == 2  # no sleep after the final attempt
+
+
+def test_retry_non_retryable_exception_propagates_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise KeyError('logic bug, not a transient')
+
+    with pytest.raises(KeyError):
+        retry_mod.call_with_retry(bad, policy=RetryPolicy(attempts=5),
+                                  clock=ManualClock())
+    assert len(calls) == 1
+
+
+def test_retry_deadline_stops_early():
+    clock = ManualClock()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        clock.now += 1.0  # each attempt costs a second
+        raise OSError('transient')
+
+    # 10 attempts allowed, but the 4s deadline forbids backoffs that
+    # would land past it
+    pol = RetryPolicy(attempts=10, base_delay=2.0, multiplier=2.0,
+                      jitter=0.0, deadline=4.0)
+    with pytest.raises(OSError):
+        retry_mod.call_with_retry(flaky, policy=pol, clock=clock,
+                                  rng=random.Random(0))
+    # attempt 1 at t=0 (fails at t=1, +2s backoff -> t=3 < 4 ok),
+    # attempt 2 fails at t=4, next backoff 4s would end at t=8 > 4: stop
+    assert len(calls) == 2
+
+
+def test_resumable_iter_rebuilds_and_fast_forwards():
+    fired = []
+
+    def make():
+        def gen():
+            for i in range(6):
+                if i == 3 and not fired:
+                    fired.append(1)
+                    raise OSError('producer died')
+                yield i
+        return gen()
+
+    out = list(retry_mod.resumable_iter(
+        make, policy=RetryPolicy(attempts=3, base_delay=0.1),
+        clock=ManualClock(), rng=random.Random(0)))
+    assert out == [0, 1, 2, 3, 4, 5]
+    assert resilience.counters.get('data_retries') == 1
+
+
+def test_resumable_iter_failure_during_fast_forward_uses_budget():
+    """A second transient failure hitting the REPLAY (not just the live
+    read) must draw from the same retry budget, not escape uncaught."""
+    builds = []
+
+    def make():
+        attempt = len(builds)
+        builds.append(1)
+
+        def gen():
+            for i in range(6):
+                # build 0 dies at i=3 (live read); build 1 dies at i=1
+                # (mid fast-forward); build 2 runs clean
+                if (attempt, i) in ((0, 3), (1, 1)):
+                    raise OSError(f'flaky at build {attempt} item {i}')
+                yield i
+        return gen()
+
+    out = list(retry_mod.resumable_iter(
+        make, policy=RetryPolicy(attempts=4, base_delay=0.1),
+        clock=ManualClock(), rng=random.Random(0)))
+    assert out == [0, 1, 2, 3, 4, 5]
+    assert resilience.counters.get('data_retries') == 2
+
+
+def test_resumable_iter_persistent_failure_raises():
+    def make():
+        def gen():
+            raise OSError('dead storage')
+            yield  # pragma: no cover
+        return gen()
+
+    with pytest.raises(OSError, match='dead storage'):
+        list(retry_mod.resumable_iter(
+            make, policy=RetryPolicy(attempts=2, base_delay=0.1),
+            clock=ManualClock()))
+
+
+# ---------------------------------------------------------------------------
+# next-batch retry through the real Loader + injected data fault
+# ---------------------------------------------------------------------------
+
+def test_loader_next_batch_retry_delivers_unfaulted_sequence(monkeypatch):
+    x, y = kdata.synthetic_classification(32, (4, 4, 3), 10, seed=3)
+    control = list(kdata.Loader(x, y, 8, train=True, seed=7,
+                                shard=(0, 1)).epoch(prefetch_depth=0))
+
+    faults.reset_data_fault()
+    monkeypatch.setenv(faults.ENV_DATA, '2')
+    try:
+        faulted = list(kdata.Loader(x, y, 8, train=True, seed=7,
+                                    shard=(0, 1)).epoch(
+            retry=RetryPolicy(attempts=3, base_delay=0.01)))
+    finally:
+        faults.reset_data_fault()
+    assert len(faulted) == len(control) == 4
+    for a, b in zip(faulted, control):
+        np.testing.assert_array_equal(a['input'], b['input'])
+        np.testing.assert_array_equal(a['label'], b['label'])
+    assert resilience.counters.get('data_retries') == 1
+
+
+def test_loader_without_retry_propagates_data_fault(monkeypatch):
+    x, y = kdata.synthetic_classification(32, (4, 4, 3), 10, seed=3)
+    faults.reset_data_fault()
+    monkeypatch.setenv(faults.ENV_DATA, '1')
+    try:
+        with pytest.raises(OSError):
+            list(kdata.Loader(x, y, 8, train=True, seed=7,
+                              shard=(0, 1)).epoch(prefetch_depth=0))
+    finally:
+        faults.reset_data_fault()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_trips_with_stack_dump(caplog):
+    import threading
+    tripped = threading.Event()
+    wd = StepWatchdog(0.1, action=tripped.set)
+    with caplog.at_level('ERROR', logger='kfac_pytorch_tpu.resilience'
+                                         '.watchdog'):
+        wd.arm(tag='step 7')
+        assert tripped.wait(10), 'watchdog never tripped'
+    wd.stop()
+    text = caplog.text
+    assert 'step deadline exceeded' in text
+    assert 'MainThread' in text  # the all-thread stack dump
+    assert resilience.counters.get('watchdog_trips') == 1
+
+
+def test_watchdog_disarm_prevents_trip():
+    import threading
+    import time
+    tripped = threading.Event()
+    wd = StepWatchdog(0.15, action=tripped.set)
+    for _ in range(3):
+        wd.arm()
+        wd.disarm()
+    time.sleep(0.4)
+    assert not tripped.is_set()
+    wd.stop()
+
+
+def test_watchdog_paused_ignores_arm():
+    import threading
+    import time
+    tripped = threading.Event()
+    wd = StepWatchdog(0.15, action=tripped.set)
+    wd.arm()
+    with wd.paused():
+        wd.arm()  # e.g. a nested step during the final blocking save
+        time.sleep(0.4)
+    assert not tripped.is_set()
+    # after the pause the watchdog still works
+    wd.arm()
+    assert tripped.wait(10)
+    wd.stop()
+
+
+def test_watchdog_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError):
+        StepWatchdog(0)
+
+
+# ---------------------------------------------------------------------------
+# straggler governor (pure + through the real train step via slow fault)
+# ---------------------------------------------------------------------------
+
+class _FakePrecond:
+    fac_update_freq = 1
+    kfac_update_freq = 10
+
+
+def test_straggler_governor_stretch_and_restore():
+    pre = _FakePrecond()
+    clk = ManualClock()
+    gov = StragglerGovernor(pre, budget=1.0, decay=0.5, warmup=1,
+                            clock=clk.monotonic, sleep=clk.sleep)
+    for s in range(20):
+        gov.tick(s)
+        clk.sleep(5.0 if 3 <= s < 8 else 0.1)
+    assert gov.degrades >= 1 and gov.recoveries == 1
+    assert gov.level == 0
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (1, 10)
+
+
+def test_straggler_governor_respects_external_rebase():
+    pre = _FakePrecond()
+    clk = ManualClock()
+    gov = StragglerGovernor(pre, budget=1.0, decay=0.5, warmup=0,
+                            clock=clk.monotonic, sleep=clk.sleep)
+    for dt in (5.0, 5.0, 5.0):
+        gov.observe(dt)
+    assert gov.level >= 1
+    # a KFACParamScheduler epoch step rewrites the freqs under us
+    pre.fac_update_freq, pre.kfac_update_freq = 4, 40
+    for _ in range(10):
+        gov.observe(0.01)
+    # recovery must NOT clobber the scheduler's values with stale ones
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (4, 40)
+    assert gov.level == 0
+
+
+def test_slow_step_fault_stretches_freqs_then_recovers(monkeypatch):
+    """The acceptance drill: KFAC_FAULT_SLOW_STEP stretches
+    kfac_update_freq via the governor, recovery restores it — fully
+    deterministic on a ManualClock (the fault's sleep and the governor's
+    measurements share it)."""
+    monkeypatch.setenv(faults.ENV_SLOW, '3:7')
+    monkeypatch.setenv(faults.ENV_SLOW_SECS, '5.0')
+    rng = np.random.RandomState(0)
+    batches = [{'input': np.asarray(rng.randn(8, 8, 8, 3), np.float32),
+                'label': rng.randint(0, 10, 8)}
+               for _ in range(16)]
+
+    model = TinyCNN()
+    precond = kfac.KFAC(variant='eigen', lr=0.05, damping=0.003,
+                        fac_update_freq=1, kfac_update_freq=2,
+                        num_devices=1, axis_name=None)
+    tx = training.sgd(0.05)
+    state = training.init_train_state(model, tx, precond,
+                                      jax.random.PRNGKey(0),
+                                      batches[0]['input'])
+    clk = ManualClock()
+    gov = StragglerGovernor(precond, budget=1.0, decay=0.5, warmup=1,
+                            stretch=2, clock=clk.monotonic,
+                            sleep=clk.sleep)
+
+    def ce(outputs, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, batch['label']).mean()
+
+    step = training.build_train_step(model, tx, precond, ce,
+                                     straggler=gov)
+    base = precond.kfac_update_freq
+    stretched_seen = []
+    for b in batches:
+        state, _ = step(state, b, lr=0.05, damping=0.003)
+        stretched_seen.append(precond.kfac_update_freq)
+    assert max(stretched_seen) > base, 'slow fault never stretched freqs'
+    assert gov.degrades >= 1 and gov.recoveries >= 1
+    assert precond.kfac_update_freq == base, 'recovery did not restore'
+    assert precond.fac_update_freq == 1
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+def _counter_child(path, fail_times, rc=1):
+    prog = (f'import os,sys;p={str(path)!r};'
+            'n=int(open(p).read()) if os.path.exists(p) else 0;'
+            f"open(p,'w').write(str(n+1));"
+            f'sys.exit(0 if n>={fail_times} else {rc})')
+    return [sys.executable, '-c', prog]
+
+
+def test_supervisor_restarts_crash_until_success(tmp_path):
+    sup = Supervisor(_counter_child(tmp_path / 'n', 2), max_restarts=5,
+                     backoff_base=0.01, clock=ManualClock(),
+                     rng=random.Random(0))
+    assert sup.run() == 0
+    assert sup.counts() == {'restarts': 2, 'crashes': 2, 'hangs': 0}
+
+
+def test_supervisor_classifies_hang_rc_and_gives_up(tmp_path):
+    sup = Supervisor([sys.executable, '-c', f'import sys;sys.exit({RC_HANG})'],
+                     max_restarts=1, backoff_base=0.01,
+                     clock=ManualClock(), rng=random.Random(0))
+    assert sup.run() == RC_HANG
+    assert sup.hangs == 2 and sup.crashes == 0 and sup.restarts == 1
+
+
+def test_supervisor_stop_rc_propagates_without_restart(tmp_path):
+    sup = Supervisor([sys.executable, '-c', 'import sys;sys.exit(7)'],
+                     max_restarts=5, stop_rcs=(7,), backoff_base=0.01,
+                     clock=ManualClock())
+    assert sup.run() == 7
+    assert sup.restarts == 0
+
+
+def test_supervisor_forwards_sigterm_to_trainer(tmp_path):
+    """Under KFAC_SUPERVISE=1 the supervisor is the process the platform
+    SIGTERMs on preemption: it must forward the signal to the trainer
+    (whose PreemptionGuard owns the grace-window save) and stop the
+    restart loop instead of counting the exit as a crash."""
+    import subprocess
+    import time
+    marker = tmp_path / 'graceful'
+    child_prog = (
+        'import signal, sys, time\n'
+        f'marker = {str(marker)!r}\n'
+        'def h(s, f):\n'
+        "    open(marker, 'w').write('saved')\n"
+        '    sys.exit(0)\n'
+        'signal.signal(signal.SIGTERM, h)\n'
+        "print('READY', flush=True)\n"
+        'time.sleep(60)\n')
+    child_file = tmp_path / 'child.py'
+    child_file.write_text(child_prog)
+    sup = subprocess.Popen(
+        [sys.executable, '-m', 'kfac_pytorch_tpu.resilience.supervisor',
+         '--max-restarts', '3', '--backoff-base', '0.05', '--',
+         sys.executable, '-u', str(child_file)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        # READY is printed AFTER the child installed its handler, so the
+        # forwarded signal cannot race the installation
+        while True:
+            line = sup.stdout.readline()
+            assert line, 'supervisor/child died before READY'
+            if 'READY' in line:
+                break
+        time.sleep(0.1)
+        sup.send_signal(signal.SIGTERM)
+        out, _ = sup.communicate(timeout=60)
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+    assert sup.returncode == 0, out[-2000:]
+    assert marker.exists(), out[-2000:]  # the grace-window path ran
+    assert 'forwarding to trainer' in out
+    assert 'not restarting' in out
+
+
+def test_counter_deltas_per_epoch_view():
+    now = {'io_retries': 3, 'watchdog_trips': 1, 'straggler_level': 2}
+    prev = {'io_retries': 3, 'watchdog_trips': 0}
+    d = runlog.counter_deltas(now, prev)
+    assert d == {'io_retries': 0, 'watchdog_trips': 1,
+                 'straggler_level': 2}  # gauge passes through
+    # an incident-free epoch after an incident formats to ''
+    assert runlog.resilience_suffix(
+        runlog.counter_deltas({'io_retries': 3}, {'io_retries': 3})) == ''
+
+
+def test_supervisor_main_requires_command(capsys):
+    from kfac_pytorch_tpu.resilience import supervisor as sup_mod
+    with pytest.raises(SystemExit):
+        sup_mod.main(['--max-restarts', '2'])
+
+
+def test_supervisor_main_runs_command():
+    from kfac_pytorch_tpu.resilience import supervisor as sup_mod
+    rc = sup_mod.main(['--max-restarts', '0', '--',
+                       sys.executable, '-c', 'pass'])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# transient checkpoint write (eio_once) under a retry policy
+# ---------------------------------------------------------------------------
+
+def test_ckpt_eio_once_without_retry_raises(tmp_path, monkeypatch):
+    monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
+    monkeypatch.setenv(faults.ENV_CKPT, 'eio_once')
+    faults.reset_ckpt_fault()
+    with pytest.raises(OSError):
+        checkpoint.save_checkpoint(tmp_path, 0, {'w': np.zeros(8)})
+    assert not (tmp_path / 'checkpoint-0.pkl').exists()
+    # the transient cleared: the next save succeeds
+    checkpoint.save_checkpoint(tmp_path, 0, {'w': np.zeros(8)})
+    assert (tmp_path / 'checkpoint-0.pkl').exists()
+    faults.reset_ckpt_fault()
+
+
+def test_ckpt_eio_once_with_retry_succeeds(tmp_path, monkeypatch):
+    monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
+    monkeypatch.setenv(faults.ENV_CKPT, 'eio_once')
+    faults.reset_ckpt_fault()
+    payload = {'w': np.arange(16, dtype=np.float32)}
+    checkpoint.save_checkpoint(
+        tmp_path, 2, payload,
+        retry=RetryPolicy(attempts=3, base_delay=0.01))
+    assert (tmp_path / 'checkpoint-2.pkl').exists()
+    assert resilience.counters.get('io_retries') == 1
+    monkeypatch.delenv(faults.ENV_CKPT)
+    restored = checkpoint.restore_checkpoint(
+        tmp_path, 2, payload, retry=RetryPolicy(attempts=2,
+                                                base_delay=0.01))
+    np.testing.assert_array_equal(restored['w'], payload['w'])
+    faults.reset_ckpt_fault()
+
+
+def test_auto_resume_with_retry_policy(tmp_path, monkeypatch):
+    monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
+    payload = {'w': np.ones(4, np.float32)}
+    checkpoint.save_checkpoint(tmp_path, 1, payload)
+    restored, epoch = checkpoint.auto_resume(
+        tmp_path, 5, payload, retry=RetryPolicy(attempts=2,
+                                                base_delay=0.01))
+    assert epoch == 1
+    np.testing.assert_array_equal(restored['w'], payload['w'])
+
+
+# ---------------------------------------------------------------------------
+# runlog: flush hooks + resilience suffix; PreemptionGuard interplay
+# ---------------------------------------------------------------------------
+
+def test_resilience_suffix_formatting():
+    assert runlog.resilience_suffix({}) == ''
+    assert runlog.resilience_suffix({'io_retries': 0}) == ''
+    s = runlog.resilience_suffix({'io_retries': 2, 'watchdog_trips': 1,
+                                  'straggler_level': 0})
+    assert s == ' [resilience: io_retries=2 watchdog_trips=1]'
+
+
+def test_flush_hooks_chain_under_preemption_guard():
+    """runlog's SIGTERM flush must not steal the exit from a
+    PreemptionGuard installed over it: the guard's cooperative flag is
+    set, the process survives, and the flush hook ran as the chained
+    predecessor."""
+    runlog.uninstall_flush_hooks()
+    runlog.install_flush_hooks()
+    try:
+        guard = checkpoint.PreemptionGuard()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert guard.triggered  # alive and cooperatively flagged
+        finally:
+            guard.uninstall()
+    finally:
+        runlog.uninstall_flush_hooks()
+
+
+def test_flush_hooks_install_idempotent_and_uninstall_restores():
+    runlog.uninstall_flush_hooks()
+    before = signal.getsignal(signal.SIGTERM)
+    runlog.install_flush_hooks()
+    runlog.install_flush_hooks()  # idempotent
+    assert signal.getsignal(signal.SIGTERM) is runlog._sigterm_flush
+    runlog.uninstall_flush_hooks()
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_preemption_guard_install_uninstall_reinstall():
+    """The satellite drill: a guard can be installed, uninstalled and
+    reinstalled; each uninstall restores the prior handler and a
+    reinstalled guard still converts SIGTERM into the cooperative flag.
+    """
+    before = signal.getsignal(signal.SIGTERM)
+    g1 = checkpoint.PreemptionGuard()
+    g1.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == (
+        before if before is not None else signal.SIG_DFL)
+    g2 = checkpoint.PreemptionGuard()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert g2.triggered
+        assert not g1.triggered  # g1 is fully retired, its flag untouched
+    finally:
+        g2.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == (
+        before if before is not None else signal.SIG_DFL)
+
+
+def test_guard_final_save_runs_with_watchdog_paused(tmp_path, monkeypatch):
+    """The PreemptionGuard grace-window save must not race the step
+    watchdog: inside ``paused()`` even a save far exceeding the step
+    deadline cannot trip it."""
+    import threading
+    import time
+    monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
+    tripped = threading.Event()
+    wd = StepWatchdog(0.1, action=tripped.set)
+    guard = checkpoint.PreemptionGuard()
+    try:
+        wd.arm()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.should_stop()
+        with wd.paused():
+            time.sleep(0.3)  # a "slow" final save, > deadline
+            checkpoint.save_checkpoint(tmp_path, 0, {'w': np.zeros(4)})
+        assert not tripped.is_set()
+    finally:
+        guard.uninstall()
+        wd.stop()
+    assert (tmp_path / 'checkpoint-0.pkl').exists()
