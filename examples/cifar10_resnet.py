@@ -186,22 +186,46 @@ def main():
                                       jax.random.PRNGKey(args.seed), sample)
 
     # resilient runtime: retrying I/O, auto-resume, step watchdog,
-    # straggler-driven freq degradation (kfac_pytorch_tpu/resilience/)
+    # straggler-driven freq degradation, pod heartbeat + elastic resume
+    # (kfac_pytorch_tpu/resilience/)
     from kfac_pytorch_tpu import resilience
     io_retry = (resilience.RetryPolicy(attempts=args.io_retries + 1)
                 if args.io_retries > 0 else None)
+
+    def make_old_precond(nd):
+        # elastic resume: the checkpoint's world-size preconditioner
+        # over the SAME layer list the current plan discovered
+        pre = kfac.get_kfac_module(args.kfac_name)(
+            lr=args.base_lr, damping=args.damping,
+            fac_update_freq=args.kfac_cov_update_freq,
+            kfac_update_freq=args.kfac_update_freq,
+            exclude_parts=args.exclude_parts, num_devices=nd,
+            axis_name='batch' if nd > 1 else None,
+            assignment=args.assignment)
+        pre.setup(precond.plan.metas)
+        return pre
+
     start_epoch = 0
     if args.resume and args.checkpoint_dir:
-        restored, resume = utils.auto_resume(args.checkpoint_dir,
-                                             args.epochs, state,
-                                             retry=io_retry)
+        restored, resume, old_world = resilience.elastic_resume(
+            args.checkpoint_dir, args.epochs, precond, state,
+            make_precond=make_old_precond, retry=io_retry, log=log)
         if resume is not None:
             state = restored
             start_epoch = resume + 1
             if scheduler is not None:
                 scheduler.step(start_epoch)
+            if old_world is not None:
+                log.info('RESHARDED from_world=%d to_world=%d step=%d',
+                         old_world, args.num_devices, int(state.step))
             log.info('resumed from checkpoint-%d (step %d)', resume,
                      int(state.step))
+    # pod peer liveness: configured by launch_tpu.sh / kfac-pod-supervise
+    # via KFAC_HB_* env; a dead peer aborts this trainer RC_PEER_DEAD
+    # within the heartbeat deadline instead of hanging in a collective
+    hb = resilience.heartbeat_from_env(log=log)
+    if hb is not None:
+        hb.start()
     governor = None
     if args.straggler_budget > 0 and precond is not None:
         governor = resilience.StragglerGovernor(
@@ -215,7 +239,7 @@ def main():
                                      extra_mutable=('batch_stats',),
                                      fisher_type=args.kfac_type,
                                      fisher_seed=args.seed,
-                                     straggler=governor)
+                                     straggler=governor, heartbeat=hb)
 
     @jax.jit
     def eval_step(params, extra_vars, batch):
@@ -242,6 +266,10 @@ def main():
     # health-guard event log: skipped batches / ladder escalations surface
     # as WARNINGs at the step they happen, plus a per-epoch summary suffix
     monitor = utils.HealthMonitor(log, state=state)
+    if args.checkpoint_dir:
+        # world-size stamp: lets a shrunken pod's relaunch route this
+        # run's checkpoints through the factor reshard (elastic_resume)
+        utils.write_world_stamp(args.checkpoint_dir, args.num_devices)
     lr_now = args.base_lr
     res_prev = {}
     for epoch in range(start_epoch, args.epochs):
@@ -331,6 +359,8 @@ def main():
         utils.prune_checkpoints(args.checkpoint_dir, args.keep_checkpoints)
     if watchdog is not None:
         watchdog.stop()
+    if hb is not None:
+        hb.stop()
 
 
 if __name__ == '__main__':

@@ -198,22 +198,46 @@ def main():
     # auto-resume (reference: pytorch_imagenet_resnet.py:162-167,305-312),
     # hardened: an unreadable newest checkpoint (truncated write, storage
     # corruption) falls back to the next-older epoch instead of crashing;
-    # a TRANSIENT read failure retries in place (io_retry)
+    # a TRANSIENT read failure retries in place (io_retry). World-aware:
+    # a checkpoint stamped with a different mesh size (the pod shrank)
+    # routes through reshard_kfac_state instead of dying on a structure
+    # mismatch.
+    def make_old_precond(nd):
+        pre = kfac.get_kfac_module(args.kfac_name)(
+            lr=args.base_lr, damping=args.damping,
+            fac_update_freq=args.kfac_cov_update_freq,
+            kfac_update_freq=args.kfac_update_freq,
+            exclude_parts=args.exclude_parts, num_devices=nd,
+            axis_name='batch' if nd > 1 else None,
+            assignment=args.assignment)
+        pre.setup(precond.plan.metas)
+        return pre
+
     start_epoch = 0
-    restored, resume = utils.auto_resume(args.checkpoint_format,
-                                         args.epochs, state,
-                                         retry=io_retry)
+    restored, resume, old_world = resilience.elastic_resume(
+        args.checkpoint_format, args.epochs, precond, state,
+        make_precond=make_old_precond, retry=io_retry, log=log)
     if resume is not None:
         state = restored
         start_epoch = resume + 1
         if scheduler is not None:
             scheduler.step(start_epoch)
+        if old_world is not None:
+            log.info('RESHARDED from_world=%d to_world=%d step=%d',
+                     old_world, args.num_devices, int(state.step))
         log.info('resumed from checkpoint-%d', resume)
+    utils.write_world_stamp(args.checkpoint_format, args.num_devices)
+    # pod peer liveness (KFAC_HB_* from launch_tpu.sh/kfac-pod-supervise):
+    # a dead peer aborts this trainer RC_PEER_DEAD within the heartbeat
+    # deadline instead of hanging in a collective
+    hb = resilience.heartbeat_from_env(log=log)
+    if hb is not None:
+        hb.start()
 
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
                                      extra_mutable=('batch_stats',),
-                                     straggler=governor)
+                                     straggler=governor, heartbeat=hb)
 
     @jax.jit
     def eval_step(params, extra_vars, batch):
@@ -322,6 +346,8 @@ def main():
                                 args.keep_checkpoints)
     if watchdog is not None:
         watchdog.stop()
+    if hb is not None:
+        hb.stop()
 
 
 if __name__ == '__main__':

@@ -43,7 +43,16 @@ Host-side faults:
   KFAC_FAULT_CRASH_STEP      die at this step: KFAC_FAULT_CRASH_MODE
                              'exit' (default, os._exit(CRASH_RC=113))
                              or 'sigkill' (SIGKILL to self — the
-                             supervisor restart drill)
+                             supervisor restart drill; on ONE host of a
+                             pod this doubles as the PEER-DEATH drill —
+                             the survivors' heartbeats must detect it)
+  KFAC_FAULT_HB_STOP_STEP    stop publishing heartbeats at this step
+                             while the trainer keeps running — the
+                             HEARTBEAT-LOSS drill: the peers declare
+                             this host dead and shrink around it, and
+                             its own pod supervisor must fence it
+                             (resilience/heartbeat.py consumes this via
+                             PeerHeartbeat.tick)
   KFAC_FAULT_DATA_STEP       the data loader raises a transient EIO at
                              this batch index, once (next-batch retry
                              drill)
@@ -82,11 +91,14 @@ ENV_CRASH = 'KFAC_FAULT_CRASH_STEP'
 ENV_CRASH_MODE = 'KFAC_FAULT_CRASH_MODE'
 ENV_DATA = 'KFAC_FAULT_DATA_STEP'
 ENV_ONCE_DIR = 'KFAC_FAULT_ONCE_DIR'
+# defined by the (jax-free) heartbeat module, registered here so the
+# strict from_env knows the drill exists
+from kfac_pytorch_tpu.resilience.heartbeat import ENV_HB_STOP  # noqa: E402
 
 KNOWN_ENVS = frozenset({
     ENV_NAN_GRAD, ENV_INF_GRAD, ENV_STATS, ENV_FACTOR, ENV_EIGH,
     ENV_SIGTERM, ENV_CKPT, ENV_HANG, ENV_SLOW, ENV_SLOW_SECS, ENV_CRASH,
-    ENV_CRASH_MODE, ENV_DATA, ENV_ONCE_DIR,
+    ENV_CRASH_MODE, ENV_DATA, ENV_ONCE_DIR, ENV_HB_STOP,
 })
 
 # rc of the 'exit'-mode crash fault: distinct from Python's generic 1
@@ -173,6 +185,11 @@ def from_env() -> FaultConfig:
         raise ValueError(
             f'unrecognized fault env var(s) {unknown}; known: '
             f'{sorted(KNOWN_ENVS)}')
+    # validate-only: the heartbeat-loss drill is CONSUMED by the jax-free
+    # heartbeat layer (heartbeat_from_env), not through this config — but
+    # a malformed value must still fail loudly at build time like every
+    # other drill, even in runs with no heartbeat configured
+    _int_env(ENV_HB_STOP)
     mode = os.environ.get(ENV_CKPT) or None
     if mode is not None and mode not in ('truncate', 'fail', 'eio_once'):
         raise ValueError(f'{ENV_CKPT} must be "truncate", "fail" or '

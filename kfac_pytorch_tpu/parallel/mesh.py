@@ -13,18 +13,70 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def maybe_initialize_distributed():
+def maybe_initialize_distributed(retry=None, coordinator_address=None,
+                                 num_processes=None, process_id=None):
     """Initialize jax.distributed for multi-host pods when the launcher
     exported the coordination env (launch_tpu.sh) — the process-boundary
     replacement for mpirun/hostfiles (reference: launch_horovod.sh:32).
-    No-op on single host."""
-    addr = os.environ.get('JAX_COORDINATOR_ADDRESS')
+    No-op on single host.
+
+    The explicit ``coordinator_address`` / ``num_processes`` /
+    ``process_id`` arguments override the environment — the elastic
+    shrink path (``resilience.elastic``) rebuilds the mesh with a new
+    coordinator and a reduced process count without re-exec'ing through
+    the launcher.
+
+    The initialize call runs under ``call_with_retry``: on a pod-wide
+    restart every host races the coordinator's listener coming back up,
+    and the losers used to crash their first relaunch attempt with a
+    connection error instead of backing off. ``retry`` is a
+    ``resilience.RetryPolicy`` (default: 5 attempts, 1s base backoff,
+    retrying connection-shaped failures including the RuntimeError jax
+    wraps them in); pass ``retry=False`` to fail fast.
+    """
+    addr = (coordinator_address
+            or os.environ.get('JAX_COORDINATOR_ADDRESS'))
     if not addr or not os.environ.get('KFAC_TPU_MULTIHOST'):
         return False
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=int(os.environ['JAX_NUM_PROCESSES']),
-        process_id=int(os.environ['JAX_PROCESS_ID']))
+    nproc = (num_processes if num_processes is not None
+             else int(os.environ['JAX_NUM_PROCESSES']))
+    pid = (process_id if process_id is not None
+           else int(os.environ['JAX_PROCESS_ID']))
+
+    def _init():
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid)
+
+    if retry is False:
+        _init()
+        return True
+    from kfac_pytorch_tpu.resilience.retry import (RetryError,
+                                                   RetryPolicy,
+                                                   call_with_retry)
+    on_retry = None
+    if retry is None:
+        retry = RetryPolicy(
+            attempts=5, base_delay=1.0, max_delay=15.0,
+            retry_on=(OSError, TimeoutError, ConnectionError,
+                      RuntimeError))
+
+        def on_retry(e, attempt, delay):
+            # jax wraps the coordinator race in a bare RuntimeError, but
+            # so are PERMANENT failures ("already initialized", a
+            # malformed address) — retry only the connection-shaped
+            # ones, or every host burns the whole backoff budget
+            # re-raising the same config error
+            if isinstance(e, RuntimeError) and not isinstance(
+                    e, (OSError, TimeoutError)):
+                msg = str(e).lower()
+                if not any(t in msg for t in
+                           ('connect', 'coordinator', 'unavailable',
+                            'timed out', 'deadline')):
+                    raise RetryError(msg)
+
+    call_with_retry(_init, policy=retry, on_retry=on_retry,
+                    label=f'jax.distributed.initialize({addr})',
+                    counter='dist_init_retries')
     return True
 
 

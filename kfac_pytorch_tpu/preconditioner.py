@@ -266,7 +266,15 @@ class KFAC:
         ≙ _register_module_hooks + schedule_module_ranks (reference:
         kfac_preconditioner_base.py:132-149, inv.py:62-77). The vocab-size
         exclusion is applied here if not already filtered.
+
+        ``metas`` is the ``{path: LayerMeta}`` dict from
+        ``capture.collect_layer_meta``, or a plain meta list — e.g.
+        another plan's ``.metas``, which is how the elastic resume path
+        (``resilience.elastic_resume``) rebuilds the OLD world's plan
+        over the layer list the new world's plan discovered.
         """
+        if not isinstance(metas, dict):
+            metas = {m.path: m for m in metas}
         if self.exclude_vocabulary_size is not None:
             from kfac_pytorch_tpu.capture import filter_vocab_head
             metas = filter_vocab_head(metas, self.exclude_vocabulary_size)

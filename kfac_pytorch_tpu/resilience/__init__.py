@@ -27,8 +27,23 @@ Four cooperating pieces, each usable alone:
   restores them on recovery): one slow host costs preconditioner
   freshness, not throughput.
 
-Restart/hang/retry events all land in :data:`counters`, surfaced in
-run-log epoch lines via ``utils.runlog.resilience_suffix``.
+Pod level (multi-host; everything above is one host):
+
+- :mod:`heartbeat` — side-channel peer liveness (file-lease or TCP):
+  a survivor detects a dead peer within a configurable deadline and
+  aborts with the distinct :data:`RC_PEER_DEAD` instead of blocking in
+  a collective until every host's watchdog fires.
+- :mod:`elastic` — the ``kfac-pod-supervise`` per-host supervisor: on
+  permanent peer loss the survivors agree on the surviving set, relaunch
+  trainers at the reduced world size, and resume through
+  :func:`~elastic.elastic_resume` (``reshard_kfac_state`` carries the
+  accumulated factor statistics across the world-size change).
+- :mod:`incident` — scrape ``[resilience: ...]`` runlog lines plus
+  supervisor/watchdog/heartbeat events into a structured per-run
+  incident report (JSON + human summary).
+
+Restart/hang/retry/peer-death events all land in :data:`counters`,
+surfaced in run-log epoch lines via ``utils.runlog.resilience_suffix``.
 """
 
 import threading
@@ -66,16 +81,54 @@ class Counters:
 
 counters = Counters()
 
+
+def atomic_write_json(path, obj, **dump_kw):
+    """Write ``obj`` as JSON to ``path`` atomically (full write to a
+    tmp name, then ``os.replace``) — a reader never sees a torn file,
+    and a failed write leaves no ``.tmp-<pid>`` litter behind. Shared
+    by every protocol-file writer in the resilience layer (heartbeat
+    leases, shrink claims, incident reports, the checkpoint world
+    stamp): one atomicity discipline, one place to harden it."""
+    import json
+    import os
+    tmp = f'{path}.tmp-{os.getpid()}'
+    try:
+        with open(tmp, 'w') as f:
+            json.dump(obj, f, **dump_kw)
+            f.write('\n')
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
 from kfac_pytorch_tpu.resilience.retry import (  # noqa: E402
     ManualClock, RetryError, RetryPolicy, call_with_retry, resumable_iter)
 from kfac_pytorch_tpu.resilience.watchdog import (  # noqa: E402
     RC_HANG, StepWatchdog)
-from kfac_pytorch_tpu.resilience.supervisor import Supervisor  # noqa: E402
+from kfac_pytorch_tpu.resilience.supervisor import (  # noqa: E402
+    Supervisor, parse_stop_rc)
 from kfac_pytorch_tpu.resilience.straggler import (  # noqa: E402
     StragglerGovernor)
+from kfac_pytorch_tpu.resilience.heartbeat import (  # noqa: E402
+    RC_PEER_DEAD, FileLeaseTransport, PeerHeartbeat,
+    TcpHeartbeatTransport, heartbeat_from_env)
+from kfac_pytorch_tpu.resilience.elastic import (  # noqa: E402
+    PodSupervisor, elastic_resume)
+from kfac_pytorch_tpu.resilience.incident import (  # noqa: E402
+    IncidentReport, scrape_paths)
 
 __all__ = [
-    'Counters', 'counters', 'ManualClock', 'RetryError', 'RetryPolicy',
+    'Counters', 'counters', 'atomic_write_json',
+    'ManualClock', 'RetryError', 'RetryPolicy',
     'call_with_retry', 'resumable_iter', 'RC_HANG', 'StepWatchdog',
-    'Supervisor', 'StragglerGovernor',
+    'Supervisor', 'parse_stop_rc', 'StragglerGovernor',
+    'RC_PEER_DEAD', 'FileLeaseTransport', 'PeerHeartbeat',
+    'TcpHeartbeatTransport', 'heartbeat_from_env',
+    'PodSupervisor', 'elastic_resume',
+    'IncidentReport', 'scrape_paths',
 ]

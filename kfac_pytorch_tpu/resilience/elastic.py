@@ -1,0 +1,593 @@
+"""Elastic shrink on permanent host loss: the pod survives minus one.
+
+:mod:`.supervisor` restarts ONE host's trainer; :mod:`.heartbeat` lets
+every host *know* a peer died instead of hanging in a collective. This
+module closes the remaining loop — what a POD does when the death is
+permanent: the surviving hosts' supervisors agree on the surviving set,
+relaunch their trainers with the reduced world size, and the trainers
+resume through :func:`elastic_resume`, which transports the accumulated
+K-FAC factor statistics (thousands of steps of A/G EMAs) from the old
+world's checkpoint layout into the new one via
+``utils.checkpoint.reshard_kfac_state``. Decompositions re-initialize
+and are rebuilt at the first inverse update — the fresh-start degrade
+path the trainer already handles.
+
+One :class:`PodSupervisor` per host (``kfac-pod-supervise``, or
+``KFAC_POD_SUPERVISE=1`` through ``launch_tpu.sh``)::
+
+    kfac-pod-supervise --host-id 0 --num-hosts 4 --lease-dir /shared/hb \\
+        -- python examples/imagenet_resnet.py ... \\
+           --num-hosts '{num_hosts}' --host-id '{host_id}'
+
+``{host_id}`` / ``{num_hosts}`` / ``{gen}`` placeholders in the trainer
+argv are substituted per generation, so a shrink relaunch automatically
+tells the trainer its new rank and world size; the heartbeat contract
+(``KFAC_HB_*``) and ``JAX_PROCESS_ID`` / ``JAX_NUM_PROCESSES`` are
+re-exported the same way.
+
+Shrink protocol (file-lease, generation-scoped, no leader): on a
+confirmed peer death at generation ``g`` every survivor writes a claim
+``shrink-gen{g+1}/survivor-{host}.json``, waits for the expected
+survivor set (bounded by ``shrink_timeout``) plus a ``settle`` window
+for stragglers, and takes the sorted claimant set as the new membership
+— every survivor computes the same set from the same files. A host that
+sees a next-generation claim set it cannot corroborate with a death of
+its own is the one being declared dead (its beats are not reaching
+anyone): it fences itself — kills its trainer and exits — rather than
+split-brain the run.
+"""
+
+import argparse
+import contextlib
+import logging
+import os
+import random
+import signal as _signal
+import subprocess
+import sys
+import threading
+import time
+
+from kfac_pytorch_tpu.resilience import heartbeat as hb_mod
+from kfac_pytorch_tpu.resilience.heartbeat import (
+    FileLeaseTransport, PeerHeartbeat, RC_PEER_DEAD)
+from kfac_pytorch_tpu.resilience.incident import IncidentReport
+from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK, RetryPolicy
+from kfac_pytorch_tpu.resilience.supervisor import parse_stop_rc
+from kfac_pytorch_tpu.resilience.watchdog import RC_HANG
+
+log = logging.getLogger(__name__)
+
+
+def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
+                   retry=None, log=None):
+    """World-size-aware auto-resume: ``(state, epoch, old_world)``.
+
+    Reads the world stamp the previous run left next to its checkpoints
+    (``utils.checkpoint.write_world_stamp``). Stamp matches the current
+    ``precond.num_devices`` (or there is no stamp / no preconditioner):
+    plain ``auto_resume``, ``old_world`` None. Stamp differs — the pod
+    shrank (or grew) since the checkpoint was taken: the checkpoint is
+    restored against the OLD world's state structure (``make_precond(
+    old_world)`` must return a set-up preconditioner for that size —
+    same model, same layer list) and the factor statistics are
+    transported into the new layout via ``reshard_kfac_state``; params /
+    optimizer / step restore unchanged (they are world-size invariant).
+    Returns ``(None, None, old_world)`` when nothing restorable exists.
+    """
+    import jax
+    from kfac_pytorch_tpu.utils import checkpoint as ckpt
+    lg = log if log is not None else logging.getLogger(__name__)
+    old_world = ckpt.read_world_stamp(base_dir)
+    new_world = getattr(precond, 'num_devices', None)
+    if (precond is None or old_world is None or new_world is None
+            or old_world == new_world):
+        restored, epoch = ckpt.auto_resume(base_dir, max_epoch, state,
+                                           retry=retry)
+        return restored, epoch, None
+    pre_old = make_precond(old_world)
+    old_target = state.replace(kfac_state=pre_old.init())
+    restored, epoch = ckpt.auto_resume(base_dir, max_epoch, old_target,
+                                       retry=retry)
+    if epoch is None:
+        return None, None, old_world
+    carried = ckpt.reshard_kfac_state(pre_old, precond,
+                                      restored.kfac_state)
+    # adopt through the host: restored leaves may be committed to the
+    # old world's sharding and cannot feed the new mesh directly
+    host = jax.device_get
+    new_state = state.replace(
+        step=host(restored.step), params=host(restored.params),
+        opt_state=host(restored.opt_state),
+        extra_vars=host(restored.extra_vars), health=restored.health,
+        kfac_state=host(carried))
+    lg.info('elastic resume: transported K-FAC factors from world %d -> '
+            '%d at checkpoint-%d (step %d); decompositions rebuild at '
+            'the first inverse update', old_world, new_world, epoch,
+            int(jax.device_get(restored.step)))
+    return new_state, epoch, old_world
+
+
+class PodSupervisor:
+    """One per host: supervise the local trainer, heartbeat with peer
+    supervisors, orchestrate the shrink when a peer dies for good.
+
+    Exit-code protocol with the trainer (superset of
+    :class:`~.supervisor.Supervisor`'s):
+
+    - ``0`` — done: stop, report, exit 0.
+    - ``RC_PEER_DEAD`` (115) — the trainer's heartbeat saw a peer die:
+      confirm with our own monitor, run the shrink protocol, relaunch
+      at the reduced world size (not charged to the restart budget).
+    - ``RC_HANG`` (114) — watchdog hang abort: restart, counted as a
+      hang.
+    - configured ``stop_rcs`` — propagate without restarting.
+    - anything else — crash: restart with backoff up to
+      ``max_restarts``.
+
+    A structured incident report (what died, detection latency,
+    restarts, shrinks) is written to ``incident_path`` on every exit
+    path.
+    """
+
+    def __init__(self, argv_template, *, host_id, num_hosts, lease_dir,
+                 host_addr=None, max_restarts=3, backoff_base=1.0,
+                 backoff_max=60.0, hb_interval=1.0, hb_deadline=5.0,
+                 hb_grace=60.0, settle=None, shrink_timeout=None,
+                 stop_rcs=(), incident_path=None, env=None, clock=None,
+                 rng=None, popen=subprocess.Popen, poll_period=0.2,
+                 child_kill_grace=5.0, log=None):
+        self.argv_template = list(argv_template)
+        self.host_id = int(host_id)
+        self.members = list(range(int(num_hosts)))
+        self.lease_dir = str(lease_dir)
+        self.host_addr = host_addr
+        self.max_restarts = int(max_restarts)
+        self.backoff = RetryPolicy(attempts=max(2, max_restarts + 1),
+                                   base_delay=backoff_base,
+                                   max_delay=backoff_max, jitter=0.5)
+        self.hb_interval = float(hb_interval)
+        self.hb_deadline = float(hb_deadline)
+        self.hb_grace = float(hb_grace)
+        self.settle = (float(settle) if settle is not None
+                       else 2.0 * self.hb_interval)
+        self.shrink_timeout = (float(shrink_timeout)
+                               if shrink_timeout is not None
+                               else self.hb_deadline + 10.0
+                               * self.hb_interval)
+        self.stop_rcs = frozenset(stop_rcs)
+        self.incident_path = incident_path or os.path.join(
+            self.lease_dir, f'incident-host{self.host_id}.json')
+        self.env = env
+        self.clock = clock or REAL_CLOCK
+        self.rng = rng or random
+        self.popen = popen
+        self.poll_period = float(poll_period)
+        self.child_kill_grace = float(child_kill_grace)
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self.gen = 0
+        self.restarts = 0
+        self.crashes = 0
+        self.hangs = 0
+        self.shrinks = 0
+        self.child = None
+        self._terminating = False
+        self._lock = threading.Lock()
+        self._lost = {}       # host_id -> heartbeat info (confirmed dead)
+        self._hb = None
+        self.report = IncidentReport(host_id=self.host_id)
+        os.makedirs(self.lease_dir, exist_ok=True)
+
+    def counts(self):
+        return {'restarts': self.restarts, 'crashes': self.crashes,
+                'hangs': self.hangs, 'shrinks': self.shrinks}
+
+    # -- supervisor-to-supervisor heartbeat -------------------------------
+
+    def _record_peer_dead(self, peer, info):
+        with self._lock:
+            if peer in self._lost:
+                return
+            self._lost[peer] = info
+        self.report.add_event('peer_dead', peer=peer,
+                              detect_s=info.get('detect_s'),
+                              last_step=info.get('last_step'))
+
+    def _clear_stale_protocol_files(self):
+        """Generation-0 startup: scrub the lease dir of the PREVIOUS
+        incarnation's protocol files. A pod restart reuses the lease dir
+        (the runbook says so), and stale shrink claims would read as "my
+        peers are shrinking around me" — every healthy host would fence
+        itself at startup — while stale heartbeat leases would feed the
+        monitors dead sequences. Every host runs this; it is idempotent,
+        and a race with a peer's fresh startup write only costs that
+        peer one beat (republished within an interval, well inside the
+        startup grace). Incident reports are kept — they are the
+        artifact, not protocol state."""
+        import shutil
+        try:
+            names = os.listdir(self.lease_dir)
+        except OSError:
+            return
+        for name in names:
+            path = os.path.join(self.lease_dir, name)
+            if name.startswith(('shrink-gen', 'trainer-gen')):
+                shutil.rmtree(path, ignore_errors=True)
+            elif name == 'sup':
+                with contextlib.suppress(OSError):
+                    for lease in os.listdir(path):
+                        if lease.startswith('hb-'):
+                            with contextlib.suppress(OSError):
+                                os.remove(os.path.join(path, lease))
+
+    def _start_monitor(self):
+        if self._hb is not None:
+            self._hb.stop()
+        sup_dir = os.path.join(self.lease_dir, 'sup')
+        self._hb = PeerHeartbeat(
+            FileLeaseTransport(sup_dir, self.host_id), self.host_id,
+            peers=[m for m in self.members if m != self.host_id],
+            interval=self.hb_interval, deadline=self.hb_deadline,
+            startup_grace=self.hb_grace, on_dead=self._record_peer_dead,
+            log=self.log)
+        if len(self.members) > 1:
+            self._hb.start()
+
+    def _confirmed_dead(self):
+        with self._lock:
+            return {h: i for h, i in self._lost.items()
+                    if h in self.members}
+
+    def _wait_for_confirmation(self, why, timeout=None):
+        """Give our own monitor time to corroborate a death someone else
+        (the trainer, or a peer's shrink claim) has already acted on."""
+        timeout = (timeout if timeout is not None
+                   else self.hb_deadline + 2.0 * self.hb_interval)
+        start = self.clock.monotonic()
+        while self.clock.monotonic() - start < timeout:
+            dead = self._confirmed_dead()
+            if dead:
+                return dead
+            self.clock.sleep(self.poll_period)
+        self.log.warning('pod-supervisor: %s, but our own heartbeat '
+                         'monitor confirmed no dead peer within %.1fs',
+                         why, timeout)
+        return {}
+
+    # -- child management -------------------------------------------------
+
+    def _subst(self, arg):
+        for k, v in (('host_id', self.members.index(self.host_id)),
+                     ('num_hosts', len(self.members)), ('gen', self.gen)):
+            arg = arg.replace('{%s}' % k, str(v))
+        return arg
+
+    def _child_argv(self):
+        return [self._subst(a) for a in self.argv_template]
+
+    def _child_env(self):
+        env = dict(self.env if self.env is not None else os.environ)
+        rank = self.members.index(self.host_id)
+        world = len(self.members)
+        env[hb_mod.ENV_DIR] = os.path.join(self.lease_dir,
+                                           f'trainer-gen{self.gen}')
+        env[hb_mod.ENV_HOST] = str(rank)
+        env[hb_mod.ENV_HOSTS] = str(world)
+        env[hb_mod.ENV_INTERVAL] = str(self.hb_interval)
+        env[hb_mod.ENV_DEADLINE] = str(self.hb_deadline)
+        env[hb_mod.ENV_GRACE] = str(self.hb_grace)
+        env['KFAC_POD_GEN'] = str(self.gen)
+        env['JAX_PROCESS_ID'] = str(rank)
+        env['JAX_NUM_PROCESSES'] = str(world)
+        coord = self._coordinator_addr()
+        if coord:
+            env['JAX_COORDINATOR_ADDRESS'] = coord
+        return env
+
+    def _coordinator_addr(self):
+        """Coordinator after a shrink = the lowest surviving host's
+        address, published in its shrink claim (``--host-addr``). None
+        when addresses are not in play (single-machine simulation)."""
+        addrs = getattr(self, '_member_addrs', None)
+        if not addrs:
+            return None
+        low = min(self.members)
+        return addrs.get(low)
+
+    def _terminate_child(self):
+        child = self.child
+        if child is None or child.poll() is not None:
+            return
+        child.terminate()
+        deadline = self.clock.monotonic() + self.child_kill_grace
+        while child.poll() is None and self.clock.monotonic() < deadline:
+            self.clock.sleep(self.poll_period)
+        if child.poll() is None:
+            # wedged in a collective: SIGTERM cannot reach a blocked
+            # main thread's cooperative shutdown in time — kill
+            child.kill()
+            child.wait()
+
+    def _forward_signal(self, signum, frame):
+        self._terminating = True
+        child = self.child
+        if child is not None and child.poll() is None:
+            self.log.warning('pod-supervisor: received signal %d — '
+                             'forwarding to trainer pid %d and stopping',
+                             signum, child.pid)
+            child.send_signal(signum)
+
+    # -- shrink protocol --------------------------------------------------
+
+    def _claim_dir(self, gen):
+        return os.path.join(self.lease_dir, f'shrink-gen{gen}')
+
+    def _read_claims(self, claim_dir):
+        import json
+        out = {}
+        try:
+            names = os.listdir(claim_dir)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith('survivor-')
+                    and name.endswith('.json')):
+                continue
+            try:
+                with open(os.path.join(claim_dir, name)) as f:
+                    payload = json.load(f)
+                out[int(payload['host'])] = payload
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+    def _write_claim(self, claim_dir):
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        os.makedirs(claim_dir, exist_ok=True)
+        atomic_write_json(
+            os.path.join(claim_dir, f'survivor-{self.host_id}.json'),
+            {'host': self.host_id, 'addr': self.host_addr,
+             'wall': time.time()})
+
+    def _peer_shrink_started(self):
+        """True when a peer has already claimed the NEXT generation."""
+        claims = self._read_claims(self._claim_dir(self.gen + 1))
+        return bool(set(claims) - {self.host_id})
+
+    def _shrink(self, dead):
+        """Run the survivor barrier; returns the new membership."""
+        next_gen = self.gen + 1
+        claim_dir = self._claim_dir(next_gen)
+        self._write_claim(claim_dir)
+        expected = set(self.members) - set(dead)
+        start = self.clock.monotonic()
+        while self.clock.monotonic() - start < self.shrink_timeout:
+            if expected <= set(self._read_claims(claim_dir)):
+                break
+            self.clock.sleep(self.poll_period)
+        # settle: a late claim from a host we wrote off means it is
+        # alive after all — better to keep it than split-brain
+        self.clock.sleep(self.settle)
+        claims = self._read_claims(claim_dir)
+        claims.setdefault(self.host_id,
+                          {'host': self.host_id, 'addr': self.host_addr})
+        survivors = sorted(claims)
+        old_world = len(self.members)
+        self.members = survivors
+        self._member_addrs = {h: c.get('addr')
+                              for h, c in claims.items()}
+        self.gen = next_gen
+        self.shrinks += 1
+        from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        self.log.warning(
+            'elastic: shrinking world %d -> %d survivors=%s gen=%d%s',
+            old_world, len(survivors), survivors, next_gen,
+            resilience_suffix(self.counts()))
+        self.report.add_event('shrink', **{
+            'from': old_world, 'to': len(survivors),
+            'survivors': survivors, 'gen': next_gen,
+            'dead': sorted(dead)})
+        self._start_monitor()
+
+    def _fence(self, rc):
+        from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        self.log.error(
+            'pod-supervisor: the other hosts are shrinking around us and '
+            'no peer looks dead from here — OUR heartbeats are not '
+            'reaching them. Fencing this host (killing the trainer and '
+            'exiting) rather than split-braining the pod. '
+            '[resilience: fenced=1]%s', resilience_suffix(self.counts()))
+        self.report.add_event('fenced', gen=self.gen + 1)
+        self.report.bump({'fenced': 1})
+        self._terminate_child()
+        return rc if rc else RC_PEER_DEAD
+
+    # -- main loop --------------------------------------------------------
+
+    def run(self):
+        prev_handlers = {}
+        try:
+            for s in (_signal.SIGTERM, _signal.SIGINT):
+                prev_handlers[s] = _signal.signal(s, self._forward_signal)
+        except ValueError:  # pragma: no cover — non-main thread (tests)
+            prev_handlers = {}
+        self._clear_stale_protocol_files()
+        self._start_monitor()
+        try:
+            rc = self._run_loop()
+        finally:
+            for s, h in prev_handlers.items():
+                _signal.signal(s, h if h is not None else _signal.SIG_DFL)
+            if self._hb is not None:
+                self._hb.stop()
+            self.report.bump(self.counts())
+            try:
+                self.report.write(self.incident_path)
+                self.log.info('pod-supervisor: incident report written '
+                              'to %s\n%s', self.incident_path,
+                              self.report.summary())
+            except OSError:  # pragma: no cover — report must not mask rc
+                self.log.exception('pod-supervisor: could not write the '
+                                   'incident report')
+        return rc
+
+    def _wait_child(self):
+        """Wait for the trainer; interleave peer-death / shrink / signal
+        checks. Returns (rc, reason) with reason in
+        {'exit', 'peer_dead', 'fenced'}."""
+        while True:
+            rc = self.child.poll()
+            if rc is not None:
+                return rc, 'exit'
+            if self._terminating:
+                return self.child.wait(), 'exit'
+            if self._confirmed_dead():
+                self.log.warning('pod-supervisor: peer death confirmed '
+                                 'while the trainer is still up — '
+                                 'stopping it for the shrink')
+                self._terminate_child()
+                return self.child.poll(), 'peer_dead'
+            if self._peer_shrink_started():
+                dead = self._wait_for_confirmation(
+                    'peers began a shrink')
+                if dead:
+                    self._terminate_child()
+                    return self.child.poll(), 'peer_dead'
+                return None, 'fenced'
+            self.clock.sleep(self.poll_period)
+
+    def _run_loop(self):
+        from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        while True:
+            argv, env = self._child_argv(), self._child_env()
+            self.log.info('pod-supervisor[host %d, gen %d]: launching: '
+                          '%s', self.host_id, self.gen, ' '.join(argv))
+            self.report.add_event('launch', gen=self.gen,
+                                  world=len(self.members))
+            self.child = self.popen(argv, env=env)
+            rc, reason = self._wait_child()
+            self.report.add_event('trainer_exit', rc=rc, reason=reason,
+                                  gen=self.gen)
+            if reason == 'fenced':
+                return self._fence(rc)
+            if self._terminating:
+                self.log.info('pod-supervisor: trainer exited rc=%s '
+                              'after forwarded signal — not restarting%s',
+                              rc, resilience_suffix(self.counts()))
+                return rc if rc is not None else 0
+            if reason == 'exit' and rc == 0:
+                self.log.info('pod-supervisor: trainer finished '
+                              'cleanly%s', resilience_suffix(self.counts()))
+                return 0
+            if reason == 'exit' and rc in self.stop_rcs:
+                self.log.warning('pod-supervisor: trainer exited rc=%d '
+                                 '(configured stop code) — not '
+                                 'restarting%s', rc,
+                                 resilience_suffix(self.counts()))
+                return rc
+            if reason == 'peer_dead' or rc == RC_PEER_DEAD:
+                dead = (self._confirmed_dead()
+                        or self._wait_for_confirmation(
+                            f'trainer exited rc={rc}'))
+                if dead:
+                    if len(self.members) - len(dead) < 1:
+                        self.log.error('pod-supervisor: no survivors '
+                                       'left — giving up [resilience: '
+                                       'gave_up=1]')
+                        return RC_PEER_DEAD
+                    self._shrink(dead)
+                    self.restarts += 1
+                    continue
+                # the trainer cried peer-death but nobody looks dead from
+                # here: transient (network blip its deadline caught) —
+                # budgeted restart, same as a crash
+                self.log.warning('pod-supervisor: unconfirmed peer '
+                                 'death (rc=%s) — treating as a crash',
+                                 rc)
+            if rc == RC_HANG:
+                self.hangs += 1
+                why = 'hang (watchdog abort)'
+            else:
+                self.crashes += 1
+                why = (f'killed by signal {-rc}' if rc is not None
+                       and rc < 0 else 'crash')
+            budget_spent = (self.crashes + self.hangs
+                            > self.max_restarts)
+            if budget_spent:
+                self.log.error(
+                    'pod-supervisor: trainer exited rc=%s (%s) and the '
+                    'restart budget (%d) is spent — giving up%s', rc,
+                    why, self.max_restarts, resilience_suffix(
+                        dict(self.counts(), gave_up=1)))
+                self.report.bump({'gave_up': 1})
+                return rc if rc is not None else 1
+            delay = self.backoff.delay(
+                max(0, self.crashes + self.hangs - 1), self.rng)
+            self.restarts += 1
+            self.log.warning(
+                'pod-supervisor: trainer exited rc=%s (%s) — restart '
+                '%d/%d in %.2fs%s', rc, why, self.crashes + self.hangs,
+                self.max_restarts, delay,
+                resilience_suffix(self.counts()))
+            self.clock.sleep(delay)
+            if self._terminating:
+                return rc if rc is not None else 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='kfac-pod-supervise',
+        description='Per-host pod supervisor: restart a crashed/hung '
+                    'trainer, heartbeat with peer supervisors, and '
+                    'shrink the pod when a host dies for good. '
+                    '{host_id}/{num_hosts}/{gen} in the trainer command '
+                    'are substituted per generation.')
+    p.add_argument('--host-id', type=int, required=True)
+    p.add_argument('--num-hosts', type=int, required=True)
+    p.add_argument('--lease-dir', required=True,
+                   help='shared directory for heartbeat leases and '
+                        'shrink claims (must be visible to every host)')
+    p.add_argument('--host-addr', default=None,
+                   help='this host\'s coordinator address (host:port); '
+                        'the lowest surviving host\'s address becomes '
+                        'JAX_COORDINATOR_ADDRESS after a shrink')
+    p.add_argument('--max-restarts', type=int, default=3)
+    p.add_argument('--backoff-base', type=float, default=1.0)
+    p.add_argument('--backoff-max', type=float, default=60.0)
+    p.add_argument('--hb-interval', type=float, default=1.0)
+    p.add_argument('--hb-deadline', type=float, default=5.0)
+    p.add_argument('--hb-grace', type=float, default=60.0)
+    p.add_argument('--settle', type=float, default=None)
+    p.add_argument('--shrink-timeout', type=float, default=None)
+    p.add_argument('--stop-rc', type=parse_stop_rc, action='append',
+                   default=[],
+                   help='exit code (number or name: hang / peer_dead / '
+                        'crash) to propagate without restarting')
+    p.add_argument('--incident-out', default=None,
+                   help='incident report path (default: '
+                        '<lease-dir>/incident-host<id>.json)')
+    p.add_argument('command', nargs=argparse.REMAINDER,
+                   help='trainer command (prefix with -- to separate)')
+    args = p.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == '--':
+        cmd = cmd[1:]
+    if not cmd:
+        p.error('no trainer command given '
+                '(kfac-pod-supervise [opts] -- cmd)')
+    if not logging.getLogger().handlers:
+        logging.basicConfig(level=logging.INFO,
+                            format='%(asctime)s %(message)s')
+    sup = PodSupervisor(
+        cmd, host_id=args.host_id, num_hosts=args.num_hosts,
+        lease_dir=args.lease_dir, host_addr=args.host_addr,
+        max_restarts=args.max_restarts, backoff_base=args.backoff_base,
+        backoff_max=args.backoff_max, hb_interval=args.hb_interval,
+        hb_deadline=args.hb_deadline, hb_grace=args.hb_grace,
+        settle=args.settle, shrink_timeout=args.shrink_timeout,
+        stop_rcs=args.stop_rc, incident_path=args.incident_out)
+    return sup.run()
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,395 @@
+"""Side-channel peer liveness for multi-host pods: detect a dead peer
+in seconds, not watchdog-deadlines.
+
+A pod whose host dies presents to every SURVIVOR as a wedged collective:
+the psum never completes, the step never returns, and nothing happens
+until each survivor's own :class:`~.watchdog.StepWatchdog` fires — a
+deadline sized for the SLOWEST legitimate step, i.e. far larger than the
+time it takes to *know* a peer is gone. The heartbeat is the side
+channel that closes that gap: every host publishes a monotonically
+increasing sequence number out-of-band (a lease file on the shared
+filesystem, or a tiny TCP responder), and a background monitor on every
+host watches the peers' sequences ADVANCE. A peer whose sequence stops
+advancing for ``deadline`` seconds is declared dead; the default
+reaction is to flush the run log and exit with :data:`RC_PEER_DEAD` —
+a return code the pod supervisor (:mod:`.elastic`) distinguishes from a
+crash (restart me) and a hang (restart me, count separately): it means
+*shrink the pod*.
+
+Liveness is judged purely from sequence ADVANCE against the local
+monotonic clock — no cross-host clock comparison anywhere, so skewed
+wall clocks cannot fake a death or hide one. Everything time-shaped
+(clock, transport) is injectable; unit tests drive :meth:`poll_once`
+directly under a ``ManualClock`` and never sleep.
+"""
+
+import contextlib
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+from kfac_pytorch_tpu import resilience as _res
+
+log = logging.getLogger(__name__)
+
+# "a peer of mine is dead" return code: distinct from clean exit (0),
+# generic Python death (1), the crash drill (113) and the watchdog's
+# RC_HANG (114). The pod supervisor keys the SHRINK path off it — a
+# restart alone cannot fix a run whose world has changed size.
+RC_PEER_DEAD = 115
+
+# chaos drill (faults.py re-exports this into its strict registry): the
+# trainer stops PUBLISHING heartbeats at the given step while continuing
+# to run — the silent-death drill, exercising the peers' detection path
+# without actually killing anything.
+ENV_HB_STOP = 'KFAC_FAULT_HB_STOP_STEP'
+
+# launcher/pod-supervisor -> trainer heartbeat contract (heartbeat_from_env)
+ENV_DIR = 'KFAC_HB_DIR'
+ENV_HOST = 'KFAC_HB_HOST'
+ENV_HOSTS = 'KFAC_HB_HOSTS'
+ENV_INTERVAL = 'KFAC_HB_INTERVAL'
+ENV_DEADLINE = 'KFAC_HB_DEADLINE'
+ENV_GRACE = 'KFAC_HB_GRACE'
+
+
+class FileLeaseTransport:
+    """Shared-filesystem leases: host ``i`` owns ``hb-i.json``.
+
+    Writes are atomic (tmp + rename, same discipline as the pickle
+    checkpoint path) so a reader never sees a torn payload; a reader
+    that catches a file mid-replace just keeps the previous sequence for
+    one poll. Works on anything rename-atomic (local disk, NFS, gcsfuse
+    with a single writer per object — each host only ever writes its own
+    lease).
+    """
+
+    def __init__(self, lease_dir, host_id):
+        self.lease_dir = str(lease_dir)
+        self.host_id = int(host_id)
+        os.makedirs(self.lease_dir, exist_ok=True)
+
+    def _path(self, host_id):
+        return os.path.join(self.lease_dir, f'hb-{host_id}.json')
+
+    def publish(self, payload):
+        _res.atomic_write_json(self._path(self.host_id), payload)
+
+    def read_peers(self):
+        """{host_id: payload} for every readable lease but our own."""
+        out = {}
+        try:
+            names = os.listdir(self.lease_dir)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith('hb-') and name.endswith('.json')):
+                continue
+            try:
+                hid = int(name[3:-5])
+            except ValueError:
+                continue
+            if hid == self.host_id:
+                continue
+            try:
+                with open(os.path.join(self.lease_dir, name)) as f:
+                    out[hid] = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-replace or unreadable: next poll
+        return out
+
+
+class TcpHeartbeatTransport:
+    """Connection-per-probe TCP liveness: each host runs a one-shot
+    responder that answers any connection with its current payload.
+
+    No shared filesystem needed (pods whose checkpoint store is object
+    storage without rename semantics). A dead host's port stops
+    accepting, so its sequence stops advancing — exactly the same signal
+    the monitor already consumes from the file transport. The responder
+    is a daemon thread; ``close()`` stops it for clean trainer exits.
+    """
+
+    def __init__(self, host_id, port, peer_addrs, bind_host='0.0.0.0',
+                 timeout=1.0):
+        self.host_id = int(host_id)
+        self.peer_addrs = {int(k): v for k, v in dict(peer_addrs).items()
+                           if int(k) != int(host_id)}
+        self.timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._payload = b'{}'
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((bind_host, int(port)))
+        self._srv.settimeout(0.25)
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]  # resolves port=0
+        self._stopped = False
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f'kfac-hb-srv-{host_id}')
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stopped:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with contextlib.suppress(OSError), conn:
+                with self._lock:
+                    blob = self._payload
+                conn.sendall(blob)
+
+    def publish(self, payload):
+        with self._lock:
+            self._payload = json.dumps(payload).encode()
+
+    def read_peers(self):
+        out = {}
+        for hid, addr in self.peer_addrs.items():
+            try:
+                with socket.create_connection(addr,
+                                              timeout=self.timeout) as s:
+                    s.settimeout(self.timeout)
+                    chunks = []
+                    while True:
+                        b = s.recv(4096)
+                        if not b:
+                            break
+                        chunks.append(b)
+                out[hid] = json.loads(b''.join(chunks) or b'{}')
+            except (OSError, ValueError):
+                continue  # unreachable/refused: sequence just won't advance
+        return out
+
+    def close(self):
+        self._stopped = True
+        with contextlib.suppress(OSError):
+            self._srv.close()
+        self._thread.join(timeout=2)
+
+
+class PeerHeartbeat:
+    """Publish our liveness, watch the peers', react to a death.
+
+    Args:
+      transport: :class:`FileLeaseTransport`-shaped object
+        (``publish(payload)`` / ``read_peers() -> {id: payload}``).
+      host_id: this host's id.
+      num_hosts: pod size — peers default to every other id in
+        ``range(num_hosts)``; pass ``peers`` for an explicit set (the
+        pod supervisor does, after a shrink).
+      interval: seconds between publish+scan polls (background thread).
+      deadline: a peer whose sequence has not advanced for this long is
+        dead. Budget rule of thumb: detection latency ≤ ``deadline`` +
+        one ``interval`` + transport staleness.
+      startup_grace: a peer never seen at all is only declared dead this
+        long after :meth:`start` — hosts of a pod come up at different
+        times (imports, compilation) and "slow to first beat" must not
+        read as "dead".
+      on_dead: ``on_dead(peer_id, info)`` callback replacing the default
+        reaction. Default (None): log, flush the run log, hard-exit
+        :data:`RC_PEER_DEAD` — correct for a trainer that may be wedged
+        in a collective only ``os._exit`` can leave. The pod supervisor
+        passes a callback (it must orchestrate, not die).
+      stop_beat_step: chaos drill (:data:`ENV_HB_STOP`): stop publishing
+        once :meth:`tick` sees this step.
+      clock: injectable monotonic clock (tests).
+    """
+
+    def __init__(self, transport, host_id, num_hosts=None, *, peers=None,
+                 interval=2.0, deadline=10.0, startup_grace=60.0,
+                 on_dead=None, rc=RC_PEER_DEAD, stop_beat_step=None,
+                 clock=time.monotonic, log=None):
+        if peers is None:
+            if num_hosts is None:
+                raise ValueError('pass num_hosts or an explicit peers list')
+            peers = [i for i in range(int(num_hosts)) if i != int(host_id)]
+        self.transport = transport
+        self.host_id = int(host_id)
+        self.peers = sorted(int(p) for p in peers)
+        self.interval = float(interval)
+        self.deadline = float(deadline)
+        self.startup_grace = float(startup_grace)
+        self.rc = rc
+        self.stop_beat_step = stop_beat_step
+        self._on_dead = on_dead
+        self._clock = clock
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self._seq = 0
+        self._step = None
+        self._suppressed = False
+        self._started_at = None
+        self._lock = threading.Lock()
+        self._seen = {}   # peer -> [seq, local time of last advance, step]
+        self._dead = {}   # peer -> detection info dict
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- publishing -------------------------------------------------------
+
+    def tick(self, step):
+        """Host-step hook (training.step_fn): stamps the current trainer
+        step into the published payload, and arms the silent-death drill.
+        Liveness does NOT depend on tick being called — a trainer wedged
+        in a collective stops ticking but keeps beating, which is the
+        point: the heartbeat answers "is the process alive", the
+        watchdog answers "is it making progress"."""
+        self._step = int(step)
+        if (self.stop_beat_step is not None and not self._suppressed
+                and self._step >= self.stop_beat_step):
+            self._suppressed = True
+            self.log.warning(
+                'CHAOS FAULT ACTIVE: %s=%d — host %d stops publishing '
+                'heartbeats now (peers should declare it dead)',
+                ENV_HB_STOP, self.stop_beat_step, self.host_id)
+
+    def _publish(self):
+        if self._suppressed:
+            return
+        self._seq += 1
+        try:
+            self.transport.publish({
+                'host': self.host_id, 'seq': self._seq, 'step': self._step,
+                'pid': os.getpid(), 'wall': time.time()})
+        except OSError as e:  # flaky shared FS: miss one beat, not the run
+            _res.counters.bump('hb_publish_errors')
+            self.log.warning('heartbeat: publish failed (%s) — peers see '
+                             'a missed beat, not a death, unless this '
+                             'persists past their deadline', e)
+
+    # -- monitoring -------------------------------------------------------
+
+    def poll_once(self):
+        """One publish+scan cycle; returns newly-dead peer ids. The
+        background loop calls this every ``interval``; deterministic
+        tests call it directly under a ManualClock."""
+        self._publish()
+        now = self._clock()
+        if self._started_at is None:
+            self._started_at = now
+        try:
+            payloads = self.transport.read_peers()
+        except OSError:
+            payloads = {}
+        newly_dead = []
+        with self._lock:
+            for peer in self.peers:
+                if peer in self._dead:
+                    continue
+                p = payloads.get(peer)
+                rec = self._seen.get(peer)
+                if p is not None and isinstance(p.get('seq'), int):
+                    # liveness = the (pid, seq) identity CHANGED, not
+                    # "seq grew": a crash-restarted peer resets its
+                    # sequence to 1 under a new pid, and judging it by
+                    # the old process's high-water mark would declare a
+                    # host dead for coming back
+                    ident = (p.get('pid'), p['seq'])
+                    if rec is None or ident != rec[0]:
+                        rec = self._seen[peer] = [ident, now,
+                                                  p.get('step')]
+                if rec is None:
+                    silent_for = now - self._started_at
+                    if silent_for <= self.startup_grace:
+                        continue
+                else:
+                    silent_for = now - rec[1]
+                    if silent_for <= self.deadline:
+                        continue
+                info = {'peer': peer, 'detect_s': round(silent_for, 3),
+                        'last_seq': rec[0][1] if rec else None,
+                        'last_step': rec[2] if rec else None,
+                        'never_seen': rec is None, 'wall': time.time()}
+                self._dead[peer] = info
+                newly_dead.append(peer)
+        for peer in newly_dead:
+            self._declare_dead(peer, self._dead[peer])
+        return newly_dead
+
+    def _declare_dead(self, peer, info):
+        _res.counters.bump('peer_dead')
+        # machine-greppable: the incident scraper keys off this suffix
+        self.log.error(
+            'heartbeat: peer %d declared dead — no heartbeat advance for '
+            '%.2fs (deadline %.2fs%s) [resilience: peer_dead=1 peer=%d '
+            'detect_s=%.2f]', peer, info['detect_s'], self.deadline,
+            ', never seen at all' if info['never_seen'] else
+            f', last step {info["last_step"]}', peer, info['detect_s'])
+        if self._on_dead is not None:
+            self._on_dead(peer, info)
+            return
+        # default: this trainer is (or is about to be) wedged in a
+        # collective that will never complete — flush the log tail and
+        # hard-exit with the code that tells the pod supervisor to SHRINK
+        try:
+            from kfac_pytorch_tpu.utils.runlog import flush_all_handlers
+            flush_all_handlers()
+        except Exception:  # noqa: BLE001 — dying anyway
+            for h in logging.getLogger().handlers:
+                with contextlib.suppress(Exception):
+                    h.flush()
+        os._exit(self.rc)  # pragma: no cover — exercised by the pod drill
+
+    def dead_peers(self):
+        with self._lock:
+            return dict(self._dead)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        """Publish immediately, then poll every ``interval`` from a
+        daemon thread. Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        if self._started_at is None:
+            self._started_at = self._clock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='kfac-peer-heartbeat')
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                self.log.exception('heartbeat: poll failed; retrying')
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        close = getattr(self.transport, 'close', None)
+        if callable(close):
+            close()
+
+
+def heartbeat_from_env(log=None, on_dead=None):
+    """Build the trainer-side :class:`PeerHeartbeat` from the pod
+    contract the launcher / pod supervisor exports (``KFAC_HB_*``), or
+    None when no pod heartbeat is configured. NOT started — callers
+    ``start()`` it once logging is set up, and ``stop()`` it on clean
+    exit."""
+    lease_dir = os.environ.get(ENV_DIR)
+    if not lease_dir:
+        return None
+    host_id = int(os.environ.get(ENV_HOST, '0'))
+    num_hosts = int(os.environ.get(ENV_HOSTS, '1'))
+    if num_hosts <= 1:
+        return None
+    stop_step = os.environ.get(ENV_HB_STOP)
+    return PeerHeartbeat(
+        FileLeaseTransport(lease_dir, host_id), host_id, num_hosts,
+        interval=float(os.environ.get(ENV_INTERVAL, '2.0')),
+        deadline=float(os.environ.get(ENV_DEADLINE, '10.0')),
+        startup_grace=float(os.environ.get(ENV_GRACE, '60.0')),
+        stop_beat_step=int(stop_step) if stop_step else None,
+        on_dead=on_dead, log=log)

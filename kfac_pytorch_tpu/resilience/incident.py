@@ -1,0 +1,246 @@
+"""Structured per-run incident reports from resilience runlogs.
+
+Every resilience piece already narrates what it did in a greppable form:
+epoch lines and supervisor events carry ``[resilience: k=v ...]``
+suffixes (``utils.runlog.resilience_suffix``), the watchdog logs its
+deadline trip, the heartbeat its peer-death declaration, the supervisor
+its restarts and its machine-greppable give-up (``gave_up=1``). What was
+missing is the OTHER half of the loop: after a bad night, "what died,
+when, how many restarts, how many steps lost, which windows ran
+degraded" should be one artifact, not an hour of grepping.
+
+:class:`IncidentReport` is that artifact: events are either scraped
+from runlog lines (:meth:`scrape_lines` — regexes over exactly the
+forms the modules emit) or recorded live (:meth:`add_event` — the pod
+supervisor does this, it IS the event source for peer death / shrink /
+relaunch). ``to_dict()`` is the JSON report; ``summary()`` the human
+one. CLI::
+
+    python -m kfac_pytorch_tpu.resilience.incident run1.log run2.log \\
+        -o incident.json
+"""
+
+import argparse
+import json
+import re
+import sys
+import time
+
+# cumulative gauges/counters (supervisor totals, ladder positions):
+# aggregate by MAX. Everything else in a [resilience: ...] suffix is a
+# per-epoch delta: aggregate by SUM.
+_CUMULATIVE = frozenset({
+    'restarts', 'crashes', 'hangs', 'gave_up', 'fenced', 'shrinks',
+    'straggler_level',
+})
+
+# suffix keys that are event FIELDS riding along in a [resilience: ...]
+# line (heartbeat's peer=/detect_s=), not counters — the event regexes
+# capture them; aggregating them as counts would be nonsense
+_NON_COUNTERS = frozenset({'peer', 'detect_s'})
+
+# one regex per event-emitting module, matching the exact log forms
+_PATTERNS = (
+    ('watchdog_trip', re.compile(
+        r'watchdog: step deadline exceeded \((?P<deadline_s>[\d.]+)s'
+        r'(?:, (?P<tag>[^)]+))?\)')),
+    ('peer_dead', re.compile(
+        r'heartbeat: peer (?P<peer>\d+) declared dead — no heartbeat '
+        r'advance for (?P<detect_s>[\d.]+)s')),
+    ('restart', re.compile(
+        r'supervisor: trainer exited rc=(?P<rc>-?\d+) \((?P<why>[^)]+)\) '
+        r'— restart (?P<n>\d+)/(?P<max>\d+) in (?P<delay_s>[\d.]+)s')),
+    ('gave_up', re.compile(
+        r'supervisor: trainer exited rc=(?P<rc>-?\d+) .*giving up')),
+    ('shrink', re.compile(
+        r'elastic: shrinking world (?P<from>\d+) -> (?P<to>\d+) '
+        r'survivors=(?P<survivors>\[[^\]]*\]) gen=(?P<gen>\d+)')),
+    ('straggler_degrade', re.compile(
+        r'straggler: step-time EMA (?P<ema_s>[\d.]+)s over budget '
+        r'(?P<budget_s>[\d.]+)s(?: at step (?P<step>\d+))? — stretching '
+        r'update freqs to fac=(?P<fac>\d+) kfac=(?P<kfac>\d+) '
+        r'\(level (?P<level>\d+)/(?P<max_level>\d+)\)')),
+    ('straggler_recover', re.compile(
+        r'straggler: recovered \(EMA (?P<ema_s>[\d.]+)s\)')),
+    ('preempted', re.compile(
+        r'preempted (?:in|after) epoch (?P<epoch>\d+)')),
+    ('resumed', re.compile(
+        r'(?:RESUMED from=checkpoint-(?P<epoch>\d+) step=(?P<step>\d+)'
+        r'|resumed from checkpoint-(?P<epoch2>\d+) \(step '
+        r'(?P<step2>\d+)\))')),
+    ('resharded', re.compile(
+        r'RESHARDED from_world=(?P<from>\d+) to_world=(?P<to>\d+) '
+        r'step=(?P<step>\d+)')),
+)
+
+_INT = re.compile(r'^-?\d+$')
+_FLOAT = re.compile(r'^-?\d+\.\d+$')
+
+
+def _coerce(v):
+    if isinstance(v, str):
+        if _INT.match(v):
+            return int(v)
+        if _FLOAT.match(v):
+            return float(v)
+    return v
+
+
+class IncidentReport:
+    """Accumulate events + counters; render JSON and a human summary."""
+
+    def __init__(self, host_id=None):
+        self.host_id = host_id
+        self.events = []
+        self.counters = {}
+        self.sources = []
+
+    # -- live recording (the pod supervisor's path) -----------------------
+
+    def add_event(self, kind, **fields):
+        evt = {'kind': kind, 'wall': fields.pop('wall', time.time())}
+        evt.update(fields)
+        self.events.append(evt)
+        return evt
+
+    def bump(self, counts):
+        """Fold a ``[resilience: ...]``-shaped dict into the aggregate
+        (MAX for cumulative supervisor counters, SUM for epoch deltas).
+        """
+        for k, v in counts.items():
+            if k in _NON_COUNTERS or not isinstance(v, (int, float)):
+                continue
+            if k in _CUMULATIVE:
+                self.counters[k] = max(self.counters.get(k, 0), v)
+            else:
+                self.counters[k] = self.counters.get(k, 0) + v
+
+    # -- scraping ---------------------------------------------------------
+
+    def scrape_lines(self, lines, source=None):
+        """Scrape runlog ``lines`` for resilience events and counter
+        suffixes. Returns self (chainable)."""
+        # lazy: utils.runlog sits under the jax-heavy utils package, and
+        # incident must stay importable from the lightweight supervisor
+        from kfac_pytorch_tpu.utils.runlog import parse_resilience_suffix
+        if source is not None:
+            self.sources.append(str(source))
+        for line in lines:
+            counts = parse_resilience_suffix(line)
+            if counts:
+                self.bump(counts)
+            for kind, pat in _PATTERNS:
+                m = pat.search(line)
+                if not m:
+                    continue
+                fields = {k: _coerce(v) for k, v in
+                          m.groupdict().items() if v is not None}
+                # the two 'resumed' spellings share one event shape
+                for alias, canon in (('epoch2', 'epoch'), ('step2', 'step')):
+                    if alias in fields:
+                        fields[canon] = fields.pop(alias)
+                if source is not None:
+                    fields['source'] = str(source)
+                self.add_event(kind, wall=None, **fields)
+        return self
+
+    def scrape_path(self, path):
+        with open(path, errors='replace') as f:
+            return self.scrape_lines(f, source=path)
+
+    # -- rendering --------------------------------------------------------
+
+    def to_dict(self):
+        deaths = [e for e in self.events if e['kind'] == 'peer_dead']
+        restarts = [e for e in self.events if e['kind'] in
+                    ('restart', 'relaunch')]
+        shrinks = [e for e in self.events if e['kind'] == 'shrink']
+        degrades = [e for e in self.events if e['kind'] ==
+                    'straggler_degrade']
+        steps_lost = sum(e.get('steps_lost', 0) for e in self.events
+                         if isinstance(e.get('steps_lost'), int))
+        return {
+            'host_id': self.host_id,
+            'sources': self.sources,
+            'what_died': [{'peer': e.get('peer'),
+                           'detect_s': e.get('detect_s'),
+                           'wall': e.get('wall')} for e in deaths],
+            'restarts_taken': max(len(restarts),
+                                  self.counters.get('restarts', 0)),
+            'shrinks': [{'from': e.get('from'), 'to': e.get('to'),
+                         'survivors': e.get('survivors'),
+                         'gen': e.get('gen')} for e in shrinks],
+            'degrade_windows': len(degrades),
+            'steps_lost': steps_lost or None,
+            'gave_up': bool(self.counters.get('gave_up')
+                            or any(e['kind'] == 'gave_up'
+                                   for e in self.events)),
+            'counters': dict(sorted(self.counters.items())),
+            'events': self.events,
+        }
+
+    def summary(self):
+        d = self.to_dict()
+        lines = ['incident report'
+                 + (f' (host {self.host_id})' if self.host_id is not None
+                    else '')
+                 + (f' — {len(self.sources)} log(s)' if self.sources
+                    else '')]
+        if not self.events and not self.counters:
+            lines.append('  clean run: no resilience events recorded')
+            return '\n'.join(lines)
+        for e in d['what_died']:
+            lines.append(f"  peer {e['peer']} died — detected in "
+                         f"{e['detect_s']}s")
+        if d['restarts_taken']:
+            lines.append(f"  restarts taken: {d['restarts_taken']}")
+        for s in d['shrinks']:
+            lines.append(f"  pod shrank {s['from']} -> {s['to']} hosts "
+                         f"(gen {s['gen']}, survivors {s['survivors']})")
+        if d['degrade_windows']:
+            lines.append(f"  straggler degrade windows: "
+                         f"{d['degrade_windows']}")
+        if d['steps_lost']:
+            lines.append(f"  steps lost to restarts: {d['steps_lost']}")
+        if d['gave_up']:
+            lines.append('  SUPERVISOR GAVE UP — run did not complete')
+        if d['counters']:
+            body = ' '.join(f'{k}={v}' for k, v in d['counters'].items())
+            lines.append(f'  counters: {body}')
+        return '\n'.join(lines)
+
+    def write(self, path):
+        """Atomic JSON dump (tmp + rename — the report must never be a
+        torn artifact, it is what gets read AFTER things went wrong)."""
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        return atomic_write_json(path, self.to_dict(), indent=2,
+                                 default=str)
+
+
+def scrape_paths(paths, host_id=None):
+    report = IncidentReport(host_id=host_id)
+    for p in paths:
+        report.scrape_path(p)
+    return report
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='python -m kfac_pytorch_tpu.resilience.incident',
+        description='Scrape run logs into a structured incident report '
+                    '(JSON + human summary).')
+    p.add_argument('logs', nargs='+', help='run log file(s) to scrape')
+    p.add_argument('-o', '--out', default=None,
+                   help='write the JSON report here (default: stdout '
+                        'summary only)')
+    args = p.parse_args(argv)
+    report = scrape_paths(args.logs)
+    print(report.summary())
+    if args.out:
+        report.write(args.out)
+        print(f'wrote {args.out}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -17,6 +17,11 @@ Exit-code protocol (the whole contract between trainer and supervisor):
 - ``RC_HANG`` (114)— the step watchdog aborted a hang: restart, counted
                      separately (``hangs``) because repeated hangs point
                      at a peer/network problem, not this process.
+- ``RC_PEER_DEAD`` (115) — a POD peer died (heartbeat.py): this plain
+                     single-host supervisor treats it as a stop code
+                     candidate (``--stop-rc peer_dead``) — restarting
+                     alone cannot fix a shrunken world; the pod-aware
+                     :class:`~.elastic.PodSupervisor` owns that case.
 - negative / other — crash (signal death reports negative returncodes
                      via ``Popen``): restart, counted as ``crashes``.
 
@@ -38,6 +43,29 @@ from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK, RetryPolicy
 from kfac_pytorch_tpu.resilience.watchdog import RC_HANG
 
 log = logging.getLogger(__name__)
+
+# --stop-rc accepts the protocol names as well as raw numbers, so launch
+# scripts read as intent ("--stop-rc peer_dead") instead of magic
+# numbers. The table IS the exit-code protocol (README "Pod
+# resilience"); crash (113) is faults.CRASH_RC spelled as a literal so
+# this module stays importable without jax.
+STOP_RC_NAMES = {'hang': RC_HANG, 'peer_dead': 115, 'peer-dead': 115,
+                 'crash': 113}
+
+
+def parse_stop_rc(value):
+    """``'114'`` -> 114; ``'hang'`` -> RC_HANG; unknown names raise (an
+    argparse ``type=``, so the error surfaces as a usage message)."""
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return STOP_RC_NAMES[value.strip().lower()]
+    except KeyError:
+        raise argparse.ArgumentTypeError(
+            f'unknown stop-rc {value!r}: pass a number or one of '
+            f'{sorted(STOP_RC_NAMES)}') from None
 
 
 class Supervisor:
@@ -132,10 +160,14 @@ class Supervisor:
                 return rc
             why = self._classify(rc)
             if self.restarts >= self.max_restarts:
+                # gave_up=1 in the counter suffix: the incident scraper
+                # (resilience.incident) keys off it — prose changes must
+                # not be able to hide a given-up run
                 self.log.error(
                     'supervisor: trainer exited rc=%d (%s) and the '
                     'restart budget (%d) is spent — giving up%s', rc, why,
-                    self.max_restarts, resilience_suffix(self.counts()))
+                    self.max_restarts,
+                    resilience_suffix(dict(self.counts(), gave_up=1)))
                 return rc
             delay = self.backoff.delay(self.restarts, self.rng)
             self.restarts += 1
@@ -159,9 +191,12 @@ def main(argv=None):
                    help='first restart delay (seconds); doubles per '
                         'restart with +/-50%% jitter')
     p.add_argument('--backoff-max', type=float, default=60.0)
-    p.add_argument('--stop-rc', type=int, action='append', default=[],
+    p.add_argument('--stop-rc', type=parse_stop_rc, action='append',
+                   default=[],
                    help='nonzero exit code(s) to propagate without '
-                        'restarting (repeatable)')
+                        'restarting (repeatable); accepts numbers or '
+                        'protocol names: hang (114), peer_dead (115), '
+                        'crash (113)')
     p.add_argument('command', nargs=argparse.REMAINDER,
                    help='trainer command (prefix with -- to separate)')
     args = p.parse_args(argv)

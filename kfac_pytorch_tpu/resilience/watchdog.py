@@ -165,13 +165,26 @@ class StepWatchdog:
             'restart this trainer\n%s',
             self.deadline, f', {tag}' if tag else '', self.rc,
             format_all_stacks())
-        # the run log must carry the dump: flush every handler before the
-        # hard exit (os._exit skips atexit and io finalizers by design)
-        for h in logging.getLogger().handlers:
-            try:
-                h.flush()
-            except Exception:  # noqa: BLE001 — dying anyway
-                pass
+        # the epoch line that would have carried this epoch's counters
+        # never comes (we die mid-epoch): emit the cumulative snapshot
+        # in the same greppable form so the incident report still sees
+        # the last step's counters
+        try:
+            from kfac_pytorch_tpu.utils.runlog import (
+                flush_all_handlers, resilience_suffix)
+            suffix = resilience_suffix(_res.counters.snapshot())
+            if suffix:
+                self.log.error('watchdog: final counters%s', suffix)
+            # the run log must carry the dump AND the counters: run the
+            # same flush the runlog exit hook would have (os._exit skips
+            # atexit and io finalizers by design)
+            flush_all_handlers()
+        except Exception:  # noqa: BLE001 — dying anyway: flush manually
+            for h in logging.getLogger().handlers:
+                try:
+                    h.flush()
+                except Exception:  # noqa: BLE001
+                    pass
         if self._action is not None:
             self._action()
         else:  # pragma: no cover — exercised by the subprocess chaos drill

@@ -78,7 +78,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      dropout_seed=None, batch_specs=None, check_vma=None,
                      fisher_type='Femp', fisher_loss_fn=None,
                      fisher_sample_fn=None, fisher_seed=0, health='auto',
-                     straggler=None):
+                     straggler=None, heartbeat=None):
     """Build the per-iteration function family.
 
     Args:
@@ -159,6 +159,16 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         host-side freq gating the scheduler uses (restored on
         recovery): a slow host degrades preconditioner freshness
         instead of throughput.
+      heartbeat: a ``resilience.PeerHeartbeat`` (or None). When set,
+        every host step calls ``heartbeat.tick(step)`` — stamping the
+        current step into the published liveness payload (so a peer's
+        incident report can say how far the dead host got) and arming
+        the silent-death chaos drill (``KFAC_FAULT_HB_STOP_STEP``).
+        Liveness itself rides the heartbeat's own background thread,
+        not this tick: a trainer wedged in a collective stops ticking
+        but keeps beating, which is exactly the split the pod needs —
+        the heartbeat answers "alive?", the watchdog answers
+        "progressing?".
 
     Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
     dispatches between up to four compiled variants using the
@@ -369,6 +379,8 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         # the NEXT tick's interval, like any real stall would)
         if straggler is not None:
             straggler.tick(step)
+        if heartbeat is not None:
+            heartbeat.tick(step)
         # host-side chaos drills (all no-ops unless env-configured):
         # SIGTERM (PreemptionGuard), crash (supervisor restart), hang
         # (step watchdog), slow (straggler governor)

@@ -8,7 +8,7 @@ from kfac_pytorch_tpu.utils.losses import (
 from kfac_pytorch_tpu.utils.checkpoint import (
     save_checkpoint, restore_checkpoint, find_resume_epoch, auto_resume,
     PreemptionGuard, wait_for_checkpoints, prune_checkpoints,
-    reshard_kfac_state)
+    reshard_kfac_state, write_world_stamp, read_world_stamp)
 from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
@@ -19,6 +19,6 @@ __all__ = [
     'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',
     'auto_resume',
     'PreemptionGuard', 'wait_for_checkpoints', 'prune_checkpoints',
-    'reshard_kfac_state',
+    'reshard_kfac_state', 'write_world_stamp', 'read_world_stamp',
     'trace', 'time_steps', 'exclude_parts_breakdown',
 ]

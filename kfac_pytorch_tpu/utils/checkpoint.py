@@ -191,6 +191,35 @@ def reshard_kfac_state(pre_old, pre_new, kfac_state):
         factors={k: jnp.asarray(v) for k, v in factors.items()})
 
 
+def write_world_stamp(base_dir, num_devices):
+    """Record the K-FAC world size the checkpoints in ``base_dir`` were
+    taken at (``world.json``, atomic, rank-0 only). The elastic resume
+    path (``resilience.elastic.elastic_resume``) compares this stamp to
+    the relaunched trainer's world and routes a mismatch through
+    :func:`reshard_kfac_state` — without the stamp a shrunken pod would
+    try to restore factor buckets shaped for the old mesh and die on a
+    structure mismatch."""
+    if jax.process_index() != 0:
+        return
+    from kfac_pytorch_tpu.resilience import atomic_write_json
+    os.makedirs(base_dir, exist_ok=True)
+    atomic_write_json(os.path.join(os.path.abspath(base_dir),
+                                   'world.json'),
+                      {'num_devices': int(num_devices)})
+
+
+def read_world_stamp(base_dir):
+    """The ``num_devices`` recorded by :func:`write_world_stamp`, or
+    None (no stamp — pre-elastic checkpoints resume as same-world)."""
+    import json
+    path = os.path.join(os.path.abspath(base_dir), 'world.json')
+    try:
+        with open(path) as f:
+            return int(json.load(f)['num_devices'])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def wait_for_checkpoints():
     """Block until all in-flight async saves are durable on disk."""
     if _ASYNC_CKPTR is not None:

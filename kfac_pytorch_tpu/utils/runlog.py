@@ -141,6 +141,39 @@ def counter_deltas(now, prev):
             for k, v in now.items()}
 
 
+_RES_SUFFIX = None  # compiled lazily; runlog stays import-light
+
+
+def parse_resilience_suffix(line):
+    """Inverse of :func:`resilience_suffix`: extract the ``{name: value}``
+    dict from a log line's ``[resilience: k=v ...]`` suffix, or {} when
+    the line has none. Values parse to int when they look like ints,
+    float otherwise, raw string as the fallback — the incident scraper
+    (``resilience.incident``) is the consumer, so the parser accepts
+    exactly what the formatter below emits plus numeric extras like
+    ``detect_s=1.25``."""
+    global _RES_SUFFIX
+    if _RES_SUFFIX is None:
+        import re
+        _RES_SUFFIX = re.compile(r'\[resilience: ([^\]]+)\]')
+    m = _RES_SUFFIX.search(line)
+    if not m:
+        return {}
+    out = {}
+    for part in m.group(1).split():
+        if '=' not in part:
+            continue
+        k, v = part.split('=', 1)
+        try:
+            out[k] = int(v)
+        except ValueError:
+            try:
+                out[k] = float(v)
+            except ValueError:
+                out[k] = v
+    return out
+
+
 def resilience_suffix(counts):
     """Format process-resilience counters for a log line.
 

@@ -46,6 +46,31 @@ if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi
 
+# Pod-resilience wrapper: KFAC_POD_SUPERVISE=1 runs the trainer under
+# the per-host kfac-pod-supervise loop (resilience/elastic.py) — on top
+# of the crash/hang restarts below, the supervisors heartbeat each other
+# through KFAC_POD_LEASE_DIR (a shared directory every host can see);
+# a host that dies for good (trainer rc 115 RC_PEER_DEAD, or this
+# supervisor's own monitor) triggers the shrink protocol: the survivors
+# agree on the surviving set, relaunch at the reduced world size, and
+# the trainers reshard their K-FAC factor state through elastic_resume.
+# An incident report JSON lands in the lease dir on every exit path.
+# Requires JAX_PROCESS_ID / JAX_NUM_PROCESSES (the pod coordination env
+# above) and a checkpoint dir, like KFAC_SUPERVISE.
+if [ -n "$KFAC_POD_SUPERVISE" ]; then
+  : "${KFAC_POD_LEASE_DIR:?KFAC_POD_SUPERVISE=1 needs KFAC_POD_LEASE_DIR (shared across hosts)}"
+  exec "${PY:-python}" -m kfac_pytorch_tpu.resilience.elastic \
+    --host-id "${JAX_PROCESS_ID:-0}" \
+    --num-hosts "${JAX_NUM_PROCESSES:-1}" \
+    --lease-dir "$KFAC_POD_LEASE_DIR" \
+    ${KFAC_HOST_ADDR:+--host-addr "$KFAC_HOST_ADDR"} \
+    --max-restarts "${KFAC_MAX_RESTARTS:-3}" \
+    --backoff-base "${KFAC_RESTART_BACKOFF:-2}" \
+    --hb-interval "${KFAC_HB_INTERVAL:-2}" \
+    --hb-deadline "${KFAC_HB_DEADLINE:-10}" \
+    -- "${PY:-python}" "$script" "$@"
+fi
+
 # Resilient-runtime wrapper: KFAC_SUPERVISE=1 runs the trainer under the
 # kfac-supervise restart loop (kfac_pytorch_tpu/resilience/supervisor.py)
 # — a crash (nonzero rc / signal death) or a step-watchdog hang abort
@@ -53,10 +78,17 @@ fi
 # exponential backoff; the trainer resumes via its auto_resume
 # checkpoint path. Give the trainer a --checkpoint-dir/--resume (cifar)
 # or --checkpoint-format (imagenet, always on) or restarts start over.
+# KFAC_STOP_RCS ("peer_dead 7 ...") propagates those exit codes instead
+# of restarting — names from the protocol table (README) or numbers.
 if [ -n "$KFAC_SUPERVISE" ]; then
+  stop_rc_flags=""
+  for rc in ${KFAC_STOP_RCS:-}; do
+    stop_rc_flags="$stop_rc_flags --stop-rc $rc"
+  done
   exec "${PY:-python}" -m kfac_pytorch_tpu.resilience.supervisor \
     --max-restarts "${KFAC_MAX_RESTARTS:-3}" \
     --backoff-base "${KFAC_RESTART_BACKOFF:-2}" \
+    $stop_rc_flags \
     -- "${PY:-python}" "$script" "$@"
 fi
 

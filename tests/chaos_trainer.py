@@ -1,4 +1,4 @@
-"""Miniature REAL trainer for the supervisor/watchdog chaos drills.
+"""Miniature REAL trainer for the supervisor/watchdog/pod chaos drills.
 
 A full example trainer (resnet32) is too slow to relaunch repeatedly in
 a test, so this is the smallest program that still exercises every
@@ -9,12 +9,25 @@ per-epoch ``save_checkpoint`` and ``auto_resume`` (so a supervised
 relaunch genuinely resumes), the step watchdog, the retrying I/O path,
 and the straggler governor.
 
-Protocol with tests/test_chaos.py (stdout, line-oriented):
+Pod mode (``--num-hosts N --host-id I``, the peer-death drills): each
+host process runs the SAME N-device data-parallel mesh computation on
+simulated CPU devices — a stand-in for one slice of a pod that keeps
+every pod-level mechanism REAL across processes: the peer heartbeat
+(``KFAC_HB_*`` env from the pod supervisor), the ``RC_PEER_DEAD`` abort,
+the world stamp next to the checkpoints, and the elastic resume that
+reshards the K-FAC factors when a relaunch arrives with a smaller
+world. Because every host computes the full (seeded) batch stream, the
+step schedule is world-size independent — the DONE line of a shrunken
+run must equal an undisturbed one's.
+
+Protocol with tests/test_chaos.py + tests/test_pod_chaos.py (stdout):
   ``EPOCH <e> step=<s> loss=<l>``  after each epoch (post-checkpoint)
+  ``RESUMED from=checkpoint-<e> step=<s>``  on any resume
+  ``RESHARDED from_world=<o> to_world=<n> step=<s>``  on elastic resume
   ``DONE final_step=<s> epochs=<e>``  on clean completion
 The DONE line is the schedule-equivalence assertion: a SIGKILLed /
-hung / restarted run must end with the same line as an uninterrupted
-one.
+hung / restarted / shrunken run must end with the same line as an
+uninterrupted one.
 """
 
 import argparse
@@ -23,6 +36,12 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# pod mode runs an N-device mesh inside one process; force enough
+# simulated CPU devices BEFORE jax initializes (same trick as conftest)
+if '--xla_force_host_platform_device_count' not in \
+        os.environ.get('XLA_FLAGS', ''):
+    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')
+                               + ' --xla_force_host_platform_device_count=4')
 
 import jax
 import numpy as np
@@ -48,6 +67,12 @@ def main():
     p.add_argument('--straggler-budget', type=float, default=0)
     p.add_argument('--io-retries', type=int, default=3)
     p.add_argument('--seed', type=int, default=0)
+    # pod mode (resilience/heartbeat.py + elastic.py)
+    p.add_argument('--num-hosts', type=int, default=1,
+                   help='pod world size: the K-FAC mesh spans this many '
+                        'simulated devices; >1 enables the env-driven '
+                        'peer heartbeat and world-stamped checkpoints')
+    p.add_argument('--host-id', type=int, default=0)
     args = p.parse_args()
 
     import logging
@@ -60,28 +85,53 @@ def main():
     loader = kdata.Loader(x, y, args.batch_size, train=True,
                           seed=args.seed, shard=(0, 1))
 
-    model = TinyCNN()
-    precond = kfac.KFAC(variant='eigen', lr=0.05, damping=0.003,
+    world = max(1, args.num_hosts)
+    axis = 'batch' if world > 1 else None
+    mesh = None
+    if world > 1:
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:world]), ('batch',))
+
+    def make_precond(nd):
+        pre = kfac.KFAC(variant='eigen', lr=0.05, damping=0.003,
                         fac_update_freq=1, kfac_update_freq=2,
-                        num_devices=1, axis_name=None)
+                        num_devices=nd,
+                        axis_name='batch' if nd > 1 else None)
+        return pre
+
+    model = TinyCNN()
+    precond = make_precond(world)
     tx = training.sgd(0.05, momentum=0.9)
     state = training.init_train_state(
         model, tx, precond, jax.random.PRNGKey(args.seed),
         np.zeros((args.batch_size, 8, 8, 3), np.float32))
 
+    def make_old_precond(nd):
+        # elastic resume: the OLD world's preconditioner over the SAME
+        # layer list (the metas the set-up new-world plan discovered)
+        pre = make_precond(nd)
+        pre.setup(precond.plan.metas)
+        return pre
+
     io_retry = (resilience.RetryPolicy(attempts=args.io_retries + 1,
                                        base_delay=0.05)
                 if args.io_retries > 0 else None)
     start_epoch = 0
-    restored, resume = checkpoint.auto_resume(args.checkpoint_dir,
-                                              args.epochs, state,
-                                              retry=io_retry)
+    restored, resume, old_world = resilience.elastic_resume(
+        args.checkpoint_dir, args.epochs, precond, state,
+        make_precond=make_old_precond, retry=io_retry)
     if resume is not None:
         state = restored
         start_epoch = resume + 1
+        if old_world is not None:
+            print(f'RESHARDED from_world={old_world} to_world={world} '
+                  f'step={int(state.step)}', flush=True)
         print(f'RESUMED from=checkpoint-{resume} step={int(state.step)}',
               flush=True)
 
+    heartbeat = resilience.heartbeat_from_env()
+    if heartbeat is not None:
+        heartbeat.start()
     governor = None
     if args.straggler_budget > 0:
         governor = resilience.StragglerGovernor(precond,
@@ -95,7 +145,9 @@ def main():
             outputs, batch['label']).mean()
 
     step = training.build_train_step(model, tx, precond, loss_fn,
-                                     straggler=governor)
+                                     axis_name=axis, mesh=mesh,
+                                     straggler=governor,
+                                     heartbeat=heartbeat)
     loss = float('nan')
     for epoch in range(start_epoch, args.epochs):
         for batch in loader.epoch(retry=io_retry):
@@ -107,11 +159,14 @@ def main():
                 watchdog.disarm()
         checkpoint.save_checkpoint(args.checkpoint_dir, epoch, state,
                                    retry=io_retry)
+        checkpoint.write_world_stamp(args.checkpoint_dir, world)
         print(f'EPOCH {epoch} step={int(state.step)} loss={loss:.4f}',
               flush=True)
     checkpoint.wait_for_checkpoints()
     if watchdog is not None:
         watchdog.stop()
+    if heartbeat is not None:
+        heartbeat.stop()
     print(f'DONE final_step={int(state.step)} epochs={args.epochs}',
           flush=True)
 

@@ -129,6 +129,153 @@ def test_training_continues_after_reshard():
                for x in jax.tree.leaves(state.kfac_state.decomp))
 
 
+def test_reshard_uneven_world_with_pad_rows_roundtrips():
+    """Shrink edge case: a world size that does not divide the slot
+    count — the device-major bucket layout then carries dummy pad rows
+    in one plan and not the other, and the transport must land every
+    TRUE block while ignoring the padding. 5 Dense layers = 10 factor
+    slots: nd=4 pads (10 % 4 != 0), nd=2 does not."""
+    from kfac_pytorch_tpu import nn as knn
+    import flax.linen as linen
+
+    class FiveMLP(linen.Module):
+        @linen.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            for i, w in enumerate((17, 13, 11, 9)):
+                x = linen.relu(knn.Dense(w, name=f'd{i}')(x))
+            return knn.Dense(10, name='out')(x)
+
+    model = FiveMLP()
+    pre2, state2, step2 = _make(2, model)
+    pre4, state4, step4 = _make(4, model)
+    # the two plans pad differently (device-major rows per world size),
+    # so rows genuinely move between real and dummy positions
+    pad4 = sum(1 for b in pre4.plan.buckets.values()
+               for s in b.slot_of_row if s is None)
+    pad2 = sum(1 for b in pre2.plan.buckets.values()
+               for s in b.slot_of_row if s is None)
+    assert pad4 > pad2 > 0 or (pad4 > 0 and pad2 == 0), (pad4, pad2)
+
+    state2, _ = _run(step2, state2, 3)
+    up = kutils.reshard_kfac_state(pre2, pre4, state2.kfac_state)
+    back = kutils.reshard_kfac_state(pre4, pre2, up)
+    got = _layer_blocks(pre2, back.factors)
+    want = _layer_blocks(pre2, state2.kfac_state.factors)
+    for path in want:
+        for g, w in zip(got[path], want[path]):
+            np.testing.assert_array_equal(g, w)
+    # and training continues in the padded world
+    host = jax.device_get
+    state = state4.replace(step=host(state2.step),
+                           params=host(state2.params),
+                           opt_state=host(state2.opt_state),
+                           extra_vars=host(state2.extra_vars),
+                           kfac_state=host(up))
+    state, loss = _run(step4, state, 2)
+    assert np.isfinite(loss), loss
+
+
+def test_ekfac_scales_reaccumulate_after_transport():
+    """E-KFAC shrink edge case: the transported state carries only the
+    FACTORS — the eigenbasis-bound scales re-initialize to zero and must
+    re-accumulate after the first inverse update in the new world (they
+    are meaningless against a recomputed basis, so carrying them would
+    be wrong, not just unnecessary)."""
+    model = TinyCNN(batch_norm=False)
+
+    def _make_ekfac(nd):
+        axis = 'batch' if nd > 1 else None
+        mesh = (Mesh(np.array(jax.devices()[:nd]), ('batch',)) if nd > 1
+                else None)
+        pre = kfac.KFAC(variant='ekfac', lr=0.1, damping=0.03,
+                        fac_update_freq=1, kfac_update_freq=2,
+                        num_devices=nd, axis_name=axis)
+        tx = training.sgd(0.1, momentum=0.9)
+        state = training.init_train_state(model, tx, pre,
+                                          jax.random.PRNGKey(0),
+                                          _batch()['input'])
+        step = training.build_train_step(model, tx, pre, _ce,
+                                         axis_name=axis, mesh=mesh,
+                                         donate=False)
+        return pre, state, step
+
+    pre2, state2, step2 = _make_ekfac(2)
+    pre1, state1, step1 = _make_ekfac(1)
+    state2, _ = _run(step2, state2, 4)
+    assert any(np.any(np.asarray(v) != 0)
+               for v in state2.kfac_state.decomp['scales'].values())
+
+    carried = kutils.reshard_kfac_state(pre2, pre1, state2.kfac_state)
+    # scales zeroed by the transport (basis-bound, like the decomp)
+    assert all(not np.any(np.asarray(v))
+               for v in carried.decomp['scales'].values())
+    host = jax.device_get
+    state = state1.replace(step=host(state2.step),
+                           params=host(state2.params),
+                           opt_state=host(state2.opt_state),
+                           extra_vars=host(state2.extra_vars),
+                           kfac_state=host(carried))
+    state, loss = _run(step1, state, 4)
+    assert np.isfinite(loss), loss
+    # the resumed inverse updates rebuilt basis AND moments
+    assert any(np.any(np.asarray(v) != 0)
+               for v in state.kfac_state.decomp['scales'].values())
+
+
+def test_elastic_resume_reshards_stamped_checkpoint(tmp_path, monkeypatch):
+    """The full elastic-resume path a shrunken pod's relaunch takes:
+    checkpoint + world stamp written at nd=2, trainer comes back at
+    nd=4 — elastic_resume restores against the OLD structure, reshards
+    the factors, and training continues; without a stamp (or with a
+    matching one) it behaves exactly like auto_resume."""
+    from kfac_pytorch_tpu import resilience
+    from kfac_pytorch_tpu.utils import checkpoint as ckpt
+    monkeypatch.setattr(ckpt, '_HAS_ORBAX', False)
+    model = TinyCNN(batch_norm=False)
+    pre2, state2, step2 = _make(2, model)
+    state2, _ = _run(step2, state2, 3)
+    ckpt.save_checkpoint(tmp_path, 0, state2)
+    ckpt.write_world_stamp(tmp_path, 2)
+
+    pre4, state4, step4 = _make(4, model)
+
+    def make_old(nd):
+        pre = kfac.KFAC(variant='eigen', lr=0.1, damping=0.003,
+                        fac_update_freq=1, kfac_update_freq=2,
+                        num_devices=nd,
+                        axis_name='batch' if nd > 1 else None)
+        pre.setup(pre4.plan.metas)
+        return pre
+
+    restored, epoch, old_world = resilience.elastic_resume(
+        tmp_path, 5, pre4, state4, make_precond=make_old)
+    assert epoch == 0 and old_world == 2
+    assert int(restored.step) == int(state2.step)
+    # the transported factors match a direct reshard of the live state
+    want = kutils.reshard_kfac_state(pre2, pre4, state2.kfac_state)
+    got = _layer_blocks(pre4, restored.kfac_state.factors)
+    ref = _layer_blocks(pre4, want.factors)
+    for path in ref:
+        for g, w in zip(got[path], ref[path]):
+            np.testing.assert_array_equal(g, w)
+    state, loss = _run(step4, restored, 2)
+    assert np.isfinite(loss), loss
+
+    # matching stamp -> plain auto_resume territory (no reshard)
+    ckpt.write_world_stamp(tmp_path, 4)
+    ckpt.save_checkpoint(tmp_path, 1, state)
+    again, epoch2, ow2 = resilience.elastic_resume(
+        tmp_path, 5, pre4, state4, make_precond=make_old)
+    assert epoch2 == 1 and ow2 is None
+
+    # nothing restorable -> (None, None, old_world)
+    empty = tmp_path / 'empty'
+    none_state, none_epoch, _ = resilience.elastic_resume(
+        empty, 5, pre4, state4, make_precond=make_old)
+    assert none_state is None and none_epoch is None
+
+
 def test_reshard_rejects_mismatched_layer_sets():
     model = TinyCNN(batch_norm=False)
     pre2, state2, _ = _make(2, model)

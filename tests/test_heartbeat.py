@@ -1,0 +1,249 @@
+"""Peer-heartbeat unit drills (resilience/heartbeat.py).
+
+Everything here is wall-clock-free (ManualClock + manual ``poll_once``
+driving) or sub-second (the real TCP responder on a loopback port). The
+real multi-process detection drill — SIGKILL one host of a two-process
+pod, survivor exits RC_PEER_DEAD — lives in tests/test_pod_chaos.py
+behind ``-m slow``.
+"""
+
+import logging
+import os
+
+import pytest
+
+from kfac_pytorch_tpu import resilience
+from kfac_pytorch_tpu.resilience.heartbeat import (
+    RC_PEER_DEAD, FileLeaseTransport, PeerHeartbeat,
+    TcpHeartbeatTransport, heartbeat_from_env)
+from kfac_pytorch_tpu.resilience.retry import ManualClock
+from kfac_pytorch_tpu.utils.runlog import parse_resilience_suffix
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    resilience.counters.reset()
+    yield
+    resilience.counters.reset()
+
+
+def _pair(tmp_path, clock0, clock1, **kw):
+    """Two in-process hosts sharing a lease dir, manual polling."""
+    deaths = []
+
+    def on_dead(peer, info):
+        deaths.append((peer, info))
+
+    kw.setdefault('interval', 1.0)
+    kw.setdefault('deadline', 5.0)
+    kw.setdefault('startup_grace', 30.0)
+    h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       clock=clock0.monotonic, on_dead=on_dead, **kw)
+    h1 = PeerHeartbeat(FileLeaseTransport(tmp_path, 1), 1, 2,
+                       clock=clock1.monotonic, on_dead=on_dead, **kw)
+    return h0, h1, deaths
+
+
+def test_live_peers_are_never_declared_dead(tmp_path):
+    c0, c1 = ManualClock(), ManualClock()
+    h0, h1, deaths = _pair(tmp_path, c0, c1)
+    for _ in range(20):
+        assert h0.poll_once() == []
+        assert h1.poll_once() == []
+        c0.sleep(1.0)
+        c1.sleep(1.0)
+    assert deaths == []
+    assert h0.dead_peers() == {} and h1.dead_peers() == {}
+
+
+def test_silent_peer_declared_dead_after_deadline(tmp_path):
+    c0, c1 = ManualClock(), ManualClock()
+    h0, h1, deaths = _pair(tmp_path, c0, c1, deadline=5.0)
+    for _ in range(3):  # both beating: seen and advancing
+        h0.poll_once(); h1.poll_once(); c0.sleep(1.0); c1.sleep(1.0)
+    # host 1 goes silent (no more polls); host 0 keeps polling
+    silent = 0
+    while not deaths and silent < 50:
+        h0.poll_once()
+        c0.sleep(1.0)
+        silent += 1
+    assert deaths and deaths[0][0] == 1
+    info = deaths[0][1]
+    # detection latency: just past the 5s deadline, never anywhere near
+    # a watchdog-scale timeout
+    assert 5.0 < info['detect_s'] <= 7.0
+    assert info['never_seen'] is False
+    assert resilience.counters.get('peer_dead') == 1
+    # declared once, not re-declared on later polls
+    h0.poll_once()
+    assert len(deaths) == 1
+
+
+def test_restarted_peer_with_reset_sequence_stays_alive(tmp_path):
+    """A crash-restarted peer resets its sequence to 1 under a new pid;
+    liveness is (pid, seq) IDENTITY change, not seq growth — judging by
+    the dead process's high-water mark would turn every crash restart
+    into a pod shrink."""
+    c0 = ManualClock()
+    deaths = []
+    h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       interval=1.0, deadline=4.0, startup_grace=30.0,
+                       clock=c0.monotonic,
+                       on_dead=lambda p, i: deaths.append((p, i)))
+    t1 = FileLeaseTransport(tmp_path, 1)
+    # peer ran a long time (seq 300), then its process died...
+    t1.publish({'host': 1, 'seq': 300, 'pid': 111, 'step': 300})
+    h0.poll_once()
+    c0.sleep(2.0)
+    # ...and the supervisor relaunched it: NEW pid, seq starts over
+    for seq in range(1, 12):
+        t1.publish({'host': 1, 'seq': seq, 'pid': 222, 'step': seq})
+        h0.poll_once()
+        c0.sleep(1.0)
+    assert deaths == [], deaths
+    # and a genuinely silent restarted peer still dies on schedule
+    for _ in range(8):
+        h0.poll_once()
+        c0.sleep(1.0)
+    assert deaths and deaths[0][0] == 1
+
+
+def test_peer_never_seen_respects_startup_grace(tmp_path):
+    c0 = ManualClock()
+    deaths = []
+    h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       interval=1.0, deadline=2.0, startup_grace=10.0,
+                       clock=c0.monotonic,
+                       on_dead=lambda p, i: deaths.append((p, i)))
+    for _ in range(9):  # within grace: a slow-to-start peer is not dead
+        assert h0.poll_once() == []
+        c0.sleep(1.0)
+    assert deaths == []
+    c0.sleep(2.5)  # past the grace
+    h0.poll_once()
+    assert deaths and deaths[0][0] == 1 and deaths[0][1]['never_seen']
+
+
+def test_stop_beat_fault_makes_peers_declare_us_dead(tmp_path):
+    """The heartbeat-loss drill (KFAC_FAULT_HB_STOP_STEP semantics):
+    host 1 keeps polling (it is alive and watching) but stops PUBLISHING
+    at step 3 — host 0 must declare it dead while host 1 still sees
+    host 0 as alive."""
+    c0, c1 = ManualClock(), ManualClock()
+    h0, h1, deaths = _pair(tmp_path, c0, c1, deadline=4.0)
+    h1.stop_beat_step = 3
+    for step in range(30):
+        h1.tick(step)
+        h0.poll_once()
+        h1.poll_once()
+        c0.sleep(1.0)
+        c1.sleep(1.0)
+        if deaths:
+            break
+    assert deaths and deaths[0][0] == 1
+    assert h1._suppressed
+    # the zombie's own monitor still sees host 0 alive — fencing is the
+    # pod supervisor's job, not the monitor's
+    assert h1.dead_peers() == {}
+
+
+def test_declared_dead_line_is_machine_greppable(tmp_path, caplog):
+    c0, c1 = ManualClock(), ManualClock()
+    h0, h1, deaths = _pair(tmp_path, c0, c1, deadline=3.0)
+    h0.poll_once(); h1.poll_once()
+    with caplog.at_level(logging.ERROR,
+                         logger='kfac_pytorch_tpu.resilience.heartbeat'):
+        while not deaths:
+            c0.sleep(1.0)
+            h0.poll_once()
+    counts = {}
+    for rec in caplog.records:
+        counts = parse_resilience_suffix(rec.getMessage())
+        if counts:
+            break
+    assert counts.get('peer_dead') == 1
+    assert counts.get('peer') == 1
+    assert counts.get('detect_s', 0) > 3.0
+
+
+def test_publish_failure_is_survived_and_counted(tmp_path):
+    c0 = ManualClock()
+
+    class FlakyTransport(FileLeaseTransport):
+        fails = 0
+
+        def publish(self, payload):
+            if FlakyTransport.fails < 2:
+                FlakyTransport.fails += 1
+                raise OSError('EIO')
+            super().publish(payload)
+
+    h0 = PeerHeartbeat(FlakyTransport(tmp_path, 0), 0, 2, interval=1.0,
+                       deadline=5.0, clock=c0.monotonic,
+                       on_dead=lambda p, i: None)
+    h0.poll_once(); h0.poll_once(); h0.poll_once()
+    assert resilience.counters.get('hb_publish_errors') == 2
+    # the third publish landed
+    assert os.path.exists(tmp_path / 'hb-0.json')
+
+
+def test_background_thread_detects_real_death(tmp_path):
+    """Real threads, real (tiny) clocks: host 1's beats stop and host
+    0's background monitor fires the on_dead callback without anyone
+    driving poll_once."""
+    import threading
+    fired = threading.Event()
+    h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       interval=0.05, deadline=0.4, startup_grace=5.0,
+                       on_dead=lambda p, i: fired.set())
+    h1 = PeerHeartbeat(FileLeaseTransport(tmp_path, 1), 1, 2,
+                       interval=0.05, deadline=0.4, startup_grace=5.0,
+                       on_dead=lambda p, i: None)
+    h0.start()
+    h1.start()
+    try:
+        import time
+        time.sleep(0.3)        # both beating
+        assert not fired.is_set()
+        h1.stop()              # host 1 "dies"
+        assert fired.wait(10), 'peer death never detected'
+        assert 1 in h0.dead_peers()
+    finally:
+        h0.stop()
+        h1.stop()
+
+
+def test_tcp_transport_roundtrip_and_death():
+    t0 = TcpHeartbeatTransport(0, 0, {}, bind_host='127.0.0.1')
+    t1 = TcpHeartbeatTransport(1, 0, {0: ('127.0.0.1', t0.port)},
+                               bind_host='127.0.0.1', timeout=2.0)
+    t0.peer_addrs = {1: ('127.0.0.1', t1.port)}
+    try:
+        t0.publish({'host': 0, 'seq': 7})
+        t1.publish({'host': 1, 'seq': 3})
+        assert t1.read_peers()[0]['seq'] == 7
+        assert t0.read_peers()[1]['seq'] == 3
+        t1.close()  # "host 1 died": connection refused -> absent
+        assert 1 not in t0.read_peers()
+    finally:
+        t0.close()
+        t1.close()
+
+
+def test_heartbeat_from_env(tmp_path, monkeypatch):
+    from kfac_pytorch_tpu.resilience import heartbeat as hb_mod
+    assert heartbeat_from_env() is None  # no pod contract in env
+    monkeypatch.setenv(hb_mod.ENV_DIR, str(tmp_path))
+    monkeypatch.setenv(hb_mod.ENV_HOST, '1')
+    monkeypatch.setenv(hb_mod.ENV_HOSTS, '3')
+    monkeypatch.setenv(hb_mod.ENV_INTERVAL, '0.5')
+    monkeypatch.setenv(hb_mod.ENV_DEADLINE, '2.5')
+    monkeypatch.setenv(hb_mod.ENV_HB_STOP, '9')
+    hb = heartbeat_from_env()
+    assert hb is not None
+    assert hb.host_id == 1 and hb.peers == [0, 2]
+    assert hb.interval == 0.5 and hb.deadline == 2.5
+    assert hb.stop_beat_step == 9
+    monkeypatch.setenv(hb_mod.ENV_HOSTS, '1')
+    assert heartbeat_from_env() is None  # single host: no heartbeat
+    assert resilience.RC_PEER_DEAD == RC_PEER_DEAD == 115

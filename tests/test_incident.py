@@ -1,0 +1,151 @@
+"""Incident-report scraper drills (resilience/incident.py).
+
+The scraper's contract is "parses exactly what the resilience modules
+emit", so the fixture lines below are copied from the real log formats
+(supervisor restarts/give-up, watchdog trip, heartbeat declaration,
+elastic shrink, straggler ladder, trainer RESUMED/RESHARDED protocol
+lines) — a format drift in either direction fails here.
+"""
+
+import json
+
+import pytest
+
+from kfac_pytorch_tpu.resilience.incident import (
+    IncidentReport, main as incident_main, scrape_paths)
+
+LOG = """\
+2026-08-02 10:00:01 epoch 0: train_loss 1.9 val_loss 1.8 val_acc 0.40 (12.1s)
+2026-08-02 10:00:09 straggler: step-time EMA 2.513s over budget 1.000s at step 37 — stretching update freqs to fac=2 kfac=4 (level 1/3)
+2026-08-02 10:00:30 straggler: recovered (EMA 0.612s) at step 61 — update freqs restored to fac=1 kfac=2
+2026-08-02 10:00:41 epoch 1: train_loss 1.2 val_loss 1.3 val_acc 0.55 (11.8s) [resilience: io_retries=2 straggler_degrades=1 straggler_recoveries=1]
+2026-08-02 10:01:02 heartbeat: peer 1 declared dead — no heartbeat advance for 3.21s (deadline 3.00s, last step 88) [resilience: peer_dead=1 peer=1 detect_s=3.21]
+2026-08-02 10:01:02 watchdog: step deadline exceeded (40.0s, step 88) — dumping all thread stacks and exiting rc=114 so the supervisor can restart this trainer
+2026-08-02 10:01:03 supervisor: trainer exited rc=-9 (killed by signal 9) — restart 1/3 in 0.41s [resilience: crashes=1 restarts=1]
+2026-08-02 10:01:05 elastic: shrinking world 2 -> 1 survivors=[0] gen=1 [resilience: restarts=1 shrinks=1]
+RESHARDED from_world=2 to_world=1 step=88
+RESUMED from=checkpoint-1 step=88
+2026-08-02 10:02:00 epoch 2: train_loss 0.9 val_loss 1.0 val_acc 0.61 (12.0s)
+"""
+
+GAVE_UP = ('2026-08-02 11:00:00 supervisor: trainer exited rc=113 (crash) '
+           'and the restart budget (2) is spent — giving up '
+           '[resilience: crashes=3 gave_up=1 restarts=2]')
+
+
+def _report(text=LOG):
+    return IncidentReport(host_id=0).scrape_lines(text.splitlines())
+
+
+def test_scrape_extracts_every_event_kind():
+    kinds = [e['kind'] for e in _report().events]
+    for expected in ('straggler_degrade', 'straggler_recover',
+                     'peer_dead', 'watchdog_trip', 'restart', 'shrink',
+                     'resharded', 'resumed'):
+        assert expected in kinds, (expected, kinds)
+
+
+def test_report_answers_the_incident_questions():
+    d = _report().to_dict()
+    # what died
+    assert d['what_died'] == [{'peer': 1, 'detect_s': 3.21,
+                               'wall': None}]
+    # when / how fast it was caught
+    assert d['what_died'][0]['detect_s'] < 40.0  # beat the watchdog
+    # restarts taken
+    assert d['restarts_taken'] == 1
+    # shrink history
+    assert d['shrinks'] == [{'from': 2, 'to': 1, 'survivors': '[0]',
+                             'gen': 1}]
+    # degrade windows
+    assert d['degrade_windows'] == 1
+    assert d['gave_up'] is False
+
+
+def test_counter_aggregation_sums_deltas_maxes_cumulatives():
+    rep = IncidentReport()
+    rep.scrape_lines([
+        'epoch 1: x [resilience: io_retries=2]',
+        'epoch 2: x [resilience: io_retries=3]',          # delta: sum
+        'supervisor: x [resilience: restarts=1 crashes=1]',
+        'supervisor: x [resilience: restarts=2 crashes=2]',  # cum: max
+    ])
+    assert rep.counters['io_retries'] == 5
+    assert rep.counters['restarts'] == 2
+    assert rep.counters['crashes'] == 2
+    # heartbeat event FIELDS riding in a suffix are not counters
+    rep.scrape_lines(['x [resilience: peer_dead=1 peer=1 detect_s=3.2]'])
+    assert 'peer' not in rep.counters and 'detect_s' not in rep.counters
+    assert rep.counters['peer_dead'] == 1
+
+
+def test_gave_up_is_machine_detectable():
+    rep = IncidentReport().scrape_lines([GAVE_UP])
+    d = rep.to_dict()
+    assert d['gave_up'] is True
+    assert rep.counters['gave_up'] == 1
+    assert 'GAVE UP' in rep.summary()
+
+
+def test_live_events_and_scraped_lines_compose():
+    rep = IncidentReport(host_id=0)
+    rep.add_event('peer_dead', peer=3, detect_s=1.5, last_step=200)
+    rep.add_event('shrink', **{'from': 4, 'to': 3,
+                               'survivors': [0, 1, 2], 'gen': 1})
+    rep.scrape_lines(['epoch 9: x [resilience: io_retries=1]'])
+    d = rep.to_dict()
+    assert d['what_died'][0]['peer'] == 3
+    assert d['shrinks'][0]['survivors'] == [0, 1, 2]
+    assert d['counters']['io_retries'] == 1
+    s = rep.summary()
+    assert 'peer 3 died' in s and '4 -> 3' in s
+
+
+def test_write_is_atomic_json(tmp_path):
+    rep = _report()
+    out = tmp_path / 'incident.json'
+    rep.write(str(out))
+    d = json.loads(out.read_text())
+    assert d['host_id'] == 0
+    assert d['what_died'][0]['peer'] == 1
+    assert not list(tmp_path.glob('*.tmp-*'))  # no torn tmp left behind
+
+
+def test_cli_scrapes_files_and_writes_report(tmp_path, capsys):
+    log1 = tmp_path / 'run1.log'
+    log1.write_text(LOG)
+    log2 = tmp_path / 'run2.log'
+    log2.write_text(GAVE_UP + '\n')
+    out = tmp_path / 'incident.json'
+    rc = incident_main([str(log1), str(log2), '-o', str(out)])
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    assert 'peer 1 died' in stdout
+    assert 'GAVE UP' in stdout
+    d = json.loads(out.read_text())
+    assert sorted(d['sources']) == sorted([str(log1), str(log2)])
+    assert d['gave_up'] is True
+
+
+def test_scrape_paths_merges(tmp_path):
+    (tmp_path / 'a.log').write_text(LOG)
+    (tmp_path / 'b.log').write_text(LOG)
+    rep = scrape_paths([str(tmp_path / 'a.log'), str(tmp_path / 'b.log')])
+    assert len(rep.to_dict()['what_died']) == 2
+
+
+def test_clean_run_summary():
+    rep = IncidentReport(host_id=2).scrape_lines(
+        ['epoch 0: train_loss 1.0 val_loss 1.0 val_acc 0.5 (9.0s)'])
+    assert 'clean run' in rep.summary()
+    d = rep.to_dict()
+    assert d['what_died'] == [] and d['restarts_taken'] == 0
+
+
+@pytest.mark.parametrize('line,key,value', [
+    (GAVE_UP, 'gave_up', 1),
+    ('x [resilience: watchdog_trips=2]', 'watchdog_trips', 2),
+])
+def test_suffix_parse_contract(line, key, value):
+    from kfac_pytorch_tpu.utils.runlog import parse_resilience_suffix
+    assert parse_resilience_suffix(line)[key] == value

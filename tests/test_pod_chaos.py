@@ -1,0 +1,170 @@
+"""Pod-level chaos drill with REAL processes (``-m slow``).
+
+The acceptance drill for the pod-resilience layer: a two-host pod (one
+``kfac-pod-supervise`` + one real mini trainer per host, sharing a
+lease directory) loses host 1 to SIGKILL mid-run — the whole process
+GROUP dies, exactly like a host vanishing. The survivor must:
+
+- detect the death via the peer HEARTBEAT (within its deadline — not
+  via a watchdog timeout: the trainer runs with a deliberately huge
+  step deadline and the log must show no watchdog trip),
+- abort its trainer with ``RC_PEER_DEAD`` (115),
+- run the shrink protocol down to world size 1,
+- relaunch, reshard the K-FAC factor state through ``elastic_resume``
+  (the ``RESHARDED from_world=2 to_world=1`` protocol line),
+- and finish with the SAME ``DONE`` schedule line as an undisturbed
+  single-host control run,
+- leaving an incident report JSON naming the dead host, the detection
+  latency, and the restarts taken.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, 'tests', 'chaos_trainer.py')
+
+HB_DEADLINE = 4.0
+
+
+def _env(**extra):
+    base = {k: v for k, v in os.environ.items()
+            if not (k.startswith('KFAC_FAULT_')
+                    or k.startswith('KFAC_HB_'))}
+    base['JAX_PLATFORMS'] = 'cpu'
+    base.update(extra)
+    return base
+
+
+def _done_line(out):
+    lines = [l for l in out.splitlines() if l.startswith('DONE ')]
+    assert lines, f'no DONE line; output tail: {out[-3000:]}'
+    return lines[-1]
+
+
+def _control_done(tmp_path):
+    p = subprocess.run(
+        [sys.executable, TRAINER, '--epochs', '3',
+         '--checkpoint-dir', str(tmp_path / 'ckpt_control')],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=540)
+    assert p.returncode == 0, p.stdout[-3000:]
+    return _done_line(p.stdout)
+
+
+def _pod_cmd(host_id, lease, ckpt_dir):
+    return [
+        sys.executable, '-m', 'kfac_pytorch_tpu.resilience.elastic',
+        '--host-id', str(host_id), '--num-hosts', '2',
+        '--lease-dir', str(lease),
+        '--max-restarts', '3', '--backoff-base', '0.2',
+        '--hb-interval', '0.3', '--hb-deadline', str(HB_DEADLINE),
+        '--hb-grace', '180', '--settle', '1', '--shrink-timeout', '8',
+        '--',
+        sys.executable, TRAINER, '--epochs', '3',
+        '--checkpoint-dir', str(ckpt_dir),
+        '--num-hosts', '{num_hosts}', '--host-id', '{host_id}',
+        '--step-deadline', '300',  # watchdog present but MUST not fire
+    ]
+
+
+def _has_checkpoint(ckpt_dir, epoch=0):
+    return (os.path.isdir(os.path.join(ckpt_dir, f'checkpoint-{epoch}'))
+            or os.path.exists(os.path.join(ckpt_dir,
+                                           f'checkpoint-{epoch}.pkl')))
+
+
+def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
+    control = _control_done(tmp_path)
+    lease = tmp_path / 'lease'
+    ckpt0, ckpt1 = str(tmp_path / 'ckpt_h0'), str(tmp_path / 'ckpt_h1')
+    out0_path = tmp_path / 'host0.out'
+    out1_path = tmp_path / 'host1.out'
+    # pace every trainer step (the slow-step fault, all steps): the mini
+    # trainer's raw epochs are faster than the heartbeat deadline, and a
+    # survivor that FINISHES before it can detect the death proves
+    # nothing — with ~1.5s/step the remaining schedule is several
+    # detection windows long
+    pod_env = _env(KFAC_FAULT_SLOW_STEP='0:999',
+                   KFAC_FAULT_SLOW_SECS='1.5')
+    procs = []
+    try:
+        with open(out0_path, 'wb') as f0, open(out1_path, 'wb') as f1:
+            for host_id, ckpt, f in ((0, ckpt0, f0), (1, ckpt1, f1)):
+                procs.append(subprocess.Popen(
+                    _pod_cmd(host_id, lease, ckpt), env=pod_env, cwd=REPO,
+                    stdout=f, stderr=subprocess.STDOUT,
+                    start_new_session=True))  # its own group == "a host"
+
+            # wait until BOTH hosts banked epoch 0 (resumable state
+            # exists and the run is mid-flight), then kill host 1's
+            # whole process group — supervisor, trainer, everything
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                if procs[0].poll() is not None or procs[1].poll() is not None:
+                    pytest.fail('a pod member exited before the kill; '
+                                'host0 tail: '
+                                + out0_path.read_text()[-3000:])
+                if _has_checkpoint(ckpt0) and _has_checkpoint(ckpt1):
+                    break
+                time.sleep(0.5)
+            else:
+                pytest.fail('epoch-0 checkpoints never appeared; host0 '
+                            'tail: ' + out0_path.read_text()[-3000:])
+            kill_t = time.time()
+            os.killpg(os.getpgid(procs[1].pid), signal.SIGKILL)
+            procs[1].wait(timeout=30)
+
+            # the survivor must finish the whole schedule on its own
+            rc0 = procs[0].wait(timeout=420)
+            detect_wall = time.time() - kill_t
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    out0 = out0_path.read_text()
+    assert rc0 == 0, out0[-4000:]
+
+    # detection came from the heartbeat, not the (300s) watchdog
+    assert 'declared dead' in out0, out0[-4000:]
+    assert 'step deadline exceeded' not in out0
+    # and it was fast: the whole recover-and-finish took far less wall
+    # time than a single watchdog deadline
+    assert detect_wall < 300, detect_wall
+
+    # shrink happened and the relaunched trainer resharded the factors
+    assert 'elastic: shrinking world 2 -> 1' in out0, out0[-4000:]
+    assert 'RESHARDED from_world=2 to_world=1' in out0, out0[-4000:]
+    assert 'RESUMED from=checkpoint-' in out0
+
+    # schedule equivalence: same DONE line as the undisturbed control
+    assert _done_line(out0) == control
+
+    # incident report: names the dead host, the detection latency, the
+    # restarts taken, and the shrink
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    assert report['host_id'] == 0
+    dead = report['what_died']
+    assert dead and dead[0]['peer'] == 1, report
+    # latency ~ heartbeat deadline (+ poll slack), nowhere near the
+    # 300s watchdog deadline
+    assert HB_DEADLINE <= dead[0]['detect_s'] < 60, dead
+    assert report['restarts_taken'] >= 1
+    assert report['shrinks'] and report['shrinks'][0]['from'] == 2
+    assert report['shrinks'][0]['to'] == 1
+    assert report['gave_up'] is False
+    exits = [e for e in report['events'] if e['kind'] == 'trainer_exit']
+    from kfac_pytorch_tpu.resilience.heartbeat import RC_PEER_DEAD
+    assert any(e.get('rc') == RC_PEER_DEAD for e in exits), exits

@@ -550,6 +550,283 @@ def test_preemption_guard_install_uninstall_reinstall():
         before if before is not None else signal.SIG_DFL)
 
 
+# ---------------------------------------------------------------------------
+# supervisor satellites: --stop-rc names, machine-greppable give-up
+# ---------------------------------------------------------------------------
+
+def test_parse_stop_rc_accepts_names_and_numbers():
+    from kfac_pytorch_tpu.resilience.heartbeat import RC_PEER_DEAD
+    from kfac_pytorch_tpu.resilience.supervisor import parse_stop_rc
+    assert parse_stop_rc('114') == RC_HANG
+    assert parse_stop_rc('hang') == RC_HANG
+    assert parse_stop_rc('peer_dead') == RC_PEER_DEAD
+    assert parse_stop_rc('peer-dead') == RC_PEER_DEAD
+    assert parse_stop_rc('crash') == faults.CRASH_RC
+    assert parse_stop_rc('7') == 7
+    import argparse
+    with pytest.raises(argparse.ArgumentTypeError, match='unknown'):
+        parse_stop_rc('sideways')
+
+
+def test_supervisor_main_accepts_stop_rc_name():
+    """--stop-rc peer_dead propagates 115 without restarting (the
+    single-host deployment posture: a pod problem is not fixable by a
+    local restart loop)."""
+    from kfac_pytorch_tpu.resilience import supervisor as sup_mod
+    from kfac_pytorch_tpu.resilience.heartbeat import RC_PEER_DEAD
+    rc = sup_mod.main(
+        ['--max-restarts', '5', '--backoff-base', '0.01',
+         '--stop-rc', 'peer_dead', '--',
+         sys.executable, '-c', f'import sys; sys.exit({RC_PEER_DEAD})'])
+    assert rc == RC_PEER_DEAD
+
+
+def test_supervisor_give_up_line_is_machine_greppable(caplog):
+    """The incident scraper must not have to parse prose: the final
+    give-up log line carries [resilience: ... gave_up=1 ...]."""
+    sup = Supervisor([sys.executable, '-c', 'import sys; sys.exit(3)'],
+                     max_restarts=1, backoff_base=0.01,
+                     clock=ManualClock(), rng=random.Random(0))
+    with caplog.at_level('INFO', logger='kfac_pytorch_tpu.resilience'
+                                        '.supervisor'):
+        assert sup.run() == 3
+    give_up = [r.getMessage() for r in caplog.records
+               if 'giving up' in r.getMessage()]
+    assert give_up
+    counts = runlog.parse_resilience_suffix(give_up[-1])
+    assert counts.get('gave_up') == 1
+    assert counts.get('crashes') == 2
+
+
+# ---------------------------------------------------------------------------
+# watchdog satellite: final counters reach the log before the hard exit
+# ---------------------------------------------------------------------------
+
+def test_watchdog_expire_emits_final_counters_and_flushes(caplog):
+    """The epoch line that would have carried this epoch's counters
+    never comes after an abort — the watchdog itself must emit the
+    cumulative [resilience: ...] snapshot and run the runlog flush
+    before exiting, so the incident report sees the last step's
+    counters."""
+    import threading
+    resilience.counters.bump('io_retries', 3)
+    flushed = []
+    orig_flush = runlog.flush_all_handlers
+    tripped = threading.Event()
+    try:
+        runlog.flush_all_handlers = lambda: (flushed.append(1),
+                                             orig_flush())[1]
+        wd = StepWatchdog(0.1, action=tripped.set)
+        with caplog.at_level('ERROR', logger='kfac_pytorch_tpu'
+                                             '.resilience.watchdog'):
+            wd.arm(tag='step 9')
+            assert tripped.wait(10)
+        wd.stop()
+    finally:
+        runlog.flush_all_handlers = orig_flush
+    final = [r.getMessage() for r in caplog.records
+             if 'final counters' in r.getMessage()]
+    assert final, 'no final-counters line before the abort'
+    counts = runlog.parse_resilience_suffix(final[-1])
+    assert counts.get('watchdog_trips') == 1
+    assert counts.get('io_retries') == 3
+    assert flushed, 'runlog flush hook did not run before the exit'
+
+
+# ---------------------------------------------------------------------------
+# mesh satellite: coordinator startup race retries instead of crashing
+# ---------------------------------------------------------------------------
+
+def test_maybe_initialize_distributed_retries_coordinator_race(
+        monkeypatch):
+    from kfac_pytorch_tpu.parallel import mesh as kmesh
+    calls = []
+
+    def flaky_init(coordinator_address, num_processes, process_id):
+        calls.append((coordinator_address, num_processes, process_id))
+        if len(calls) < 3:
+            raise RuntimeError('failed to connect to coordinator')
+
+    monkeypatch.setattr(jax.distributed, 'initialize', flaky_init)
+    monkeypatch.setenv('JAX_COORDINATOR_ADDRESS', 'hostA:8476')
+    monkeypatch.setenv('KFAC_TPU_MULTIHOST', '1')
+    monkeypatch.setenv('JAX_NUM_PROCESSES', '2')
+    monkeypatch.setenv('JAX_PROCESS_ID', '1')
+    pol = RetryPolicy(attempts=4, base_delay=0.0, jitter=0.0,
+                      retry_on=(RuntimeError,))
+    assert kmesh.maybe_initialize_distributed(retry=pol) is True
+    assert len(calls) == 3  # two coordinator races, then success
+    assert calls[0] == ('hostA:8476', 2, 1)
+    assert resilience.counters.get('dist_init_retries') == 2
+    # elastic-relaunch overrides beat the env
+    calls.clear()
+    assert kmesh.maybe_initialize_distributed(
+        retry=pol, coordinator_address='hostB:8476', num_processes=1,
+        process_id=0) is True
+    assert calls[-1] == ('hostB:8476', 1, 0)
+    # no coordination env -> no-op, nothing called
+    monkeypatch.delenv('JAX_COORDINATOR_ADDRESS')
+    calls.clear()
+    assert kmesh.maybe_initialize_distributed() is False
+    assert calls == []
+
+
+def test_maybe_initialize_distributed_default_policy_skips_permanent(
+        monkeypatch):
+    """The default retry policy only retries connection-SHAPED
+    RuntimeErrors: a permanent one ('already initialized', bad address)
+    must surface after a single attempt, not burn the whole backoff
+    budget re-raising itself."""
+    from kfac_pytorch_tpu.parallel import mesh as kmesh
+    calls = []
+
+    def permanent(coordinator_address, num_processes, process_id):
+        calls.append(1)
+        raise RuntimeError('jax.distributed is already initialized')
+
+    monkeypatch.setattr(jax.distributed, 'initialize', permanent)
+    monkeypatch.setenv('JAX_COORDINATOR_ADDRESS', 'hostA:8476')
+    monkeypatch.setenv('KFAC_TPU_MULTIHOST', '1')
+    monkeypatch.setenv('JAX_NUM_PROCESSES', '2')
+    monkeypatch.setenv('JAX_PROCESS_ID', '0')
+    with pytest.raises(RuntimeError, match='already initialized'):
+        kmesh.maybe_initialize_distributed()  # default policy
+    assert len(calls) == 1
+
+
+def test_maybe_initialize_distributed_fail_fast_opt_out(monkeypatch):
+    from kfac_pytorch_tpu.parallel import mesh as kmesh
+
+    def always_down(**kw):
+        raise RuntimeError('failed to connect to coordinator')
+
+    monkeypatch.setattr(jax.distributed, 'initialize', always_down)
+    monkeypatch.setenv('JAX_COORDINATOR_ADDRESS', 'hostA:8476')
+    monkeypatch.setenv('KFAC_TPU_MULTIHOST', '1')
+    monkeypatch.setenv('JAX_NUM_PROCESSES', '2')
+    monkeypatch.setenv('JAX_PROCESS_ID', '0')
+    with pytest.raises(RuntimeError):
+        kmesh.maybe_initialize_distributed(retry=False)
+    assert resilience.counters.get('dist_init_retries') == 0
+
+
+# ---------------------------------------------------------------------------
+# world stamp (elastic resume routing)
+# ---------------------------------------------------------------------------
+
+def test_world_stamp_roundtrip_and_absence(tmp_path):
+    assert checkpoint.read_world_stamp(tmp_path) is None
+    checkpoint.write_world_stamp(tmp_path, 4)
+    assert checkpoint.read_world_stamp(tmp_path) == 4
+    checkpoint.write_world_stamp(tmp_path, 2)  # overwrite on shrink
+    assert checkpoint.read_world_stamp(tmp_path) == 2
+    # corrupt stamp reads as "no stamp" (same-world resume), not a crash
+    (tmp_path / 'world.json').write_text('not json')
+    assert checkpoint.read_world_stamp(tmp_path) is None
+
+
+# ---------------------------------------------------------------------------
+# pod supervisor (fast paths; the real two-process SIGKILL drill is in
+# tests/test_pod_chaos.py behind -m slow)
+# ---------------------------------------------------------------------------
+
+def test_pod_supervisor_clean_exit_writes_incident(tmp_path):
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    sup = PodSupervisor([sys.executable, '-c', 'pass'], host_id=0,
+                        num_hosts=1, lease_dir=str(tmp_path / 'lease'),
+                        max_restarts=2, backoff_base=0.01,
+                        poll_period=0.02)
+    assert sup.run() == 0
+    report = json.loads(
+        (tmp_path / 'lease' / 'incident-host0.json').read_text())
+    assert report['host_id'] == 0
+    assert report['gave_up'] is False
+    kinds = [e['kind'] for e in report['events']]
+    assert 'launch' in kinds and 'trainer_exit' in kinds
+
+
+def test_pod_supervisor_crash_loop_gives_up_with_incident(tmp_path):
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    sup = PodSupervisor([sys.executable, '-c', 'import sys;sys.exit(3)'],
+                        host_id=0, num_hosts=1,
+                        lease_dir=str(tmp_path / 'lease'),
+                        max_restarts=1, backoff_base=0.01,
+                        poll_period=0.02, rng=random.Random(0))
+    assert sup.run() == 3
+    assert sup.crashes == 2 and sup.restarts == 1
+    report = json.loads(
+        (tmp_path / 'lease' / 'incident-host0.json').read_text())
+    assert report['gave_up'] is True
+    assert report['counters']['crashes'] == 2
+
+
+def test_pod_supervisor_substitutes_world_placeholders(tmp_path):
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    sup = PodSupervisor(['trainer', '--host-id', '{host_id}',
+                         '--num-hosts', '{num_hosts}', '--tag',
+                         'gen{gen}', '--plain'],
+                        host_id=2, num_hosts=3,
+                        lease_dir=str(tmp_path / 'lease'))
+    assert sup._child_argv() == ['trainer', '--host-id', '2',
+                                 '--num-hosts', '3', '--tag', 'gen0',
+                                 '--plain']
+    # after a (simulated) shrink the rank and world re-derive
+    sup.members = [1, 2]
+    sup.gen = 1
+    assert sup._child_argv() == ['trainer', '--host-id', '1',
+                                 '--num-hosts', '2', '--tag', 'gen1',
+                                 '--plain']
+    env = sup._child_env()
+    assert env['JAX_PROCESS_ID'] == '1'
+    assert env['JAX_NUM_PROCESSES'] == '2'
+    assert env['KFAC_POD_GEN'] == '1'
+    assert env['KFAC_HB_HOST'] == '1'
+    assert env['KFAC_HB_HOSTS'] == '2'
+    assert env['KFAC_HB_DIR'].endswith('trainer-gen1')
+
+
+def test_pod_supervisor_clears_stale_protocol_files_at_startup(tmp_path):
+    """A pod restart reuses the lease dir (the runbook): stale shrink
+    claims and heartbeat leases from the previous incarnation must be
+    scrubbed at generation 0, or every healthy host would read "peers
+    are shrinking around me" and fence itself."""
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    # previous incarnation's debris: a completed shrink + old leases
+    (lease / 'shrink-gen1').mkdir(parents=True)
+    (lease / 'shrink-gen1' / 'survivor-1.json').write_text(
+        '{"host": 1, "addr": null}')
+    (lease / 'sup').mkdir()
+    (lease / 'sup' / 'hb-1.json').write_text(
+        '{"host": 1, "seq": 900, "pid": 1}')
+    (lease / 'trainer-gen0').mkdir()
+    (lease / 'incident-host1.json').write_text('{}')  # artifact: kept
+    sup = PodSupervisor([sys.executable, '-c', 'pass'], host_id=0,
+                        num_hosts=1, lease_dir=str(lease),
+                        max_restarts=1, backoff_base=0.01,
+                        poll_period=0.02)
+    assert sup.run() == 0  # no self-fence, clean completion
+    assert not (lease / 'shrink-gen1').exists()
+    assert not (lease / 'sup' / 'hb-1.json').exists()
+    assert (lease / 'incident-host1.json').exists()
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    assert not any(e['kind'] == 'fenced' for e in report['events'])
+
+
+def test_pod_supervisor_stop_rc_propagates(tmp_path):
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    sup = PodSupervisor([sys.executable, '-c', 'import sys;sys.exit(7)'],
+                        host_id=0, num_hosts=1,
+                        lease_dir=str(tmp_path / 'lease'),
+                        max_restarts=5, stop_rcs=(7,),
+                        backoff_base=0.01, poll_period=0.02)
+    assert sup.run() == 7
+    assert sup.restarts == 0
+
+
 def test_guard_final_save_runs_with_watchdog_paused(tmp_path, monkeypatch):
     """The PreemptionGuard grace-window save must not race the step
     watchdog: inside ``paused()`` even a save far exceeding the step
