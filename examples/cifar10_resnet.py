@@ -66,6 +66,12 @@ def parse_args():
                         '(KFAC_EIGH_IMPL=subspace|auto|jacobi), Cholesky '
                         'variants Newton-Schulz-iterate the previous '
                         'inverse')
+    p.add_argument('--kfac-stagger', action='store_true',
+                   help='staggered inverse refresh: decompose one cost-'
+                        'balanced cohort of factors per step instead of '
+                        'ALL factors every --kfac-update-freq steps — '
+                        'same staleness contract, no periodic eigh spike '
+                        '(see README "Staggered refresh")')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-type', '--fisher-type', default='Femp',
                    choices=['Femp', 'F1mc'],
@@ -134,6 +140,7 @@ def main():
         f'basis{args.kfac_basis_update_freq}'
         if args.kfac_basis_update_freq else None,
         'warm' if args.kfac_warm_start else None,
+        'stagger' if args.kfac_stagger else None,
         f'bs{args.batch_size}', f'nd{args.num_devices}')
     log.info('args: %s', vars(args))
 
@@ -160,6 +167,7 @@ def main():
             kfac_update_freq=args.kfac_update_freq,
             basis_update_freq=(args.kfac_basis_update_freq or None),
             warm_start_basis=args.kfac_warm_start,
+            stagger=args.kfac_stagger,
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_parts=args.exclude_parts,
             num_devices=args.num_devices,
@@ -266,6 +274,10 @@ def main():
     # health-guard event log: skipped batches / ladder escalations surface
     # as WARNINGs at the step they happen, plus a per-epoch summary suffix
     monitor = utils.HealthMonitor(log, state=state)
+    # per-phase step timing (stats/decomp/gather/pred) for the epoch
+    # lines — makes the refresh spike (and its removal under
+    # --kfac-stagger) visible as step_max vs step_mean
+    timers = utils.PhaseTimers()
     if args.checkpoint_dir:
         # world-size stamp: lets a shrunken pod's relaunch route this
         # run's checkpoints through the factor reshard (elastic_resume)
@@ -282,9 +294,13 @@ def main():
             lr_now = float(lr_fn(int(state.step)))
             if watchdog is not None:
                 watchdog.arm(tag=f'step {int(state.step)}')
+            t_step = time.perf_counter()
             state, m = step(state, batch, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             train_loss.update(m['loss'], len(batch['label']))
+            # the update above materialized the step result: this wall
+            # time covers dispatch + device execution of the whole step
+            timers.record(step.last_phases, time.perf_counter() - t_step)
             if watchdog is not None:
                 # the float() above materialized the step result: the
                 # blocking window the deadline covers is over
@@ -328,15 +344,18 @@ def main():
                               val_acc.sync().avg)
         from kfac_pytorch_tpu.utils.runlog import (counter_deltas,
                                                    health_suffix,
+                                                   kfac_phase_suffix,
                                                    resilience_suffix)
         res_now = resilience.counters.snapshot()
         if governor is not None:
             res_now.update(governor.counts())
         res_delta, res_prev = counter_deltas(res_now, res_prev), res_now
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)%s%s', epoch, tl, vl_avg, va_avg, time.time() - t0,
+                 '(%.1fs)%s%s%s', epoch, tl, vl_avg, va_avg,
+                 time.time() - t0,
                  health_suffix(monitor.epoch_flush()),
-                 resilience_suffix(res_delta))
+                 resilience_suffix(res_delta),
+                 kfac_phase_suffix(timers.epoch_flush()))
         log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)

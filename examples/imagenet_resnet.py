@@ -60,6 +60,12 @@ def parse_args():
                         '(KFAC_EIGH_IMPL=subspace|auto|jacobi), Cholesky '
                         'variants Newton-Schulz-iterate the previous '
                         'inverse')
+    p.add_argument('--kfac-stagger', action='store_true',
+                   help='staggered inverse refresh: decompose one cost-'
+                        'balanced cohort of factors per step instead of '
+                        'ALL factors every --kfac-update-freq steps — '
+                        'same staleness contract, no periodic eigh spike '
+                        '(see README "Staggered refresh")')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp',
                    choices=list(kfac.KFAC_VARIANTS))
@@ -156,6 +162,7 @@ def main():
             kfac_update_freq=args.kfac_update_freq,
             basis_update_freq=(args.kfac_basis_update_freq or None),
             warm_start_basis=args.kfac_warm_start,
+            stagger=args.kfac_stagger,
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_parts=args.exclude_parts,
             num_devices=args.num_devices,
@@ -262,6 +269,10 @@ def main():
     tb = maybe_writer(args.tb_dir)
     guard = utils.PreemptionGuard()
     monitor = utils.HealthMonitor(log, state=state)
+    # per-phase step timing (stats/decomp/gather/pred) for the epoch
+    # lines — makes the refresh spike (and its removal under
+    # --kfac-stagger) visible as step_max vs step_mean
+    timers = utils.PhaseTimers()
     res_prev = {}
     lr_now = args.base_lr
     for epoch in range(start_epoch, args.epochs):
@@ -275,9 +286,13 @@ def main():
             lr_now = float(lr_fn(int(state.step)))
             if watchdog is not None:
                 watchdog.arm(tag=f'step {int(state.step)}')
+            t_step = time.perf_counter()
             state, m = step(state, b, lr=lr_now,
                             damping=precond.damping if precond else 0.0)
             tm.update(m['loss'])
+            # the update above materialized the step result: this wall
+            # time covers dispatch + device execution of the whole step
+            timers.record(step.last_phases, time.perf_counter() - t_step)
             if watchdog is not None:
                 watchdog.disarm()
             monitor.update(m, step=int(state.step) - 1)
@@ -314,15 +329,18 @@ def main():
         tl, vl_avg, va_avg = (tm.sync().avg, vl.sync().avg, va.sync().avg)
         from kfac_pytorch_tpu.utils.runlog import (counter_deltas,
                                                    health_suffix,
+                                                   kfac_phase_suffix,
                                                    resilience_suffix)
         res_now = resilience.counters.snapshot()
         if governor is not None:
             res_now.update(governor.counts())
         res_delta, res_prev = counter_deltas(res_now, res_prev), res_now
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)%s%s', epoch, tl, vl_avg, va_avg, time.time() - t0,
+                 '(%.1fs)%s%s%s', epoch, tl, vl_avg, va_avg,
+                 time.time() - t0,
                  health_suffix(monitor.epoch_flush()),
-                 resilience_suffix(res_delta))
+                 resilience_suffix(res_delta),
+                 kfac_phase_suffix(timers.epoch_flush()))
         log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)

@@ -209,6 +209,23 @@ def local_invs(plan, decomp, axis_name, comm_mode):
     return _local_rows(plan, decomp['invs'], axis_name, comm_mode)
 
 
+def _local_trace_avgs(plan, factors_local, axis_name):
+    """Per-local-slot ``trace/true_dim`` averages (flat, concat over
+    buckets in bucket_dims order) — the pi-damping inputs shared by the
+    full and staggered Cholesky paths. O(D) per slot: cheap enough to
+    recompute every step even when only a cohort is decomposed."""
+    trace_parts, dim_parts = [], []
+    for bdim in plan.bucket_dims:
+        b = plan.buckets[bdim]
+        tdl = _local_table(b.true_dims.reshape(plan.num_devices, b.per_dev),
+                           axis_name)
+        trace_parts.append(ops.masked_trace(factors_local[_key(bdim)], tdl))
+        dim_parts.append(tdl)
+    flat_tr = jnp.concatenate(trace_parts)
+    flat_dim = jnp.concatenate(dim_parts).astype(jnp.float32)
+    return flat_tr / flat_dim
+
+
 #: NS acceptance threshold on the returned inverse's residual
 #: ``max |I - A X|`` (measured AFTER the final iteration, i.e. the bound
 #: on the accepted result itself): healthy tracking sits at f32 noise —
@@ -257,21 +274,7 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
         return {'evals': evals, 'evecs': evecs}
 
     # cholesky: per-slot traces (mate maps guarantee co-location, plan.py)
-    trace_parts = []
-    for bdim in plan.bucket_dims:
-        b = plan.buckets[bdim]
-        tdl = _local_table(b.true_dims.reshape(plan.num_devices, b.per_dev),
-                           axis_name)
-        trace_parts.append(ops.masked_trace(factors_local[_key(bdim)], tdl))
-    flat_tr = jnp.concatenate(trace_parts)
-
-    flat_dims = []
-    for bdim in plan.bucket_dims:
-        b = plan.buckets[bdim]
-        flat_dims.append(_local_table(
-            b.true_dims.reshape(plan.num_devices, b.per_dev), axis_name))
-    flat_dim = jnp.concatenate(flat_dims).astype(jnp.float32)
-    flat_avg = flat_tr / flat_dim
+    flat_avg = _local_trace_avgs(plan, factors_local, axis_name)
 
     invs = {}
     for bdim in plan.bucket_dims:
@@ -327,6 +330,141 @@ def refresh_decomposition(plan, factors_local, decomp_prev, eps, axis_name,
                                          communicate=False)
         return {'evals': evals, 'evecs': decomp_prev['evecs']}
     return {'evals': evals, 'evecs': evecs_local}
+
+
+def _cohort_table(tbl, cohort_idx, axis_name):
+    """Select this device's row of a static ``[F, P, R]`` cohort table
+    for a TRACED cohort index — the indirection that keeps one compiled
+    program serving every cohort (no per-cohort step variants)."""
+    t = jnp.take(jnp.asarray(tbl), cohort_idx, axis=0)
+    return jnp.take(t, coll.axis_index(axis_name), axis=0)
+
+
+def compute_cohort_decomposition(plan, cohorts, factors_local, cohort_idx,
+                                 damping, method, eps, axis_name):
+    """Decompose ONLY this step's cohort rows of the local factor shard.
+
+    The staggered counterpart of :func:`compute_decomposition`:
+    ``cohort_idx`` (traced, = ``step % num_cohorts``) selects the
+    precomputed row tables (plan.build_cohorts) and the batched
+    eigh/Cholesky runs over ``R_b`` rows per bucket instead of
+    ``per_dev`` — ~``1/num_cohorts`` of the refresh-spike work per step.
+    Returns cohort-shaped components (``[R_b, ...]`` rows per bucket);
+    :func:`merge_cohort_decomposition` scatters them into the stored
+    decomposition. Padding rows (off-peak cohorts) decompose a real
+    factor row whose result the merge discards.
+
+    Cholesky pi-damping uses fresh traces of ALL local rows (O(D) per
+    slot) so each cohort row is damped exactly as the full path would
+    damp it at this step.
+    """
+    sel = {bdim: _cohort_table(cohorts.rows[bdim], cohort_idx, axis_name)
+           for bdim in plan.bucket_dims}
+    if method == 'eigh':
+        evals, evecs = {}, {}
+        for bdim in plan.bucket_dims:
+            key = _key(bdim)
+            f = jnp.take(factors_local[key], sel[bdim], axis=0)
+            d, q = ops.sym_eig(f)
+            evals[key] = ops.clamp_eigvals(d, eps)
+            evecs[key] = q
+        return {'evals': evals, 'evecs': evecs}
+
+    flat_avg = _local_trace_avgs(plan, factors_local, axis_name)
+    invs = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        own_avg = jnp.take(flat_avg, _cohort_table(
+            cohorts.own_flat[bdim], cohort_idx, axis_name))
+        mate_avg = jnp.take(flat_avg, _cohort_table(
+            cohorts.mate_flat[bdim], cohort_idx, axis_name))
+        damp_vec = jnp.sqrt(damping * own_avg / mate_avg)
+        f = jnp.take(factors_local[key], sel[bdim], axis=0)
+        invs[key] = ops.psd_inverse(ops.add_scaled_identity(f, damp_vec))
+    return {'invs': invs}
+
+
+def merge_cohort_decomposition(plan, cohorts, decomp_stored, cohort_new,
+                               cohort_idx, axis_name, comm_mode, method,
+                               communicate=True, guard=True):
+    """Scatter freshly decomposed cohort rows into the stored
+    decomposition; every other row keeps its stored bits exactly.
+
+    comm_mode='pred': local scatter, zero comm (the owner's shard holds
+    its own decomposition rows).
+
+    comm_mode='inverse': the cohort rows are all-gathered — the
+    double-buffered publish: only ``Σ_b R_b`` rows travel per step
+    (~``1/num_cohorts`` of the full decomposition gather), and because
+    the caller preconditions with the PREVIOUS table this gather has no
+    same-step consumer, so XLA can overlap it with the pred einsums.
+    With ``communicate=False`` (the CommunicateInverse ablation) each
+    device scatters only its own rows at its global offsets.
+
+    ``guard``: per-row non-finite screen — a blown cohort row keeps the
+    last good stored row instead of poisoning the table (the staggered
+    form of :func:`guard_decomposition`). Padding rows always rewrite
+    the stored value (all duplicate scatter writes carry identical
+    values, so the merge is deterministic and bit-stable).
+    """
+    def tables(bdim):
+        if comm_mode == 'inverse' and communicate:
+            rows = jnp.take(jnp.asarray(cohorts.global_rows[bdim]),
+                            cohort_idx, axis=0)
+            valid = jnp.take(jnp.asarray(cohorts.global_valid[bdim]),
+                             cohort_idx, axis=0)
+            gather = lambda x: coll.all_gather_rows(x, axis_name)  # noqa: E731
+        elif comm_mode == 'inverse':
+            F, PR = cohorts.global_rows[bdim].shape
+            P = plan.num_devices
+            rows = _cohort_table(
+                cohorts.global_rows[bdim].reshape(F, P, PR // P),
+                cohort_idx, axis_name)
+            valid = _cohort_table(
+                cohorts.global_valid[bdim].reshape(F, P, PR // P),
+                cohort_idx, axis_name)
+            gather = lambda x: x  # noqa: E731
+        else:
+            rows = _cohort_table(cohorts.rows[bdim], cohort_idx, axis_name)
+            valid = _cohort_table(cohorts.valid[bdim], cohort_idx, axis_name)
+            gather = lambda x: x  # noqa: E731
+        return rows, valid, gather
+
+    out = dict(decomp_stored)
+    if method == 'eigh':
+        new_d, new_q = {}, {}
+        for bdim in plan.bucket_dims:
+            key = _key(bdim)
+            rows, valid, gather = tables(bdim)
+            dn = gather(cohort_new['evals'][key])
+            qn = gather(cohort_new['evecs'][key])
+            ds = decomp_stored['evals'][key]
+            qs = decomp_stored['evecs'][key]
+            ok = valid
+            if guard:
+                ok = jnp.logical_and(ok, jnp.logical_and(
+                    _rows_finite(dn), _rows_finite(qn)))
+            d_prev = jnp.take(ds, rows, axis=0)
+            q_prev = jnp.take(qs, rows, axis=0)
+            new_d[key] = ds.at[rows].set(jnp.where(ok[:, None], dn, d_prev))
+            new_q[key] = qs.at[rows].set(
+                jnp.where(ok[:, None, None], qn, q_prev))
+        out['evals'], out['evecs'] = new_d, new_q
+        return out
+    new_i = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        rows, valid, gather = tables(bdim)
+        xn = gather(cohort_new['invs'][key])
+        xs = decomp_stored['invs'][key]
+        ok = valid
+        if guard:
+            ok = jnp.logical_and(ok, _rows_finite(xn))
+        x_prev = jnp.take(xs, rows, axis=0)
+        new_i[key] = xs.at[rows].set(
+            jnp.where(ok[:, None, None], xn, x_prev))
+    out['invs'] = new_i
+    return out
 
 
 def _layer_rows_padded(meta, acts, gs, batch_averaged, pg):

@@ -113,6 +113,142 @@ def _slot_cost(dim):
     return float(dim) ** 3
 
 
+@dataclasses.dataclass
+class CohortPlan:
+    """Staggered-refresh layout: every device's valid factor rows
+    partitioned into ``num_cohorts`` cohorts, one refreshed per step.
+
+    Instead of decomposing ALL rows every ``kfac_update_freq`` steps (the
+    eigh spike), the staggered schedule decomposes cohort ``step % F``
+    each step — same per-slot staleness contract (every slot refreshed
+    once per F-step window), cost spread evenly. All tables are static
+    host arrays indexed by a *traced* cohort scalar at runtime, so one
+    compiled program covers every cohort (training.py's variant cache
+    does not grow with F).
+
+    Shapes are static per bucket: ``R_b = max over (cohort, device)`` of
+    that bucket's cohort size, so off-peak cohorts decompose up to
+    ``R_b - count`` padding rows (real factor rows whose results the
+    merge discards) — the price of a single uniform program. Padding row
+    indices are chosen OUTSIDE the cohort so scatter indices never
+    collide with real updates (deterministic merge).
+    """
+    num_cohorts: int
+    # per bucket dim, [F, P, R_b]: local row index (within the device's
+    # per_dev rows) to decompose on cohort f / device p
+    rows: Dict[int, np.ndarray]
+    valid: Dict[int, np.ndarray]        # [F, P, R_b] bool (False = padding)
+    # comm_inverse merge tables, flattened device-major to match
+    # all_gather_rows output: [F, P*R_b] global row index / validity
+    global_rows: Dict[int, np.ndarray]
+    global_valid: Dict[int, np.ndarray]
+    # cholesky pi-damping lookups for the selected rows, [F, P, R_b]:
+    # flat local slot index of the row itself and of its mate factor
+    own_flat: Dict[int, np.ndarray]
+    mate_flat: Dict[int, np.ndarray]
+    cohort_cost: np.ndarray             # [P, F] Σ bucket_dim³ per cohort
+    cohort_count: np.ndarray            # [P, F] valid rows per cohort
+
+    def max_rows_per_step(self):
+        """Max over (device, cohort) of genuinely refreshed rows — the
+        per-step decomposition row bound the bench records."""
+        return int(self.cohort_count.max()) if self.cohort_count.size else 0
+
+    def padded_rows_per_step(self):
+        """Static per-device rows decomposed every step (Σ_b R_b) —
+        includes the discarded padding rows of off-peak cohorts."""
+        return int(sum(t.shape[2] for t in self.rows.values()))
+
+    def total_rows(self):
+        """Valid rows per device over a full window (= per-device slots)."""
+        return int(self.cohort_count.sum(axis=1).max()) \
+            if self.cohort_count.size else 0
+
+
+def build_cohorts(plan: 'FactorPlan', num_cohorts: int) -> CohortPlan:
+    """Partition each device's valid factor rows into ``num_cohorts``
+    refresh cohorts, balanced by eigh cost ∝ D³.
+
+    Per device: buckets are visited largest-dim first and every row goes
+    to the cohort with the lexicographically least (row count, Σ D³) —
+    counts stay within ±1 at all times, so the max refreshed rows per
+    step is ceil(total_rows / F) (the bench's row budget), while the
+    cost tiebreak round-robins each bucket's equal-cost rows over the
+    cheapest cohorts (large buckets don't clump onto the step that also
+    drew the small-bucket overflow).
+    """
+    F = max(1, int(num_cohorts))
+    P = plan.num_devices
+    assign: Dict[int, np.ndarray] = {}
+    cohort_cost = np.zeros((P, F), dtype=np.float64)
+    cohort_count = np.zeros((P, F), dtype=np.int64)
+    for bdim in plan.bucket_dims:
+        b = plan.buckets[bdim]
+        assign[bdim] = np.full((P, b.per_dev), -1, dtype=np.int64)
+    for d in range(P):
+        loads = np.zeros(F, dtype=np.float64)
+        counts = np.zeros(F, dtype=np.int64)
+        for bdim in sorted(plan.bucket_dims, reverse=True):
+            b = plan.buckets[bdim]
+            ks = [k for k in range(b.per_dev) if b.valid[d * b.per_dev + k]]
+            for k in ks:
+                c = min(range(F), key=lambda i: (counts[i], loads[i], i))
+                assign[bdim][d, k] = c
+                # cost at the PADDED dim: that is what the batched
+                # decomposition actually runs at
+                loads[c] += _slot_cost(bdim)
+                counts[c] += 1
+        cohort_cost[d] = loads
+        cohort_count[d] = counts
+
+    rows, valid, grows, gvalid, own_flat, mate_flat = {}, {}, {}, {}, {}, {}
+    for bdim in plan.bucket_dims:
+        b = plan.buckets[bdim]
+        counts = np.zeros((F, P), dtype=np.int64)
+        for d in range(P):
+            for k in range(b.per_dev):
+                c = assign[bdim][d, k]
+                if c >= 0:
+                    counts[c, d] += 1
+        R = max(1, int(counts.max()))
+        r_tbl = np.zeros((F, P, R), dtype=np.int32)
+        v_tbl = np.zeros((F, P, R), dtype=bool)
+        for f in range(F):
+            for d in range(P):
+                members = [k for k in range(b.per_dev)
+                           if assign[bdim][d, k] == f]
+                # padding points at a row OUTSIDE this cohort (always
+                # exists whenever padding is needed: count < R ≤ per_dev)
+                # so real updates and padding writes never collide
+                spare = next((k for k in range(b.per_dev)
+                              if assign[bdim][d, k] != f), 0)
+                for j in range(R):
+                    if j < len(members):
+                        r_tbl[f, d, j] = members[j]
+                        v_tbl[f, d, j] = True
+                    else:
+                        r_tbl[f, d, j] = spare
+        rows[bdim] = r_tbl
+        valid[bdim] = v_tbl
+        dev_off = (np.arange(P, dtype=np.int32) * b.per_dev)[None, :, None]
+        grows[bdim] = (r_tbl + dev_off).reshape(F, P * R)
+        gvalid[bdim] = v_tbl.reshape(F, P * R)
+        own_flat[bdim] = (r_tbl + plan.local_flat_offsets[bdim]).astype(
+            np.int32)
+        if b.mate_flat is not None:
+            mate_flat[bdim] = np.take_along_axis(
+                np.broadcast_to(b.mate_flat[None], (F,) + b.mate_flat.shape),
+                r_tbl, axis=2).astype(np.int32)
+        else:
+            # factor-wise distributed layouts carry no mate maps (eigh
+            # only there — the cholesky path never reads this table)
+            mate_flat[bdim] = own_flat[bdim]
+    return CohortPlan(num_cohorts=F, rows=rows, valid=valid,
+                      global_rows=grows, global_valid=gvalid,
+                      own_flat=own_flat, mate_flat=mate_flat,
+                      cohort_cost=cohort_cost, cohort_count=cohort_count)
+
+
 def build_plan(metas: Dict[str, LayerMeta], num_devices: int, comm_mode: str,
                assignment: str = 'round_robin',
                distribute_layer_factors: bool = False,

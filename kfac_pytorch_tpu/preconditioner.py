@@ -33,7 +33,7 @@ from jax.sharding import PartitionSpec as P
 
 from kfac_pytorch_tpu import engine, faults
 from kfac_pytorch_tpu import health as health_lib
-from kfac_pytorch_tpu.plan import build_plan, default_bucket_fn
+from kfac_pytorch_tpu.plan import build_cohorts, build_plan, default_bucket_fn
 
 
 class KFACState(flax.struct.PyTreeNode):
@@ -164,6 +164,28 @@ class KFAC:
         ones — the chained basis Q <- Q @ V' accumulates ~1e-7
         orthogonality error per warm full, and the periodic cold full
         resets it. Must be a positive int.
+      stagger: staggered inverse refresh (beyond reference — the KAISA /
+        Osawa et al. amortization done evenly): instead of decomposing
+        EVERY factor on ``kfac_update_freq``-boundary steps (a periodic
+        multi-x step-time spike), the device-major rows are partitioned
+        into ``kfac_update_freq`` cost-balanced cohorts
+        (plan.build_cohorts, eigh cost ~ D^3) and every step decomposes
+        only cohort ``step % kfac_update_freq`` — the same per-slot
+        staleness contract (each slot refreshed once per window), cost
+        spread evenly so the second-order work hides behind the
+        first-order step. The cohort index is a TRACED scalar, so the
+        trainer's compiled-variant count does not grow with the freq.
+        Double-buffered publish: the step preconditions with the
+        PREVIOUS stored table while the freshly decomposed cohort rows
+        are merged (and, in comm_mode='inverse', all-gathered at
+        ~1/kfac_update_freq of the full volume, overlappable with the
+        pred einsums) into the state for the NEXT step — one extra step
+        of staleness for the refreshed cohort, well inside the contract
+        ``kfac_update_freq`` already accepts. Mutually exclusive with
+        the basis_update_freq / warm_start_basis amortizations and the
+        ekfac variants (those re-use the full-refresh structure).
+        The first decomposition of a run is always a full one (the
+        trainer's cold-start gate); staggering begins after it.
       health: the numerical-health guard (beyond reference, health.py).
         True (default) enables the in-engine screens with the default
         ladder: factor-EMA rows and decomposition rows that come back
@@ -184,7 +206,8 @@ class KFAC:
                  num_devices=1, axis_name=None, assignment='round_robin',
                  distribute_layer_factors=None, bucket_fn=None, eps=1e-10,
                  basis_update_freq=None, warm_start_basis=False,
-                 warm_sweeps=None, cold_restart_every=50, health=True):
+                 warm_sweeps=None, cold_restart_every=50, stagger=False,
+                 health=True):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -247,6 +270,20 @@ class KFAC:
             raise ValueError('cold_restart_every must be a positive int '
                              f'(got {cold_restart_every!r})')
         self.cold_restart_every = cold_restart_every
+        self.stagger = bool(stagger)
+        if self.stagger:
+            if self.ekfac:
+                raise ValueError(
+                    'stagger is not supported for the ekfac variants: the '
+                    'per-example moment rotation assumes a whole-table '
+                    'basis change, not a per-cohort one')
+            if basis_update_freq is not None or warm_start_basis:
+                raise ValueError(
+                    'stagger is an alternative amortization of the inverse '
+                    'refresh — it does not compose with basis_update_freq '
+                    'or warm_start_basis (pick one; see README '
+                    '"Staggered refresh")')
+        self._cohorts = None
         self.health = health_lib.resolve(health)
         # deterministic fault injection (chaos tests): the env snapshot
         # happens here, at construction, so the traced step is static
@@ -288,7 +325,30 @@ class KFAC:
             assignment=self.assignment,
             distribute_layer_factors=bool(distribute),
             bucket_fn=self.bucket_fn)
+        self._cohorts = None
+        if self.stagger:
+            self.rebase_cohorts()
         return self.plan
+
+    def rebase_cohorts(self):
+        """(Re)build the staggered cohort layout for the CURRENT
+        ``kfac_update_freq``. Called by :meth:`setup`, by
+        KFACParamScheduler after a frequency rescale, and lazily by the
+        trainer on every staggered dispatch (which also covers the
+        StragglerGovernor's temporary frequency stretches). No-op when
+        the layout already matches; returns the layout (None when
+        stagger is off or setup hasn't run)."""
+        if not self.stagger or self.plan is None:
+            return None
+        f = max(1, int(self.kfac_update_freq))
+        if self._cohorts is None or self._cohorts.num_cohorts != f:
+            self._cohorts = build_cohorts(self.plan, f)
+        return self._cohorts
+
+    @property
+    def cohorts(self):
+        """The current staggered cohort layout (plan.CohortPlan)."""
+        return self._cohorts
 
     def init(self):
         """Initial state: identity factors (reference initializes running
@@ -391,7 +451,7 @@ class KFAC:
              hyper: Optional[KFACHyperParams] = None, *,
              update_factors: bool = True, update_inverse: bool = True,
              update_basis: bool = True, warm_basis: bool = False,
-             factors_only: bool = False,
+             factors_only: bool = False, stagger_update: bool = False,
              axis_name: str = '__default__'):
         """One K-FAC step: (state, grads, captured stats) ->
         (preconditioned grads, new state).
@@ -400,6 +460,16 @@ class KFAC:
         and ``update_inverse`` are STATIC — the trainer picks them from
         ``should_update_*`` (the steps-%-freq gating of
         kfac_preconditioner_base.py:198-213 moved to the host).
+
+        ``stagger_update`` (STATIC; requires ``stagger=True``) replaces
+        the windowed full refresh: cohort ``state.step % kfac_update_freq``
+        (a TRACED index — one compiled program serves every cohort) is
+        decomposed and merged into the stored decomposition for the NEXT
+        step, while THIS step preconditions with the previous table (the
+        double-buffered publish). ``update_inverse`` is ignored when set.
+        The stored decomposition must already be populated (the trainer
+        runs one full decomposition first); a cold state would
+        precondition with zeros.
 
         Parity with step() (kfac_preconditioner_base.py:185-230): factor
         stats + running-avg update (+ pmean for MPD), decomposition on the
@@ -459,6 +529,9 @@ class KFAC:
             # ablation: no decomposition -> grads pass through
             # (kfac_preconditioner_base.py:206-226)
             return grads, state.replace(step=state.step + 1, factors=factors)
+
+        if stagger_update:
+            update_inverse = False  # stagger replaces the windowed refresh
 
         scales_prev = None
         if self.ekfac:
@@ -570,17 +643,44 @@ class KFAC:
                         decomp['scales'] = engine.where_finite_rows(
                             decomp['scales'], scales_prev)
 
+        # double-buffer: staggered steps precondition with the PREVIOUS
+        # stored table while the freshly decomposed cohort is merged into
+        # the state for the next step — the cohort eigh/gather has no
+        # same-step consumer, so XLA can overlap it with the pred einsums
+        pred_decomp = decomp
+        if stagger_update:
+            cohorts = self._cohorts
+            assert cohorts is not None, \
+                'stagger_update requires KFAC(stagger=True) + setup()'
+            cohort_idx = jnp.mod(jnp.asarray(state.step, jnp.int32),
+                                 jnp.int32(cohorts.num_cohorts))
+            with jax.named_scope('kfac.ComputeInverse.stagger'):
+                cohort_new = engine.compute_cohort_decomposition(
+                    plan, cohorts, factors, cohort_idx, damping,
+                    self.method, self.eps, axis_name)
+            # chaos drill parity with the full path: blowups injected
+            # BEFORE the merge's per-row screen, which is what heals them
+            cohort_new = faults.corrupt_decomposition(
+                self._faults, state.step, cohort_new)
+            with jax.named_scope('kfac.CommunicateInverse.stagger'):
+                decomp = engine.merge_cohort_decomposition(
+                    plan, cohorts, decomp, cohort_new, cohort_idx,
+                    axis_name, self.comm_mode, self.method,
+                    communicate=not self.exclude_communicate_inverse,
+                    guard=self.health is not None)
+
         grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
         with jax.named_scope('kfac.Precondition'):
             if self.comm_mode == 'inverse':
                 preds = engine.compute_pred_replicated(
-                    plan, decomp, grad_mats, damping, self.method,
-                    scales=decomp.get('scales') if self.ekfac else None)
+                    plan, pred_decomp, grad_mats, damping, self.method,
+                    scales=pred_decomp.get('scales') if self.ekfac else None)
             else:
                 preds = engine.compute_pred_local(
-                    plan, decomp, grad_mats, damping, self.method, axis_name,
+                    plan, pred_decomp, grad_mats, damping, self.method,
+                    axis_name,
                     communicate=not self.exclude_communicate_inverse,
-                    scales=decomp.get('scales') if self.ekfac else None)
+                    scales=pred_decomp.get('scales') if self.ekfac else None)
 
         new_grads = engine.preconditioned_grads(
             plan, grads, grad_mats, preds, lr, self.kl_clip,

@@ -44,6 +44,14 @@ class KFACParamScheduler:
         f = self.update_freq_factor_func(self.epoch)
         self.kfac.fac_update_freq = max(1, int(self.fac_update_freq_base * f))
         self.kfac.kfac_update_freq = max(1, int(self.kfac_update_freq_base * f))
+        # staggered refresh: the cohort layout is derived from
+        # kfac_update_freq (one cohort per step of the window) — a
+        # rescaled frequency must rebase it, like the staleness-based
+        # last_full_step rebase of should_update_basis. No-op when
+        # stagger is off or the frequency didn't change.
+        rebase = getattr(self.kfac, 'rebase_cohorts', None)
+        if rebase is not None:
+            rebase()
 
     def step(self, epoch=None):
         """Advance to ``epoch`` (or by one) and update the wrapped KFAC's

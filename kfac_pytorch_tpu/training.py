@@ -172,7 +172,15 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
 
     Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
     dispatches between up to four compiled variants using the
-    preconditioner's host-side update frequencies.
+    preconditioner's host-side update frequencies. With a
+    ``KFAC(stagger=True)`` preconditioner, the first inverse update is
+    still one full decomposition; afterwards every step dispatches the
+    staggered variant (traced cohort index — the variant count does not
+    grow with ``kfac_update_freq``), and the dispatch rebases the cohort
+    layout whenever the scheduler or straggler governor rescaled the
+    frequency. ``step_fn.last_phases`` names the K-FAC phases the last
+    dispatch ran ('pred'/'stats'/'decomp'/'gather') for
+    ``utils.metrics.PhaseTimers``.
     """
     if fisher_type not in ('Femp', 'F1mc'):
         raise ValueError(f'fisher_type must be Femp or F1mc, '
@@ -194,7 +202,8 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         fisher_sample_fn = sample_pseudo_labels
 
     def one_step(state, batch, hyper, update_factors, update_inverse,
-                 update_basis=True, warm_basis=False, factors_only=False):
+                 update_basis=True, warm_basis=False, factors_only=False,
+                 stagger_update=False):
         x = batch['input']
         variables = {'params': state.params, **state.extra_vars}
         use_capture = precond is not None and update_factors
@@ -279,6 +288,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                     update_inverse=update_inverse,
                     update_basis=update_basis,
                     warm_basis=warm_basis, factors_only=factors_only,
+                    stagger_update=stagger_update,
                     axis_name=axis_name)
                 if health_cfg is None:
                     new_grads = pgrads
@@ -344,12 +354,14 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     state_specs_cache = {}
 
     def make_variant(update_factors, update_inverse, update_basis=True,
-                     warm_basis=False, factors_only=False):
+                     warm_basis=False, factors_only=False,
+                     stagger_update=False):
         fn = functools.partial(one_step, update_factors=update_factors,
                                update_inverse=update_inverse,
                                update_basis=update_basis,
                                warm_basis=warm_basis,
-                               factors_only=factors_only)
+                               factors_only=factors_only,
+                               stagger_update=stagger_update)
         if axis_name is None:
             return jax.jit(fn, donate_argnums=(0,) if donate else ())
         kspecs = (precond.state_pspecs(axis_name) if precond is not None
@@ -402,6 +414,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                 state.kfac_state is not None
                 and any(bool(jnp.any(x != 0))
                         for x in jax.tree.leaves(state.kfac_state.decomp)))
+        st = False
         if precond is None:
             uf = ui = False
             ub, warm = True, False
@@ -414,26 +427,60 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             # reference would have no factors to read at all here).
             enabled = getattr(precond, 'hook_enabled', True)
             uf = enabled and precond.should_update_factors(step)
-            ui = enabled and precond.should_update_inverse(step)
-            # eigenvalue-only refresh needs a basis to refresh: the first
-            # inverse update of this run is always a full decomposition
-            # (no last_full yet — covers fresh starts and resumes alike)
-            ub = (not seen_inverse['yes']
-                  or precond.should_update_basis(
-                      step, seen_inverse.get('last_full')))
-            warm = _warm_basis_gate(precond, seen_inverse, step, ui, ub)
-            seen_inverse['yes'] = seen_inverse['yes'] or ui
-            if not ui:
-                ub, warm = True, False  # unused without an inverse update
-            if not ub:
-                warm = False            # refresh path has no eigh to warm
+            st = (getattr(precond, 'stagger', False) and enabled
+                  and seen_inverse['yes'])
+            if st:
+                # staggered refresh: after the first (full) decomposition
+                # EVERY step decomposes one cost-balanced cohort — the
+                # cohort index is traced, so this is ONE compiled variant
+                # per uf setting, not one per cohort
+                ui, ub, warm = False, True, False
+            else:
+                ui = enabled and precond.should_update_inverse(step)
+                # eigenvalue-only refresh needs a basis to refresh: the
+                # first inverse update of this run is always a full
+                # decomposition (no last_full yet — covers fresh starts,
+                # resumes, and the stagger cold start alike)
+                ub = (not seen_inverse['yes']
+                      or precond.should_update_basis(
+                          step, seen_inverse.get('last_full')))
+                warm = _warm_basis_gate(precond, seen_inverse, step, ui, ub)
+                seen_inverse['yes'] = seen_inverse['yes'] or ui
+                if not ui:
+                    ub, warm = True, False  # unused w/o an inverse update
+                if not ub:
+                    warm = False        # refresh path has no eigh to warm
         key = (uf, ui, ub, warm)
+        if st:
+            # the cohort layout derives from kfac_update_freq: a
+            # scheduler/straggler rescale rebases it here, and the cohort
+            # count rides in the cache key so the rebuilt (static) tables
+            # get a fresh trace — same freq back again reuses the old one
+            layout = precond.rebase_cohorts()
+            key = (uf, 'stagger', layout.num_cohorts)
+            if key not in variants:
+                variants[key] = make_variant(uf, False, stagger_update=True)
         if precond is not None and not seen_inverse['yes']:
             key = (uf, False, 'factors_only')
             if key not in variants:
                 variants[key] = make_variant(uf, False, factors_only=True)
         if key not in variants:
             variants[key] = make_variant(uf, ui, ub, warm)
+        # host-visible phase set of THIS dispatch (consumed by
+        # utils.metrics.PhaseTimers for the kfac_phase_ms epoch suffix)
+        if precond is None:
+            step_fn.last_phases = ()
+        elif not seen_inverse['yes']:
+            step_fn.last_phases = ('stats',) if uf else ()
+        else:
+            ph = ['pred']
+            if uf:
+                ph.append('stats')
+            if ui or st:
+                ph.append('decomp')
+                if precond.comm_mode == 'inverse':
+                    ph.append('gather')
+            step_fn.last_phases = tuple(ph)
         hyper = KFACHyperParams(
             lr=jnp.float32(lr if lr is not None
                            else getattr(precond, 'lr', 0.0)),
@@ -470,6 +517,11 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     # saved). Pinned by tests/test_training.py::
     # test_warm_tracking_resume_semantics.
     step_fn.warm_tracking = seen_inverse
+    # which K-FAC phases the LAST dispatch ran ('pred'/'stats'/'decomp'/
+    # 'gather') — host-side knowledge the examples feed to
+    # utils.metrics.PhaseTimers together with the step's wall time, so
+    # epoch lines can attribute time per phase (runlog.kfac_phase_suffix)
+    step_fn.last_phases = ()
     # the jitted variant cache + constructor, exposed for introspection:
     # scripts/comm_count.py builds a variant via make_variant and lowers
     # it WITHOUT executing a step (AOT lower/compile only)

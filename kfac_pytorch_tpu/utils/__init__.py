@@ -1,6 +1,7 @@
 """Harness utilities — parity with the reference's examples/utils.py."""
 
-from kfac_pytorch_tpu.utils.metrics import Metric, HealthMonitor, accuracy
+from kfac_pytorch_tpu.utils.metrics import (
+    Metric, HealthMonitor, PhaseTimers, accuracy)
 from kfac_pytorch_tpu.utils.lr import (
     warmup_multistep, polynomial_decay, inverse_sqrt)
 from kfac_pytorch_tpu.utils.losses import (
@@ -13,7 +14,7 @@ from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
 __all__ = [
-    'Metric', 'HealthMonitor', 'accuracy', 'warmup_multistep',
+    'Metric', 'HealthMonitor', 'PhaseTimers', 'accuracy', 'warmup_multistep',
     'polynomial_decay',
     'inverse_sqrt', 'label_smoothing_cross_entropy', 'sample_pseudo_labels',
     'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',

@@ -84,6 +84,75 @@ class HealthMonitor:
         return out
 
 
+class PhaseTimers:
+    """Host-side per-step wall-time attribution by K-FAC phase set
+    (beyond reference — the staggered-refresh observability companion).
+
+    Under jit every K-FAC phase fuses into one program, so per-phase
+    time cannot be read off the device per step; what the host CAN see
+    is which phases each dispatched variant ran
+    (``step_fn.last_phases``: 'pred'/'stats'/'decomp'/'gather') and the
+    step's wall time. The timers bucket wall times by phase set and at
+    ``epoch_flush`` derive marginal per-phase costs by subtraction
+    between observed sets — the passive, in-run form of the
+    exclude-parts ablation method (utils/profiling.
+    exclude_parts_breakdown). A set with no observed strict subset
+    reports its joint mean under a '+'-joined label (e.g. a staggered
+    fac-freq-1 run, where every step runs everything, honestly reports
+    one ``decomp+gather+pred+stats`` figure).
+
+    ``step_max``/``step_mean`` always ride along: the refresh spike —
+    and its removal under ``stagger=True`` — is visible as
+    ``step_max/step_mean`` collapsing toward 1 in the epoch lines
+    (runlog.kfac_phase_suffix formats the dict).
+    """
+
+    def __init__(self):
+        self._acc = {}
+        self._max = 0.0
+        self._total = 0.0
+        self._n = 0
+
+    def record(self, phases, seconds):
+        """One step's wall time, attributed to its phase set. Call with
+        the COMPLETED step's duration (time around the dispatch plus the
+        blocking metric read that materializes it)."""
+        key = frozenset(phases)
+        tot, n = self._acc.get(key, (0.0, 0))
+        self._acc[key] = (tot + seconds, n + 1)
+        self._total += seconds
+        self._n += 1
+        self._max = max(self._max, seconds)
+
+    def epoch_flush(self):
+        """Per-epoch ``{label: ms}`` (resets the accumulators): marginal
+        per-phase costs where a baseline set was observed, joint means
+        otherwise, plus ``step_mean``/``step_max``. Empty dict when
+        nothing was recorded."""
+        means = {k: t / n for k, (t, n) in self._acc.items()}
+        out = {}
+        for s in sorted(means, key=lambda k: (len(k), sorted(k))):
+            bases = [b for b in means if b < s]
+            if bases:
+                # deterministic base pick; and the FIRST derivation of a
+                # label wins — smaller sets are flushed first and their
+                # baselines are the better-sampled ones (a refresh step's
+                # 'stats' marginal would be the noisiest estimate)
+                base = max(bases, key=lambda b: (len(b), tuple(sorted(b))))
+                label = '+'.join(sorted(s - base))
+                val = max(means[s] - means[base], 0.0)
+            else:
+                label = '+'.join(sorted(s)) if s else 'step'
+                val = means[s]
+            if label and label not in out:
+                out[label] = val
+        if self._n:
+            out['step_mean'] = self._total / self._n
+            out['step_max'] = self._max
+        self._acc, self._max, self._total, self._n = {}, 0.0, 0.0, 0
+        return {k: v * 1000.0 for k, v in out.items()}
+
+
 class Metric:
     """Weighted running average of scalars (loss, accuracy)."""
 

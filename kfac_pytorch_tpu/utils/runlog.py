@@ -130,6 +130,24 @@ def health_suffix(epoch_counts):
                epoch_counts['max_rung']))
 
 
+def kfac_phase_suffix(phase_ms):
+    """Format per-phase K-FAC step timing for the epoch line.
+
+    ``phase_ms`` is ``metrics.PhaseTimers.epoch_flush()``'s dict
+    (stats/decomp/gather/pred marginals in ms, plus step_mean/step_max).
+    Empty input formats to '' (no timers wired / nothing recorded);
+    otherwise e.g. `` kfac_phase_ms=decomp+gather:3.1,pred:1.2,``
+    ``stats:0.4,step_max:6.0,step_mean:4.8`` — grep run logs for
+    ``kfac_phase_ms=`` to track where step time goes; the staggered
+    refresh's win shows as step_max collapsing onto step_mean (no more
+    periodic decomposition spike).
+    """
+    if not phase_ms:
+        return ''
+    body = ','.join(f'{k}:{v:.2f}' for k, v in sorted(phase_ms.items()))
+    return f' kfac_phase_ms={body}'
+
+
 def counter_deltas(now, prev):
     """Per-epoch view of cumulative resilience counters: ``now - prev``
     per key, except ``*_level`` keys which are gauges (current ladder
