@@ -357,10 +357,32 @@ def _micro_bench():
     }
 
 
+def _attach_drift(extra, measured=None, variant='inverse_dp',
+                  platform=None, source=None):
+    """Attach the measured-vs-predicted ``drift`` block (obs.drift) to
+    the bench extras. Never raises — every future BENCH JSON carries
+    measured-vs-predicted (or the in-band error), even on CPU rounds
+    (then clearly ``comparable: false``)."""
+    try:
+        from kfac_pytorch_tpu.obs import drift as obs_drift
+        if measured is None:
+            measured = obs_drift.measured_from_bench_extras(extra)
+        extra['drift'] = obs_drift.drift_block(
+            measured, extra.get('predicted'), platform=platform,
+            variant=variant, source=source)
+    except Exception as e:  # noqa: BLE001 — the bench must still emit
+        traceback.print_exc(file=sys.stderr)
+        extra['drift'] = {'measured_vs_predicted': True,
+                          'error': f'{type(e).__name__}: {e}'}
+
+
 def _run_micro_mode():
     """BENCH_MICRO=1 entrypoint: emit the micro-bench as the round's
     metric (one JSON line, the standard partial-emission contract)."""
     _install_partial_emitter()
+    # same stable-key contract as main(): drift is an explicit null
+    # until (and unless) the measured-vs-predicted block computes
+    PARTIAL['extra']['drift'] = None
     _checkpoint()
     try:
         micro = _micro_bench()
@@ -368,6 +390,18 @@ def _run_micro_mode():
         PARTIAL['unit'] = 'samples/s'
         PARTIAL['extra']['platform'] = 'cpu_fallback'
         PARTIAL['extra']['micro'] = micro
+        # the drift schema runs on every round: the micro phases vs the
+        # analytic model (advisory on this platform by construction)
+        try:
+            from kfac_pytorch_tpu import perfmodel
+            from kfac_pytorch_tpu.obs import drift as obs_drift
+            PARTIAL['extra']['predicted'] = perfmodel.predict_block()
+            _attach_drift(PARTIAL['extra'],
+                          measured=obs_drift.micro_measured(micro),
+                          variant='eigen_dp', platform='cpu_fallback',
+                          source='micro')
+        except Exception:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
         _checkpoint()
         _emit(PARTIAL, exit_code=0)
     except BaseException as e:  # noqa: BLE001 — the JSON line must go out
@@ -558,6 +592,11 @@ def _run(devices):
     if os.environ.get('BENCH_BREAKDOWN'):
         extra['phase_breakdown_s'] = _optional(
             lambda: _phase_breakdown(model, tx, batch))
+    _attach_drift(extra, measured=None, variant='inverse_dp',
+                  platform=extra.get('device_kind'),
+                  source='bench_legs' + ('+phase_breakdown'
+                                         if extra.get('phase_breakdown_s')
+                                         else ''))
     _checkpoint()
 
     return PARTIAL
@@ -585,6 +624,9 @@ def main():
         traceback.print_exc(file=sys.stderr)
         PARTIAL['extra']['predicted'] = {'predicted_not_measured': True,
                                          'error': repr(e)}
+    # stable key contract: a round that dies before any measurement
+    # reads drift as an explicit null, never an absent key
+    PARTIAL['extra']['drift'] = None
     # overwrite any previous run's checkpoint file BEFORE probing: if this
     # run dies emit-less inside backend init, the queue must read an
     # honest null, not the prior run's numbers
@@ -616,6 +658,11 @@ def main():
                 PARTIAL['unit'] = micro.get('unit', 'samples/s')
                 PARTIAL['extra']['platform'] = 'cpu_fallback'
                 PARTIAL['extra']['micro'] = micro['extra'].get('micro')
+                # the child computed measured-vs-predicted over its own
+                # micro phases; carry it so even a tunnel-down round's
+                # JSON pairs a measurement with the analytic model
+                if micro['extra'].get('drift') is not None:
+                    PARTIAL['extra']['drift'] = micro['extra']['drift']
                 # the hang stays on record, but as context — the metric
                 # itself is real (measured, on the fallback platform)
                 PARTIAL['extra']['backend_error'] = PARTIAL.pop('error')

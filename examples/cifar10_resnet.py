@@ -119,6 +119,16 @@ def parse_args():
     p.add_argument('--io-retries', type=int, default=3,
                    help='retry budget for checkpoint I/O and next-batch '
                         'transients (0 = fail fast)')
+    # observability (kfac_pytorch_tpu/obs/)
+    p.add_argument('--trace', default=None, metavar='DIR',
+                   help='write Chrome-trace spans (per-step phase spans, '
+                        'resilience instants) to DIR/trace-host<i>.jsonl '
+                        'and epoch metric snapshots to '
+                        'DIR/metrics.jsonl; merge a pod\'s files with '
+                        'kfac-obs (defaults to $KFAC_TRACE_DIR when set)')
+    p.add_argument('--prom-file', default=None, metavar='PATH',
+                   help='export the metrics registry as a Prometheus '
+                        'textfile at PATH after every epoch (rank 0)')
     return p.parse_args()
 
 
@@ -242,12 +252,22 @@ def main():
     if args.step_deadline > 0:
         watchdog = resilience.StepWatchdog(args.step_deadline, log=log)
 
+    # observability: trace recorder (per-step spans + resilience
+    # instants, flushed on the runlog SIGTERM/atexit chain) and the
+    # metrics registry that renders the epoch-line suffixes and feeds
+    # the exporters (obs/)
+    from kfac_pytorch_tpu import obs
+    tracer, reg = obs.setup_trainer(trace_dir=args.trace,
+                                    prom_file=args.prom_file,
+                                    governor=governor)
+
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
                                      extra_mutable=('batch_stats',),
                                      fisher_type=args.kfac_type,
                                      fisher_seed=args.seed,
-                                     straggler=governor, heartbeat=hb)
+                                     straggler=governor, heartbeat=hb,
+                                     tracer=tracer)
 
     @jax.jit
     def eval_step(params, extra_vars, batch):
@@ -265,25 +285,31 @@ def main():
         profiling.speed_report(
             log, step, state, batch, len(batch['label']), unit='imgs/sec',
             iters=SPEED_ITERS, kw_fn=lambda i: dict(lr=lr_fn(i)),
-            damping=precond.damping if precond else 0.0)
+            tracer=tracer, damping=precond.damping if precond else 0.0)
         return
 
     from kfac_pytorch_tpu.utils.summary import log_epoch_scalars, maybe_writer
     tb = maybe_writer(args.tb_dir)
+    if tb is not None:
+        # the registry's scalars land in the same event files the loss/
+        # lr scalars already use (one TensorBoard run per trainer run)
+        reg.add_exporter(obs.metrics.TensorBoardExporter(tb))
     guard = utils.PreemptionGuard()
     # health-guard event log: skipped batches / ladder escalations surface
     # as WARNINGs at the step they happen, plus a per-epoch summary suffix
-    monitor = utils.HealthMonitor(log, state=state)
+    # (published through the registry)
+    monitor = utils.HealthMonitor(log, state=state, registry=reg)
     # per-phase step timing (stats/decomp/gather/pred) for the epoch
     # lines — makes the refresh spike (and its removal under
-    # --kfac-stagger) visible as step_max vs step_mean
-    timers = utils.PhaseTimers()
+    # --kfac-stagger) visible as step_max vs step_mean; with a tracer,
+    # every step also lands as a kfac.step span
+    timers = utils.PhaseTimers(tracer=tracer, registry=reg,
+                               histogram=True)
     if args.checkpoint_dir:
         # world-size stamp: lets a shrunken pod's relaunch route this
         # run's checkpoints through the factor reshard (elastic_resume)
         utils.write_world_stamp(args.checkpoint_dir, args.num_devices)
     lr_now = args.base_lr
-    res_prev = {}
     for epoch in range(start_epoch, args.epochs):
         train_loss = utils.Metric('train_loss')
         t0 = time.time()
@@ -342,20 +368,17 @@ def main():
         # and reuse the values in the rank-0-only tb block below
         tl, vl_avg, va_avg = (train_loss.sync().avg, val_loss.sync().avg,
                               val_acc.sync().avg)
-        from kfac_pytorch_tpu.utils.runlog import (counter_deltas,
-                                                   health_suffix,
-                                                   kfac_phase_suffix,
-                                                   resilience_suffix)
-        res_now = resilience.counters.snapshot()
-        if governor is not None:
-            res_now.update(governor.counts())
-        res_delta, res_prev = counter_deltas(res_now, res_prev), res_now
+        # one registry call replaces the old hand-plumbed health /
+        # resilience / kfac_phase suffix juggling — byte-identical
+        # rendering (obs.metrics.Registry.epoch_suffixes, pinned by
+        # tests/test_obs.py)
         log.info('epoch %d: train_loss %.4f val_loss %.4f val_acc %.4f '
-                 '(%.1fs)%s%s%s', epoch, tl, vl_avg, va_avg,
-                 time.time() - t0,
-                 health_suffix(monitor.epoch_flush()),
-                 resilience_suffix(res_delta),
-                 kfac_phase_suffix(timers.epoch_flush()))
+                 '(%.1fs)%s', epoch, tl, vl_avg, va_avg,
+                 time.time() - t0, reg.epoch_suffixes())
+        monitor.epoch_flush()  # reset the monitor's own epoch window
+        reg.export(step=epoch)
+        if tracer is not None:
+            tracer.flush()
         log_epoch_scalars(tb, epoch, tl, lr_now, vl_avg, va_avg)
         if scheduler is not None:
             scheduler.step(epoch + 1)
@@ -380,6 +403,9 @@ def main():
         watchdog.stop()
     if hb is not None:
         hb.stop()
+    if tracer is not None:
+        tracer.flush()
+    reg.close()
 
 
 if __name__ == '__main__':

@@ -1,0 +1,359 @@
+"""``kfac-obs`` — one clock-aligned pod timeline from per-host debris.
+
+After an incident a pod leaves its story scattered across artifacts
+with three different clocks and four different shapes: per-host trace
+JSONL (``obs.trace``, wall-clock microseconds), run logs (``asctime``
+prefixes on the supervisor lines, bare protocol prints from the
+trainers), and ``incident-host*.json`` (epoch-second ``wall`` fields on
+live events, clockless scraped ones). This module merges them into ONE
+ordered timeline — the ROADMAP "incident reports aggregated across
+hosts into one pod-level timeline" item — usable directly on the
+two-process chaos drills::
+
+    kfac-obs lease/ host0.out host1.out -o timeline.json \\
+        --trace-out pod_trace.json
+
+Clock alignment: every event is placed on the wall-clock axis. Events
+that carry no timestamp of their own (a trainer's bare protocol line)
+inherit the nearest preceding timestamped event of the SAME source
+(carry-forward, micro-tiebroken by line order), so intra-source order
+is always preserved and cross-source order is as good as the artifact's
+own clock. Hosts on one machine (the drills) share a clock exactly;
+across real hosts the residual skew is NTP-bounded — trace files embed
+``clock_sync`` (wall, monotonic) pairs so a future offset-solver has
+its inputs, and ``--offset host=secs`` applies a manual correction
+today.
+
+Outputs: a human timeline on stdout, ``-o`` a JSON timeline, and
+``--trace-out`` a merged Chrome/Perfetto trace (every host as a
+process row, log/incident events injected as instants).
+
+Zero dependencies; shares the event grammar with
+``resilience.incident`` (same regexes — one source of truth).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+from kfac_pytorch_tpu.resilience.incident import EVENT_PATTERNS, _coerce
+
+#: logging's default asctime prefix: '2026-08-03 12:34:56,789'
+_ASCTIME = re.compile(r'^(\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}),(\d{3})')
+
+#: trainer protocol lines (tests/chaos_trainer.py contract) — events the
+#: incident scraper does not classify but a timeline should show
+_PROTOCOL = (
+    ('epoch_done', re.compile(
+        r'^EPOCH (?P<epoch>\d+) step=(?P<step>\d+) loss=(?P<loss>[\d.nan]+)')),
+    ('run_done', re.compile(
+        r'^DONE final_step=(?P<step>\d+) epochs=(?P<epochs>\d+)')),
+)
+
+_HOST_HINT = re.compile(r'host[-_]?(\d+)')
+
+
+def _parse_asctime(line):
+    m = _ASCTIME.match(line)
+    if not m:
+        return None
+    try:
+        t = time.mktime(time.strptime(m.group(1), '%Y-%m-%d %H:%M:%S'))
+        return t + int(m.group(2)) / 1e3
+    except (ValueError, OverflowError):
+        return None
+
+
+def _host_from_name(path):
+    m = _HOST_HINT.search(os.path.basename(str(path)))
+    return int(m.group(1)) if m else None
+
+
+def load_runlog(path, host=None):
+    """Scrape one run log into timeline events: every incident-grammar
+    match plus the trainer protocol lines, each stamped with the line's
+    own asctime when present."""
+    if host is None:
+        host = _host_from_name(path)
+    events = []
+    with open(path, errors='replace') as f:
+        for lineno, line in enumerate(f, 1):
+            wall = _parse_asctime(line)
+            for kind, pat in (*EVENT_PATTERNS, *_PROTOCOL):
+                m = pat.search(line)
+                if not m:
+                    continue
+                detail = {k: _coerce(v) for k, v in m.groupdict().items()
+                          if v is not None}
+                events.append({'wall': wall, 'host': host, 'kind': kind,
+                               'detail': detail, 'source': str(path),
+                               'line': lineno})
+    return events
+
+
+def load_incident(path, host=None):
+    """One incident-host*.json -> timeline events (live events carry
+    wall already; scraped ones are clockless and inherit by position)."""
+    with open(path) as f:
+        report = json.load(f)
+    if host is None:
+        host = report.get('host_id')
+        if host is None:
+            host = _host_from_name(path)
+    events = []
+    for i, e in enumerate(report.get('events', ())):
+        e = dict(e)
+        kind = e.pop('kind', 'event')
+        wall = e.pop('wall', None)
+        events.append({'wall': wall, 'host': host, 'kind': kind,
+                       'detail': e, 'source': str(path), 'line': i + 1})
+    return events
+
+
+def load_trace(path, host=None, spans=False):
+    """One trace JSONL -> (timeline events, raw chrome events).
+
+    Instants become timeline events; spans are summarized per name
+    (count + total duration) unless ``spans=True`` lifts each one into
+    the timeline. Malformed lines are skipped with a count — a
+    ring-buffer file truncated mid-write must still aggregate."""
+    raw = []
+    events = []
+    span_acc = {}
+    bad = 0
+    with open(path, errors='replace') as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                evt = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if not isinstance(evt, dict) or 'ph' not in evt:
+                # JSONL that is not Chrome-trace-shaped (e.g. the
+                # registry's metrics.jsonl living in the same --trace
+                # dir) must not leak junk rows into the merged trace
+                bad += 1
+                continue
+            raw.append(evt)
+            pid = evt.get('pid')
+            h = host if host is not None else pid
+            ph = evt.get('ph')
+            wall = (evt['ts'] / 1e6 if isinstance(
+                evt.get('ts'), (int, float)) and evt['ts'] > 0 else None)
+            if ph == 'i' and evt.get('name') != 'clock_sync':
+                events.append({'wall': wall, 'host': h,
+                               'kind': evt.get('name', 'instant'),
+                               'detail': dict(evt.get('args') or {}),
+                               'source': str(path), 'line': lineno})
+            elif ph == 'X':
+                if spans:
+                    events.append({'wall': wall, 'host': h,
+                                   'kind': 'span:' + evt.get('name', '?'),
+                                   'detail': {
+                                       'dur_ms': round(
+                                           evt.get('dur', 0) / 1e3, 3),
+                                       **(evt.get('args') or {})},
+                                   'source': str(path), 'line': lineno})
+                else:
+                    name = evt.get('name', '?')
+                    cnt, dur = span_acc.get((h, name), (0, 0.0))
+                    span_acc[(h, name)] = (cnt + 1,
+                                           dur + evt.get('dur', 0))
+    for (h, name), (cnt, dur) in sorted(span_acc.items()):
+        events.append({'wall': None, 'host': h, 'kind': 'span_summary',
+                       'detail': {'name': name, 'count': cnt,
+                                  'total_ms': round(dur / 1e3, 3)},
+                       'source': str(path), 'line': 0})
+    if bad:
+        events.append({'wall': None, 'host': host, 'kind': 'parse_errors',
+                       'detail': {'lines_skipped': bad},
+                       'source': str(path), 'line': 0})
+    return events, raw
+
+
+def classify(path):
+    """'trace' | 'incident' | 'log' by extension and shape."""
+    if str(path).endswith('.jsonl'):
+        return 'trace'
+    if str(path).endswith('.json'):
+        try:
+            with open(path) as f:
+                head = json.load(f)
+            if isinstance(head, dict) and 'events' in head:
+                return 'incident'
+        except (OSError, ValueError):
+            pass
+        return 'log'
+    return 'log'
+
+
+def expand_paths(paths):
+    """Directories expand to their trace/incident/log artifacts."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for pat in ('*.jsonl', 'incident*.json', '*.log', '*.out'):
+                out.extend(sorted(glob.glob(os.path.join(p, pat))))
+        else:
+            out.append(p)
+    return out
+
+
+def build_timeline(paths, offsets=None, spans=False):
+    """Merge artifacts into one ordered timeline.
+
+    Returns ``{'sources': [...], 'events': [...]}`` with events sorted
+    on the aligned wall clock. ``offsets``: {host: seconds} added to
+    that host's timestamps before merging (manual skew correction)."""
+    offsets = offsets or {}
+    sources = []
+    all_events = []
+    trace_events = []
+    for idx, path in enumerate(expand_paths(paths)):
+        kind = classify(path)
+        sources.append({'path': str(path), 'kind': kind})
+        if kind == 'trace':
+            evts, raw = load_trace(path, spans=spans)
+            trace_events.extend(raw)
+        elif kind == 'incident':
+            evts = load_incident(path)
+        else:
+            evts = load_runlog(path)
+        # carry-forward clock alignment within the source: a clockless
+        # event inherits the nearest preceding timestamped one plus a
+        # micro-offset preserving line order; clockless events BEFORE
+        # the source's first timestamp sit just before it (still in
+        # order), so intra-source causality is never inverted
+        last, last_idx = None, 0
+        for i, e in enumerate(evts):
+            if e['wall'] is not None:
+                last, last_idx = e['wall'], i
+                e['wall_aligned'] = e['wall']
+            elif last is not None:
+                e['wall_aligned'] = last + (i - last_idx) * 1e-6
+            else:
+                e['wall_aligned'] = None
+        lead = [e for e in evts if e['wall_aligned'] is None]
+        first = next((e['wall_aligned'] for e in evts
+                      if e['wall_aligned'] is not None), None)
+        if first is not None:
+            for j, e in enumerate(lead):
+                e['wall_aligned'] = first - (len(lead) - j) * 1e-6
+        for i, e in enumerate(evts):
+            off = offsets.get(e['host'])
+            if off and e['wall_aligned'] is not None:
+                e['wall_aligned'] += off
+            e['_order'] = (idx, i)
+        all_events.extend(evts)
+    all_events.sort(key=lambda e: (
+        e['wall_aligned'] if e['wall_aligned'] is not None else float('inf'),
+        e['_order']))
+    for e in all_events:
+        e.pop('_order', None)
+    return {'sources': sources, 'events': all_events,
+            '_trace_events': trace_events}
+
+
+def merged_chrome_trace(timeline):
+    """One Perfetto-loadable trace: every host a process row, raw trace
+    events as-is, and every non-trace timeline event injected as an
+    instant so the incident story sits on the same canvas as the step
+    spans."""
+    events = list(timeline.get('_trace_events', ()))
+    seen_pids = {e.get('pid') for e in events}
+    for e in timeline['events']:
+        if e['source'].endswith('.jsonl'):
+            continue  # already present as a raw trace event
+        wall = e.get('wall_aligned')
+        if wall is None:
+            continue
+        pid = e['host'] if isinstance(e['host'], int) else -1
+        if pid not in seen_pids:
+            seen_pids.add(pid)
+            events.append({'ph': 'M', 'name': 'process_name', 'pid': pid,
+                           'tid': 0, 'ts': 0,
+                           'args': {'name': f'host{pid}'
+                                    if pid >= 0 else 'unattributed'}})
+        events.append({'name': e['kind'], 'ph': 'i', 's': 'p',
+                       'cat': 'timeline', 'ts': wall * 1e6, 'pid': pid,
+                       'tid': 0, 'args': dict(e['detail'])})
+    return {'traceEvents': events,
+            'displayTimeUnit': 'ms'}
+
+
+def render(timeline, limit=None):
+    """Human form: one line per event, local-clock stamped."""
+    events = timeline['events']
+    lines = [f'pod timeline — {len(events)} events from '
+             f'{len(timeline["sources"])} source(s)']
+    shown = events if limit is None else events[:limit]
+    for e in shown:
+        wall = e.get('wall_aligned')
+        stamp = (time.strftime('%H:%M:%S', time.localtime(wall))
+                 + f'.{int(wall % 1 * 1000):03d}' if wall is not None
+                 else '--:--:--.---')
+        host = f'host{e["host"]}' if e['host'] is not None else 'host?'
+        detail = ' '.join(f'{k}={v}' for k, v in e['detail'].items())
+        lines.append(f'  {stamp}  {host:<6} {e["kind"]:<20} {detail}')
+    if limit is not None and len(events) > limit:
+        lines.append(f'  ... {len(events) - limit} more')
+    return '\n'.join(lines)
+
+
+def _parse_offset(value):
+    try:
+        host, secs = value.split('=', 1)
+        return int(host), float(secs)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f'offset must be HOST=SECONDS, got {value!r}') from None
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='kfac-obs',
+        description='Merge per-host trace JSONL, run logs and incident '
+                    'reports into one clock-aligned pod timeline.')
+    p.add_argument('paths', nargs='+',
+                   help='artifacts or directories (dirs expand to '
+                        '*.jsonl, incident*.json, *.log, *.out)')
+    p.add_argument('-o', '--out', default=None,
+                   help='write the JSON timeline here')
+    p.add_argument('--trace-out', default=None,
+                   help='write a merged Chrome/Perfetto trace here')
+    p.add_argument('--spans', action='store_true',
+                   help='lift every trace span into the timeline '
+                        '(default: spans are summarized per name)')
+    p.add_argument('--offset', type=_parse_offset, action='append',
+                   default=[], metavar='HOST=SECONDS',
+                   help='manual clock-skew correction for one host '
+                        '(repeatable)')
+    p.add_argument('--limit', type=int, default=None,
+                   help='print at most N events (full set still goes '
+                        'to -o)')
+    args = p.parse_args(argv)
+    timeline = build_timeline(args.paths, offsets=dict(args.offset),
+                              spans=args.spans)
+    print(render(timeline, limit=args.limit))
+    if args.out:
+        doc = {k: v for k, v in timeline.items()
+               if not k.startswith('_')}
+        with open(args.out, 'w') as f:
+            json.dump(doc, f, indent=2, default=str)
+        print(f'wrote {args.out}')
+    if args.trace_out:
+        with open(args.trace_out, 'w') as f:
+            json.dump(merged_chrome_trace(timeline), f)
+        print(f'wrote {args.trace_out}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

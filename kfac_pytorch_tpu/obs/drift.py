@@ -1,0 +1,209 @@
+"""Perf-model drift: measured per-phase wall time vs ``perfmodel.py``.
+
+The analytic perf model (VERDICT r4 #1) predicts per-phase seconds for
+the flagship configs under three roofline scenarios; its own contract
+says a fenced measurement outside the [optimistic, conservative] band
+falsifies it. This module closes that loop mechanically: every
+``bench.py`` emission carries a ``drift`` block pairing whatever WAS
+measured this round — full chip legs, the exclude-parts breakdown, or
+the CPU-fallback micro phases — against the matching ``predicted``
+entries, as per-phase ratios with an explicit verdict.
+
+Two honesty rules, enforced structurally:
+
+- a measurement taken on a platform the model does not describe (CPU
+  fallback, a different TPU generation) still produces ratios, but the
+  gate verdict is ``advisory`` and ``comparable: false`` rides next to
+  every number — a CPU round can never read as chip evidence;
+- a phase with no prediction (the single-chip model predicts no comm
+  phases) or no measurement reports ``null``, never a fabricated ratio.
+
+Measured inputs arrive in the exclude-parts ledger taxonomy
+(ComputeFactor / CommunicateFactor / ComputeInverse /
+CommunicateInverse, plus Model / Precondition); adapters below convert
+the two host-side sources (``PhaseTimers`` epoch dicts, ``bench.py``
+extras). Pure stdlib arithmetic — importable anywhere, pinned by
+``tests/test_obs.py`` on a synthetic predicted/measured pair.
+"""
+
+import math
+
+#: PhaseTimers label -> ledger taxonomy: the single source of truth
+#: lives next to the span emitter (both sides must speak it).
+from kfac_pytorch_tpu.obs.trace import PHASE_TAXONOMY as _TIMER_LABELS
+
+#: substrings of jax device_kind identifying the chip the model is fit
+#: for (perfmodel targets TPU v5e / "v5 lite").
+_MODEL_CHIP_KEYS = ('v5e', 'v5 lite', 'v5lite')
+
+
+def _timer_label_to_taxonomy(label):
+    """'decomp+gather' -> 'ComputeInverse+CommunicateInverse' etc."""
+    return '+'.join(_TIMER_LABELS.get(p, p) for p in label.split('+'))
+
+
+def measured_from_phase_timers(phase_ms):
+    """Convert a ``PhaseTimers.epoch_flush()`` dict (ms, host labels)
+    into ledger-taxonomy seconds. ``step_mean``/``step_max`` ride along
+    under their own names (no prediction maps to them — they stay
+    informational)."""
+    out = {}
+    for label, ms in (phase_ms or {}).items():
+        if label in ('step_mean', 'step_max'):
+            out[label] = ms / 1e3
+        else:
+            out[_timer_label_to_taxonomy(label)] = ms / 1e3
+    return out
+
+
+def measured_from_bench_extras(extra):
+    """Pull every phase-shaped measurement out of a ``bench.py`` extras
+    dict: the exclude-parts breakdown (already ledger-taxonomy) when
+    present, the SGD leg as the Model phase, and the freq-1 K-FAC
+    overhead as a joint phase when only whole-iteration legs exist."""
+    out = {}
+    bd = extra.get('phase_breakdown_s')
+    if bd:
+        for k, v in bd.items():
+            if k not in ('Total', 'Rest') and v is not None:
+                out[k] = float(v)
+    sgd = extra.get('sgd_iter_s')
+    if sgd is not None:
+        out.setdefault('Model', float(sgd))
+        inv1 = extra.get('inverse_dp_iter_s_freq1')
+        if inv1 is not None and not bd:
+            # whole-iteration difference: everything K-FAC adds at the
+            # every-step cadence, attributable no finer without the
+            # breakdown ladder
+            out['Precondition+ComputeFactor+ComputeInverse'] = max(
+                float(inv1) - float(sgd), 0.0)
+    return out
+
+
+def _predicted_phase(phases_s, name, variant):
+    """Predicted seconds for one (possibly joint) taxonomy name, or
+    None when any component has no prediction. 'ComputeInverse' binds
+    to the variant's decomposition kernel (Cholesky for inverse_*,
+    the fenced full eigh for eigen_*)."""
+    total = 0.0
+    for part in name.split('+'):
+        if part == 'ComputeInverse':
+            key = ('ComputeInverse_eigh_full' if variant.startswith('eigen')
+                   or variant.startswith('ekfac')
+                   else 'ComputeInverse_chol')
+        else:
+            key = part
+        v = phases_s.get(key)
+        if v is None:
+            return None
+        total += float(v)
+    return total
+
+
+def drift_block(measured_s, predicted_block, *, platform=None,
+                variant='inverse_dp', anchor='central', tolerance=1.0,
+                source=None):
+    """Assemble the ``drift`` block for a bench emission.
+
+    Args:
+      measured_s: {taxonomy phase: seconds} (see the adapters above).
+      predicted_block: ``perfmodel.predict_block()``'s dict (or the
+        ``extra['predicted']`` already embedded in a bench JSON).
+      platform: the measured device kind (``device_kind`` string, or
+        'cpu_fallback'); decides ``comparable``.
+      variant: which decomposition kernel the measured config ran.
+      anchor: scenario the headline ratio is taken against.
+      tolerance: multiplicative slack on the scenario band before a
+        phase counts as drifted (the gate's knob; 1.0 = the model's own
+        falsification contract).
+      source: free-form provenance string recorded in the block.
+
+    Returns a dict; never raises on malformed inputs (a drift block
+    must never take the bench down — errors are reported in-band).
+    """
+    try:
+        scenarios = (predicted_block or {}).get('scenarios') or {}
+        per_scen = {name: scen.get('phases_s', {})
+                    for name, scen in scenarios.items()
+                    if isinstance(scen, dict)}
+        comparable = bool(platform) and any(
+            k in str(platform).lower() for k in _MODEL_CHIP_KEYS)
+        phases = {}
+        violations = []
+        for name, meas in sorted((measured_s or {}).items()):
+            if meas is None:
+                continue
+            pred = {scen: _predicted_phase(ph, name, variant)
+                    for scen, ph in per_scen.items()}
+            pred = {k: v for k, v in pred.items() if v is not None}
+            entry = {'measured_s': round(float(meas), 6),
+                     'predicted_s': {k: round(v, 6)
+                                     for k, v in sorted(pred.items())}}
+            anchor_pred = pred.get(anchor)
+            if anchor_pred and anchor_pred > 0 and meas >= 0:
+                entry['ratio'] = round(meas / anchor_pred, 4)
+            else:
+                entry['ratio'] = None
+            band_vals = [v for k, v in pred.items()
+                         if k in ('optimistic', 'conservative', 'central')]
+            if band_vals and entry['ratio'] is not None:
+                lo, hi = min(band_vals), max(band_vals)
+                entry['band_s'] = [round(lo, 6), round(hi, 6)]
+                within = (lo / tolerance <= meas <= hi * tolerance)
+                entry['within_band'] = within
+                if not within:
+                    violations.append(name)
+            else:
+                entry['within_band'] = None
+            phases[name] = entry
+        if not comparable:
+            verdict = 'advisory'
+        elif violations:
+            verdict = 'drift'
+        elif any(e['within_band'] for e in phases.values()):
+            verdict = 'ok'
+        else:
+            verdict = 'no_overlap'  # nothing measured maps to a prediction
+        return {
+            'measured_vs_predicted': True,
+            'source': source,
+            'platform': platform,
+            'variant': variant,
+            'comparable': comparable,
+            'anchor_scenario': anchor,
+            'tolerance': tolerance,
+            'phases': phases,
+            'gate': {
+                'verdict': verdict,
+                'violations': violations,
+                'note': ('ratios are informational: the analytic model '
+                         'describes TPU v5e, not this platform'
+                         if not comparable else
+                         'a phase outside the [optimistic, conservative]'
+                         ' band (x tolerance) falsifies the model for '
+                         'that phase'),
+            },
+        }
+    except Exception as e:  # noqa: BLE001 — never break the bench
+        return {'measured_vs_predicted': True,
+                'error': f'{type(e).__name__}: {e}'}
+
+
+def micro_measured(micro):
+    """Adapter for the CPU-fallback micro-bench block: its steady step
+    runs model+precondition+stats fused; the unstaggered refresh step
+    adds the full decomposition, so the refresh-minus-steady marginal is
+    the ComputeInverse phase. Returns ledger-taxonomy seconds (the
+    micro model is an MLP — these numbers exercise the drift schema on
+    tunnel-down rounds and are never chip-comparable)."""
+    try:
+        un = micro['unstaggered']
+        steady = un['steady_ms'] / 1e3
+        refresh = un['refresh_ms'] / 1e3
+        out = {'Model+Precondition+ComputeFactor': steady}
+        marg = refresh - steady
+        if math.isfinite(marg) and marg >= 0:
+            out['ComputeInverse'] = marg
+        return out
+    except (KeyError, TypeError):
+        return {}

@@ -314,6 +314,16 @@ class PeerHeartbeat:
 
     def _declare_dead(self, peer, info):
         _res.counters.bump('peer_dead')
+        # guarded: the death declaration must reach the log + exit even
+        # if the trace layer is unavailable (interpreter shutdown)
+        try:
+            from kfac_pytorch_tpu.obs import trace as _trace
+            _trace.instant('peer_dead', peer=peer,
+                           detect_s=info.get('detect_s'),
+                           last_step=info.get('last_step'),
+                           never_seen=info.get('never_seen'))
+        except Exception:  # noqa: BLE001
+            pass
         # machine-greppable: the incident scraper keys off this suffix
         self.log.error(
             'heartbeat: peer %d declared dead — no heartbeat advance for '

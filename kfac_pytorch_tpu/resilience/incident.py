@@ -73,6 +73,11 @@ _PATTERNS = (
         r'step=(?P<step>\d+)')),
 )
 
+#: public name for the event grammar — ``obs.aggregate`` (the pod
+#: timeline) reuses exactly these regexes so the two consumers of the
+#: log forms can never drift apart.
+EVENT_PATTERNS = _PATTERNS
+
 _INT = re.compile(r'^-?\d+$')
 _FLOAT = re.compile(r'^-?\d+\.\d+$')
 
@@ -145,8 +150,37 @@ class IncidentReport:
         return self
 
     def scrape_path(self, path):
+        if str(path).endswith('.jsonl'):
+            return self.scrape_trace(path)
         with open(path, errors='replace') as f:
             return self.scrape_lines(f, source=path)
+
+    def scrape_trace(self, path):
+        """Scrape an ``obs.trace`` JSONL file: every resilience-category
+        instant becomes an event (same kinds the modules log — the trace
+        stream is the structured twin of the log lines, with wall
+        timestamps the log scrape lacks). Malformed lines are skipped:
+        a ring buffer cut off mid-write must still report."""
+        self.sources.append(str(path))
+        with open(path, errors='replace') as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    evt = json.loads(line)
+                except ValueError:
+                    continue
+                if evt.get('ph') != 'i' or evt.get('cat') != 'resilience':
+                    continue
+                fields = dict(evt.get('args') or {})
+                fields['source'] = str(path)
+                ts = evt.get('ts')
+                wall = (ts / 1e6 if isinstance(ts, (int, float)) and ts > 0
+                        else None)
+                self.add_event(evt.get('name', 'event'), wall=wall,
+                               **fields)
+        return self
 
     # -- rendering --------------------------------------------------------
 

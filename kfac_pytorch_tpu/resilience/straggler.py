@@ -111,6 +111,12 @@ class StragglerGovernor:
         self.level += 1
         self.degrades += 1
         _res.counters.bump('straggler_degrades')
+        try:
+            from kfac_pytorch_tpu.obs import trace as _trace
+            _trace.instant('straggler_degrade', level=self.level,
+                           ema_s=round(self.ema, 4), step=step)
+        except Exception:  # noqa: BLE001 — tracing never blocks the ladder
+            pass
         factor = self.stretch ** self.level
         self._applied = (max(1, self._saved[0] * factor),
                          max(1, self._saved[1] * factor))
@@ -143,6 +149,12 @@ class StragglerGovernor:
         self.recoveries += 1
         self._applied = None
         _res.counters.bump('straggler_recoveries')
+        try:
+            from kfac_pytorch_tpu.obs import trace as _trace
+            _trace.instant('straggler_recover', ema_s=round(self.ema, 4),
+                           step=step)
+        except Exception:  # noqa: BLE001 — tracing never blocks the ladder
+            pass
 
     def counts(self):
         return {'straggler_level': self.level,

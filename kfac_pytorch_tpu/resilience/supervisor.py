@@ -159,7 +159,18 @@ class Supervisor:
                     resilience_suffix(self.counts()))
                 return rc
             why = self._classify(rc)
+
+            def _instant(name, **args):
+                # tracing never blocks the restart loop
+                try:
+                    from kfac_pytorch_tpu.obs import trace as _trace
+                    _trace.instant(name, **args)
+                except Exception:  # noqa: BLE001
+                    pass
+
             if self.restarts >= self.max_restarts:
+                _instant('supervisor_gave_up', rc=rc, why=why,
+                         restarts=self.restarts)
                 # gave_up=1 in the counter suffix: the incident scraper
                 # (resilience.incident) keys off it — prose changes must
                 # not be able to hide a given-up run
@@ -171,6 +182,9 @@ class Supervisor:
                 return rc
             delay = self.backoff.delay(self.restarts, self.rng)
             self.restarts += 1
+            _instant('supervisor_restart', rc=rc, why=why,
+                     n=self.restarts, max=self.max_restarts,
+                     delay_s=round(delay, 2))
             self.log.warning(
                 'supervisor: trainer exited rc=%d (%s) — restart %d/%d '
                 'in %.2fs%s', rc, why, self.restarts, self.max_restarts,
@@ -208,6 +222,14 @@ def main(argv=None):
     if not logging.getLogger().handlers:
         logging.basicConfig(level=logging.INFO,
                             format='%(asctime)s %(message)s')
+    # KFAC_TRACE_DIR traces the supervisor side of a run too: restart /
+    # give-up instants land in this process's own per-host JSONL, which
+    # kfac-obs merges with the trainer's
+    try:
+        from kfac_pytorch_tpu.obs import trace as _trace
+        _trace.install_from_env(role='sup')
+    except Exception:  # noqa: BLE001 — tracing is optional
+        pass
     sup = Supervisor(cmd, max_restarts=args.max_restarts,
                      backoff_base=args.backoff_base,
                      backoff_max=args.backoff_max,

@@ -159,6 +159,17 @@ class StepWatchdog:
 
     def _expire(self, tag):
         _res.counters.bump('watchdog_trips')
+        # trace instant BEFORE the log/flush dance: the recorder flushes
+        # on the same chain as the log handlers below, so the trip event
+        # makes it into the trace file even though we hard-exit. Fully
+        # guarded — NOTHING may stand between an expired deadline and
+        # the abort action, not even a failed import at shutdown.
+        try:
+            from kfac_pytorch_tpu.obs import trace as _trace
+            _trace.instant('watchdog_trip', deadline_s=self.deadline,
+                           tag=tag, rc=self.rc)
+        except Exception:  # noqa: BLE001
+            pass
         self.log.error(
             'watchdog: step deadline exceeded (%.1fs%s) — dumping all '
             'thread stacks and exiting rc=%d so the supervisor can '

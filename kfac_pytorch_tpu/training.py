@@ -78,7 +78,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      dropout_seed=None, batch_specs=None, check_vma=None,
                      fisher_type='Femp', fisher_loss_fn=None,
                      fisher_sample_fn=None, fisher_seed=0, health='auto',
-                     straggler=None, heartbeat=None):
+                     straggler=None, heartbeat=None, tracer=None):
     """Build the per-iteration function family.
 
     Args:
@@ -169,6 +169,15 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         but keeps beating, which is exactly the split the pod needs —
         the heartbeat answers "alive?", the watchdog answers
         "progressing?".
+      tracer: an ``obs.trace.TraceRecorder`` (or None). When set, every
+        dispatch is recorded as a ``kfac.dispatch`` span carrying the
+        step index and the dispatched phase set in the exclude-parts
+        ledger taxonomy. This span covers dispatch only (the call
+        returns before the device finishes under async dispatch); the
+        full host-side step span — including the blocking metric read —
+        is ``PhaseTimers(tracer=...)``'s ``kfac.step``, so a trace
+        shows both how long the host spent submitting and how long the
+        step really took.
 
     Returns ``step_fn(state, batch, lr, damping) -> (state, metrics)``;
     dispatches between up to four compiled variants using the
@@ -487,6 +496,13 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             damping=jnp.float32(damping if damping is not None
                                 else getattr(precond, 'damping', 0.0)))
         try:
+            if tracer is not None:
+                from kfac_pytorch_tpu.obs.trace import taxonomy_phases
+                with tracer.span('kfac.dispatch', cat='kfac.step',
+                                 step=step,
+                                 phases=taxonomy_phases(
+                                     step_fn.last_phases)):
+                    return variants[key](state, batch, hyper)
             return variants[key](state, batch, hyper)
         except Exception as e:
             # per-call block_impl='pallas_interpret' cannot be seen by the

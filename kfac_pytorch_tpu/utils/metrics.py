@@ -31,11 +31,20 @@ class HealthMonitor:
     the health scalars ride along with an already-materialized result.
     """
 
-    def __init__(self, log=None, state=None):
+    def __init__(self, log=None, state=None, registry=None):
         """``state``: pass the (possibly restored) TrainState so the
         baseline starts from ITS cumulative counters — without it, a
         resumed run's first update would re-announce every pre-resume
-        skip as if it just happened."""
+        skip as if it just happened.
+
+        ``registry``: an ``obs.metrics.Registry`` — the monitor then
+        publishes ``health/skipped``, ``health/fallbacks`` (counters)
+        and ``health/max_rung`` (per-epoch watermark) so the registry's
+        ``epoch_suffixes()`` renders the same ``[health: ...]`` suffix
+        this class used to feed by hand (and exporters see the
+        cumulative counts). The restored baseline is rebased so a
+        resume's first epoch line reports only post-resume events —
+        identical to the legacy ``epoch_flush`` semantics."""
         self.log = log if log is not None else logging.getLogger(__name__)
         self.skipped = 0      # cumulative, mirrors the device counter
         self.fallbacks = 0
@@ -46,6 +55,11 @@ class HealthMonitor:
             self.fallbacks = int(h.fallbacks)
             self.rung = int(h.rung)
         self._epoch = {'skipped': 0, 'fallbacks': 0, 'max_rung': 0}
+        self.registry = registry
+        if registry is not None:
+            registry.counter('health/skipped').rebase(self.skipped)
+            registry.counter('health/fallbacks').rebase(self.fallbacks)
+            registry.watermark('health/max_rung')
 
     def update(self, metrics, step=None):
         """Consume one step's metrics dict; no-op without health/*."""
@@ -74,6 +88,10 @@ class HealthMonitor:
                 'health: recovered%s — damping ladder reset to rung %d',
                 at, rung)
         self._epoch['max_rung'] = max(self._epoch['max_rung'], rung)
+        if self.registry is not None:
+            self.registry.counter('health/skipped').set_total(skipped)
+            self.registry.counter('health/fallbacks').set_total(fallbacks)
+            self.registry.watermark('health/max_rung').set(rung)
         self.skipped, self.fallbacks, self.rung = skipped, fallbacks, rung
 
     def epoch_flush(self):
@@ -107,11 +125,31 @@ class PhaseTimers:
     (runlog.kfac_phase_suffix formats the dict).
     """
 
-    def __init__(self):
+    def __init__(self, tracer=None, registry=None, histogram=False):
+        """``tracer``: an ``obs.trace.TraceRecorder`` — every recorded
+        step then ALSO lands as a Chrome-trace span named
+        ``kfac.step``, carrying the step's phase set in the
+        exclude-parts ledger taxonomy (``obs.trace.PHASE_TAXONOMY``), so
+        the same host-side attribution this class aggregates is
+        inspectable step-by-step in Perfetto.
+
+        ``registry``: an ``obs.metrics.Registry`` — ``collect`` (or a
+        direct ``epoch_flush``-then-set) publishes the per-epoch phase
+        marginals as ``kfac_phase/*`` epoch gauges, which the registry
+        renders into the exact legacy ``kfac_phase_ms=`` suffix.
+        ``histogram=True`` additionally feeds a ``step_seconds``
+        histogram (Prometheus-shaped step-time distribution)."""
         self._acc = {}
         self._max = 0.0
         self._total = 0.0
         self._n = 0
+        self.tracer = tracer
+        self.registry = registry
+        self._histogram = histogram
+        if registry is not None:
+            registry.add_collector(self.collect)
+            if histogram:
+                registry.histogram('step_seconds')
 
     def record(self, phases, seconds):
         """One step's wall time, attributed to its phase set. Call with
@@ -123,6 +161,21 @@ class PhaseTimers:
         self._total += seconds
         self._n += 1
         self._max = max(self._max, seconds)
+        if self.tracer is not None:
+            from kfac_pytorch_tpu.obs.trace import taxonomy_phases
+            self.tracer.complete('kfac.step', seconds, cat='kfac.step',
+                                 phases=taxonomy_phases(phases))
+        if self.registry is not None and self._histogram:
+            self.registry.histogram('step_seconds').observe(seconds)
+
+    def collect(self, registry):
+        """Registry collector: flush the epoch's marginals into
+        ``kfac_phase/<label>`` epoch gauges (reset after each flush so a
+        phase set that disappears — a variant change, an idle epoch —
+        cannot leak a stale number into the next epoch line)."""
+        for label, ms in self.epoch_flush().items():
+            registry.gauge('kfac_phase/' + label,
+                           reset_on_flush=True).set(ms)
 
     def epoch_flush(self):
         """Per-epoch ``{label: ms}`` (resets the accumulators): marginal

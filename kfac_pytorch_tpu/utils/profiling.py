@@ -81,7 +81,8 @@ def fence_rtt(out, samples=3):
     return (time.perf_counter() - t0) / samples
 
 
-def time_steps(step_fn, state, batch, iters=30, warmup=5, kw_fn=None, **kw):
+def time_steps(step_fn, state, batch, iters=30, warmup=5, kw_fn=None,
+               tracer=None, **kw):
     """Mean/std steady-state iteration time (the SPEED-mode measurement,
     reference :333-344). Fences each iteration via :func:`host_fence` and
     subtracts the measured idle round-trip so per-iter times reflect
@@ -89,6 +90,10 @@ def time_steps(step_fn, state, batch, iters=30, warmup=5, kw_fn=None, **kw):
 
     kw_fn: optional ``kw_fn(i) -> dict`` of per-iteration step kwargs
     (e.g. a stepped LR schedule); merged over ``**kw``.
+    tracer: optional ``obs.trace.TraceRecorder`` — each timed iteration
+    is recorded as a ``bench.iter`` span (RTT-corrected duration, the
+    same number that enters the mean), so a SPEED run leaves a
+    per-iteration trace next to its one-line summary.
     """
     def kwargs(i):
         return {**kw, **(kw_fn(i) if kw_fn else {})}
@@ -102,12 +107,16 @@ def time_steps(step_fn, state, batch, iters=30, warmup=5, kw_fn=None, **kw):
         t0 = time.perf_counter()
         state, m = step_fn(state, batch, **kwargs(warmup + i))
         host_fence(m)
-        times.append(max(time.perf_counter() - t0 - rtt, 0.0))
+        t = max(time.perf_counter() - t0 - rtt, 0.0)
+        times.append(t)
+        if tracer is not None:
+            tracer.complete('bench.iter', t, cat='bench', i=i)
     return float(np.mean(times)), float(np.std(times)), state
 
 
 def speed_report(log, step_fn, state, batch, units_per_iter,
-                 unit='tokens/sec', iters=60, warmup=5, kw_fn=None, **kw):
+                 unit='tokens/sec', iters=60, warmup=5, kw_fn=None,
+                 tracer=None, **kw):
     """The SPEED-mode measurement + log line shared by the example
     trainers: steady-state iteration time via :func:`time_steps`, one
     canonical format (scripts/parse_logs.py parses it). Pass the REAL
@@ -115,7 +124,8 @@ def speed_report(log, step_fn, state, batch, units_per_iter,
     sequence length — not the requested batch size, which a small
     dataset may silently truncate). Returns the advanced state."""
     mean, std, state = time_steps(step_fn, state, batch, iters=iters,
-                                  warmup=warmup, kw_fn=kw_fn, **kw)
+                                  warmup=warmup, kw_fn=kw_fn,
+                                  tracer=tracer, **kw)
     log.info('SPEED: iter time %.4f +- %.4f s (%s %.1f)',
              mean, std, unit, units_per_iter / mean)
     return state

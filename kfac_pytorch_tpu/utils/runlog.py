@@ -17,10 +17,37 @@ import time
 
 _FLUSH_HOOKS_INSTALLED = False
 _PREV_SIGTERM = None
+_EXTRA_FLUSHERS = []
+
+
+def register_flusher(fn):
+    """Add a callback to the run-log flush chain (idempotent).
+
+    Everything registered here runs wherever the log handlers flush:
+    the atexit hook, the SIGTERM handler, the watchdog's pre-abort
+    flush, and any direct :func:`flush_all_handlers` call. The trace
+    ring buffer (``obs.trace``) rides this chain so a crash or
+    preemption loses neither the log tail nor the trace tail."""
+    if fn not in _EXTRA_FLUSHERS:
+        _EXTRA_FLUSHERS.append(fn)
+
+
+def unregister_flusher(fn):
+    """Remove a callback added by :func:`register_flusher`."""
+    if fn in _EXTRA_FLUSHERS:
+        _EXTRA_FLUSHERS.remove(fn)
 
 
 def flush_all_handlers():
-    """Flush every root-logger handler (best-effort)."""
+    """Flush every root-logger handler and every registered extra
+    flusher (best-effort)."""
+    # extra flushers first: the trace buffer may want to LOG that it
+    # dropped events, and the handler flush below must carry that line
+    for fn in list(_EXTRA_FLUSHERS):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — flushing is best-effort
+            pass
     for h in logging.getLogger().handlers:
         try:
             h.flush()

@@ -42,6 +42,14 @@ if [ -n "$pod" ]; then
 fi
 export JAX_COMPILATION_CACHE_DIR XLA_PYTHON_CLIENT_PREALLOCATE
 
+# Observability: KFAC_TRACE_DIR=<shared dir> turns on structured trace
+# spans in every process of the run (trainers AND supervisors each
+# write trace-host<i>[-sup].jsonl there — obs/trace.py install_from_env).
+# After a run (or an incident), merge the pod's artifacts into one
+# clock-aligned timeline:
+#   kfac-obs "$KFAC_TRACE_DIR" logs/*.log -o timeline.json
+[ -n "$KFAC_TRACE_DIR" ] && export KFAC_TRACE_DIR
+
 if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi

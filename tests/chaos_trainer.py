@@ -79,6 +79,10 @@ def main():
     logging.basicConfig(level=logging.INFO, format='%(message)s',
                         stream=sys.stdout, force=True)
     install_flush_hooks()
+    # structured tracing (KFAC_TRACE_DIR, off by default): the drills'
+    # per-host trace JSONL is what kfac-obs merges into the pod timeline
+    from kfac_pytorch_tpu.obs import trace as obs_trace
+    tracer = obs_trace.install_from_env()
 
     x, y = kdata.synthetic_classification(
         args.num_examples, (8, 8, 3), 10, seed=args.seed)
@@ -147,7 +151,7 @@ def main():
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
                                      straggler=governor,
-                                     heartbeat=heartbeat)
+                                     heartbeat=heartbeat, tracer=tracer)
     loss = float('nan')
     for epoch in range(start_epoch, args.epochs):
         for batch in loader.epoch(retry=io_retry):
@@ -162,11 +166,15 @@ def main():
         checkpoint.write_world_stamp(args.checkpoint_dir, world)
         print(f'EPOCH {epoch} step={int(state.step)} loss={loss:.4f}',
               flush=True)
+        if tracer is not None:
+            tracer.flush()
     checkpoint.wait_for_checkpoints()
     if watchdog is not None:
         watchdog.stop()
     if heartbeat is not None:
         heartbeat.stop()
+    if tracer is not None:
+        tracer.flush()
     print(f'DONE final_step={int(state.step)} epochs={args.epochs}',
           flush=True)
 

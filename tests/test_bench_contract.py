@@ -70,6 +70,16 @@ def test_bench_json_contract_and_partial_checkpoint(tmp_path):
     # numbers) — and they must have computed cleanly, not error'd
     assert extra['predicted']['predicted_not_measured'] is True
     assert 'error' not in extra['predicted'], extra['predicted']
+    # the obs.drift block pairs the measured legs with the prediction:
+    # per-phase ratios present, and a CPU smoke run is advisory-only
+    # (comparable: false) — it must never read as chip evidence
+    dr = extra['drift']
+    assert dr['measured_vs_predicted'] is True
+    assert 'error' not in dr, dr
+    assert dr['comparable'] is False
+    assert dr['gate']['verdict'] == 'advisory'
+    assert dr['phases']['Model']['measured_s'] > 0
+    assert dr['phases']['Model']['ratio'] is not None
     assert extra['eigen_dp_iter_s_freq10'] is None  # BENCH_FULL unset
     # smoke config must be marked — a partial emission of a smoke run
     # must never read as an official resnet50 number

@@ -92,9 +92,12 @@ def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
     # trainer's raw epochs are faster than the heartbeat deadline, and a
     # survivor that FINISHES before it can detect the death proves
     # nothing — with ~1.5s/step the remaining schedule is several
-    # detection windows long
+    # detection windows long. KFAC_TRACE_DIR: every trainer writes a
+    # per-host trace JSONL — the third artifact class kfac-obs merges.
+    trace_dir = tmp_path / 'trace'
     pod_env = _env(KFAC_FAULT_SLOW_STEP='0:999',
-                   KFAC_FAULT_SLOW_SECS='1.5')
+                   KFAC_FAULT_SLOW_SECS='1.5',
+                   KFAC_TRACE_DIR=str(trace_dir))
     procs = []
     try:
         with open(out0_path, 'wb') as f0, open(out1_path, 'wb') as f1:
@@ -168,3 +171,67 @@ def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
     exits = [e for e in report['events'] if e['kind'] == 'trainer_exit']
     from kfac_pytorch_tpu.resilience.heartbeat import RC_PEER_DEAD
     assert any(e.get('rc') == RC_PEER_DEAD for e in exits), exits
+
+    # kfac-obs: ONE clock-aligned pod timeline from the drill's three
+    # artifact classes (stdout runlogs, the incident report, the
+    # per-host trace JSONL) — the ROADMAP "pod-level timeline" item.
+    # Host death, heartbeat detection, shrink and reshard-resume must
+    # all be present as events, in causal order on the merged clock.
+    import glob
+
+    from kfac_pytorch_tpu.obs import aggregate
+    paths = [str(out0_path), str(out1_path),
+             str(lease / 'incident-host0.json')]
+    traces = sorted(glob.glob(str(trace_dir / '*.jsonl')))
+    assert traces, 'trainers wrote no trace JSONL under KFAC_TRACE_DIR'
+    timeline = aggregate.build_timeline(paths + traces)
+    events = timeline['events']
+    kinds = [e['kind'] for e in events]
+
+    def first(kind, **match):
+        for i, e in enumerate(events):
+            if e['kind'] == kind and all(
+                    e['detail'].get(k) == v for k, v in match.items()):
+                return i
+        raise AssertionError(
+            f'{kind} {match or ""} missing from timeline; kinds: '
+            f'{sorted(set(kinds))}')
+
+    # the dead host's death + its detection (peer named, latency carried)
+    i_dead = first('peer_dead', peer=1)
+    detect = events[i_dead]['detail'].get('detect_s')
+    assert detect and detect >= HB_DEADLINE, events[i_dead]
+    # the survivor's trainer aborting RC_PEER_DEAD (host-death fallout)
+    i_exit = first('trainer_exit', rc=RC_PEER_DEAD)
+    # the shrink agreement and the resharded resume
+    i_shrink = first('shrink')
+    i_reshard = first('resharded')
+    i_resume = first('resumed')
+    assert i_dead < i_shrink < i_reshard, (i_dead, i_shrink, i_reshard)
+    assert i_exit < i_shrink
+    assert i_reshard <= i_resume
+    # clock-aligned: the causally-ordered events carry non-decreasing
+    # aligned wall stamps (same machine here — exact clock)
+    walls = [events[i]['wall_aligned'] for i in
+             (i_dead, i_shrink, i_reshard)]
+    assert all(w is not None for w in walls), walls
+    assert walls == sorted(walls), walls
+    # per-step spans made it into the merged trace artifact
+    merged = aggregate.merged_chrome_trace(timeline)
+    assert any(e.get('ph') == 'X' and e.get('name') == 'kfac.dispatch'
+               for e in merged['traceEvents'])
+
+    # CI artifact export: keep the drill's debris + the aggregated
+    # timeline when the workflow asks for it
+    art = os.environ.get('KFAC_DRILL_ARTIFACTS')
+    if art:
+        import shutil
+        os.makedirs(art, exist_ok=True)
+        for p in paths + traces:
+            shutil.copy(p, art)
+        with open(os.path.join(art, 'timeline.json'), 'w') as f:
+            json.dump({k: v for k, v in timeline.items()
+                       if not k.startswith('_')}, f, indent=2,
+                      default=str)
+        with open(os.path.join(art, 'pod_trace.json'), 'w') as f:
+            json.dump(merged, f)
