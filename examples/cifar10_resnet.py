@@ -223,11 +223,30 @@ def main():
         pre.setup(precond.plan.metas)
         return pre
 
+    rescaled = []
+
+    def on_world_change(ow, nw):
+        # elastic shrink/grow hook: this trainer's loader produces the
+        # GLOBAL batch (args.batch_size) regardless of mesh size, so
+        # the global batch is the invariant and the linear-scaling rule
+        # leaves the lr alone (lr_factor 1) — the WORLD_RESCALE line
+        # records that for the churn timeline, and the schedule below
+        # stays exactly the checkpoint's. A deployment feeding per-host
+        # batches would pass per_host_batch= instead; a non-identity
+        # result then rebuilds the schedule from the rescaled base lr.
+        res = training.world_change_rescale(ow, nw, lr=args.base_lr,
+                                            global_batch=args.batch_size)
+        log.info(res.log_line())
+        if res.lr != args.base_lr:
+            args.base_lr = res.lr
+            rescaled.append(res)
+
     start_epoch = 0
     if args.resume and args.checkpoint_dir:
         restored, resume, old_world = resilience.elastic_resume(
             args.checkpoint_dir, args.epochs, precond, state,
-            make_precond=make_old_precond, retry=io_retry, log=log)
+            make_precond=make_old_precond, retry=io_retry,
+            on_world_change=on_world_change, log=log)
         if resume is not None:
             state = restored
             start_epoch = resume + 1
@@ -236,6 +255,16 @@ def main():
             if old_world is not None:
                 log.info('RESHARDED from_world=%d to_world=%d step=%d',
                          old_world, args.num_devices, int(state.step))
+            if rescaled:
+                # the hook actually changed the base lr (per-host-batch
+                # deployments): the schedule re-derives from it
+                lr_fn = utils.warmup_multistep(
+                    args.base_lr, steps_per_epoch, args.warmup_epochs,
+                    args.lr_decay,
+                    scale=max(1, args.num_devices * args.batch_size
+                              // 128))
+                tx = training.sgd(lr_fn, momentum=args.momentum,
+                                  weight_decay=args.wd)
             log.info('resumed from checkpoint-%d (step %d)', resume,
                      int(state.step))
     # pod peer liveness: configured by launch_tpu.sh / kfac-pod-supervise
@@ -306,9 +335,11 @@ def main():
     timers = utils.PhaseTimers(tracer=tracer, registry=reg,
                                histogram=True)
     if args.checkpoint_dir:
-        # world-size stamp: lets a shrunken pod's relaunch route this
-        # run's checkpoints through the factor reshard (elastic_resume)
-        utils.write_world_stamp(args.checkpoint_dir, args.num_devices)
+        # world-size stamp: lets a shrunken (or re-grown) pod's relaunch
+        # route this run's checkpoints through the factor reshard
+        # (elastic_resume); the generation rides along as provenance
+        utils.write_world_stamp(args.checkpoint_dir, args.num_devices,
+                                gen=os.environ.get('KFAC_POD_GEN'))
     lr_now = args.base_lr
     for epoch in range(start_epoch, args.epochs):
         train_loss = utils.Metric('train_loss')

@@ -230,10 +230,28 @@ def main():
         pre.setup(precond.plan.metas)
         return pre
 
+    rescaled = []
+
+    def on_world_change(ow, nw):
+        # elastic shrink/grow hook: the loader feeds the GLOBAL batch
+        # whatever the mesh size, so the global batch is the invariant
+        # and the linear-scaling rule keeps the lr (lr_factor 1) and
+        # the checkpoint's schedule; the WORLD_RESCALE line records it
+        # for the churn timeline. A per-host-batch deployment would
+        # pass per_host_batch= — a non-identity result then rebuilds
+        # the lr schedule below.
+        res = training.world_change_rescale(ow, nw, lr=args.base_lr,
+                                            global_batch=args.batch_size)
+        log.info(res.log_line())
+        if res.lr != args.base_lr:
+            args.base_lr = res.lr
+            rescaled.append(res)
+
     start_epoch = 0
     restored, resume, old_world = resilience.elastic_resume(
         args.checkpoint_format, args.epochs, precond, state,
-        make_precond=make_old_precond, retry=io_retry, log=log)
+        make_precond=make_old_precond, retry=io_retry,
+        on_world_change=on_world_change, log=log)
     if resume is not None:
         state = restored
         start_epoch = resume + 1
@@ -242,8 +260,20 @@ def main():
         if old_world is not None:
             log.info('RESHARDED from_world=%d to_world=%d step=%d',
                      old_world, args.num_devices, int(state.step))
+        if rescaled:
+            # the hook actually changed the base lr (per-host-batch
+            # deployments): the schedule re-derives from it
+            lr_fn = utils.warmup_multistep(
+                args.base_lr, steps_per_epoch, args.warmup_epochs,
+                args.lr_decay,
+                scale=max(1, args.num_devices
+                          * args.batches_per_allreduce))
+            tx = training.sgd(lr_fn, momentum=0.9, weight_decay=args.wd)
+            if args.batches_per_allreduce > 1:
+                tx = optax.MultiSteps(tx, args.batches_per_allreduce)
         log.info('resumed from checkpoint-%d', resume)
-    utils.write_world_stamp(args.checkpoint_format, args.num_devices)
+    utils.write_world_stamp(args.checkpoint_format, args.num_devices,
+                            gen=os.environ.get('KFAC_POD_GEN'))
     # pod peer liveness (KFAC_HB_* from launch_tpu.sh/kfac-pod-supervise):
     # a dead peer aborts this trainer RC_PEER_DEAD within the heartbeat
     # deadline instead of hanging in a collective

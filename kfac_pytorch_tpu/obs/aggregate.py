@@ -29,7 +29,14 @@ Outputs: a human timeline on stdout, ``-o`` a JSON timeline, and
 process row, log/incident events injected as instants).
 
 Zero dependencies; shares the event grammar with
-``resilience.incident`` (same regexes — one source of truth).
+``resilience.incident`` (same regexes — one source of truth). That
+invariant is what makes churn renderable without new code here: the
+elastic-grow cycle (``join_announce`` -> ``grow_claim`` -> ``grow`` ->
+``grow_resharded`` -> ``world_rescale``) is defined once in
+``incident.EVENT_PATTERNS`` and lands on this timeline alongside the
+shrink-side kinds (``peer_dead``/``shrink``/``resharded``), so a
+kill-and-readmit drill reads as one causal story:
+death -> shrink -> join -> grow.
 """
 
 import argparse

@@ -37,7 +37,11 @@ Pod level (multi-host; everything above is one host):
   permanent peer loss the survivors agree on the surviving set, relaunch
   trainers at the reduced world size, and resume through
   :func:`~elastic.elastic_resume` (``reshard_kfac_state`` carries the
-  accumulated factor statistics across the world-size change).
+  accumulated factor statistics across the world-size change). The
+  same machinery runs in reverse for a repaired host: ``--join``
+  announces it on the heartbeat channel, the incumbents run the grow
+  barrier, and every trainer relaunches at the enlarged world with its
+  factors resharded UP — no cold restart, train through the churn.
 - :mod:`incident` — scrape ``[resilience: ...]`` runlog lines plus
   supervisor/watchdog/heartbeat events into a structured per-run
   incident report (JSON + human summary).
@@ -115,10 +119,10 @@ from kfac_pytorch_tpu.resilience.supervisor import (  # noqa: E402
 from kfac_pytorch_tpu.resilience.straggler import (  # noqa: E402
     StragglerGovernor)
 from kfac_pytorch_tpu.resilience.heartbeat import (  # noqa: E402
-    RC_PEER_DEAD, FileLeaseTransport, PeerHeartbeat,
-    TcpHeartbeatTransport, heartbeat_from_env)
+    RC_PEER_DEAD, FileLeaseTransport, JoinAnnouncer, PeerHeartbeat,
+    TcpHeartbeatTransport, heartbeat_from_env, read_join_announcements)
 from kfac_pytorch_tpu.resilience.elastic import (  # noqa: E402
-    PodSupervisor, elastic_resume)
+    RC_JOIN_FAILED, PodSupervisor, elastic_resume)
 from kfac_pytorch_tpu.resilience.incident import (  # noqa: E402
     IncidentReport, scrape_paths)
 
@@ -127,8 +131,9 @@ __all__ = [
     'ManualClock', 'RetryError', 'RetryPolicy',
     'call_with_retry', 'resumable_iter', 'RC_HANG', 'StepWatchdog',
     'Supervisor', 'parse_stop_rc', 'StragglerGovernor',
-    'RC_PEER_DEAD', 'FileLeaseTransport', 'PeerHeartbeat',
-    'TcpHeartbeatTransport', 'heartbeat_from_env',
+    'RC_PEER_DEAD', 'RC_JOIN_FAILED', 'FileLeaseTransport',
+    'JoinAnnouncer', 'PeerHeartbeat', 'TcpHeartbeatTransport',
+    'heartbeat_from_env', 'read_join_announcements',
     'PodSupervisor', 'elastic_resume',
     'IncidentReport', 'scrape_paths',
 ]

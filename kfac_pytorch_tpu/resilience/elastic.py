@@ -1,4 +1,5 @@
-"""Elastic shrink on permanent host loss: the pod survives minus one.
+"""Elastic shrink AND grow: the pod survives minus one, and takes a
+repaired host back without a cold restart.
 
 :mod:`.supervisor` restarts ONE host's trainer; :mod:`.heartbeat` lets
 every host *know* a peer died instead of hanging in a collective. This
@@ -35,6 +36,26 @@ sees a next-generation claim set it cannot corroborate with a death of
 its own is the one being declared dead (its beats are not reaching
 anyone): it fences itself — kills its trainer and exits — rather than
 split-brain the run.
+
+Grow protocol (the join lane, mirroring the shrink barrier): a repaired
+or newly-granted host runs ``kfac-pod-supervise --join ...``. Its
+:class:`~.heartbeat.JoinAnnouncer` publishes ``join-{host}.json`` into
+the lease dir; every incumbent supervisor polls for announcements
+between child polls, stops its trainer at the next boundary, and writes
+a claim ``grow-gen{g+1}/member-{host}.json``. The joiner claims into
+the same barrier (the highest grow generation newer than any it saw at
+startup — completed barriers from earlier cycles are inert to it), the
+expected set is ``members + announcers + claimants``, and after the
+barrier + ``settle`` everyone takes the sorted claimant set as the
+enlarged membership at generation ``g+1``. Supervisors relaunch with
+re-substituted ``{host_id}/{num_hosts}/{gen}`` argv and the trainers
+route their factor state UP through ``reshard_kfac_state`` (more
+shards, pad-row-exact). An uncorroborated next-generation claim set is
+therefore disambiguated by its lane: ``shrink-gen*`` claims you cannot
+corroborate mean YOU are the dead one (fence); ``grow-gen*`` claims
+are an invitation (join the barrier). A grow whose announcer never
+claims (a stale ``join-*.json`` from a previous life) aborts — same
+membership back, no generation bump, announcement scrubbed.
 """
 
 import argparse
@@ -50,7 +71,8 @@ import time
 
 from kfac_pytorch_tpu.resilience import heartbeat as hb_mod
 from kfac_pytorch_tpu.resilience.heartbeat import (
-    FileLeaseTransport, PeerHeartbeat, RC_PEER_DEAD)
+    FileLeaseTransport, JoinAnnouncer, PeerHeartbeat, RC_PEER_DEAD,
+    read_join_announcements)
 from kfac_pytorch_tpu.resilience.incident import IncidentReport
 from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK, RetryPolicy
 from kfac_pytorch_tpu.resilience.supervisor import parse_stop_rc
@@ -58,9 +80,16 @@ from kfac_pytorch_tpu.resilience.watchdog import RC_HANG
 
 log = logging.getLogger(__name__)
 
+# "the pod never admitted us": exit code of a `--join` supervisor whose
+# announcement went unanswered for --join-timeout. Distinct from the
+# trainer-protocol codes (113/114/115) — it is a SUPERVISOR-level
+# verdict, and the operator's reaction is to check the incumbent pod
+# (is it alive? same lease dir?) rather than to restart the trainer.
+RC_JOIN_FAILED = 116
+
 
 def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
-                   retry=None, log=None):
+                   retry=None, on_world_change=None, log=None):
     """World-size-aware auto-resume: ``(state, epoch, old_world)``.
 
     Reads the world stamp the previous run left next to its checkpoints
@@ -73,7 +102,16 @@ def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
     same model, same layer list) and the factor statistics are
     transported into the new layout via ``reshard_kfac_state``; params /
     optimizer / step restore unchanged (they are world-size invariant).
+    The transport is direction-agnostic: a GROW relaunch reshards up
+    (more shards; new pad rows stay zero, true blocks land exactly) the
+    same way a shrink reshards down.
     Returns ``(None, None, old_world)`` when nothing restorable exists.
+
+    ``on_world_change``: optional ``callback(old_world, new_world)``
+    fired after a successful cross-world transport — the trainers hang
+    their batch-size / learning-rate rescaling here
+    (``training.world_change_rescale``) so accuracy, not just liveness,
+    survives the world change.
     """
     import jax
     from kfac_pytorch_tpu.utils import checkpoint as ckpt
@@ -84,6 +122,17 @@ def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
             or old_world == new_world):
         restored, epoch = ckpt.auto_resume(base_dir, max_epoch, state,
                                            retry=retry)
+        if restored is not None and jax.process_count() == 1:
+            # adopt through the host even same-world: an orbax restore
+            # commits leaves to the restore device, and a committed
+            # single-device array cannot feed a multi-device shard_map
+            # step (host arrays place freely). First surfaced by the
+            # churn drill's 3->2 shrink — the first resume into a world
+            # that is smaller but still meshed. Single-process only:
+            # in a real multi-process pod the restored leaves span
+            # non-addressable devices (device_get would raise) and the
+            # restore already carries the target sharding.
+            restored = jax.device_get(restored)
         return restored, epoch, None
     pre_old = make_precond(old_world)
     old_target = state.replace(kfac_state=pre_old.init())
@@ -99,12 +148,21 @@ def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
     new_state = state.replace(
         step=host(restored.step), params=host(restored.params),
         opt_state=host(restored.opt_state),
-        extra_vars=host(restored.extra_vars), health=restored.health,
+        extra_vars=host(restored.extra_vars),
+        health=host(restored.health),  # committed like every other leaf
         kfac_state=host(carried))
+    step = int(jax.device_get(restored.step))
     lg.info('elastic resume: transported K-FAC factors from world %d -> '
             '%d at checkpoint-%d (step %d); decompositions rebuild at '
-            'the first inverse update', old_world, new_world, epoch,
-            int(jax.device_get(restored.step)))
+            'the first inverse update', old_world, new_world, epoch, step)
+    if new_world > old_world:
+        # machine-greppable grow form (incident/timeline grammar):
+        # distinct from the shrink direction so a churn timeline can
+        # pin death->shrink->join->grow without comparing numbers
+        lg.info('elastic: grow reshard from_world=%d to_world=%d step=%d',
+                old_world, new_world, step)
+    if on_world_change is not None:
+        on_world_change(old_world, new_world)
     return new_state, epoch, old_world
 
 
@@ -134,12 +192,14 @@ class PodSupervisor:
                  host_addr=None, max_restarts=3, backoff_base=1.0,
                  backoff_max=60.0, hb_interval=1.0, hb_deadline=5.0,
                  hb_grace=60.0, settle=None, shrink_timeout=None,
+                 grow_timeout=None, join=False, join_timeout=120.0,
                  stop_rcs=(), incident_path=None, env=None, clock=None,
                  rng=None, popen=subprocess.Popen, poll_period=0.2,
                  child_kill_grace=5.0, log=None):
         self.argv_template = list(argv_template)
         self.host_id = int(host_id)
         self.members = list(range(int(num_hosts)))
+        self._initial_members = list(self.members)
         self.lease_dir = str(lease_dir)
         self.host_addr = host_addr
         self.max_restarts = int(max_restarts)
@@ -155,6 +215,14 @@ class PodSupervisor:
                                if shrink_timeout is not None
                                else self.hb_deadline + 10.0
                                * self.hb_interval)
+        self.grow_timeout = (float(grow_timeout)
+                             if grow_timeout is not None
+                             else self.shrink_timeout)
+        # join mode: we are the REPAIRED host — announce on the
+        # heartbeat channel and wait for the incumbents' grow barrier
+        # instead of launching a trainer into a pod that isn't ours yet
+        self.join = bool(join)
+        self.join_timeout = float(join_timeout)
         self.stop_rcs = frozenset(stop_rcs)
         self.incident_path = incident_path or os.path.join(
             self.lease_dir, f'incident-host{self.host_id}.json')
@@ -170,17 +238,21 @@ class PodSupervisor:
         self.crashes = 0
         self.hangs = 0
         self.shrinks = 0
+        self.grows = 0
+        self.joins = 0
         self.child = None
         self._terminating = False
         self._lock = threading.Lock()
         self._lost = {}       # host_id -> heartbeat info (confirmed dead)
+        self._aborted_grow_gens = set()  # stale-join barrier attempts
         self._hb = None
         self.report = IncidentReport(host_id=self.host_id)
         os.makedirs(self.lease_dir, exist_ok=True)
 
     def counts(self):
         return {'restarts': self.restarts, 'crashes': self.crashes,
-                'hangs': self.hangs, 'shrinks': self.shrinks}
+                'hangs': self.hangs, 'shrinks': self.shrinks,
+                'grows': self.grows, 'joins': self.joins}
 
     # -- supervisor-to-supervisor heartbeat -------------------------------
 
@@ -211,8 +283,15 @@ class PodSupervisor:
             return
         for name in names:
             path = os.path.join(self.lease_dir, name)
-            if name.startswith(('shrink-gen', 'trainer-gen')):
+            if name.startswith(('shrink-gen', 'grow-gen', 'trainer-gen')):
                 shutil.rmtree(path, ignore_errors=True)
+            elif name.startswith('join-') and name.endswith('.json'):
+                # a stale announcement from a previous incarnation would
+                # trigger a spurious grow barrier the moment the fresh
+                # pod comes up (the grow aborts when the ghost never
+                # claims, but why start the churn at all)
+                with contextlib.suppress(OSError):
+                    os.remove(path)
             elif name == 'sup':
                 with contextlib.suppress(OSError):
                     for lease in os.listdir(path):
@@ -221,16 +300,25 @@ class PodSupervisor:
                                 os.remove(os.path.join(path, lease))
 
     def _start_monitor(self):
+        peers = [m for m in self.members if m != self.host_id]
         if self._hb is not None:
-            self._hb.stop()
+            # generation change: REBASE the live monitor instead of
+            # rebuilding it — per-peer sequence tracking is forgotten
+            # (a re-admitted host restarts its counter; judging it by
+            # the old generation's high-water mark would misread the
+            # rejoin as a stale peer) and the startup grace restarts
+            # for the just-admitted members
+            self._hb.rebase(peers=peers, gen=self.gen)
+            if peers:
+                self._hb.start()
+            return
         sup_dir = os.path.join(self.lease_dir, 'sup')
         self._hb = PeerHeartbeat(
             FileLeaseTransport(sup_dir, self.host_id), self.host_id,
-            peers=[m for m in self.members if m != self.host_id],
-            interval=self.hb_interval, deadline=self.hb_deadline,
-            startup_grace=self.hb_grace, on_dead=self._record_peer_dead,
-            log=self.log)
-        if len(self.members) > 1:
+            peers=peers, interval=self.hb_interval,
+            deadline=self.hb_deadline, startup_grace=self.hb_grace,
+            on_dead=self._record_peer_dead, gen=self.gen, log=self.log)
+        if peers:
             self._hb.start()
 
     def _confirmed_dead(self):
@@ -276,7 +364,44 @@ class PodSupervisor:
         env[hb_mod.ENV_INTERVAL] = str(self.hb_interval)
         env[hb_mod.ENV_DEADLINE] = str(self.hb_deadline)
         env[hb_mod.ENV_GRACE] = str(self.hb_grace)
+        env[hb_mod.ENV_GEN] = str(self.gen)
         env['KFAC_POD_GEN'] = str(self.gen)
+        # tcp heartbeat pass-through (real pods — launch_tpu.sh defaults
+        # multi-host runs to it): re-derive the peer map for the CURRENT
+        # membership from the claim-published host addresses, so a
+        # trainer relaunched after a shrink/grow probes exactly the
+        # hosts that are still (or newly) in the pod. Falls back to the
+        # per-generation file-lease dir when any member's address is
+        # unknown — a trainer probing a stale peer map would declare
+        # live hosts dead.
+        if env.get(hb_mod.ENV_TRANSPORT, '').strip().lower() == 'tcp':
+            port = int(env.get(hb_mod.ENV_PORT,
+                               str(hb_mod.DEFAULT_TCP_PORT)))
+            addrs = getattr(self, '_member_addrs', None) or {}
+            if all(addrs.get(m) for m in self.members):
+                env[hb_mod.ENV_PEERS] = hb_mod.format_peer_addrs({
+                    r: (str(addrs[m]).rsplit(':', 1)[0], port)
+                    for r, m in enumerate(self.members)})
+            elif (self.gen == 0
+                    and self.members == self._initial_members
+                    and env.get(hb_mod.ENV_PEERS)):
+                # generation 0, membership unchanged since launch: the
+                # launcher's full-world peer map (launch_tpu.sh derives
+                # it from KFAC_HB_WORKERS) is still rank-exact — pass
+                # it through verbatim rather than downgrading a real
+                # pod's transport to file leases at launch. LATER
+                # generations never reuse it: a host that rejoined from
+                # a replacement machine has a new address the original
+                # map cannot know, so an incomplete claim-address set
+                # takes the file-lease fallback below instead.
+                pass
+            else:
+                env[hb_mod.ENV_TRANSPORT] = 'file'
+                self.log.warning(
+                    'pod-supervisor: %s=tcp but not every member of %s '
+                    'published an address (--host-addr) — trainer '
+                    'heartbeats fall back to file leases this '
+                    'generation', hb_mod.ENV_TRANSPORT, self.members)
         env['JAX_PROCESS_ID'] = str(rank)
         env['JAX_NUM_PROCESSES'] = str(world)
         coord = self._coordinator_addr()
@@ -317,12 +442,15 @@ class PodSupervisor:
                              signum, child.pid)
             child.send_signal(signum)
 
-    # -- shrink protocol --------------------------------------------------
+    # -- shrink / grow claim lanes ----------------------------------------
 
     def _claim_dir(self, gen):
         return os.path.join(self.lease_dir, f'shrink-gen{gen}')
 
-    def _read_claims(self, claim_dir):
+    def _grow_dir(self, gen):
+        return os.path.join(self.lease_dir, f'grow-gen{gen}')
+
+    def _read_claims(self, claim_dir, prefix='survivor-'):
         import json
         out = {}
         try:
@@ -330,8 +458,7 @@ class PodSupervisor:
         except OSError:
             return out
         for name in names:
-            if not (name.startswith('survivor-')
-                    and name.endswith('.json')):
+            if not (name.startswith(prefix) and name.endswith('.json')):
                 continue
             try:
                 with open(os.path.join(claim_dir, name)) as f:
@@ -341,17 +468,47 @@ class PodSupervisor:
                 continue
         return out
 
-    def _write_claim(self, claim_dir):
+    def _write_claim(self, claim_dir, prefix='survivor-', members=None):
+        """``members``: incumbent grow claims publish the CURRENT
+        membership so the joiner can compute the same expected set the
+        incumbents wait for (a joiner admitting on claim-set stability
+        alone could adopt a smaller membership than the barrier closes
+        with, if one incumbent is slow to stop its trainer and claim).
+        """
         from kfac_pytorch_tpu.resilience import atomic_write_json
         os.makedirs(claim_dir, exist_ok=True)
+        payload = {'host': self.host_id, 'addr': self.host_addr,
+                   'wall': time.time()}
+        if members is not None:
+            payload['members'] = [int(m) for m in members]
         atomic_write_json(
-            os.path.join(claim_dir, f'survivor-{self.host_id}.json'),
-            {'host': self.host_id, 'addr': self.host_addr,
-             'wall': time.time()})
+            os.path.join(claim_dir, f'{prefix}{self.host_id}.json'),
+            payload)
 
     def _peer_shrink_started(self):
         """True when a peer has already claimed the NEXT generation."""
         claims = self._read_claims(self._claim_dir(self.gen + 1))
+        return bool(set(claims) - {self.host_id})
+
+    def _join_announced(self):
+        """{host: payload} of NON-member join announcements — the grow
+        trigger. A member's own stale announcement (it was admitted and
+        the file lingered) is not a trigger."""
+        return {h: p for h, p in
+                read_join_announcements(self.lease_dir).items()
+                if h not in self.members}
+
+    def _peer_grow_started(self):
+        """True when a peer has claimed the next generation's GROW
+        barrier — an invitation to join it (the fence-vs-join
+        distinction: shrink claims we cannot corroborate mean WE are
+        dead; grow claims mean the pod is being enlarged around us and
+        we participate). Barrier attempts this supervisor already
+        aborted (stale announcements) are inert."""
+        if self.gen + 1 in self._aborted_grow_gens:
+            return False
+        claims = self._read_claims(self._grow_dir(self.gen + 1),
+                                   prefix='member-')
         return bool(set(claims) - {self.host_id})
 
     def _shrink(self, dead):
@@ -373,11 +530,21 @@ class PodSupervisor:
                           {'host': self.host_id, 'addr': self.host_addr})
         survivors = sorted(claims)
         old_world = len(self.members)
+        dead_set = set(self.members) - set(survivors)
         self.members = survivors
         self._member_addrs = {h: c.get('addr')
                               for h, c in claims.items()}
         self.gen = next_gen
         self.shrinks += 1
+        # scrub the dead hosts' sup leases: a later REJOIN would race
+        # its first beats against the stale file, which reads to our
+        # rebased monitor as a seen-then-silent peer (bypassing the
+        # never-seen startup grace) and gets the fresh member declared
+        # dead seconds after its admission
+        for h in dead_set:
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(self.lease_dir, 'sup',
+                                       f'hb-{h}.json'))
         from kfac_pytorch_tpu.utils.runlog import resilience_suffix
         self.log.warning(
             'elastic: shrinking world %d -> %d survivors=%s gen=%d%s',
@@ -388,6 +555,257 @@ class PodSupervisor:
             'survivors': survivors, 'gen': next_gen,
             'dead': sorted(dead)})
         self._start_monitor()
+
+    # -- grow protocol ----------------------------------------------------
+
+    def _grow(self, joiners):
+        """Run the grow barrier; returns True when the membership
+        actually grew (False: aborted — stale announcement, nobody new
+        claimed — and the pod stays at the current generation)."""
+        next_gen = self.gen + 1
+        # a fresh announcement re-arms a generation we previously
+        # aborted (the barrier dir was removed with the abort; the set
+        # only guards against rmtree having failed)
+        self._aborted_grow_gens.discard(next_gen)
+        claim_dir = self._grow_dir(next_gen)
+        self._write_claim(claim_dir, prefix='member-',
+                          members=self.members)
+        self.log.info('elastic: grow claim written host=%d gen=%d',
+                      self.host_id, next_gen)
+        start = self.clock.monotonic()
+        while self.clock.monotonic() - start < self.grow_timeout:
+            # SHRINK LANE WINS: a join announcement racing an
+            # unconfirmed peer death can put peers in the shrink
+            # barrier for this same generation while we sit in the
+            # grow one — two divergent memberships at gen g+1. Any
+            # shrink claim (or a death our own monitor confirms
+            # mid-barrier) abandons the grow: withdraw our claim so a
+            # waiting joiner cannot stabilize on it, and let the
+            # normal shrink path run at the next loop.
+            if (self._read_claims(self._claim_dir(next_gen))
+                    or self._confirmed_dead()):
+                with contextlib.suppress(OSError):
+                    os.remove(os.path.join(
+                        claim_dir, f'member-{self.host_id}.json'))
+                self.log.warning(
+                    'elastic: abandoning the grow at gen %d — a shrink '
+                    'is underway at the same generation (the shrink '
+                    'lane wins)', next_gen)
+                self.report.add_event('grow_yielded', gen=next_gen)
+                return False
+            claims = self._read_claims(claim_dir, prefix='member-')
+            # expected = incumbents + every announcer + everyone who
+            # already claimed (a host that saw an announcement we
+            # missed, or a joiner we only learn about from its claim)
+            expected = (set(self.members) | set(joiners)
+                        | set(self._join_announced()) | set(claims))
+            if expected <= set(claims):
+                break
+            self.clock.sleep(self.poll_period)
+        # settle: a straggling claimant (joiner slow to scan the new
+        # barrier dir, incumbent slow to stop its trainer) makes it in
+        self.clock.sleep(self.settle)
+        claims = self._read_claims(claim_dir, prefix='member-')
+        claims.setdefault(self.host_id,
+                          {'host': self.host_id, 'addr': self.host_addr})
+        new_members = sorted(claims)
+        if set(new_members) <= set(self.members):
+            # no NEW member made it in: the announcement was stale
+            # (nobody claimed), or we raced a peer's abort-cleanup and
+            # read a partially/fully emptied dir. SUBSET, not equality:
+            # a straggler whose read returns only its own setdefault'd
+            # claim must abort like everyone else, never adopt a
+            # singleton membership and split-brain the pod. Scrub the
+            # announcement, remember the dead barrier (belt-and-braces
+            # for a failed rmtree), and stay at the current generation.
+            # The claim DIR must go too: a later REAL joiner takes the
+            # highest grow-gen dir it sees at startup as its baseline
+            # and only claims into newer ones — a leftover aborted dir
+            # at gen g+1 would make the very generation the incumbents
+            # reopen permanently unjoinable.
+            self._aborted_grow_gens.add(next_gen)
+            import shutil
+            shutil.rmtree(claim_dir, ignore_errors=True)
+            for h in joiners:
+                with contextlib.suppress(OSError):
+                    os.remove(os.path.join(self.lease_dir,
+                                           f'join-{h}.json'))
+            self.log.warning(
+                'elastic: grow aborted at gen %d — announced joiner(s) '
+                '%s never claimed (stale announcement?); membership '
+                'stays %s', next_gen, sorted(joiners), self.members)
+            self.report.add_event('grow_aborted', gen=next_gen,
+                                  joiners=sorted(joiners))
+            return False
+        old_world = len(self.members)
+        admitted = sorted(set(new_members) - set(self.members))
+        self.members = new_members
+        self._member_addrs = {h: c.get('addr')
+                              for h, c in claims.items()}
+        self.gen = next_gen
+        self.grows += 1
+        # a host we once confirmed dead is back by AGREEMENT: forget the
+        # death record, or _confirmed_dead would re-shrink the pod the
+        # moment the rejoined host re-enters the membership
+        with self._lock:
+            for h in admitted:
+                self._lost.pop(h, None)
+        # the announcements served their purpose; scrub so a LATER death
+        # of the rejoined host cannot replay them into a spurious grow
+        for h in admitted:
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(self.lease_dir, f'join-{h}.json'))
+        from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        self.log.warning(
+            'elastic: growing world %d -> %d members=%s gen=%d '
+            'joiners=%s%s', old_world, len(new_members), new_members,
+            next_gen, admitted, resilience_suffix(self.counts()))
+        self.report.add_event('grow', **{
+            'from': old_world, 'to': len(new_members),
+            'members': new_members, 'gen': next_gen,
+            'joiners': admitted})
+        self._start_monitor()
+        return True
+
+    def _max_grow_gen(self):
+        """Highest generation with a grow-claim barrier dir on disk, or
+        None — the joiner's baseline so completed barriers from earlier
+        churn cycles are inert to a later rejoin."""
+        best = None
+        try:
+            names = os.listdir(self.lease_dir)
+        except OSError:
+            return None
+        for name in names:
+            if name.startswith('grow-gen'):
+                with contextlib.suppress(ValueError):
+                    g = int(name[len('grow-gen'):])
+                    best = g if best is None else max(best, g)
+        return best
+
+    def _join_pod(self):
+        """Announce, wait for the incumbents' grow barrier, claim into
+        it, adopt the agreed membership. True on admission; False when
+        ``join_timeout`` expires unanswered."""
+        # pre-warm the jax/orbax-heavy runlog import chain OUTSIDE the
+        # admission critical path (it costs seconds on first import,
+        # and a stall between barrier-close and monitor start would
+        # read to the incumbents as missed beats); the admission log
+        # below uses the name
+        from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        # publish sup-channel liveness from the moment we ask to join:
+        # the incumbents rebase their monitors the instant the barrier
+        # closes, and our advancing beats must already be on the
+        # channel by then (also overwriting any stale lease our
+        # previous life left). Peers rebase in after admission.
+        sup_dir = os.path.join(self.lease_dir, 'sup')
+        self._hb = PeerHeartbeat(
+            FileLeaseTransport(sup_dir, self.host_id), self.host_id,
+            peers=[], interval=self.hb_interval,
+            deadline=self.hb_deadline, startup_grace=self.hb_grace,
+            on_dead=self._record_peer_dead, gen=self.gen, log=self.log)
+        self._hb.start()
+        announcer = JoinAnnouncer(self.lease_dir, self.host_id,
+                                  addr=self.host_addr, log=self.log)
+        self.report.add_event('join_announce', host=self.host_id)
+        baseline = self._max_grow_gen() or 0
+        start = self.clock.monotonic()
+        claimed_gen = None
+        prev_claims = None
+        stable_since = None
+        last_announce = None
+        try:
+            while self.clock.monotonic() - start < self.join_timeout:
+                # republish at heartbeat cadence (atomic rewrite is a
+                # tmp+rename on the shared fs — once per hb_interval is
+                # plenty; a gen-0 scrub race only costs one interval)
+                now0 = self.clock.monotonic()
+                if (last_announce is None
+                        or now0 - last_announce >= self.hb_interval):
+                    announcer.announce()
+                    last_announce = now0
+                gen = self._max_grow_gen()
+                if gen is not None and gen > baseline:
+                    claim_dir = self._grow_dir(gen)
+                    claims = self._read_claims(claim_dir,
+                                               prefix='member-')
+                    # (re-)claim when it's a new barrier OR our claim
+                    # is gone — the incumbents may have aborted this
+                    # same generation (rmtree took our claim with it)
+                    # and re-armed it on our next announcement; without
+                    # the re-claim the join could never succeed after
+                    # one abort
+                    if claimed_gen != gen or self.host_id not in claims:
+                        self._write_claim(claim_dir, prefix='member-')
+                        self.log.info('elastic: grow claim written '
+                                      'host=%d gen=%d', self.host_id, gen)
+                        claimed_gen = gen
+                        claimed_at = self.clock.monotonic()
+                        prev_claims, stable_since = None, None
+                        claims = self._read_claims(claim_dir,
+                                                   prefix='member-')
+                    now = self.clock.monotonic()
+                    if set(claims) != prev_claims:
+                        prev_claims, stable_since = set(claims), now
+                    # admission = the claim set covers every member any
+                    # incumbent's claim names (the incumbents publish
+                    # their membership precisely so we can wait for the
+                    # SAME expected set they do — a slow incumbent must
+                    # not be left out of the world we adopt) AND has
+                    # been stable for a settle window. Claims without
+                    # membership info (other joiners) widen the
+                    # expected set only by themselves.
+                    expected = set(claims)
+                    for c in claims.values():
+                        expected |= {int(m) for m in
+                                     (c.get('members') or ())}
+                    # mirror the incumbents' barrier: past grow_timeout
+                    # they adopt whatever claimed (a member that died
+                    # MID-grow never claims); insisting on full
+                    # coverage forever would strand us on the other
+                    # side of the very membership they just agreed
+                    covered = (expected <= set(claims)
+                               or now - claimed_at > self.grow_timeout)
+                    if (self.host_id in claims and len(claims) > 1
+                            and covered
+                            and now - stable_since >= self.settle):
+                        self.members = sorted(claims)
+                        self._member_addrs = {h: c.get('addr')
+                                              for h, c in claims.items()}
+                        self.gen = gen
+                        self.joins += 1
+                        self.log.warning(
+                            'join: admitted into pod as rank %d — '
+                            'world %d gen=%d members=%s%s',
+                            self.members.index(self.host_id),
+                            len(self.members), self.gen, self.members,
+                            resilience_suffix(self.counts()))
+                        self.report.add_event(
+                            'join_admitted', gen=self.gen,
+                            members=self.members,
+                            rank=self.members.index(self.host_id))
+                        return True
+                self.clock.sleep(self.poll_period)
+        finally:
+            announcer.withdraw()
+        if claimed_gen is not None:
+            # we claimed into a barrier but were never admitted: take
+            # the claim back out, or the incumbents' barrier would
+            # count a host that has already exited and grow a
+            # membership with a permanently missing rank
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(
+                    self._grow_dir(claimed_gen),
+                    f'member-{self.host_id}.json'))
+        self.log.error(
+            'join: pod never admitted host %d within %.1fs — is the '
+            'incumbent pod alive and sharing this lease dir (%s)? '
+            '[resilience: join_failed=1]', self.host_id,
+            self.join_timeout, self.lease_dir)
+        self.report.add_event('join_failed', host=self.host_id,
+                              timeout_s=self.join_timeout)
+        self.report.bump({'join_failed': 1})
+        return False
 
     def _fence(self, rc):
         from kfac_pytorch_tpu.utils.runlog import resilience_suffix
@@ -411,10 +829,20 @@ class PodSupervisor:
                 prev_handlers[s] = _signal.signal(s, self._forward_signal)
         except ValueError:  # pragma: no cover — non-main thread (tests)
             prev_handlers = {}
-        self._clear_stale_protocol_files()
-        self._start_monitor()
+        admitted = True
+        if self.join:
+            # joining an ACTIVE pod: its protocol files are live state,
+            # not stale debris — scrubbing them here would tear down the
+            # very barrier that admits us
+            admitted = self._join_pod()
+        else:
+            self._clear_stale_protocol_files()
         try:
-            rc = self._run_loop()
+            if not admitted:
+                rc = RC_JOIN_FAILED
+            else:
+                self._start_monitor()
+                rc = self._run_loop()
         finally:
             for s, h in prev_handlers.items():
                 _signal.signal(s, h if h is not None else _signal.SIG_DFL)
@@ -432,9 +860,10 @@ class PodSupervisor:
         return rc
 
     def _wait_child(self):
-        """Wait for the trainer; interleave peer-death / shrink / signal
-        checks. Returns (rc, reason) with reason in
-        {'exit', 'peer_dead', 'fenced'}."""
+        """Wait for the trainer; interleave peer-death / shrink / join /
+        signal checks. Returns (rc, reason) with reason in
+        {'exit', 'peer_dead', 'fenced', 'grow'}."""
+        next_lane_check = 0.0
         while True:
             rc = self.child.poll()
             if rc is not None:
@@ -454,6 +883,24 @@ class PodSupervisor:
                     self._terminate_child()
                     return self.child.poll(), 'peer_dead'
                 return None, 'fenced'
+            # the join lane: a repaired host announced itself (or a
+            # peer already opened the grow barrier we missed the
+            # announcement for). Unlike uncorroborated SHRINK claims
+            # this is never a fence signal — the claims include us.
+            # Stop the trainer at this boundary and run the barrier.
+            # Scanned once per hb_interval, not per poll: these are
+            # two extra lease-dir listings + reads, and on the shared
+            # filesystems real pods use that is network traffic — join
+            # latency is bounded by the barrier timeouts anyway.
+            now = self.clock.monotonic()
+            if now >= next_lane_check:
+                next_lane_check = now + self.hb_interval
+                if self._join_announced() or self._peer_grow_started():
+                    self.log.warning('pod-supervisor: join announced — '
+                                     'stopping the trainer for the grow '
+                                     'barrier')
+                    self._terminate_child()
+                    return self.child.poll(), 'grow'
             self.clock.sleep(self.poll_period)
 
     def _run_loop(self):
@@ -470,6 +917,14 @@ class PodSupervisor:
                                   gen=self.gen)
             if reason == 'fenced':
                 return self._fence(rc)
+            if reason == 'grow':
+                # grow relaunch: not charged to the crash budget (the
+                # trainer was healthy — WE stopped it to re-admit a
+                # host); an aborted barrier (stale announcement) just
+                # relaunches at the unchanged world
+                self._grow(self._join_announced())
+                self.restarts += 1
+                continue
             if self._terminating:
                 self.log.info('pod-supervisor: trainer exited rc=%s '
                               'after forwarded signal — not restarting%s',
@@ -538,8 +993,9 @@ def main(argv=None):
     p = argparse.ArgumentParser(
         prog='kfac-pod-supervise',
         description='Per-host pod supervisor: restart a crashed/hung '
-                    'trainer, heartbeat with peer supervisors, and '
-                    'shrink the pod when a host dies for good. '
+                    'trainer, heartbeat with peer supervisors, shrink '
+                    'the pod when a host dies for good, and grow it '
+                    'back when a repaired host rejoins (--join). '
                     '{host_id}/{num_hosts}/{gen} in the trainer command '
                     'are substituted per generation.')
     p.add_argument('--host-id', type=int, required=True)
@@ -559,6 +1015,16 @@ def main(argv=None):
     p.add_argument('--hb-grace', type=float, default=60.0)
     p.add_argument('--settle', type=float, default=None)
     p.add_argument('--shrink-timeout', type=float, default=None)
+    p.add_argument('--grow-timeout', type=float, default=None,
+                   help='grow-barrier bound (default: the shrink '
+                        'timeout)')
+    p.add_argument('--join', action='store_true',
+                   help='this host is REJOINING an active pod: announce '
+                        'on the heartbeat channel, wait for the '
+                        'incumbents\' grow barrier, then supervise as a '
+                        'member of the enlarged generation (exit 116 if '
+                        'never admitted within --join-timeout)')
+    p.add_argument('--join-timeout', type=float, default=120.0)
     p.add_argument('--stop-rc', type=parse_stop_rc, action='append',
                    default=[],
                    help='exit code (number or name: hang / peer_dead / '
@@ -585,6 +1051,8 @@ def main(argv=None):
         backoff_max=args.backoff_max, hb_interval=args.hb_interval,
         hb_deadline=args.hb_deadline, hb_grace=args.hb_grace,
         settle=args.settle, shrink_timeout=args.shrink_timeout,
+        grow_timeout=args.grow_timeout, join=args.join,
+        join_timeout=args.join_timeout,
         stop_rcs=args.stop_rc, incident_path=args.incident_out)
     return sup.run()
 

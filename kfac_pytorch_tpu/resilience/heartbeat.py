@@ -54,6 +54,19 @@ ENV_HOSTS = 'KFAC_HB_HOSTS'
 ENV_INTERVAL = 'KFAC_HB_INTERVAL'
 ENV_DEADLINE = 'KFAC_HB_DEADLINE'
 ENV_GRACE = 'KFAC_HB_GRACE'
+# transport selection: 'file' (lease dir, default) or 'tcp' (no shared
+# filesystem needed — real pods; launch_tpu.sh defaults multi-host runs
+# to tcp). The tcp contract: ENV_PORT is the port THIS host's responder
+# binds, ENV_PEERS maps every rank to its responder ("0=ip0:8478,1=...").
+ENV_TRANSPORT = 'KFAC_HB_TRANSPORT'
+ENV_PORT = 'KFAC_HB_PORT'
+ENV_PEERS = 'KFAC_HB_PEERS'
+# pod generation (elastic.py bumps it on every shrink/grow): rides in
+# every published payload so a peer whose sequence counter restarted
+# under a NEW generation is recognized as "rejoined", never "stale"
+ENV_GEN = 'KFAC_HB_GEN'
+
+DEFAULT_TCP_PORT = 8478
 
 
 class FileLeaseTransport:
@@ -199,13 +212,19 @@ class PeerHeartbeat:
         passes a callback (it must orchestrate, not die).
       stop_beat_step: chaos drill (:data:`ENV_HB_STOP`): stop publishing
         once :meth:`tick` sees this step.
+      gen: pod generation stamped into every published payload. Part of
+        the liveness IDENTITY (pid, gen, seq): a host re-admitted at a
+        later generation restarts its sequence counter, and without the
+        generation in the identity a recycled pid could make the reset
+        read as a stale peer. Rebased via :meth:`rebase` on every
+        elastic world change.
       clock: injectable monotonic clock (tests).
     """
 
     def __init__(self, transport, host_id, num_hosts=None, *, peers=None,
                  interval=2.0, deadline=10.0, startup_grace=60.0,
                  on_dead=None, rc=RC_PEER_DEAD, stop_beat_step=None,
-                 clock=time.monotonic, log=None):
+                 gen=0, clock=time.monotonic, log=None):
         if peers is None:
             if num_hosts is None:
                 raise ValueError('pass num_hosts or an explicit peers list')
@@ -218,6 +237,7 @@ class PeerHeartbeat:
         self.startup_grace = float(startup_grace)
         self.rc = rc
         self.stop_beat_step = stop_beat_step
+        self.gen = int(gen)
         self._on_dead = on_dead
         self._clock = clock
         self.log = log if log is not None else logging.getLogger(__name__)
@@ -256,7 +276,7 @@ class PeerHeartbeat:
         try:
             self.transport.publish({
                 'host': self.host_id, 'seq': self._seq, 'step': self._step,
-                'pid': os.getpid(), 'wall': time.time()})
+                'gen': self.gen, 'pid': os.getpid(), 'wall': time.time()})
         except OSError as e:  # flaky shared FS: miss one beat, not the run
             _res.counters.bump('hb_publish_errors')
             self.log.warning('heartbeat: publish failed (%s) — peers see '
@@ -285,12 +305,14 @@ class PeerHeartbeat:
                 p = payloads.get(peer)
                 rec = self._seen.get(peer)
                 if p is not None and isinstance(p.get('seq'), int):
-                    # liveness = the (pid, seq) identity CHANGED, not
-                    # "seq grew": a crash-restarted peer resets its
-                    # sequence to 1 under a new pid, and judging it by
-                    # the old process's high-water mark would declare a
-                    # host dead for coming back
-                    ident = (p.get('pid'), p['seq'])
+                    # liveness = the (pid, gen, seq) identity CHANGED,
+                    # not "seq grew": a crash-restarted peer resets its
+                    # sequence to 1 under a new pid, and a host
+                    # re-admitted after an elastic grow resets it under
+                    # a new GENERATION (possibly a recycled pid) —
+                    # judging either by the old process's high-water
+                    # mark would declare a host dead for coming back
+                    ident = (p.get('pid'), p.get('gen'), p['seq'])
                     if rec is None or ident != rec[0]:
                         rec = self._seen[peer] = [ident, now,
                                                   p.get('step')]
@@ -303,7 +325,7 @@ class PeerHeartbeat:
                     if silent_for <= self.deadline:
                         continue
                 info = {'peer': peer, 'detect_s': round(silent_for, 3),
-                        'last_seq': rec[0][1] if rec else None,
+                        'last_seq': rec[0][-1] if rec else None,
                         'last_step': rec[2] if rec else None,
                         'never_seen': rec is None, 'wall': time.time()}
                 self._dead[peer] = info
@@ -350,6 +372,27 @@ class PeerHeartbeat:
         with self._lock:
             return dict(self._dead)
 
+    def rebase(self, *, peers=None, gen=None):
+        """Generation change (elastic shrink/grow): adopt the new peer
+        set and generation, and FORGET all per-peer sequence tracking —
+        a re-admitted host restarts its counter at 1, and judging it
+        against the previous generation's high-water record would
+        misread the rejoin as a stale peer. The startup-grace window
+        restarts too: a host admitted this generation has not had a
+        chance to beat yet, and "slow to first beat after a grow" must
+        not read as "dead". Dead-peer records are dropped — the new
+        membership was agreed AROUND the deaths, so carrying them
+        forward would re-trigger the reaction every generation."""
+        with self._lock:
+            if peers is not None:
+                self.peers = sorted(int(p) for p in peers)
+            if gen is not None:
+                self.gen = int(gen)
+            self._seen.clear()
+            self._dead.clear()
+            self._started_at = self._clock()
+        return self
+
     # -- lifecycle --------------------------------------------------------
 
     def start(self):
@@ -382,24 +425,146 @@ class PeerHeartbeat:
             close()
 
 
+class JoinAnnouncer:
+    """A repaired (or newly-granted) host asking an incumbent pod to
+    admit it: publishes ``join-<host>.json`` into the shared lease dir.
+
+    The announcement is the GROW trigger: every incumbent pod
+    supervisor polls :func:`read_join_announcements` between child
+    polls, and on seeing one stops its trainer at the next boundary and
+    opens the grow-claim barrier (:mod:`.elastic`). The payload carries
+    an advancing sequence under this process's pid, so a live announcer
+    is distinguishable from a stale file left by a previous life;
+    :meth:`withdraw` removes the file once the pod admits us (or the
+    join is abandoned), so a LATER death of this host cannot replay the
+    announcement into a spurious grow."""
+
+    def __init__(self, lease_dir, host_id, *, addr=None, log=None):
+        self.lease_dir = str(lease_dir)
+        self.host_id = int(host_id)
+        self.addr = addr
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self._seq = 0
+        self._announced = False
+        os.makedirs(self.lease_dir, exist_ok=True)
+
+    def _path(self):
+        return os.path.join(self.lease_dir, f'join-{self.host_id}.json')
+
+    def announce(self):
+        """(Re)publish the announcement; atomic, idempotent. The first
+        publish logs the machine-greppable ``join_announce`` form the
+        incident/timeline grammar keys off."""
+        self._seq += 1
+        if not self._announced:
+            self._announced = True
+            self.log.warning(
+                'join: host %d announcing to pod (lease %s) '
+                '[resilience: join_announce=1 host=%d]',
+                self.host_id, self.lease_dir, self.host_id)
+        _res.atomic_write_json(self._path(), {
+            'host': self.host_id, 'addr': self.addr, 'seq': self._seq,
+            'pid': os.getpid(), 'wall': time.time()})
+
+    def withdraw(self):
+        self._announced = False
+        with contextlib.suppress(OSError):
+            os.remove(self._path())
+
+
+def read_join_announcements(lease_dir):
+    """{host_id: payload} for every readable ``join-*.json`` in the
+    lease dir (torn/unreadable files are skipped for one poll, same
+    discipline as the lease reader)."""
+    out = {}
+    try:
+        names = os.listdir(str(lease_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith('join-') and name.endswith('.json')):
+            continue
+        try:
+            hid = int(name[5:-5])
+        except ValueError:
+            continue
+        try:
+            with open(os.path.join(str(lease_dir), name)) as f:
+                out[hid] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def parse_peer_addrs(spec):
+    """Parse the ``KFAC_HB_PEERS`` form ``"0=ip0:8478,1=ip1:8478"`` into
+    ``{rank: (host, port)}``. Raises ValueError on a malformed entry —
+    a silently-dropped peer would be a peer nobody monitors."""
+    out = {}
+    for entry in str(spec).split(','):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            rank, addr = entry.split('=', 1)
+            host, port = addr.rsplit(':', 1)
+            out[int(rank)] = (host, int(port))
+        except ValueError:
+            raise ValueError(
+                f'{ENV_PEERS}: expected "rank=host:port", got {entry!r}'
+            ) from None
+    return out
+
+
+def format_peer_addrs(addrs):
+    """Inverse of :func:`parse_peer_addrs`."""
+    return ','.join(f'{r}={h}:{p}' for r, (h, p) in sorted(addrs.items()))
+
+
 def heartbeat_from_env(log=None, on_dead=None):
     """Build the trainer-side :class:`PeerHeartbeat` from the pod
     contract the launcher / pod supervisor exports (``KFAC_HB_*``), or
     None when no pod heartbeat is configured. NOT started — callers
     ``start()`` it once logging is set up, and ``stop()`` it on clean
-    exit."""
+    exit.
+
+    Transport selection (``KFAC_HB_TRANSPORT``): ``file`` (default when
+    ``KFAC_HB_DIR`` is set) polls peer leases in the shared dir; ``tcp``
+    binds a responder on ``KFAC_HB_PORT`` and probes the peers named in
+    ``KFAC_HB_PEERS`` — no shared filesystem in the liveness path, which
+    is what real multi-host pods need (``launch_tpu.sh`` defaults them
+    to tcp)."""
+    kind = os.environ.get(ENV_TRANSPORT, '').strip().lower()
     lease_dir = os.environ.get(ENV_DIR)
-    if not lease_dir:
+    if not kind:
+        kind = 'file' if lease_dir else ''
+    if kind not in ('file', 'tcp'):
+        if kind:
+            raise ValueError(f'{ENV_TRANSPORT} must be "file" or "tcp", '
+                             f'got {kind!r}')
         return None
     host_id = int(os.environ.get(ENV_HOST, '0'))
     num_hosts = int(os.environ.get(ENV_HOSTS, '1'))
     if num_hosts <= 1:
         return None
+    if kind == 'tcp':
+        peers_spec = os.environ.get(ENV_PEERS)
+        if not peers_spec:
+            raise ValueError(f'{ENV_TRANSPORT}=tcp needs {ENV_PEERS} '
+                             '("rank=host:port,..." for every rank)')
+        port = int(os.environ.get(ENV_PORT, str(DEFAULT_TCP_PORT)))
+        transport = TcpHeartbeatTransport(
+            host_id, port, parse_peer_addrs(peers_spec))
+    elif not lease_dir:
+        return None
+    else:
+        transport = FileLeaseTransport(lease_dir, host_id)
     stop_step = os.environ.get(ENV_HB_STOP)
+    gen = os.environ.get(ENV_GEN) or os.environ.get('KFAC_POD_GEN') or '0'
     return PeerHeartbeat(
-        FileLeaseTransport(lease_dir, host_id), host_id, num_hosts,
+        transport, host_id, num_hosts,
         interval=float(os.environ.get(ENV_INTERVAL, '2.0')),
         deadline=float(os.environ.get(ENV_DEADLINE, '10.0')),
         startup_grace=float(os.environ.get(ENV_GRACE, '60.0')),
         stop_beat_step=int(stop_step) if stop_step else None,
-        on_dead=on_dead, log=log)
+        gen=int(gen), on_dead=on_dead, log=log)

@@ -31,13 +31,14 @@ import time
 # per-epoch delta: aggregate by SUM.
 _CUMULATIVE = frozenset({
     'restarts', 'crashes', 'hangs', 'gave_up', 'fenced', 'shrinks',
-    'straggler_level',
+    'grows', 'joins', 'straggler_level',
 })
 
 # suffix keys that are event FIELDS riding along in a [resilience: ...]
-# line (heartbeat's peer=/detect_s=), not counters — the event regexes
-# capture them; aggregating them as counts would be nonsense
-_NON_COUNTERS = frozenset({'peer', 'detect_s'})
+# line (heartbeat's peer=/detect_s=, the join announcement's host=), not
+# counters — the event regexes capture them; aggregating them as counts
+# would be nonsense
+_NON_COUNTERS = frozenset({'peer', 'detect_s', 'host'})
 
 # one regex per event-emitting module, matching the exact log forms
 _PATTERNS = (
@@ -55,6 +56,29 @@ _PATTERNS = (
     ('shrink', re.compile(
         r'elastic: shrinking world (?P<from>\d+) -> (?P<to>\d+) '
         r'survivors=(?P<survivors>\[[^\]]*\]) gen=(?P<gen>\d+)')),
+    # the grow cycle (elastic GROW / train-through-churn): a repaired
+    # host's announcement, each supervisor's claim into the grow
+    # barrier, the agreed enlargement, and the trainer-side upward
+    # factor transport — one event per protocol stage so a churn
+    # timeline can pin death -> shrink -> join -> grow causally
+    ('join_announce', re.compile(
+        r'join: host (?P<host>\d+) announcing to pod')),
+    ('grow_claim', re.compile(
+        r'elastic: grow claim written host=(?P<host>\d+) '
+        r'gen=(?P<gen>\d+)')),
+    ('grow', re.compile(
+        r'elastic: growing world (?P<from>\d+) -> (?P<to>\d+) '
+        r'members=(?P<members>\[[^\]]*\]) gen=(?P<gen>\d+) '
+        r'joiners=(?P<joiners>\[[^\]]*\])')),
+    ('grow_resharded', re.compile(
+        r'elastic: grow reshard from_world=(?P<from>\d+) '
+        r'to_world=(?P<to>\d+) step=(?P<step>\d+)')),
+    # trainer-side world-change hook (training.world_change_rescale):
+    # what the batch/lr actually became after a shrink/grow
+    ('world_rescale', re.compile(
+        r'WORLD_RESCALE from_world=(?P<from>\d+) to_world=(?P<to>\d+) '
+        r'global_batch=(?P<global_batch>\d+) '
+        r'lr=(?P<lr>[\d.eE+-]+) lr_factor=(?P<lr_factor>[\d.eE+-]+)')),
     ('straggler_degrade', re.compile(
         r'straggler: step-time EMA (?P<ema_s>[\d.]+)s over budget '
         r'(?P<budget_s>[\d.]+)s(?: at step (?P<step>\d+))? — stretching '
@@ -189,6 +213,7 @@ class IncidentReport:
         restarts = [e for e in self.events if e['kind'] in
                     ('restart', 'relaunch')]
         shrinks = [e for e in self.events if e['kind'] == 'shrink']
+        grows = [e for e in self.events if e['kind'] == 'grow']
         degrades = [e for e in self.events if e['kind'] ==
                     'straggler_degrade']
         steps_lost = sum(e.get('steps_lost', 0) for e in self.events
@@ -204,6 +229,10 @@ class IncidentReport:
             'shrinks': [{'from': e.get('from'), 'to': e.get('to'),
                          'survivors': e.get('survivors'),
                          'gen': e.get('gen')} for e in shrinks],
+            'grows': [{'from': e.get('from'), 'to': e.get('to'),
+                       'members': e.get('members'),
+                       'joiners': e.get('joiners'),
+                       'gen': e.get('gen')} for e in grows],
             'degrade_windows': len(degrades),
             'steps_lost': steps_lost or None,
             'gave_up': bool(self.counters.get('gave_up')
@@ -231,6 +260,9 @@ class IncidentReport:
         for s in d['shrinks']:
             lines.append(f"  pod shrank {s['from']} -> {s['to']} hosts "
                          f"(gen {s['gen']}, survivors {s['survivors']})")
+        for g in d['grows']:
+            lines.append(f"  pod grew {g['from']} -> {g['to']} hosts "
+                         f"(gen {g['gen']}, joiners {g['joiners']})")
         if d['degrade_windows']:
             lines.append(f"  straggler degrade windows: "
                          f"{d['degrade_windows']}")

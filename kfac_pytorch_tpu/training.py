@@ -17,7 +17,7 @@ collectives over the same axis.
 """
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import flax.struct
 import jax
@@ -55,6 +55,83 @@ def sgd(lr_schedule, momentum=0.9, weight_decay=0.0, nesterov=False):
     parts.append(optax.trace(decay=momentum, nesterov=nesterov))
     parts.append(optax.scale_by_learning_rate(lr_schedule))
     return optax.chain(*parts)
+
+
+class WorldRescale(NamedTuple):
+    """What the batch geometry and learning rate become after an
+    elastic world change (:func:`world_change_rescale`)."""
+    old_world: int
+    new_world: int
+    global_batch: int          # achieved global batch AFTER the change
+    per_host_batch: int        # achieved per-host batch AFTER the change
+    lr: float                  # rescaled learning rate
+    lr_factor: float           # lr multiplier actually applied
+
+    def log_line(self):
+        """The machine-greppable trainer protocol line
+        (``incident.EVENT_PATTERNS`` 'world_rescale'): emit it verbatim
+        so the churn timeline can show what the hyper-parameters became
+        on each shrink/grow."""
+        return (f'WORLD_RESCALE from_world={self.old_world} '
+                f'to_world={self.new_world} '
+                f'global_batch={self.global_batch} '
+                f'lr={self.lr:g} lr_factor={self.lr_factor:g}')
+
+
+def world_change_rescale(old_world, new_world, *, lr,
+                         global_batch=None, per_host_batch=None,
+                         lr_scaling='linear'):
+    """Batch-size / learning-rate hook for an elastic shrink or grow:
+    liveness is the supervisor's job, this keeps the ACCURACY contract
+    across the world change.
+
+    Exactly one of ``global_batch`` / ``per_host_batch`` names the
+    deployment's batch invariant:
+
+    - ``global_batch``: the GLOBAL batch is fixed (single-process
+      trainers whose loader already produces the full batch; pods that
+      re-split a fixed token budget). The per-host share re-derives as
+      ``ceil(global / new_world)`` and the optimization trajectory is
+      unchanged, so ``lr_factor`` is exactly 1 — the hook's job is to
+      RECORD that nothing needed rescaling.
+    - ``per_host_batch``: the PER-HOST batch is fixed (the common pod
+      shape — each host feeds its local batch and the global batch IS
+      ``per_host * world``). The global batch scales with the world, and
+      the lr follows it under ``lr_scaling``: ``'linear'`` (Goyal et
+      al. — the rule the reference's warmup_multistep scale already
+      applies at launch time), ``'sqrt'``, or ``'none'`` (record only).
+
+    Returns a :class:`WorldRescale`; trainers log ``result.log_line()``
+    (the ``world_rescale`` event form) and apply ``result.lr`` /
+    ``result.per_host_batch``. Typically wired through
+    ``resilience.elastic_resume(on_world_change=...)`` so the hook
+    fires exactly when a cross-world transport happened.
+    """
+    old_world, new_world = int(old_world), int(new_world)
+    if old_world < 1 or new_world < 1:
+        raise ValueError('world sizes must be >= 1, got '
+                         f'{old_world} -> {new_world}')
+    if (global_batch is None) == (per_host_batch is None):
+        raise ValueError('pass exactly one of global_batch / '
+                         'per_host_batch (the batch invariant)')
+    if lr_scaling not in ('linear', 'sqrt', 'none'):
+        raise ValueError(f'lr_scaling must be linear/sqrt/none, '
+                         f'got {lr_scaling!r}')
+    if global_batch is not None:
+        global_batch = int(global_batch)
+        per_host = max(1, -(-global_batch // new_world))  # ceil div
+        factor = 1.0
+        new_global = global_batch
+    else:
+        per_host = int(per_host_batch)
+        old_global = per_host * old_world
+        new_global = per_host * new_world
+        ratio = new_global / old_global
+        factor = {'linear': ratio, 'sqrt': float(np.sqrt(ratio)),
+                  'none': 1.0}[lr_scaling]
+    return WorldRescale(old_world=old_world, new_world=new_world,
+                        global_batch=new_global, per_host_batch=per_host,
+                        lr=float(lr) * factor, lr_factor=factor)
 
 
 def _warm_basis_gate(precond, seen, step, ui, ub):

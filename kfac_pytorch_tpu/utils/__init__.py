@@ -9,7 +9,8 @@ from kfac_pytorch_tpu.utils.losses import (
 from kfac_pytorch_tpu.utils.checkpoint import (
     save_checkpoint, restore_checkpoint, find_resume_epoch, auto_resume,
     PreemptionGuard, wait_for_checkpoints, prune_checkpoints,
-    reshard_kfac_state, write_world_stamp, read_world_stamp)
+    reshard_kfac_state, write_world_stamp, read_world_stamp,
+    read_world_stamp_info)
 from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
@@ -21,5 +22,6 @@ __all__ = [
     'auto_resume',
     'PreemptionGuard', 'wait_for_checkpoints', 'prune_checkpoints',
     'reshard_kfac_state', 'write_world_stamp', 'read_world_stamp',
+    'read_world_stamp_info',
     'trace', 'time_steps', 'exclude_parts_breakdown',
 ]

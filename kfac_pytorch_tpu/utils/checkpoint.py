@@ -157,7 +157,10 @@ def reshard_kfac_state(pre_old, pre_new, kfac_state):
     The stacked-bucket layout is device-major per world size (plan.py),
     so a num_devices change reshuffles which row of which bucket holds
     each layer's factor — both plans' ``layer_rows`` maps make the
-    transport exact. Only the FACTORS (the accumulated statistics —
+    transport exact, and in BOTH directions: shrinking packs the rows
+    into fewer shards, growing spreads them over more (any pad rows the
+    new, less-even layout needs start from the fresh zero init and are
+    never read — pad-row-exact, pinned by the N->M->N roundtrip tests). Only the FACTORS (the accumulated statistics —
     the state that takes thousands of steps to rebuild) are carried;
     decompositions re-initialize to zero and are recomputed at the
     first inverse update, exactly the fresh-start degrade path the
@@ -191,33 +194,49 @@ def reshard_kfac_state(pre_old, pre_new, kfac_state):
         factors={k: jnp.asarray(v) for k, v in factors.items()})
 
 
-def write_world_stamp(base_dir, num_devices):
+def write_world_stamp(base_dir, num_devices, gen=None):
     """Record the K-FAC world size the checkpoints in ``base_dir`` were
     taken at (``world.json``, atomic, rank-0 only). The elastic resume
     path (``resilience.elastic.elastic_resume``) compares this stamp to
-    the relaunched trainer's world and routes a mismatch through
-    :func:`reshard_kfac_state` — without the stamp a shrunken pod would
-    try to restore factor buckets shaped for the old mesh and die on a
-    structure mismatch."""
+    the relaunched trainer's world and routes a mismatch — in EITHER
+    direction: a shrunken pod reshards down, a re-grown one reshards up
+    — through :func:`reshard_kfac_state`; without the stamp the relaunch
+    would try to restore factor buckets shaped for the old mesh and die
+    on a structure mismatch. ``gen`` (optional) records the pod
+    generation the stamp was written under (``KFAC_POD_GEN`` from the
+    pod supervisor) — provenance for churn forensics, not protocol
+    state."""
     if jax.process_index() != 0:
         return
     from kfac_pytorch_tpu.resilience import atomic_write_json
     os.makedirs(base_dir, exist_ok=True)
+    stamp = {'num_devices': int(num_devices)}
+    if gen is not None:
+        stamp['gen'] = int(gen)
     atomic_write_json(os.path.join(os.path.abspath(base_dir),
-                                   'world.json'),
-                      {'num_devices': int(num_devices)})
+                                   'world.json'), stamp)
+
+
+def read_world_stamp_info(base_dir):
+    """The full ``world.json`` payload (``num_devices`` plus the
+    optional ``gen`` provenance), or None. A corrupt/absent stamp reads
+    as None — same-world resume, never a crash."""
+    import json
+    path = os.path.join(os.path.abspath(base_dir), 'world.json')
+    try:
+        with open(path) as f:
+            stamp = json.load(f)
+        stamp['num_devices'] = int(stamp['num_devices'])
+        return stamp
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
 
 
 def read_world_stamp(base_dir):
     """The ``num_devices`` recorded by :func:`write_world_stamp`, or
     None (no stamp — pre-elastic checkpoints resume as same-world)."""
-    import json
-    path = os.path.join(os.path.abspath(base_dir), 'world.json')
-    try:
-        with open(path) as f:
-            return int(json.load(f)['num_devices'])
-    except (OSError, ValueError, KeyError, TypeError):
-        return None
+    stamp = read_world_stamp_info(base_dir)
+    return None if stamp is None else stamp['num_devices']
 
 
 def wait_for_checkpoints():

@@ -54,6 +54,53 @@ if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi
 
+# Peer-heartbeat transport (KFAC_HB_*, resilience/heartbeat.py).
+# Contract consumed by heartbeat_from_env in every trainer:
+#   KFAC_HB_TRANSPORT  file | tcp  (default: tcp when the pod has >1
+#                      worker, file otherwise — file leases need a
+#                      shared POSIX filesystem, which real multi-host
+#                      pods don't have; single-host smoke runs keep the
+#                      zero-config lease dir)
+#   KFAC_HB_PORT       port each host's TCP responder binds (8478)
+#   KFAC_HB_PEERS      "rank=host:port,..." for every rank; derived
+#                      below from KFAC_HB_WORKERS="ip0 ip1 ..." (the
+#                      pod's worker addresses in rank order) when unset
+#   KFAC_HB_HOST/HOSTS this rank / world size (default: the jax pod
+#                      coordination env)
+#   KFAC_HB_INTERVAL/DEADLINE/GRACE  beat cadence / silence-to-death /
+#                      startup grace, seconds
+#   KFAC_HB_GEN        pod generation (the pod supervisor re-exports it
+#                      per shrink/grow so a rejoined host's restarted
+#                      sequence counter is never misread as stale)
+nworkers="${JAX_NUM_PROCESSES:-1}"
+if [ -z "$KFAC_HB_TRANSPORT" ] && [ "$nworkers" -gt 1 ] \
+    && { [ -n "$KFAC_HB_PEERS" ] || [ -n "$KFAC_HB_WORKERS" ]; }; then
+  # multi-host with a derivable peer map: tcp is the default transport
+  export KFAC_HB_TRANSPORT=tcp
+fi
+if [ "$KFAC_HB_TRANSPORT" = tcp ]; then
+  export KFAC_HB_PORT="${KFAC_HB_PORT:-8478}"
+  if [ -z "$KFAC_HB_PEERS" ]; then
+    if [ -n "$KFAC_HB_WORKERS" ]; then
+      i=0; peers=""
+      for w in $KFAC_HB_WORKERS; do
+        peers="${peers:+$peers,}$i=$w:$KFAC_HB_PORT"
+        i=$((i+1))
+      done
+      export KFAC_HB_PEERS="$peers"
+    else
+      # tcp was asked for EXPLICITLY but the peer map is underivable —
+      # fail loudly rather than run a pod whose hosts can't see each
+      # other die
+      echo "launch_tpu.sh: KFAC_HB_TRANSPORT=tcp needs KFAC_HB_PEERS" \
+           "(rank=host:port,...) or KFAC_HB_WORKERS (\"ip0 ip1 ...\")" >&2
+      exit 1
+    fi
+  fi
+  export KFAC_HB_HOST="${KFAC_HB_HOST:-${JAX_PROCESS_ID:-0}}"
+  export KFAC_HB_HOSTS="${KFAC_HB_HOSTS:-$nworkers}"
+fi
+
 # Pod-resilience wrapper: KFAC_POD_SUPERVISE=1 runs the trainer under
 # the per-host kfac-pod-supervise loop (resilience/elastic.py) — on top
 # of the crash/hang restarts below, the supervisors heartbeat each other
@@ -65,6 +112,11 @@ fi
 # An incident report JSON lands in the lease dir on every exit path.
 # Requires JAX_PROCESS_ID / JAX_NUM_PROCESSES (the pod coordination env
 # above) and a checkpoint dir, like KFAC_SUPERVISE.
+# Rejoin after repair: KFAC_POD_JOIN=1 on the REPAIRED host announces
+# it on the heartbeat channel instead of cold-launching; the incumbent
+# pod runs the grow barrier, every trainer relaunches at the enlarged
+# world, and factor state reshards UP through elastic_resume. Exit 116
+# (join_failed) means the pod never answered within KFAC_JOIN_TIMEOUT.
 if [ -n "$KFAC_POD_SUPERVISE" ]; then
   : "${KFAC_POD_LEASE_DIR:?KFAC_POD_SUPERVISE=1 needs KFAC_POD_LEASE_DIR (shared across hosts)}"
   exec "${PY:-python}" -m kfac_pytorch_tpu.resilience.elastic \
@@ -72,6 +124,8 @@ if [ -n "$KFAC_POD_SUPERVISE" ]; then
     --num-hosts "${JAX_NUM_PROCESSES:-1}" \
     --lease-dir "$KFAC_POD_LEASE_DIR" \
     ${KFAC_HOST_ADDR:+--host-addr "$KFAC_HOST_ADDR"} \
+    ${KFAC_POD_JOIN:+--join} \
+    ${KFAC_JOIN_TIMEOUT:+--join-timeout "$KFAC_JOIN_TIMEOUT"} \
     --max-restarts "${KFAC_MAX_RESTARTS:-3}" \
     --backoff-base "${KFAC_RESTART_BACKOFF:-2}" \
     --hb-interval "${KFAC_HB_INTERVAL:-2}" \

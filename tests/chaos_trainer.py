@@ -24,6 +24,11 @@ Protocol with tests/test_chaos.py + tests/test_pod_chaos.py (stdout):
   ``EPOCH <e> step=<s> loss=<l>``  after each epoch (post-checkpoint)
   ``RESUMED from=checkpoint-<e> step=<s>``  on any resume
   ``RESHARDED from_world=<o> to_world=<n> step=<s>``  on elastic resume
+  ``WORLD_RESCALE from_world=<o> to_world=<n> global_batch=<b> lr=<l>
+  lr_factor=<f>``  on elastic resume (the world-change hook fired;
+  this trainer's batch stream is global-fixed, so lr_factor is 1 and
+  the schedule stays world-size independent — the line PROVES the
+  hook ran without perturbing schedule equivalence)
   ``DONE final_step=<s> epochs=<e>``  on clean completion
 The DONE line is the schedule-equivalence assertion: a SIGKILLed /
 hung / restarted / shrunken run must end with the same line as an
@@ -120,10 +125,21 @@ def main():
     io_retry = (resilience.RetryPolicy(attempts=args.io_retries + 1,
                                        base_delay=0.05)
                 if args.io_retries > 0 else None)
+
+    def on_world_change(ow, nw):
+        # the accuracy-preserving hook: this trainer's (seeded) batch
+        # stream is GLOBAL-fixed, so the rescale is exactly identity —
+        # printing the protocol line proves the hook fired on every
+        # shrink/grow without perturbing the DONE-line schedule
+        res = training.world_change_rescale(ow, nw, lr=0.05,
+                                            global_batch=args.batch_size)
+        print(res.log_line(), flush=True)
+
     start_epoch = 0
     restored, resume, old_world = resilience.elastic_resume(
         args.checkpoint_dir, args.epochs, precond, state,
-        make_precond=make_old_precond, retry=io_retry)
+        make_precond=make_old_precond, retry=io_retry,
+        on_world_change=on_world_change)
     if resume is not None:
         state = restored
         start_epoch = resume + 1
@@ -163,7 +179,8 @@ def main():
                 watchdog.disarm()
         checkpoint.save_checkpoint(args.checkpoint_dir, epoch, state,
                                    retry=io_retry)
-        checkpoint.write_world_stamp(args.checkpoint_dir, world)
+        checkpoint.write_world_stamp(args.checkpoint_dir, world,
+                                     gen=os.environ.get('KFAC_POD_GEN'))
         print(f'EPOCH {epoch} step={int(state.step)} loss={loss:.4f}',
               flush=True)
         if tracer is not None:

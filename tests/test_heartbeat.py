@@ -14,8 +14,9 @@ import pytest
 
 from kfac_pytorch_tpu import resilience
 from kfac_pytorch_tpu.resilience.heartbeat import (
-    RC_PEER_DEAD, FileLeaseTransport, PeerHeartbeat,
-    TcpHeartbeatTransport, heartbeat_from_env)
+    RC_PEER_DEAD, FileLeaseTransport, JoinAnnouncer, PeerHeartbeat,
+    TcpHeartbeatTransport, format_peer_addrs, heartbeat_from_env,
+    parse_peer_addrs, read_join_announcements)
 from kfac_pytorch_tpu.resilience.retry import ManualClock
 from kfac_pytorch_tpu.utils.runlog import parse_resilience_suffix
 
@@ -106,6 +107,124 @@ def test_restarted_peer_with_reset_sequence_stays_alive(tmp_path):
         h0.poll_once()
         c0.sleep(1.0)
     assert deaths and deaths[0][0] == 1
+
+
+def test_rejoined_peer_new_gen_not_misread_as_stale(tmp_path):
+    """The grow-path regression (ISSUE 6 satellite): a host re-admitted
+    at a later GENERATION restarts its sequence counter — under a
+    recycled pid, judging it by the previous generation's high-water
+    mark would declare the rejoined host dead on arrival. Liveness
+    identity is (pid, gen, seq), and the monitor's rebase() on a
+    generation change forgets the old tracking entirely."""
+    c0 = ManualClock()
+    deaths = []
+    h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       interval=1.0, deadline=4.0, startup_grace=30.0,
+                       clock=c0.monotonic, gen=0,
+                       on_dead=lambda p, i: deaths.append((p, i)))
+    t1 = FileLeaseTransport(tmp_path, 1)
+    # peer ran to seq 500 at generation 0, then was lost...
+    t1.publish({'host': 1, 'seq': 500, 'pid': 111, 'gen': 0, 'step': 500})
+    h0.poll_once()
+    c0.sleep(2.0)
+    # ...the pod shrank (gen 1) and re-grew (gen 2); the monitor rebases
+    h0.rebase(peers=[1], gen=2)
+    # the rejoined host comes back under the SAME (recycled) pid with a
+    # reset counter but the NEW generation — it must read as alive
+    for seq in range(1, 10):
+        t1.publish({'host': 1, 'seq': seq, 'pid': 111, 'gen': 2,
+                    'step': seq})
+        h0.poll_once()
+        c0.sleep(1.0)
+    assert deaths == [], deaths
+    # and identity still catches a FROZEN payload: same (pid, gen, seq)
+    # not advancing past the deadline is a death
+    for _ in range(8):
+        h0.poll_once()
+        c0.sleep(1.0)
+    assert deaths and deaths[0][0] == 1
+
+
+def test_rebase_clears_dead_records_and_restarts_grace(tmp_path):
+    """rebase() must (a) drop dead-peer records — the new membership was
+    agreed AROUND the deaths, and a carried record would re-fire the
+    reaction every generation — and (b) restart the startup grace, so a
+    just-admitted member slow to its first beat is not declared dead
+    with the OLD grace long spent."""
+    c0 = ManualClock()
+    deaths = []
+    h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       interval=1.0, deadline=2.0, startup_grace=5.0,
+                       clock=c0.monotonic,
+                       on_dead=lambda p, i: deaths.append((p, i)))
+    h0.poll_once()  # arms the grace clock
+    c0.sleep(6.0)   # past grace, peer 1 never seen
+    h0.poll_once()
+    assert deaths and h0.dead_peers()
+    h0.rebase(peers=[1], gen=1)
+    assert h0.dead_peers() == {}
+    deaths.clear()
+    # fresh grace: 4s of silence right after the rebase is NOT a death
+    c0.sleep(4.0)
+    h0.poll_once()
+    assert deaths == []
+    assert h0.gen == 1
+
+
+def test_join_announcer_roundtrip_and_withdraw(tmp_path):
+    assert read_join_announcements(tmp_path) == {}
+    ann = JoinAnnouncer(tmp_path, 3, addr='10.0.0.3:8476')
+    ann.announce()
+    ann.announce()  # republish: seq advances under one pid
+    seen = read_join_announcements(tmp_path)
+    assert list(seen) == [3]
+    assert seen[3]['addr'] == '10.0.0.3:8476'
+    assert seen[3]['seq'] == 2 and seen[3]['pid'] == os.getpid()
+    ann.withdraw()
+    assert read_join_announcements(tmp_path) == {}
+    ann.withdraw()  # idempotent
+    # junk in the lease dir is not an announcement
+    (tmp_path / 'join-notanint.json').write_text('{}')
+    (tmp_path / 'join-5.json').write_text('not json')
+    assert read_join_announcements(tmp_path) == {}
+
+
+def test_peer_addr_spec_roundtrip():
+    spec = '0=10.0.0.1:8478,2=hostb:9000'
+    addrs = parse_peer_addrs(spec)
+    assert addrs == {0: ('10.0.0.1', 8478), 2: ('hostb', 9000)}
+    assert format_peer_addrs(addrs) == spec
+    with pytest.raises(ValueError, match='rank=host:port'):
+        parse_peer_addrs('garbage')
+
+
+def test_heartbeat_from_env_tcp(monkeypatch):
+    """The tcp contract launch_tpu.sh exports for real (no shared
+    filesystem) pods: transport comes up bound, peers parsed, and the
+    generation rides into the published payload."""
+    from kfac_pytorch_tpu.resilience import heartbeat as hb_mod
+    monkeypatch.setenv(hb_mod.ENV_TRANSPORT, 'tcp')
+    monkeypatch.setenv(hb_mod.ENV_HOST, '0')
+    monkeypatch.setenv(hb_mod.ENV_HOSTS, '2')
+    monkeypatch.setenv(hb_mod.ENV_PORT, '0')  # ephemeral: test only
+    monkeypatch.setenv(hb_mod.ENV_PEERS, '1=127.0.0.1:19')
+    monkeypatch.setenv(hb_mod.ENV_GEN, '3')
+    hb = heartbeat_from_env()
+    try:
+        assert isinstance(hb.transport, TcpHeartbeatTransport)
+        assert hb.transport.peer_addrs == {1: ('127.0.0.1', 19)}
+        assert hb.gen == 3
+        # publish stamps the generation (rejoin-vs-stale disambiguation)
+        hb._publish()
+        import json
+        assert json.loads(hb.transport._payload)['gen'] == 3
+    finally:
+        hb.stop()
+    # tcp without a peer map is a configuration error, not a silent
+    # heartbeat-less run
+    monkeypatch.delenv(hb_mod.ENV_PEERS)
+    with pytest.raises(ValueError, match='KFAC_HB_PEERS'):
+        heartbeat_from_env()
 
 
 def test_peer_never_seen_respects_startup_grace(tmp_path):

@@ -32,6 +32,74 @@ GAVE_UP = ('2026-08-02 11:00:00 supervisor: trainer exited rc=113 (crash) '
            'and the restart budget (2) is spent — giving up '
            '[resilience: crashes=3 gave_up=1 restarts=2]')
 
+# one full GROW cycle, fixture lines copied from the real log forms
+# (heartbeat.JoinAnnouncer, elastic._grow / _join_pod, elastic_resume,
+# training.WorldRescale.log_line) — the churn counterpart of LOG above
+GROW_LOG = """\
+2026-08-02 12:00:00,000 join: host 1 announcing to pod (lease /shared/hb) [resilience: join_announce=1 host=1]
+2026-08-02 12:00:01,000 pod-supervisor: join announced — stopping the trainer for the grow barrier
+2026-08-02 12:00:01,000 elastic: grow claim written host=0 gen=2
+2026-08-02 12:00:02,000 elastic: grow claim written host=1 gen=2
+2026-08-02 12:00:03,000 elastic: growing world 2 -> 3 members=[0, 1, 2] gen=2 joiners=[1] [resilience: restarts=2 shrinks=1 grows=1]
+2026-08-02 12:00:04,000 join: admitted into pod as rank 1 — world 3 gen=2 members=[0, 1, 2] [resilience: joins=1]
+2026-08-02 12:00:09,000 elastic: grow reshard from_world=2 to_world=3 step=142
+RESHARDED from_world=2 to_world=3 step=142
+WORLD_RESCALE from_world=2 to_world=3 global_batch=96 lr=0.1 lr_factor=1
+RESUMED from=checkpoint-3 step=142
+"""
+
+
+def test_scrape_extracts_grow_cycle():
+    """The grow-lane grammar (ISSUE 6 satellite): every protocol stage
+    of a rejoin — announcement, claims, barrier agreement, upward
+    reshard, hyper-parameter rescale — is a typed event, and the shared
+    EVENT_PATTERNS table means kfac-obs renders the same cycle with no
+    code of its own."""
+    rep = IncidentReport(host_id=0).scrape_lines(GROW_LOG.splitlines())
+    kinds = [e['kind'] for e in rep.events]
+    for expected in ('join_announce', 'grow_claim', 'grow',
+                     'grow_resharded', 'world_rescale', 'resharded',
+                     'resumed'):
+        assert expected in kinds, (expected, kinds)
+    d = rep.to_dict()
+    assert d['grows'] == [{'from': 2, 'to': 3, 'members': '[0, 1, 2]',
+                           'joiners': '[1]', 'gen': 2}]
+    grow_claims = [e for e in rep.events if e['kind'] == 'grow_claim']
+    assert [(e['host'], e['gen']) for e in grow_claims] == [(0, 2),
+                                                            (1, 2)]
+    reshard = next(e for e in rep.events
+                   if e['kind'] == 'grow_resharded')
+    assert (reshard['from'], reshard['to'], reshard['step']) == (2, 3,
+                                                                 142)
+    rescale = next(e for e in rep.events
+                   if e['kind'] == 'world_rescale')
+    assert rescale['global_batch'] == 96 and rescale['lr_factor'] == 1
+    # cumulative counters: grows/joins max'd, announce-host field is
+    # NOT a counter
+    assert rep.counters['grows'] == 1 and rep.counters['joins'] == 1
+    assert 'host' not in rep.counters
+    assert 'pod grew 2 -> 3 hosts' in rep.summary()
+
+
+def test_grow_events_land_on_the_pod_timeline(tmp_path):
+    """Shared-grammar invariant, exercised from the OTHER consumer: the
+    kfac-obs timeline renders the grow cycle in causal clock order from
+    the same pattern table."""
+    from kfac_pytorch_tpu.obs import aggregate
+    log = tmp_path / 'host0.out'
+    log.write_text(GROW_LOG)
+    timeline = aggregate.build_timeline([str(log)])
+    kinds = [e['kind'] for e in timeline['events']]
+    i_join = kinds.index('join_announce')
+    i_claim = kinds.index('grow_claim')
+    i_grow = kinds.index('grow')
+    i_reshard = kinds.index('grow_resharded')
+    assert i_join < i_claim < i_grow < i_reshard
+    walls = [timeline['events'][i]['wall_aligned']
+             for i in (i_join, i_claim, i_grow, i_reshard)]
+    assert all(w is not None for w in walls)
+    assert walls == sorted(walls)
+
 
 def _report(text=LOG):
     return IncidentReport(host_id=0).scrape_lines(text.splitlines())

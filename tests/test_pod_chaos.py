@@ -1,9 +1,10 @@
-"""Pod-level chaos drill with REAL processes (``-m slow``).
+"""Pod-level chaos drills with REAL processes (``-m slow``).
 
-The acceptance drill for the pod-resilience layer: a two-host pod (one
-``kfac-pod-supervise`` + one real mini trainer per host, sharing a
-lease directory) loses host 1 to SIGKILL mid-run — the whole process
-GROUP dies, exactly like a host vanishing. The survivor must:
+Two acceptance drills for the pod-resilience layer. The SHRINK drill:
+a two-host pod (one ``kfac-pod-supervise`` + one real mini trainer per
+host, sharing a lease directory) loses host 1 to SIGKILL mid-run — the
+whole process GROUP dies, exactly like a host vanishing. The survivor
+must:
 
 - detect the death via the peer HEARTBEAT (within its deadline — not
   via a watchdog timeout: the trainer runs with a deliberately huge
@@ -16,6 +17,14 @@ GROUP dies, exactly like a host vanishing. The survivor must:
   single-host control run,
 - leaving an incident report JSON naming the dead host, the detection
   latency, and the restarts taken.
+
+The CHURN drill (ISSUE 6, elastic GROW): a THREE-host pod loses host 1
+to SIGKILL, shrinks 3 -> 2, re-admits the repaired host through the
+join protocol (``kfac-pod-supervise --join`` announces, the incumbents
+run the grow barrier, factor state reshards UP), grows 2 -> 3 — and
+survives the whole cycle TWICE, ending schedule-equivalent with
+incident JSON recording both shrinks and both grows and a ``kfac-obs``
+timeline pinning death -> shrink -> join -> grow in causal clock order.
 """
 
 import json
@@ -170,7 +179,13 @@ def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
     assert report['gave_up'] is False
     exits = [e for e in report['events'] if e['kind'] == 'trainer_exit']
     from kfac_pytorch_tpu.resilience.heartbeat import RC_PEER_DEAD
-    assert any(e.get('rc') == RC_PEER_DEAD for e in exits), exits
+    # the trainer's own monitor and the supervisor's race to the same
+    # detection (same deadline, same silence): the trainer self-aborts
+    # RC_PEER_DEAD, or the supervisor confirms first and stops it for
+    # the shrink (reason='peer_dead'). Both are the heartbeat path —
+    # the watchdog-less 'step deadline' assertion above pins that.
+    assert any(e.get('rc') == RC_PEER_DEAD
+               or e.get('reason') == 'peer_dead' for e in exits), exits
 
     # kfac-obs: ONE clock-aligned pod timeline from the drill's three
     # artifact classes (stdout runlogs, the incident report, the
@@ -201,8 +216,13 @@ def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
     i_dead = first('peer_dead', peer=1)
     detect = events[i_dead]['detail'].get('detect_s')
     assert detect and detect >= HB_DEADLINE, events[i_dead]
-    # the survivor's trainer aborting RC_PEER_DEAD (host-death fallout)
-    i_exit = first('trainer_exit', rc=RC_PEER_DEAD)
+    # the survivor's trainer going down for the peer death (either its
+    # own RC_PEER_DEAD self-abort, or the supervisor confirming first
+    # and stopping it — same detection race as the incident assertion)
+    i_exit = next(i for i, e in enumerate(events)
+                  if e['kind'] == 'trainer_exit'
+                  and (e['detail'].get('rc') == RC_PEER_DEAD
+                       or e['detail'].get('reason') == 'peer_dead'))
     # the shrink agreement and the resharded resume
     i_shrink = first('shrink')
     i_reshard = first('resharded')
@@ -235,3 +255,288 @@ def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
                       default=str)
         with open(os.path.join(art, 'pod_trace.json'), 'w') as f:
             json.dump(merged, f)
+
+
+# ---------------------------------------------------------------------------
+# the churn drill: SIGKILL -> shrink(3->2) -> rejoin -> grow(2->3), twice
+# ---------------------------------------------------------------------------
+
+CHURN_HB_DEADLINE = 3.0
+CHURN_EPOCHS = 16
+CHURN_BATCH = 12       # divides worlds 1/2/3 (shard_map needs even shards)
+CHURN_EXAMPLES = 72    # 6 steps/epoch
+
+
+def _churn_trainer_args(ckpt_dir):
+    return [sys.executable, TRAINER, '--epochs', str(CHURN_EPOCHS),
+            '--batch-size', str(CHURN_BATCH),
+            '--num-examples', str(CHURN_EXAMPLES),
+            '--checkpoint-dir', str(ckpt_dir),
+            '--num-hosts', '{num_hosts}', '--host-id', '{host_id}',
+            '--step-deadline', '300']  # watchdog present, must NOT fire
+
+
+def _churn_cmd(host_id, lease, ckpt_dir, join=False):
+    cmd = [sys.executable, '-m', 'kfac_pytorch_tpu.resilience.elastic',
+           '--host-id', str(host_id), '--num-hosts', '3',
+           '--lease-dir', str(lease),
+           '--max-restarts', '6', '--backoff-base', '0.2',
+           '--hb-interval', '0.25', '--hb-deadline',
+           str(CHURN_HB_DEADLINE),
+           '--hb-grace', '300', '--settle', '0.8',
+           '--shrink-timeout', '8', '--grow-timeout', '10']
+    if join:
+        cmd += ['--join', '--join-timeout', '300']
+    return cmd + ['--'] + _churn_trainer_args(ckpt_dir)
+
+
+def _wait_count(path, needle, count, timeout, procs=()):
+    """Poll ``path`` until ``needle`` occurs >= ``count`` times; fail
+    fast if any of ``procs`` (that should outlive this phase) died."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        text = path.read_text() if path.exists() else ''
+        if text.count(needle) >= count:
+            return text
+        for tag, p in procs:
+            if p.poll() is not None:
+                pytest.fail(f'{tag} exited rc={p.returncode} while '
+                            f'waiting for {needle!r} x{count}; tail: '
+                            + text[-3000:])
+        time.sleep(0.3)
+    pytest.fail(f'{needle!r} x{count} never appeared in {path}; tail: '
+                + (path.read_text()[-3000:] if path.exists() else '<none>'))
+
+
+def _killpg(proc):
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def _wait_stamp(ckpt_dir, world, timeout, procs=()):
+    """Wait until the checkpoint world stamp says ``world`` — i.e. the
+    pod has BANKED an epoch at that world size. The churn only proves an
+    upward reshard if the shrunken generation checkpointed before the
+    rejoin (the stamp is written after each epoch's save), so each
+    cycle gates on it before moving to the next phase."""
+    deadline = time.time() + timeout
+    path = os.path.join(str(ckpt_dir), 'world.json')
+    while time.time() < deadline:
+        try:
+            with open(path) as f:
+                if json.load(f).get('num_devices') == world:
+                    return
+        except (OSError, ValueError):
+            pass
+        for tag, p in procs:
+            if p.poll() is not None:
+                pytest.fail(f'{tag} exited rc={p.returncode} while '
+                            f'waiting for world stamp {world}')
+        time.sleep(0.3)
+    pytest.fail(f'world stamp never became {world} in {path}')
+
+
+def test_pod_survives_churn_kill_and_rejoin(tmp_path):
+    """Train-through-churn: kill -> shrink(3->2) -> rejoin -> grow(2->3),
+    twice, schedule-equivalent at DONE with the full death->shrink->
+    join->grow story on the merged kfac-obs timeline."""
+    # undisturbed single-host control fixes the schedule contract
+    p = subprocess.run(
+        [sys.executable, TRAINER, '--epochs', str(CHURN_EPOCHS),
+         '--batch-size', str(CHURN_BATCH),
+         '--num-examples', str(CHURN_EXAMPLES),
+         '--checkpoint-dir', str(tmp_path / 'ckpt_control')],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=540)
+    assert p.returncode == 0, p.stdout[-3000:]
+    control = _done_line(p.stdout)
+
+    lease = tmp_path / 'lease'
+    trace_dir = tmp_path / 'trace'
+    ckpts = {h: str(tmp_path / f'ckpt_h{h}') for h in range(3)}
+    outs = {h: tmp_path / f'host{h}.out' for h in range(3)}
+    rejoin_outs = [tmp_path / 'rejoin1.out', tmp_path / 'rejoin2.out']
+    # pace steps so every churn phase overlaps live training, never a
+    # finished schedule; per-host trace JSONL feeds the timeline merge
+    pod_env = _env(KFAC_FAULT_SLOW_STEP='0:999',
+                   KFAC_FAULT_SLOW_SECS='1.5',
+                   KFAC_TRACE_DIR=str(trace_dir))
+
+    def start(cmd, out_path):
+        f = open(out_path, 'wb')
+        proc = subprocess.Popen(cmd, env=pod_env, cwd=REPO, stdout=f,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        proc._outfile = f
+        return proc
+
+    procs = {}
+    rejoins = []
+    try:
+        for h in range(3):
+            procs[h] = start(_churn_cmd(h, lease, ckpts[h]), outs[h])
+
+        # epoch 0 banked everywhere: resumable state exists, run is live
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs.values()):
+                pytest.fail('a pod member exited before the first kill; '
+                            'host0 tail: ' + outs[0].read_text()[-3000:])
+            if all(_has_checkpoint(ckpts[h]) for h in range(3)):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail('epoch-0 checkpoints never appeared; host0 tail: '
+                        + outs[0].read_text()[-3000:])
+
+        survivors = [('host0', procs[0]), ('host2', procs[2])]
+        victim = procs[1]
+        for cycle in (1, 2):
+            # kill the current host-1 incarnation's whole process group
+            _killpg(victim)
+            victim.wait(timeout=30)
+            # survivors agree on the shrink and resume resharded DOWN
+            _wait_count(outs[0], 'elastic: shrinking world 3 -> 2',
+                        cycle, 240, survivors)
+            _wait_count(outs[0], 'RESHARDED from_world=3 to_world=2',
+                        cycle, 240, survivors)
+            # let the SHRUNKEN generation bank an epoch (stamp -> 2):
+            # only then does the grow relaunch genuinely reshard UP —
+            # rejoining against a still-3-stamped checkpoint would
+            # resume same-world and prove nothing
+            _wait_stamp(ckpts[0], 2, 240, survivors)
+            # the repaired host comes back through the join protocol
+            rejoin = start(_churn_cmd(1, lease, ckpts[1], join=True),
+                           rejoin_outs[cycle - 1])
+            rejoins.append(rejoin)
+            watch = survivors + [(f'rejoin{cycle}', rejoin)]
+            _wait_count(outs[0], 'elastic: growing world 2 -> 3',
+                        cycle, 300, watch)
+            # and the incumbents' trainers reshard UP into the grown pod
+            _wait_count(outs[0], 'RESHARDED from_world=2 to_world=3',
+                        cycle, 300, watch)
+            # grown generation banks an epoch (stamp -> 3) before the
+            # next kill, so cycle 2 reshards down from a real world-3
+            # checkpoint again
+            _wait_stamp(ckpts[0], 3, 300, watch)
+            victim = rejoin
+
+        # everyone left finishes the schedule (the end-game may cascade
+        # further shrinks as hosts complete at different epochs — that
+        # is the elastic layer working, not a failure)
+        rc0 = procs[0].wait(timeout=600)
+        rc2 = procs[2].wait(timeout=600)
+        rcr = rejoins[1].wait(timeout=600)
+    finally:
+        for proc in list(procs.values()) + rejoins:
+            if proc.poll() is None:
+                _killpg(proc)
+            f = getattr(proc, '_outfile', None)
+            if f is not None:
+                f.close()
+
+    out0 = outs[0].read_text()
+    assert rc0 == 0, out0[-4000:]
+    assert rc2 == 0, outs[2].read_text()[-4000:]
+    assert rcr == 0, rejoin_outs[1].read_text()[-4000:]
+
+    # detection was heartbeat-speed, never the (300s) watchdog
+    assert 'declared dead' in out0
+    assert 'step deadline exceeded' not in out0
+    # nobody fenced, nobody gave up
+    assert 'fenced' not in out0 and 'giving up' not in out0
+
+    # both full churn cycles are in host 0's story
+    assert out0.count('elastic: shrinking world 3 -> 2') >= 2
+    assert out0.count('elastic: growing world 2 -> 3') >= 2
+    assert out0.count('RESHARDED from_world=3 to_world=2') >= 2
+    assert out0.count('RESHARDED from_world=2 to_world=3') >= 2
+    # the world-change hook fired on every transport, identity rescale
+    assert 'WORLD_RESCALE from_world=2 to_world=3' in out0
+    assert 'lr_factor=1' in out0
+    # the rejoiner announced and was admitted, twice
+    for r_out in rejoin_outs:
+        text = r_out.read_text()
+        assert 'join: host 1 announcing to pod' in text
+        assert 'join: admitted into pod' in text, text[-2000:]
+
+    # schedule equivalence across the whole churn
+    assert _done_line(out0) == control
+
+    # incident report: both shrinks AND both grows, with the joiner named
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    assert report['gave_up'] is False
+    shrinks = [s for s in report['shrinks'] if s['from'] == 3]
+    assert len(shrinks) >= 2, report['shrinks']
+    grows = [g for g in report['grows']
+             if g['from'] == 2 and g['to'] == 3]
+    assert len(grows) >= 2, report['grows']
+    assert all(g['joiners'] == [1] for g in grows), grows
+    assert report['counters']['grows'] >= 2
+    # generations interleave: shrink gen < grow gen < next shrink gen
+    gens = [(e['gen'], e['kind']) for e in report['events']
+            if e['kind'] in ('shrink', 'grow')]
+    assert [k for _, k in gens[:4]] == ['shrink', 'grow', 'shrink',
+                                       'grow'], gens
+    assert [g for g, _ in gens] == sorted(g for g, _ in gens), gens
+
+    # kfac-obs: ONE clock-aligned timeline from logs + incidents +
+    # traces, pinning death -> shrink -> join -> grow causally
+    import glob
+
+    from kfac_pytorch_tpu.obs import aggregate
+    paths = [str(o) for o in outs.values()]
+    paths += [str(o) for o in rejoin_outs]
+    paths += sorted(glob.glob(str(lease / 'incident-host*.json')))
+    traces = sorted(glob.glob(str(trace_dir / '*.jsonl')))
+    assert traces, 'trainers wrote no trace JSONL under KFAC_TRACE_DIR'
+    timeline = aggregate.build_timeline(paths + traces)
+    events = timeline['events']
+    kinds = [e['kind'] for e in events]
+
+    def first(kind, after=0, **match):
+        for i in range(after, len(events)):
+            e = events[i]
+            if e['kind'] == kind and all(
+                    e['detail'].get(k) == v for k, v in match.items()):
+                return i
+        raise AssertionError(
+            f'{kind} {match or ""} missing after index {after}; kinds: '
+            f'{sorted(set(kinds))}')
+
+    # first cycle in causal order, then the SECOND death strictly after
+    # the first grow — the timeline proves churn, not a single incident
+    i_dead = first('peer_dead', peer=1)
+    i_shrink = first('shrink', after=i_dead)
+    i_join = first('join_announce', after=i_shrink)
+    i_grow = first('grow', after=i_join)
+    i_dead2 = first('peer_dead', after=i_grow, peer=1)
+    i_shrink2 = first('shrink', after=i_dead2)
+    i_join2 = first('join_announce', after=i_shrink2)
+    i_grow2 = first('grow', after=i_join2)
+    order = [i_dead, i_shrink, i_join, i_grow,
+             i_dead2, i_shrink2, i_join2, i_grow2]
+    assert order == sorted(order), order
+    walls = [events[i]['wall_aligned'] for i in order]
+    assert all(w is not None for w in walls), walls
+    assert walls == sorted(walls), walls
+    # the upward transports and the rescale hook made the timeline too
+    assert 'grow_resharded' in kinds
+    assert 'world_rescale' in kinds
+
+    # CI artifact export: keep the churn debris + aggregated timeline
+    art = os.environ.get('KFAC_DRILL_ARTIFACTS')
+    if art:
+        import shutil
+        churn_art = os.path.join(art, 'churn')
+        os.makedirs(churn_art, exist_ok=True)
+        for src in paths + traces:
+            shutil.copy(src, churn_art)
+        with open(os.path.join(churn_art, 'timeline.json'), 'w') as f:
+            json.dump({k: v for k, v in timeline.items()
+                       if not k.startswith('_')}, f, indent=2,
+                      default=str)
+        with open(os.path.join(churn_art, 'pod_trace.json'), 'w') as f:
+            json.dump(aggregate.merged_chrome_trace(timeline), f)

@@ -827,6 +827,498 @@ def test_pod_supervisor_stop_rc_propagates(tmp_path):
     assert sup.restarts == 0
 
 
+# ---------------------------------------------------------------------------
+# pod supervisor GROW lane (join announcements, grow barrier, --join
+# mode; the real 3-host churn drill is in tests/test_pod_chaos.py
+# behind -m slow)
+# ---------------------------------------------------------------------------
+
+def _world_gated_trainer(tmp_path, exit_world):
+    """A trainer that finishes (rc 0) only at the given world size and
+    sleeps otherwise — the first generation runs until the supervisor
+    stops it for the grow, the enlarged generation exits clean."""
+    trainer = tmp_path / 'trainer.py'
+    trainer.write_text(
+        'import sys, time\n'
+        f'if sys.argv[1] != {str(exit_world)!r}:\n'
+        '    time.sleep(600)\n')
+    return [sys.executable, str(trainer), '{num_hosts}']
+
+
+def test_pod_supervisor_grow_admits_announced_joiner(tmp_path):
+    """The incumbent side of the rejoin protocol: a join announcement
+    appears, the supervisor stops its (healthy) trainer at the next
+    boundary, runs the grow barrier with the joiner's claim, and
+    relaunches at the enlarged world/generation — none of it charged to
+    the crash budget."""
+    import json
+    import threading
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    from kfac_pytorch_tpu.resilience.heartbeat import JoinAnnouncer
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor(_world_gated_trainer(tmp_path, '2'),
+                        host_id=0, num_hosts=1, lease_dir=str(lease),
+                        max_restarts=1, backoff_base=0.01,
+                        settle=0.2, grow_timeout=5.0,
+                        poll_period=0.02, child_kill_grace=1.0)
+
+    def joiner():
+        # keep announcing (the real JoinAnnouncer republishes too —
+        # the supervisor's gen-0 scrub may eat an announcement that
+        # landed before startup), then claim into the barrier once the
+        # incumbent opens it
+        import time
+        ann = JoinAnnouncer(lease, 1, addr='hostb:8476')
+        deadline = time.monotonic() + 10
+        claim_dir = lease / 'grow-gen1'
+        while time.monotonic() < deadline:
+            ann.announce()
+            if (claim_dir / 'member-0.json').exists():
+                resilience.atomic_write_json(
+                    str(claim_dir / 'member-1.json'),
+                    {'host': 1, 'addr': 'hostb:8476'})
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=joiner)
+    t.start()
+    try:
+        rc = sup.run()
+    finally:
+        t.join()
+    assert rc == 0
+    assert sup.members == [0, 1] and sup.gen == 1
+    assert sup.grows == 1 and sup.crashes == 0 and sup.hangs == 0
+    assert sup._member_addrs[1] == 'hostb:8476'
+    # the announcement was consumed — a later death of host 1 cannot
+    # replay it into a spurious grow
+    assert not (lease / 'join-1.json').exists()
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    kinds = [e['kind'] for e in report['events']]
+    assert 'grow' in kinds and 'fenced' not in kinds
+    grow = next(e for e in report['events'] if e['kind'] == 'grow')
+    assert grow['from'] == 1 and grow['to'] == 2
+    assert grow['joiners'] == [1] and grow['gen'] == 1
+    exits = [e for e in report['events'] if e['kind'] == 'trainer_exit']
+    assert any(e.get('reason') == 'grow' for e in exits), exits
+    assert report['grows'][0]['to'] == 2
+    assert report['counters']['grows'] == 1
+
+
+def test_pod_supervisor_stale_join_announcement_aborts_grow(tmp_path):
+    """A join-*.json left by a previous life (its announcer never
+    claims) must not churn the pod: the barrier times out, the grow
+    aborts at the SAME membership and generation, the stale file is
+    scrubbed, and the relaunched trainer finishes — no livelock on the
+    supervisor's own lingering claims."""
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    lease.mkdir()
+    resilience.atomic_write_json(str(lease / 'join-1.json'),
+                                 {'host': 1, 'addr': None})
+    sup = PodSupervisor([sys.executable, '-c', 'import time;time.sleep(1)'],
+                        host_id=0, num_hosts=1, lease_dir=str(lease),
+                        max_restarts=1, backoff_base=0.01,
+                        settle=0.1, grow_timeout=0.5,
+                        poll_period=0.02, child_kill_grace=1.0)
+    # NOTE: _clear_stale_protocol_files scrubs gen-0 join debris at
+    # startup, which already defuses this scenario — drop the file
+    # AFTER construction but impersonate mid-run appearance by writing
+    # it again once run() starts via a pre-cleared dir: simplest is to
+    # re-create it post-scrub from the popen hook
+    real_popen = sup.popen
+    wrote = []
+
+    def popen_hook(argv, **kw):
+        if not wrote:
+            wrote.append(1)
+            resilience.atomic_write_json(str(lease / 'join-1.json'),
+                                         {'host': 1, 'addr': None})
+        return real_popen(argv, **kw)
+
+    sup.popen = popen_hook
+    assert sup.run() == 0
+    assert sup.members == [0] and sup.gen == 0 and sup.grows == 0
+    assert not (lease / 'join-1.json').exists()
+    # the whole barrier dir went with the abort: a later REAL joiner
+    # baselines on the highest grow-gen dir it sees, and a leftover
+    # aborted dir would make this very generation unjoinable
+    assert not (lease / 'grow-gen1').exists()
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    kinds = [e['kind'] for e in report['events']]
+    assert 'grow_aborted' in kinds and 'grow' not in kinds
+    assert 'fenced' not in kinds
+
+
+def test_pod_supervisor_grow_succeeds_after_aborted_attempt(tmp_path):
+    """Abort-then-rejoin regression (review finding): a stale-join
+    abort at gen g+1 must not poison a LATER real join at the same
+    generation — the barrier dir is removed with the abort, so the
+    real joiner's startup baseline excludes it and both sides reopen
+    gen g+1 cleanly."""
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor(['t'], host_id=0, num_hosts=1,
+                        lease_dir=str(lease), settle=0.05,
+                        grow_timeout=0.3, poll_period=0.02)
+    # stale announcement: nobody claims -> abort, dir scrubbed
+    resilience.atomic_write_json(str(lease / 'join-9.json'),
+                                 {'host': 9, 'addr': None})
+    assert sup._grow(sup._join_announced()) is False
+    assert sup.gen == 0 and not (lease / 'grow-gen1').exists()
+    # real join at the SAME generation: joiner claims concurrently
+    import threading
+
+    def joiner_claims():
+        import time as _t
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            if (lease / 'grow-gen1' / 'member-0.json').exists():
+                resilience.atomic_write_json(
+                    str(lease / 'grow-gen1' / 'member-1.json'),
+                    {'host': 1, 'addr': None})
+                return
+            _t.sleep(0.01)
+
+    resilience.atomic_write_json(str(lease / 'join-1.json'),
+                                 {'host': 1, 'addr': None})
+    t = threading.Thread(target=joiner_claims)
+    t.start()
+    try:
+        assert sup._grow(sup._join_announced()) is True
+    finally:
+        t.join()
+    assert sup.members == [0, 1] and sup.gen == 1
+
+
+def test_grow_abort_on_partial_claims_never_adopts_subset(tmp_path):
+    """Review finding: a straggler incumbent racing a peer's
+    abort-cleanup can read an emptied barrier dir — its claims then
+    contain only itself, and the abort guard must treat ANY subset of
+    the current membership as an abort, never as a 'grow' down to a
+    singleton that split-brains the pod."""
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor(['t'], host_id=0, num_hosts=2,
+                        lease_dir=str(lease), settle=0.05,
+                        grow_timeout=0.3, poll_period=0.02)
+    # ghost announcement, peer 1 never claims either (its abort already
+    # scrubbed the dir): our claims come back as just ourselves
+    resilience.atomic_write_json(str(lease / 'join-9.json'),
+                                 {'host': 9, 'addr': None})
+    assert sup._grow(sup._join_announced()) is False
+    assert sup.members == [0, 1] and sup.gen == 0 and sup.grows == 0
+
+
+def test_grow_yields_to_concurrent_shrink_at_same_generation(tmp_path):
+    """Review finding: a join announcement racing an unconfirmed peer
+    death can put peers in the shrink barrier for gen g+1 while we sit
+    in the grow one. The shrink lane wins: the grow abandons, our grow
+    claim is withdrawn (a waiting joiner must not stabilize on it),
+    and the generation does not move."""
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor(['t'], host_id=0, num_hosts=2,
+                        lease_dir=str(lease), settle=0.05,
+                        grow_timeout=5.0, poll_period=0.02)
+    (lease / 'shrink-gen1').mkdir(parents=True)
+    resilience.atomic_write_json(
+        str(lease / 'shrink-gen1' / 'survivor-1.json'),
+        {'host': 1, 'addr': None})
+    resilience.atomic_write_json(str(lease / 'join-3.json'),
+                                 {'host': 3, 'addr': None})
+    assert sup._grow(sup._join_announced()) is False
+    assert sup.gen == 0 and sup.grows == 0
+    assert not (lease / 'grow-gen1' / 'member-0.json').exists()
+    events = [e['kind'] for e in sup.report.events]
+    assert 'grow_yielded' in events and 'grow' not in events
+
+
+def test_join_timeout_withdraws_orphan_barrier_claim(tmp_path):
+    """Review finding: a joiner that claimed into a barrier but timed
+    out before admission must take its claim back out — the incumbents
+    would otherwise count a host that already exited and grow a
+    membership with a permanently missing rank."""
+    import threading
+    import time as _t
+    from kfac_pytorch_tpu.resilience.elastic import (
+        RC_JOIN_FAILED, PodSupervisor)
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor(['t'], host_id=1, num_hosts=3,
+                        lease_dir=str(lease), join=True,
+                        join_timeout=3.0, settle=0.05,
+                        grow_timeout=60.0, poll_period=0.02)
+    claim_dir = lease / 'grow-gen1'
+
+    def open_barrier():
+        # the barrier opens AFTER the joiner's baseline snapshot, with
+        # a claim naming a member that never arrives — the joiner
+        # claims, waits for coverage, and times out unadmitted
+        _t.sleep(0.3)
+        claim_dir.mkdir(parents=True)
+        resilience.atomic_write_json(
+            str(claim_dir / 'member-0.json'),
+            {'host': 0, 'addr': None, 'members': [0, 2]})
+
+    t = threading.Thread(target=open_barrier)
+    t.start()
+    try:
+        assert sup.run() == RC_JOIN_FAILED
+    finally:
+        t.join()
+    assert (claim_dir / 'member-0.json').exists()  # claimed mid-run
+    assert not (claim_dir / 'member-1.json').exists()
+    assert not (lease / 'join-1.json').exists()
+
+
+def test_joiner_reclaims_after_incumbent_abort_at_same_gen(tmp_path):
+    """Review finding: if the incumbents abort the barrier (rmtree
+    deletes the joiner's claim with it) and re-arm the SAME generation
+    on the next announcement, the joiner must notice its claim is gone
+    and re-write it — `claimed_gen` alone would skip the re-claim and
+    the join could never succeed after one abort."""
+    import threading
+    import time as _t
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor(['t'], host_id=1, num_hosts=2,
+                        lease_dir=str(lease), join=True,
+                        join_timeout=15.0, settle=0.2,
+                        grow_timeout=10.0, poll_period=0.02,
+                        hb_interval=0.05)
+    claim_dir = lease / 'grow-gen1'
+
+    def incumbent():
+        import shutil
+        from kfac_pytorch_tpu.resilience.heartbeat import (
+            read_join_announcements)
+        deadline = _t.monotonic() + 10
+        # the barrier opens only AFTER the announcement (the real flow;
+        # also guarantees the joiner snapshotted its baseline first)
+        while _t.monotonic() < deadline:
+            if read_join_announcements(lease):
+                break
+            _t.sleep(0.01)
+        # open the barrier, wait for the joiner's claim...
+        claim_dir.mkdir(parents=True)
+        while _t.monotonic() < deadline:
+            if (claim_dir / 'member-1.json').exists():
+                break
+            _t.sleep(0.01)
+        # ...abort: the whole dir goes, the joiner's claim with it...
+        shutil.rmtree(claim_dir, ignore_errors=True)
+        _t.sleep(0.3)
+        # ...then re-arm the SAME generation and admit (exist_ok: the
+        # joiner's own re-claim may have re-created the dir already —
+        # the real _grow uses makedirs(exist_ok=True) too)
+        claim_dir.mkdir(parents=True, exist_ok=True)
+        resilience.atomic_write_json(
+            str(claim_dir / 'member-0.json'),
+            {'host': 0, 'addr': None, 'members': [0]})
+
+    t = threading.Thread(target=incumbent)
+    t.start()
+    try:
+        assert sup._join_pod() is True
+    finally:
+        t.join()
+    assert sup.members == [0, 1] and sup.gen == 1
+    assert (claim_dir / 'member-1.json').exists()  # the re-claim
+
+
+def test_joiner_waits_for_slow_incumbent_named_in_claims(tmp_path):
+    """Review finding: the joiner must adopt the SAME membership the
+    incumbents' barrier closes with. Incumbent claims publish their
+    membership; a joiner seeing claims {fast incumbent, itself} stable
+    must keep waiting for the slow incumbent those claims name."""
+    import threading
+    import time as _t
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    lease.mkdir()
+    sup = PodSupervisor(['t'], host_id=3, num_hosts=4,
+                        lease_dir=str(lease), join=True,
+                        join_timeout=15.0, settle=0.2,
+                        grow_timeout=10.0, poll_period=0.02)
+
+    def incumbents():
+        deadline = _t.monotonic() + 10
+        from kfac_pytorch_tpu.resilience.heartbeat import (
+            read_join_announcements)
+        while _t.monotonic() < deadline:
+            if read_join_announcements(lease):
+                break
+            _t.sleep(0.01)
+        claim_dir = lease / 'grow-gen1'
+        claim_dir.mkdir()
+        # fast incumbent claims immediately, naming BOTH incumbents
+        resilience.atomic_write_json(
+            str(claim_dir / 'member-0.json'),
+            {'host': 0, 'addr': None, 'members': [0, 2]})
+        # slow incumbent (child_kill_grace-style delay, > settle)
+        _t.sleep(1.0)
+        resilience.atomic_write_json(
+            str(claim_dir / 'member-2.json'),
+            {'host': 2, 'addr': None, 'members': [0, 2]})
+
+    t = threading.Thread(target=incumbents)
+    t.start()
+    try:
+        assert sup._join_pod() is True
+    finally:
+        t.join()
+    # adopted the FULL membership, not the stable-but-partial prefix
+    assert sup.members == [0, 2, 3] and sup.gen == 1
+
+
+def test_pod_supervisor_join_mode_admitted(tmp_path):
+    """The joiner side: --join announces, waits for the incumbents'
+    barrier, claims into it, adopts the agreed membership/generation,
+    and only then launches its trainer as a member."""
+    import json
+    import threading
+    import time as _time
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    from kfac_pytorch_tpu.resilience.heartbeat import (
+        read_join_announcements)
+    lease = tmp_path / 'lease'
+    lease.mkdir()
+    sup = PodSupervisor(_world_gated_trainer(tmp_path, '2'),
+                        host_id=1, num_hosts=2, lease_dir=str(lease),
+                        join=True, join_timeout=10.0,
+                        max_restarts=1, backoff_base=0.01,
+                        settle=0.2, poll_period=0.02,
+                        child_kill_grace=1.0, hb_grace=60.0)
+
+    def incumbent():
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            if read_join_announcements(lease):
+                break
+            _time.sleep(0.02)
+        claim_dir = lease / 'grow-gen1'
+        claim_dir.mkdir()
+        resilience.atomic_write_json(str(claim_dir / 'member-0.json'),
+                                     {'host': 0, 'addr': 'hosta:8476',
+                                      'members': [0]})
+
+    t = threading.Thread(target=incumbent)
+    t.start()
+    try:
+        rc = sup.run()
+    finally:
+        t.join()
+    assert rc == 0
+    assert sup.members == [0, 1] and sup.gen == 1 and sup.joins == 1
+    assert sup._member_addrs[0] == 'hosta:8476'
+    assert not (lease / 'join-1.json').exists()  # withdrawn on admission
+    report = json.loads((lease / 'incident-host1.json').read_text())
+    kinds = [e['kind'] for e in report['events']]
+    assert 'join_announce' in kinds and 'join_admitted' in kinds
+    admitted = next(e for e in report['events']
+                    if e['kind'] == 'join_admitted')
+    assert admitted['members'] == [0, 1] and admitted['rank'] == 1
+    assert report['counters']['joins'] == 1
+
+
+def test_pod_supervisor_join_timeout_exits_116(tmp_path):
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import (
+        RC_JOIN_FAILED, PodSupervisor)
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor([sys.executable, '-c', 'pass'],
+                        host_id=1, num_hosts=2, lease_dir=str(lease),
+                        join=True, join_timeout=0.3,
+                        settle=0.05, poll_period=0.02)
+    assert sup.run() == RC_JOIN_FAILED == 116
+    assert not (lease / 'join-1.json').exists()  # withdrawn on give-up
+    report = json.loads((lease / 'incident-host1.json').read_text())
+    kinds = [e['kind'] for e in report['events']]
+    assert 'join_failed' in kinds and 'launch' not in kinds
+    assert report['counters']['join_failed'] == 1
+
+
+def test_pod_supervisor_peer_grow_claims_join_not_fence(tmp_path):
+    """The fence-vs-join distinction: an uncorroborated NEXT-generation
+    claim set in the shrink lane means we are the one being declared
+    dead (fence); the same situation in the GROW lane is an invitation
+    — a peer saw an announcement we missed — and we claim into the
+    barrier instead of fencing."""
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor(_world_gated_trainer(tmp_path, '3'),
+                        host_id=0, num_hosts=2, lease_dir=str(lease),
+                        max_restarts=1, backoff_base=0.01,
+                        settle=0.2, grow_timeout=5.0, hb_grace=300.0,
+                        poll_period=0.02, child_kill_grace=1.0)
+    # peer 1 (incumbent) and host 2 (the joiner we never saw announce)
+    # have already claimed the next generation's grow barrier
+    claim_dir = lease / 'grow-gen1'
+
+    real_popen = sup.popen
+    wrote = []
+
+    def popen_hook(argv, **kw):
+        if not wrote:  # after the gen-0 scrub, before the first wait
+            wrote.append(1)
+            claim_dir.mkdir(parents=True)
+            resilience.atomic_write_json(str(claim_dir / 'member-1.json'),
+                                         {'host': 1, 'addr': None})
+            resilience.atomic_write_json(str(claim_dir / 'member-2.json'),
+                                         {'host': 2, 'addr': None})
+        return real_popen(argv, **kw)
+
+    sup.popen = popen_hook
+    assert sup.run() == 0
+    assert sup.members == [0, 1, 2] and sup.gen == 1 and sup.grows == 1
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    kinds = [e['kind'] for e in report['events']]
+    assert 'fenced' not in kinds and 'grow' in kinds
+    grow = next(e for e in report['events'] if e['kind'] == 'grow')
+    assert grow['joiners'] == [1, 2] or grow['joiners'] == [2], grow
+
+
+def test_pod_supervisor_child_env_tcp_peers(tmp_path):
+    """KFAC_HB_TRANSPORT=tcp pass-through: the trainer contract gets a
+    peer map re-derived for the CURRENT membership (rank=host:port from
+    the claim-published addresses), and falls back to file leases when
+    an address is missing."""
+    from kfac_pytorch_tpu.resilience import heartbeat as hb_mod
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    base_env = {'PATH': os.environ.get('PATH', ''),
+                hb_mod.ENV_TRANSPORT: 'tcp', hb_mod.ENV_PORT: '9000'}
+    sup = PodSupervisor(['t'], host_id=2, num_hosts=3,
+                        lease_dir=str(tmp_path / 'lease'), env=base_env)
+    sup.members = [0, 2]
+    sup.gen = 2
+    sup._member_addrs = {0: 'h0:8476', 2: 'h2:8476'}
+    env = sup._child_env()
+    assert env[hb_mod.ENV_TRANSPORT] == 'tcp'
+    assert env[hb_mod.ENV_PEERS] == '0=h0:9000,1=h2:9000'
+    assert env[hb_mod.ENV_GEN] == '2'
+    # missing member address: file-lease fallback, never a stale peer map
+    sup._member_addrs = {0: 'h0:8476', 2: None}
+    env = sup._child_env()
+    assert env[hb_mod.ENV_TRANSPORT] == 'file'
+    assert hb_mod.ENV_PEERS not in env
+    # generation 0, membership unchanged: the launcher's full-world
+    # peer map (KFAC_HB_WORKERS-derived) passes through VERBATIM even
+    # though no --host-addr claims exist yet — downgrading a real pod
+    # to file leases at launch was the review finding
+    launch_env = dict(base_env,
+                      **{hb_mod.ENV_PEERS: '0=w0:9000,1=w1:9000,'
+                                           '2=w2:9000'})
+    sup0 = PodSupervisor(['t'], host_id=1, num_hosts=3,
+                         lease_dir=str(tmp_path / 'lease0'),
+                         env=launch_env)
+    env = sup0._child_env()
+    assert env[hb_mod.ENV_TRANSPORT] == 'tcp'
+    assert env[hb_mod.ENV_PEERS] == '0=w0:9000,1=w1:9000,2=w2:9000'
+
+
 def test_guard_final_save_runs_with_watchdog_paused(tmp_path, monkeypatch):
     """The PreemptionGuard grace-window save must not race the step
     watchdog: inside ``paused()`` even a save far exceeding the step

@@ -410,3 +410,70 @@ def test_warm_tracking_resume_semantics():
     state, _ = step3(state, batch, lr=0.05, damping=0.003)  # step 7
     state, _ = step3(state, batch, lr=0.05, damping=0.003)  # step 8: full
     assert step3.warm_tracking['warm_streak'] == pre['warm_streak'] + 1
+
+
+# ---------------------------------------------------------------------------
+# elastic world-change hooks (ISSUE 6: batch/LR rescaling on grow/shrink)
+# ---------------------------------------------------------------------------
+
+def test_world_change_rescale_global_batch_invariant():
+    """Global-fixed deployments (the example trainers, the chaos drill):
+    the optimization trajectory is untouched, so lr_factor is exactly 1
+    and the per-host share re-derives — the hook RECORDS, not perturbs,
+    which is what keeps the churn drill schedule-equivalent."""
+    r = training.world_change_rescale(3, 2, lr=0.1, global_batch=96)
+    assert r.lr == 0.1 and r.lr_factor == 1.0
+    assert r.global_batch == 96 and r.per_host_batch == 48
+    assert r.log_line() == ('WORLD_RESCALE from_world=3 to_world=2 '
+                            'global_batch=96 lr=0.1 lr_factor=1')
+    # uneven split rounds UP so no example is dropped
+    r = training.world_change_rescale(2, 3, lr=0.1, global_batch=8)
+    assert r.per_host_batch == 3 and r.global_batch == 8
+
+
+def test_world_change_rescale_per_host_batch_scales_lr():
+    """Per-host-fixed pods: the global batch scales with the world and
+    the lr follows under the chosen rule — the accuracy half of
+    train-through-churn (linear rule per Goyal et al., sqrt, or
+    record-only)."""
+    grow = training.world_change_rescale(2, 3, lr=0.1, per_host_batch=64)
+    assert grow.global_batch == 192 and grow.per_host_batch == 64
+    assert grow.lr_factor == pytest.approx(1.5)
+    assert grow.lr == pytest.approx(0.15)
+    shrink = training.world_change_rescale(4, 1, lr=0.1,
+                                           per_host_batch=32,
+                                           lr_scaling='sqrt')
+    assert shrink.lr_factor == pytest.approx(0.5)
+    assert shrink.lr == pytest.approx(0.05)
+    rec = training.world_change_rescale(4, 1, lr=0.1, per_host_batch=32,
+                                        lr_scaling='none')
+    assert rec.lr == 0.1 and rec.lr_factor == 1.0
+    assert rec.global_batch == 32
+
+
+def test_world_change_rescale_validates_inputs():
+    with pytest.raises(ValueError, match='exactly one'):
+        training.world_change_rescale(2, 3, lr=0.1)
+    with pytest.raises(ValueError, match='exactly one'):
+        training.world_change_rescale(2, 3, lr=0.1, global_batch=8,
+                                      per_host_batch=4)
+    with pytest.raises(ValueError, match='lr_scaling'):
+        training.world_change_rescale(2, 3, lr=0.1, per_host_batch=4,
+                                      lr_scaling='cubic')
+    with pytest.raises(ValueError, match='world sizes'):
+        training.world_change_rescale(0, 3, lr=0.1, global_batch=8)
+
+
+def test_world_rescale_line_matches_incident_grammar():
+    """The hook's protocol line is parsed by the SAME pattern table the
+    incident scraper and kfac-obs share — a drift in either direction
+    fails here."""
+    from kfac_pytorch_tpu.resilience.incident import EVENT_PATTERNS
+    line = training.world_change_rescale(
+        2, 3, lr=0.05, per_host_batch=64).log_line()
+    pat = dict(EVENT_PATTERNS)['world_rescale']
+    m = pat.search(line)
+    assert m, line
+    assert m.group('from') == '2' and m.group('to') == '3'
+    assert m.group('global_batch') == '192'
+    assert float(m.group('lr_factor')) == pytest.approx(1.5)
