@@ -337,9 +337,12 @@ def main():
     if args.checkpoint_dir:
         # world-size stamp: lets a shrunken (or re-grown) pod's relaunch
         # route this run's checkpoints through the factor reshard
-        # (elastic_resume); the generation rides along as provenance
+        # (elastic_resume); the generation rides along as provenance,
+        # the lineage epoch as commit fencing (the stamp never moves
+        # backward — a fenced fork's straggler cannot clobber it)
         utils.write_world_stamp(args.checkpoint_dir, args.num_devices,
-                                gen=os.environ.get('KFAC_POD_GEN'))
+                                gen=os.environ.get('KFAC_POD_GEN'),
+                                lineage=os.environ.get('KFAC_LINEAGE'))
     lr_now = args.base_lr
     for epoch in range(start_epoch, args.epochs):
         train_loss = utils.Metric('train_loss')

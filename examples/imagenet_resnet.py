@@ -273,7 +273,8 @@ def main():
                 tx = optax.MultiSteps(tx, args.batches_per_allreduce)
         log.info('resumed from checkpoint-%d', resume)
     utils.write_world_stamp(args.checkpoint_format, args.num_devices,
-                            gen=os.environ.get('KFAC_POD_GEN'))
+                            gen=os.environ.get('KFAC_POD_GEN'),
+                            lineage=os.environ.get('KFAC_LINEAGE'))
     # pod peer liveness (KFAC_HB_* from launch_tpu.sh/kfac-pod-supervise):
     # a dead peer aborts this trainer RC_PEER_DEAD within the heartbeat
     # deadline instead of hanging in a collective

@@ -84,6 +84,19 @@ def parse_args():
                    help='per-run log files land here')
     p.add_argument('--tb-dir', default=None,
                    help='TensorBoard scalar summaries (rank 0)')
+    # observability (kfac_pytorch_tpu/obs/), matching the cifar/imagenet
+    # wiring: one flag turns on Chrome-trace spans + metric snapshots,
+    # one exports the registry as a Prometheus textfile
+    p.add_argument('--trace', default=None, metavar='DIR',
+                   help='write Chrome-trace spans (per-step dispatch '
+                        'spans, resilience instants) to '
+                        'DIR/trace-host<i>.jsonl and epoch metric '
+                        'snapshots to DIR/metrics.jsonl; merge a pod\'s '
+                        'files with kfac-obs (defaults to '
+                        '$KFAC_TRACE_DIR when set)')
+    p.add_argument('--prom-file', default=None, metavar='PATH',
+                   help='export the metrics registry as a Prometheus '
+                        'textfile at PATH after every epoch (rank 0)')
     return p.parse_args()
 
 
@@ -246,11 +259,18 @@ def main():
                                 opt_state=tx.init(params),
                                 kfac_state=kfac_state, extra_vars={})
 
+    # observability: trace recorder + metrics registry (epoch-line
+    # suffixes render through the registry, byte-compatible with the
+    # old hand-plumbed health_suffix)
+    from kfac_pytorch_tpu import obs
+    tracer, reg = obs.setup_trainer(trace_dir=args.trace,
+                                    prom_file=args.prom_file)
+
     step = training.build_train_step(
         wrapped, tx, precond, loss_fn, axis_name=axis, mesh=mesh,
-        dropout_seed=args.seed + 2)
+        dropout_seed=args.seed + 2, tracer=tracer)
 
-    monitor = utils.HealthMonitor(log, state=state)
+    monitor = utils.HealthMonitor(log, state=state, registry=reg)
 
     def run_epoch(state, epoch):
         m = utils.Metric('loss')
@@ -282,6 +302,8 @@ def main():
 
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
+    if tb is not None:
+        reg.add_exporter(obs.metrics.TensorBoardExporter(tb))
     for epoch in range(args.epochs):
         t0 = time.time()
         state, train_loss = run_epoch(state, epoch)
@@ -299,14 +321,22 @@ def main():
             hyps.append(h)
             refs.append(r)
         score = translator.bleu(hyps, refs)
-        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        # one registry call renders the health/resilience suffixes
+        # byte-identically to the old hand-plumbed health_suffix
         log.info('epoch %d: train_loss %.4f BLEU %.2f (%.1fs)%s',
                  epoch, train_loss, score, time.time() - t0,
-                 health_suffix(monitor.epoch_flush()))
+                 reg.epoch_suffixes())
+        monitor.epoch_flush()
+        reg.export(step=epoch)
+        if tracer is not None:
+            tracer.flush()
         if tb is not None:
             tb.add_scalar('train/loss', train_loss, epoch)
             tb.add_scalar('val/BLEU', score, epoch)
             tb.flush()
+    if tracer is not None:
+        tracer.flush()
+    reg.close()
 
 
 if __name__ == '__main__':

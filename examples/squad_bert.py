@@ -70,6 +70,19 @@ def parse_args():
                    help='per-run log files land here')
     p.add_argument('--tb-dir', default=None,
                    help='TensorBoard scalar summaries (rank 0)')
+    # observability (kfac_pytorch_tpu/obs/), matching the cifar/imagenet
+    # wiring: one flag turns on Chrome-trace spans + metric snapshots,
+    # one exports the registry as a Prometheus textfile
+    p.add_argument('--trace', default=None, metavar='DIR',
+                   help='write Chrome-trace spans (per-step dispatch '
+                        'spans, resilience instants) to '
+                        'DIR/trace-host<i>.jsonl and epoch metric '
+                        'snapshots to DIR/metrics.jsonl; merge a pod\'s '
+                        'files with kfac-obs (defaults to '
+                        '$KFAC_TRACE_DIR when set)')
+    p.add_argument('--prom-file', default=None, metavar='PATH',
+                   help='export the metrics registry as a Prometheus '
+                        'textfile at PATH after every epoch (rank 0)')
     return p.parse_args()
 
 
@@ -190,9 +203,17 @@ def main():
         opt_state=tx.init(params),
         kfac_state=precond.init() if precond else None, extra_vars={})
 
+    # observability: trace recorder + metrics registry (epoch-line
+    # suffixes render through the registry, byte-compatible with the
+    # old hand-plumbed health_suffix)
+    from kfac_pytorch_tpu import obs
+    tracer, reg = obs.setup_trainer(trace_dir=args.trace,
+                                    prom_file=args.prom_file)
+
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
-                                     dropout_seed=args.seed + 2)
+                                     dropout_seed=args.seed + 2,
+                                     tracer=tracer)
 
     @jax.jit
     def eval_step(params, batch):
@@ -213,7 +234,9 @@ def main():
 
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
-    monitor = utils.HealthMonitor(log, state=state)
+    if tb is not None:
+        reg.add_exporter(obs.metrics.TensorBoardExporter(tb))
+    monitor = utils.HealthMonitor(log, state=state, registry=reg)
     for epoch in range(args.epochs):
         t0 = time.time()
         m = utils.Metric('loss')
@@ -234,15 +257,23 @@ def main():
                             jnp.asarray(vmask)))
         f1, em = squad_f1_em(list(zip(np.asarray(ps), np.asarray(pe))),
                              list(zip(vstarts, vends)), vids)
-        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        # one registry call renders the health/resilience suffixes
+        # byte-identically to the old hand-plumbed health_suffix
         log.info('epoch %d: loss %.4f F1 %.2f EM %.2f (%.1fs)%s',
                  epoch, m.avg, f1, em, time.time() - t0,
-                 health_suffix(monitor.epoch_flush()))
+                 reg.epoch_suffixes())
+        monitor.epoch_flush()
+        reg.export(step=epoch)
+        if tracer is not None:
+            tracer.flush()
         if tb is not None:
             tb.add_scalar('train/loss', m.avg, epoch)
             tb.add_scalar('val/F1', f1, epoch)
             tb.add_scalar('val/EM', em, epoch)
             tb.flush()
+    if tracer is not None:
+        tracer.flush()
+    reg.close()
 
 
 if __name__ == '__main__':

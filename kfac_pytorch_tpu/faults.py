@@ -56,6 +56,15 @@ Host-side faults:
   KFAC_FAULT_DATA_STEP       the data loader raises a transient EIO at
                              this batch index, once (next-batch retry
                              drill)
+  KFAC_FAULT_NET_*           deterministic network chaos on the pod's
+                             side channels: seeded drop/delay/duplicate/
+                             reorder schedules plus a time-windowed
+                             (src, dst) partition matrix, applied by
+                             resilience.chaos_net.ChaosTransport around
+                             the heartbeat transports and consulted by
+                             the pod supervisor's protocol-file readers
+                             (the partition drill; see chaos_net.py for
+                             the full sub-contract)
   KFAC_FAULT_ONCE_DIR        directory of cross-RESTART one-shot
                              tokens: with it set, hang/crash faults
                              fire only in the first process that
@@ -94,12 +103,17 @@ ENV_ONCE_DIR = 'KFAC_FAULT_ONCE_DIR'
 # defined by the (jax-free) heartbeat module, registered here so the
 # strict from_env knows the drill exists
 from kfac_pytorch_tpu.resilience.heartbeat import ENV_HB_STOP  # noqa: E402
+# network chaos (drop/delay/dup/reorder schedules + the time-windowed
+# partition matrix): defined and CONSUMED by the jax-free
+# resilience.chaos_net layer, registered here so the strict from_env
+# validates the whole drill surface at build time
+from kfac_pytorch_tpu.resilience.chaos_net import NET_ENVS  # noqa: E402
 
 KNOWN_ENVS = frozenset({
     ENV_NAN_GRAD, ENV_INF_GRAD, ENV_STATS, ENV_FACTOR, ENV_EIGH,
     ENV_SIGTERM, ENV_CKPT, ENV_HANG, ENV_SLOW, ENV_SLOW_SECS, ENV_CRASH,
     ENV_CRASH_MODE, ENV_DATA, ENV_ONCE_DIR, ENV_HB_STOP,
-})
+}) | NET_ENVS
 
 # rc of the 'exit'-mode crash fault: distinct from Python's generic 1
 # and from the watchdog's RC_HANG (114) so supervisor logs attribute it
@@ -190,6 +204,11 @@ def from_env() -> FaultConfig:
     # a malformed value must still fail loudly at build time like every
     # other drill, even in runs with no heartbeat configured
     _int_env(ENV_HB_STOP)
+    # validate-only likewise: the network-chaos schedule is consumed by
+    # resilience.chaos_net (ChaosTransport + the protocol-file partition
+    # filter), but a malformed spec must die here, at build time
+    from kfac_pytorch_tpu.resilience import chaos_net as _chaos_net
+    _chaos_net.from_env()
     mode = os.environ.get(ENV_CKPT) or None
     if mode is not None and mode not in ('truncate', 'fail', 'eio_once'):
         raise ValueError(f'{ENV_CKPT} must be "truncate", "fail" or '

@@ -19,10 +19,16 @@ inherit the nearest preceding timestamped event of the SAME source
 (carry-forward, micro-tiebroken by line order), so intra-source order
 is always preserved and cross-source order is as good as the artifact's
 own clock. Hosts on one machine (the drills) share a clock exactly;
-across real hosts the residual skew is NTP-bounded — trace files embed
-``clock_sync`` (wall, monotonic) pairs so a future offset-solver has
-its inputs, and ``--offset host=secs`` applies a manual correction
-today.
+across real hosts the residual skew is solved automatically: the
+heartbeat monitors emit cross-host ``clock_sync`` trace pairs (the
+sender's wall stamp vs the receiver's at delivery), and
+:func:`solve_offsets` turns those samples into per-host corrections —
+the minimum observed delta per link bounds the skew to within one
+transport latency, and a BFS over the link graph anchors every host to
+the lowest-id one. No pairs (single-host runs, tracing off) falls back
+to the plain carry-forward alignment; ``--offset host=secs`` still
+overrides any host by hand, and ``--no-solve-offsets`` turns the
+solver off.
 
 Outputs: a human timeline on stdout, ``-o`` a JSON timeline, and
 ``--trace-out`` a merged Chrome/Perfetto trace (every host as a
@@ -185,6 +191,79 @@ def load_trace(path, host=None, spans=False):
     return events, raw
 
 
+def solve_offsets(paths):
+    """Per-host clock corrections from the cross-host ``clock_sync``
+    trace pairs: ``{host: seconds_to_add}``.
+
+    Each heartbeat monitor periodically records an instant named
+    ``clock_sync`` carrying ``peer`` (the sender) and ``peer_wall``
+    (the wall stamp inside the sender's payload); the instant's own
+    ``ts`` is the receiver's wall clock at delivery. For receiver clock
+    error ``e_r`` and sender error ``e_s``, one sample's delta
+    ``ts - peer_wall = latency + e_r - e_s`` — so the MINIMUM delta
+    over a link's samples bounds ``e_r - e_s`` to within the link's
+    best-case latency. The solver takes the min per (receiver, sender)
+    link, anchors the lowest host id at offset 0, and BFS-propagates
+    along known links (either direction, sign flipped) to every
+    reachable host. Unreachable hosts (no samples) get no entry —
+    their events keep the raw carry-forward alignment, which is the
+    documented fallback.
+
+    Caveat: host identity is the trace file's ``pid`` / the payload's
+    peer id — for pod-supervised trainers that is the RANK of the
+    generation the trace was written under, so long multi-generation
+    churn logs solve per-rank, not per-machine. Good enough for the
+    drills (one machine, offsets ~latency) and for steady-membership
+    production pods; not a substitute for NTP discipline."""
+    samples = {}
+    for path in expand_paths(paths):
+        if not str(path).endswith('.jsonl'):
+            continue
+        try:
+            f = open(path, errors='replace')
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    evt = json.loads(line)
+                except ValueError:
+                    continue
+                if (not isinstance(evt, dict) or evt.get('ph') != 'i'
+                        or evt.get('name') != 'clock_sync'):
+                    continue
+                args = evt.get('args') or {}
+                peer, peer_wall = args.get('peer'), args.get('peer_wall')
+                ts, pid = evt.get('ts'), evt.get('pid')
+                if (not isinstance(peer, int) or pid is None
+                        or not isinstance(peer_wall, (int, float))
+                        or not isinstance(ts, (int, float)) or ts <= 0):
+                    continue  # the per-process (wall, monotonic) pairs
+                samples.setdefault((int(pid), peer),
+                                   []).append(ts / 1e6 - peer_wall)
+    if not samples:
+        return {}
+    skew = {link: min(deltas) for link, deltas in samples.items()}
+    hosts = sorted({h for link in skew for h in link})
+    ref = hosts[0]
+    # e[h] = clock error of h relative to ref; offset to ADD = -e[h]
+    e = {ref: 0.0}
+    frontier = [ref]
+    while frontier:
+        cur = frontier.pop()
+        for (dst, src), d in skew.items():
+            if dst == cur and src not in e:
+                e[src] = e[cur] - d          # d = e_dst - e_src + lat
+                frontier.append(src)
+            elif src == cur and dst not in e:
+                e[dst] = e[cur] + d
+                frontier.append(dst)
+    return {h: -err for h, err in e.items() if h != ref or err}
+
+
 def classify(path):
     """'trace' | 'incident' | 'log' by extension and shape."""
     if str(path).endswith('.jsonl'):
@@ -341,12 +420,23 @@ def main(argv=None):
     p.add_argument('--offset', type=_parse_offset, action='append',
                    default=[], metavar='HOST=SECONDS',
                    help='manual clock-skew correction for one host '
-                        '(repeatable)')
+                        '(repeatable; overrides the automatic '
+                        'clock_sync-pair solution for that host)')
+    p.add_argument('--no-solve-offsets', action='store_true',
+                   help='disable the automatic cross-host clock-offset '
+                        'solution from the trace clock_sync pairs '
+                        '(raw carry-forward alignment only)')
     p.add_argument('--limit', type=int, default=None,
                    help='print at most N events (full set still goes '
                         'to -o)')
     args = p.parse_args(argv)
-    timeline = build_timeline(args.paths, offsets=dict(args.offset),
+    offsets = {} if args.no_solve_offsets else solve_offsets(args.paths)
+    if offsets:
+        print('clock offsets solved from clock_sync pairs: '
+              + ' '.join(f'host{h}={o:+.4f}s'
+                         for h, o in sorted(offsets.items())))
+    offsets.update(dict(args.offset))
+    timeline = build_timeline(args.paths, offsets=offsets,
                               spans=args.spans)
     print(render(timeline, limit=args.limit))
     if args.out:

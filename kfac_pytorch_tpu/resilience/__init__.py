@@ -122,7 +122,9 @@ from kfac_pytorch_tpu.resilience.heartbeat import (  # noqa: E402
     RC_PEER_DEAD, FileLeaseTransport, JoinAnnouncer, PeerHeartbeat,
     TcpHeartbeatTransport, heartbeat_from_env, read_join_announcements)
 from kfac_pytorch_tpu.resilience.elastic import (  # noqa: E402
-    RC_JOIN_FAILED, PodSupervisor, elastic_resume)
+    RC_FENCED, RC_JOIN_FAILED, PodSupervisor, elastic_resume)
+from kfac_pytorch_tpu.resilience.chaos_net import (  # noqa: E402
+    ChaosTransport, NetFaultConfig)
 from kfac_pytorch_tpu.resilience.incident import (  # noqa: E402
     IncidentReport, scrape_paths)
 
@@ -131,8 +133,9 @@ __all__ = [
     'ManualClock', 'RetryError', 'RetryPolicy',
     'call_with_retry', 'resumable_iter', 'RC_HANG', 'StepWatchdog',
     'Supervisor', 'parse_stop_rc', 'StragglerGovernor',
-    'RC_PEER_DEAD', 'RC_JOIN_FAILED', 'FileLeaseTransport',
+    'RC_PEER_DEAD', 'RC_JOIN_FAILED', 'RC_FENCED', 'FileLeaseTransport',
     'JoinAnnouncer', 'PeerHeartbeat', 'TcpHeartbeatTransport',
+    'ChaosTransport', 'NetFaultConfig',
     'heartbeat_from_env', 'read_join_announcements',
     'PodSupervisor', 'elastic_resume',
     'IncidentReport', 'scrape_paths',

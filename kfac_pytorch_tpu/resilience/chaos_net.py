@@ -1,0 +1,433 @@
+"""Deterministic network chaos for the pod protocols: the transport
+misbehaves on a SEEDED schedule, so a partition drill is reproducible
+down to the delivery trace.
+
+The pod's failure story so far injects HOST faults (SIGKILL, hangs,
+silent heartbeat stops). What the resilience stack could not yet prove
+is survival of the NETWORK failing: drops, delays, duplicated and
+reordered deliveries, and — the split-brain maker — a time-windowed
+partition that cuts the pod into sides that each look "dead" to the
+other. :class:`ChaosTransport` wraps any heartbeat transport
+(:class:`~.heartbeat.FileLeaseTransport` /
+:class:`~.heartbeat.TcpHeartbeatTransport`) and applies all of those on
+the READ side, per ``(src, dst)`` link, with every decision derived
+from ``(seed, src, dst, seq)`` — identical env, identical poll
+sequence, identical delivery trace (:attr:`ChaosTransport.trace`), which
+is what the determinism unit tests pin.
+
+Env contract (``KFAC_FAULT_NET_*``, registered in ``faults.py``'s
+STRICT ``from_env`` so a typo'd drill fails loudly at build time):
+
+  KFAC_FAULT_NET_SEED       int; presence arms the chaos layer
+  KFAC_FAULT_NET_DROP       P(drop) per fresh payload          [0, 1]
+  KFAC_FAULT_NET_DELAY      max delivery delay, seconds (uniform)
+  KFAC_FAULT_NET_DUP        P(duplicate delivery on a later poll)
+  KFAC_FAULT_NET_REORDER    P(an older ready payload is delivered
+                            before a newer one)
+  KFAC_FAULT_NET_PARTITION  static window spec, e.g. "10:40=0,2|1":
+                            from t0+10s to t0+40s hosts {0,2} and {1}
+                            cannot see each other's messages (";" joins
+                            windows; hosts not listed stay connected)
+  KFAC_FAULT_NET_T0         wall-clock base of the static windows
+                            (default: when the config was loaded)
+  KFAC_FAULT_NET_PARTITION_FILE
+                            live JSON file with ABSOLUTE wall windows:
+                            {"windows": [{"start": w0, "end": w1,
+                            "groups": [[0, 2], [1]]}]} — polled per
+                            check (mtime-cached), so a drill can cut
+                            and heal the network mid-run; a missing or
+                            torn file reads as "no partition"
+  KFAC_FAULT_NET_IDMAP      "rank=host,..." identity map: trainer
+                            heartbeat ids are RANKS, which drift from
+                            pod host ids across shrink/grow
+                            generations — the pod supervisor exports
+                            the current rank->host map so the partition
+                            matrix always cuts on stable POD host ids
+
+The partition matrix governs more than the wrapped heartbeat reads: the
+pod supervisor consults :meth:`NetFaultConfig.partitioned` when reading
+shrink/grow claims and join announcements too, so a partitioned host
+genuinely cannot see the other side's protocol messages even when the
+drill runs on one shared filesystem.
+
+Zero dependencies, jax-free (the heartbeat layer imports this).
+"""
+
+import collections
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Optional, Tuple
+
+ENV_NET_SEED = 'KFAC_FAULT_NET_SEED'
+ENV_NET_DROP = 'KFAC_FAULT_NET_DROP'
+ENV_NET_DELAY = 'KFAC_FAULT_NET_DELAY'
+ENV_NET_DUP = 'KFAC_FAULT_NET_DUP'
+ENV_NET_REORDER = 'KFAC_FAULT_NET_REORDER'
+ENV_NET_PARTITION = 'KFAC_FAULT_NET_PARTITION'
+ENV_NET_PARTITION_FILE = 'KFAC_FAULT_NET_PARTITION_FILE'
+ENV_NET_T0 = 'KFAC_FAULT_NET_T0'
+ENV_NET_IDMAP = 'KFAC_FAULT_NET_IDMAP'
+
+NET_ENVS = frozenset({
+    ENV_NET_SEED, ENV_NET_DROP, ENV_NET_DELAY, ENV_NET_DUP,
+    ENV_NET_REORDER, ENV_NET_PARTITION, ENV_NET_PARTITION_FILE,
+    ENV_NET_T0, ENV_NET_IDMAP,
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionWindow:
+    start: float            # wall seconds (absolute, or relative to t0)
+    end: float
+    groups: Tuple[frozenset, ...]
+
+    def cuts(self, a, b):
+        """True when ``a`` and ``b`` sit in different groups. Hosts not
+        listed in any group are unaffected (connected to everyone)."""
+        ga = gb = None
+        for g in self.groups:
+            if a in g:
+                ga = g
+            if b in g:
+                gb = g
+        return ga is not None and gb is not None and ga is not gb
+
+
+def _parse_groups(spec, env):
+    groups = []
+    for part in str(spec).split('|'):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            groups.append(frozenset(int(h) for h in part.split(',') if
+                                    h.strip()))
+        except ValueError:
+            raise ValueError(f'{env}: malformed host group {part!r} '
+                             '(expected comma-separated ints)') from None
+    if len(groups) < 2:
+        raise ValueError(f'{env}: a partition needs at least two host '
+                         f'groups, got {spec!r}')
+    seen = set()
+    for g in groups:
+        if g & seen:
+            raise ValueError(f'{env}: host(s) {sorted(g & seen)} appear '
+                             'in more than one group')
+        seen |= g
+    return tuple(groups)
+
+
+def parse_partition_spec(spec, env=ENV_NET_PARTITION):
+    """``"10:40=0,2|1"`` -> one window; ``";"`` joins several."""
+    windows = []
+    for part in str(spec).split(';'):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            times, groups = part.split('=', 1)
+            lo, hi = times.split(':', 1)
+            start, end = float(lo), float(hi)
+        except ValueError:
+            raise ValueError(
+                f'{env}: malformed window {part!r}; expected '
+                '"start:end=hosts|hosts" (e.g. "10:40=0,2|1")') from None
+        if end <= start:
+            raise ValueError(f'{env}: window {part!r} ends before it '
+                             'starts')
+        windows.append(PartitionWindow(start, end,
+                                       _parse_groups(groups, env)))
+    return tuple(windows)
+
+
+def parse_idmap(spec, env=ENV_NET_IDMAP):
+    """``"0=0,1=2"`` -> {0: 0, 1: 2} (rank -> pod host id)."""
+    out = {}
+    for entry in str(spec).split(','):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            rank, host = entry.split('=', 1)
+            out[int(rank)] = int(host)
+        except ValueError:
+            raise ValueError(f'{env}: expected "rank=host,...", got '
+                             f'{entry!r}') from None
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class NetFaultConfig:
+    seed: int = 0
+    drop: float = 0.0
+    delay: float = 0.0
+    dup: float = 0.0
+    reorder: float = 0.0
+    windows: Tuple[PartitionWindow, ...] = ()   # relative to t0
+    t0: float = 0.0
+    partition_file: Optional[str] = None
+    idmap: Optional[dict] = None                # rank -> pod host id
+
+    def map_id(self, hid):
+        """Transport id -> stable pod host id (identity without a map)."""
+        if self.idmap is None:
+            return int(hid)
+        return int(self.idmap.get(int(hid), hid))
+
+    # -- partition matrix -------------------------------------------------
+
+    def _file_windows(self):
+        """ABSOLUTE-wall windows from the live partition file; a
+        missing/torn file reads as no partition (skip-and-retry, the
+        same discipline as every protocol-file reader)."""
+        path = self.partition_file
+        if not path:
+            return ()
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            _FILE_CACHE.pop(path, None)
+            return ()
+        cached = _FILE_CACHE.get(path)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            windows = tuple(
+                PartitionWindow(float(w['start']), float(w['end']),
+                                tuple(frozenset(int(h) for h in g)
+                                      for g in w['groups']))
+                for w in doc.get('windows', ()))
+        except (OSError, ValueError, KeyError, TypeError):
+            return ()
+        _FILE_CACHE[path] = (mtime, windows)
+        return windows
+
+    def partitioned(self, a, b, wall=None):
+        """Is the ``a`` <-> ``b`` link cut at wall time ``wall``?
+        ``a``/``b`` are transport ids, mapped through ``idmap`` onto
+        stable pod host ids before the matrix is consulted."""
+        a, b = self.map_id(a), self.map_id(b)
+        if a == b:
+            return False
+        wall = time.time() if wall is None else float(wall)
+        rel = wall - self.t0
+        for w in self.windows:
+            if w.start <= rel < w.end and w.cuts(a, b):
+                return True
+        for w in self._file_windows():
+            if w.start <= wall < w.end and w.cuts(a, b):
+                return True
+        return False
+
+    @property
+    def any_link_chaos(self):
+        return bool(self.drop or self.delay or self.dup or self.reorder)
+
+
+_FILE_CACHE = {}  # partition-file path -> (mtime_ns, windows)
+
+
+def _prob_env(env):
+    raw = os.environ.get(env)
+    if not raw:
+        return 0.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f'{env} must be a probability in [0, 1], '
+                         f'got {raw!r}') from None
+    if not 0.0 <= v <= 1.0:
+        raise ValueError(f'{env} must be in [0, 1], got {v}')
+    return v
+
+
+def from_env(env=None):
+    """Snapshot the network-fault environment, or None when no
+    ``KFAC_FAULT_NET_*`` variable is set. STRICT like ``faults.from_env``
+    (which delegates validation here): malformed values raise."""
+    e = os.environ if env is None else env
+    if not any(k in e for k in NET_ENVS):
+        return None
+    raw_seed = e.get(ENV_NET_SEED, '0')
+    try:
+        seed = int(raw_seed)
+    except ValueError:
+        raise ValueError(f'{ENV_NET_SEED} must be an integer, '
+                         f'got {raw_seed!r}') from None
+    raw_delay = e.get(ENV_NET_DELAY, '0')
+    try:
+        delay = float(raw_delay)
+    except ValueError:
+        raise ValueError(f'{ENV_NET_DELAY} must be seconds, '
+                         f'got {raw_delay!r}') from None
+    if delay < 0:
+        raise ValueError(f'{ENV_NET_DELAY} must be >= 0, got {delay}')
+    raw_t0 = e.get(ENV_NET_T0)
+    try:
+        t0 = float(raw_t0) if raw_t0 else time.time()
+    except ValueError:
+        raise ValueError(f'{ENV_NET_T0} must be a wall timestamp, '
+                         f'got {raw_t0!r}') from None
+    spec = e.get(ENV_NET_PARTITION)
+    idmap = e.get(ENV_NET_IDMAP)
+    return NetFaultConfig(
+        seed=seed,
+        drop=_prob_env(ENV_NET_DROP),
+        delay=delay,
+        dup=_prob_env(ENV_NET_DUP),
+        reorder=_prob_env(ENV_NET_REORDER),
+        windows=parse_partition_spec(spec) if spec else (),
+        t0=t0,
+        partition_file=e.get(ENV_NET_PARTITION_FILE) or None,
+        idmap=parse_idmap(idmap) if idmap else None)
+
+
+def _decisions(cfg, src, dst, seq):
+    """Per-payload fault decisions, a pure function of
+    ``(seed, src, dst, seq)`` — the determinism contract. Three uniform
+    draws + one delay draw from a SHA-256 stream (stable across runs
+    and interpreters, unlike ``hash()``)."""
+    digest = hashlib.sha256(
+        f'{cfg.seed}:{src}:{dst}:{seq}'.encode()).digest()
+
+    def u(i):
+        return int.from_bytes(digest[i * 8:(i + 1) * 8], 'big') / 2 ** 64
+
+    return {'drop': u(0) < cfg.drop,
+            'delay': u(1) * cfg.delay,
+            'dup': u(2) < cfg.dup,
+            'reorder': u(3) < cfg.reorder}
+
+
+class _Link:
+    """Per-(src -> dst) delivery state: pending (delayed) payloads, the
+    last delivered one (a silent link keeps presenting it — exactly how
+    a stale lease file or unreachable responder presents), and a queued
+    duplicate redelivery."""
+
+    def __init__(self):
+        self.pending = []       # [arrival, seq, payload, decisions]
+        self.seen = set()       # seqs already decided
+        self.last = None        # last delivered payload
+        self.redeliver = None   # (payload, seq) to deliver again
+
+
+class ChaosTransport:
+    """Wrap a heartbeat transport; inject seeded drop/delay/dup/reorder
+    schedules and the partition matrix on the read path. ``publish`` /
+    ``close`` pass through untouched (chaos is what the NETWORK does to
+    deliveries, not what the host writes).
+
+    ``clock`` (monotonic) drives delay arithmetic, ``wall`` the
+    partition windows — both injectable so unit tests run wall-free
+    under a ManualClock. :attr:`trace` records every link event as
+    ``(kind, src, seq)`` with kind in ``deliver / drop / dup / reorder /
+    partition`` — two runs with the same config and poll sequence
+    produce identical traces.
+    """
+
+    def __init__(self, transport, cfg, host_id, *, clock=time.monotonic,
+                 wall=time.time):
+        self.inner = transport
+        self.cfg = cfg
+        self.host_id = int(host_id)
+        self._clock = clock
+        self._wall = wall
+        self._links = {}
+        # bounded: a multi-hour drill must not grow an unbounded log —
+        # 64k link events is far beyond what any unit test compares
+        self.trace = collections.deque(maxlen=65536)
+
+    def publish(self, payload):
+        return self.inner.publish(payload)
+
+    def close(self):
+        close = getattr(self.inner, 'close', None)
+        if callable(close):
+            close()
+
+    def _link(self, src):
+        link = self._links.get(src)
+        if link is None:
+            link = self._links[src] = _Link()
+        return link
+
+    def read_peers(self):
+        raw = self.inner.read_peers()
+        now = self._clock()
+        wall = self._wall()
+        out = {}
+        for src in sorted(raw):
+            payload = raw[src]
+            if self.cfg.partitioned(src, self.host_id, wall):
+                self.trace.append(('partition', src,
+                                   payload.get('seq')))
+                continue  # the link is cut: this peer's seq stalls
+            delivered = self._offer(src, payload, now)
+            if delivered is not None:
+                out[src] = delivered
+        return out
+
+    def _offer(self, src, payload, now):
+        seq = payload.get('seq')
+        if not isinstance(seq, int) or not self.cfg.any_link_chaos:
+            # non-sequenced payloads (or a partition-only config) pass
+            # through — only the matrix applies to them
+            if self.cfg.any_link_chaos:
+                return payload
+            self.trace.append(('deliver', src, seq))
+            return payload
+        link = self._link(src)
+        if seq not in link.seen:
+            link.seen.add(seq)
+            if len(link.seen) > 8192:   # bounded per-link memory
+                link.seen = set(sorted(link.seen)[-4096:])
+            d = _decisions(self.cfg, src, self.host_id, seq)
+            if d['drop']:
+                self.trace.append(('drop', src, seq))
+            else:
+                link.pending.append([now + d['delay'], seq, payload, d])
+        if link.redeliver is not None:
+            stale, stale_seq = link.redeliver
+            link.redeliver = None
+            self.trace.append(('dup', src, stale_seq))
+            return stale
+        ready = sorted((e for e in link.pending if e[0] <= now),
+                       key=lambda e: e[1])
+        if not ready:
+            return link.last
+        entry = ready[-1]
+        kind = 'deliver'
+        if entry[3]['reorder'] and len(ready) >= 2:
+            # deliver the second-newest first; the newest stays pending
+            # (its reorder decision is consumed so it delivers next poll)
+            entry[3] = dict(entry[3], reorder=False)
+            entry = ready[-2]
+            kind = 'reorder'
+        link.pending.remove(entry)
+        # older ready payloads that were not the pick are superseded
+        # (last-value-cache transports never deliver them)
+        if kind == 'deliver':
+            link.pending = [e for e in link.pending if e[1] > entry[1]]
+        _, dseq, dpayload, d = entry
+        if d['dup']:
+            link.redeliver = (dpayload, dseq)
+        link.last = dpayload
+        self.trace.append((kind, src, dseq))
+        return dpayload
+
+
+def maybe_wrap(transport, host_id, cfg=None):
+    """Wrap ``transport`` in a :class:`ChaosTransport` when the chaos
+    env is armed (or an explicit ``cfg`` is given); otherwise return it
+    untouched. The one-liner every transport construction site uses."""
+    if cfg is None:
+        cfg = from_env()
+    if cfg is None:
+        return transport
+    return ChaosTransport(transport, cfg, host_id)

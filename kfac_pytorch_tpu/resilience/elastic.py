@@ -34,8 +34,31 @@ for stragglers, and takes the sorted claimant set as the new membership
 — every survivor computes the same set from the same files. A host that
 sees a next-generation claim set it cannot corroborate with a death of
 its own is the one being declared dead (its beats are not reaching
-anyone): it fences itself — kills its trainer and exits — rather than
-split-brain the run.
+anyone): it fences itself — kills its trainer and exits
+:data:`RC_FENCED` — rather than split-brain the run.
+
+Quorum gate (partition tolerance): corroboration alone cannot survive a
+SYMMETRIC partition — each side of a 2|1 or 2|2 split corroborates the
+other side's "death" internally and would relaunch as a rival
+generation. The barrier therefore only COMMITS when the claimant set is
+a strict majority of generation ``g``'s membership (hosts that
+announced graceful completion via ``done-{host}.json`` are exempt from
+the count), with a deterministic tiebreak for exact halves: the side
+holding the lowest host of the membership wins — and when that host
+genuinely died rather than partitioned, BOTH halves fence (silence is
+indistinguishable from a partition; losing availability is the price
+of never forking the run). The losing side fences itself with
+:data:`RC_FENCED` (117), its lineage epoch freezes, and — because the
+supervisor dies with its trainer — no checkpoint is finalized past the
+fence. ``world.json`` carries that monotonic lineage epoch
+(:data:`ENV_LINEAGE`), so even state the fork wrote BEFORE fencing is
+refused by :func:`elastic_resume` once the majority's lineage has moved
+on. A fenced host rejoins through the ``--join`` grow lane after the
+partition heals. The whole path is drillable deterministically:
+``KFAC_FAULT_NET_*`` (``resilience.chaos_net``) injects seeded
+drop/delay/duplicate/reorder schedules and a time-windowed partition
+matrix that this supervisor honors on its heartbeat transport AND its
+protocol-file reads.
 
 Grow protocol (the join lane, mirroring the shrink barrier): a repaired
 or newly-granted host runs ``kfac-pod-supervise --join ...``. Its
@@ -69,6 +92,7 @@ import sys
 import threading
 import time
 
+from kfac_pytorch_tpu.resilience import chaos_net
 from kfac_pytorch_tpu.resilience import heartbeat as hb_mod
 from kfac_pytorch_tpu.resilience.heartbeat import (
     FileLeaseTransport, JoinAnnouncer, PeerHeartbeat, RC_PEER_DEAD,
@@ -87,9 +111,30 @@ log = logging.getLogger(__name__)
 # (is it alive? same lease dir?) rather than to restart the trainer.
 RC_JOIN_FAILED = 116
 
+# "this host fenced itself": the supervisor-level verdict of a host on
+# the losing side of a membership change — it could not corroborate the
+# peers' shrink (its messages are not reaching them), or its own shrink
+# barrier closed WITHOUT a quorum of the generation's membership (the
+# minority side of a network partition). The reaction is never an
+# automatic relaunch: a fenced host rejoins through the --join grow
+# lane once the partition heals, and until then it must not touch
+# shared state (its supervisor stops, so no further checkpoints are
+# finalized under its lineage).
+RC_FENCED = 117
+
+# supervisor -> trainer lineage contract: the monotonic lineage epoch
+# of the membership this trainer belongs to (bumped on every COMMITTED
+# shrink/grow; persisted across pod incarnations in the lease dir's
+# lineage.json). The trainer stamps it into world.json and
+# elastic_resume refuses checkpoints stamped with a NEWER lineage than
+# its own — a fenced fork's relaunch can therefore never resume from,
+# or clobber, the majority's state.
+ENV_LINEAGE = 'KFAC_LINEAGE'
+
 
 def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
-                   retry=None, on_world_change=None, log=None):
+                   retry=None, on_world_change=None, lineage=None,
+                   log=None):
     """World-size-aware auto-resume: ``(state, epoch, old_world)``.
 
     Reads the world stamp the previous run left next to its checkpoints
@@ -112,11 +157,34 @@ def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
     their batch-size / learning-rate rescaling here
     (``training.world_change_rescale``) so accuracy, not just liveness,
     survives the world change.
+
+    ``lineage``: this process's lineage epoch (default: the
+    ``KFAC_LINEAGE`` env the pod supervisor exports; None disables the
+    check). A ``world.json`` stamped with a NEWER lineage than ours
+    means the pod committed membership changes we were not part of —
+    we are a fenced fork's relaunch, and resuming (then re-writing)
+    this state would clobber the majority's run. Raises
+    :class:`~kfac_pytorch_tpu.utils.checkpoint.StaleLineageError`
+    instead of touching anything.
     """
     import jax
     from kfac_pytorch_tpu.utils import checkpoint as ckpt
     lg = log if log is not None else logging.getLogger(__name__)
-    old_world = ckpt.read_world_stamp(base_dir)
+    if lineage is None:
+        raw = os.environ.get(ENV_LINEAGE)
+        lineage = int(raw) if raw else None
+    stamp = ckpt.read_world_stamp_info(base_dir)
+    if (lineage is not None and stamp is not None
+            and isinstance(stamp.get('lineage'), int)
+            and stamp['lineage'] > lineage):
+        raise ckpt.StaleLineageError(
+            f'checkpoints in {base_dir} are stamped lineage '
+            f'{stamp["lineage"]} but this process is at lineage '
+            f'{lineage}: this host belongs to an abandoned (fenced) '
+            'fork of the pod — refusing to resume or overwrite the '
+            'surviving lineage\'s state. Rejoin through the --join '
+            'grow lane instead of relaunching directly.')
+    old_world = None if stamp is None else stamp['num_devices']
     new_world = getattr(precond, 'num_devices', None)
     if (precond is None or old_world is None or new_world is None
             or old_world == new_world):
@@ -183,6 +251,12 @@ class PodSupervisor:
     - anything else — crash: restart with backoff up to
       ``max_restarts``.
 
+    This supervisor itself exits ``RC_FENCED`` (117) when it is on the
+    losing side of a membership change (uncorroborated shrink claims,
+    or a shrink barrier that closed without quorum): the trainer is
+    killed, nothing further is finalized, and the host waits for an
+    operator (or automation) to bring it back through ``--join``.
+
     A structured incident report (what died, detection latency,
     restarts, shrinks) is written to ``incident_path`` on every exit
     path.
@@ -195,7 +269,7 @@ class PodSupervisor:
                  grow_timeout=None, join=False, join_timeout=120.0,
                  stop_rcs=(), incident_path=None, env=None, clock=None,
                  rng=None, popen=subprocess.Popen, poll_period=0.2,
-                 child_kill_grace=5.0, log=None):
+                 child_kill_grace=5.0, net_chaos=None, log=None):
         self.argv_template = list(argv_template)
         self.host_id = int(host_id)
         self.members = list(range(int(num_hosts)))
@@ -246,13 +320,81 @@ class PodSupervisor:
         self._lost = {}       # host_id -> heartbeat info (confirmed dead)
         self._aborted_grow_gens = set()  # stale-join barrier attempts
         self._hb = None
+        # network-chaos drill (KFAC_FAULT_NET_*): wraps the sup-channel
+        # heartbeat transport AND filters the protocol-file reads, so a
+        # partitioned host genuinely cannot see the other side's claims
+        # even on one shared filesystem. Injectable for the fake-clock
+        # quorum tests; None + no env = off.
+        self.net_chaos = (net_chaos if net_chaos is not None
+                          else chaos_net.from_env())
         self.report = IncidentReport(host_id=self.host_id)
         os.makedirs(self.lease_dir, exist_ok=True)
+        # monotonic lineage epoch (see ENV_LINEAGE): persisted in the
+        # lease dir so a whole-pod restart reusing its directories does
+        # not start below the lineage its own checkpoints are stamped
+        # with (which would wrongly read as "we are the fenced fork")
+        self._lineage_mem = self._read_lineage()
 
     def counts(self):
         return {'restarts': self.restarts, 'crashes': self.crashes,
                 'hangs': self.hangs, 'shrinks': self.shrinks,
                 'grows': self.grows, 'joins': self.joins}
+
+    # -- lineage epoch + graceful-departure markers -----------------------
+
+    def _lineage_path(self):
+        return os.path.join(self.lease_dir, 'lineage.json')
+
+    def _read_lineage(self):
+        import json
+        try:
+            with open(self._lineage_path()) as f:
+                return int(json.load(f)['lineage'])
+        except (OSError, ValueError, KeyError, TypeError):
+            return 0
+
+    def _current_lineage(self):
+        """max(what we committed, what any member committed): the file
+        re-read lets a member that raced a commit self-heal by the next
+        relaunch instead of exporting a stale epoch forever."""
+        return max(self._lineage_mem, self._read_lineage())
+
+    def _bump_lineage(self):
+        """On every COMMITTED membership change. All members compute
+        the same successor value from the same file, so concurrent
+        writes are idempotent. NEVER called on a quorum-lost barrier —
+        a fenced host's lineage freezes, which is exactly what lets
+        elastic_resume refuse its fork later."""
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        self._lineage_mem = self._current_lineage() + 1
+        with contextlib.suppress(OSError):
+            atomic_write_json(self._lineage_path(),
+                              {'lineage': self._lineage_mem,
+                               'gen': self.gen, 'host': self.host_id,
+                               'wall': time.time()})
+        return self._lineage_mem
+
+    def _done_path(self, host):
+        return os.path.join(self.lease_dir, f'done-{host}.json')
+
+    def _mark_done(self):
+        """Graceful-departure marker: a supervisor whose trainer
+        FINISHED announces it, so peers that outlive us can tell
+        'completed and left' from 'died/partitioned' — a departed host
+        neither counts toward nor against the shrink quorum."""
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        with contextlib.suppress(OSError):
+            atomic_write_json(self._done_path(self.host_id),
+                              {'host': self.host_id, 'gen': self.gen,
+                               'wall': time.time()})
+
+    def _departed(self):
+        """Members that announced graceful completion."""
+        out = set()
+        for m in self.members:
+            if m != self.host_id and os.path.exists(self._done_path(m)):
+                out.add(m)
+        return out
 
     # -- supervisor-to-supervisor heartbeat -------------------------------
 
@@ -285,11 +427,14 @@ class PodSupervisor:
             path = os.path.join(self.lease_dir, name)
             if name.startswith(('shrink-gen', 'grow-gen', 'trainer-gen')):
                 shutil.rmtree(path, ignore_errors=True)
-            elif name.startswith('join-') and name.endswith('.json'):
+            elif (name.startswith(('join-', 'done-'))
+                    and name.endswith('.json')):
                 # a stale announcement from a previous incarnation would
                 # trigger a spurious grow barrier the moment the fresh
                 # pod comes up (the grow aborts when the ghost never
-                # claims, but why start the churn at all)
+                # claims, but why start the churn at all); stale DONE
+                # markers would exempt live hosts from the new
+                # incarnation's shrink quorum
                 with contextlib.suppress(OSError):
                     os.remove(path)
             elif name == 'sup':
@@ -298,6 +443,14 @@ class PodSupervisor:
                         if lease.startswith('hb-'):
                             with contextlib.suppress(OSError):
                                 os.remove(os.path.join(path, lease))
+
+    def _monitor_transport(self):
+        transport = FileLeaseTransport(
+            os.path.join(self.lease_dir, 'sup'), self.host_id)
+        if self.net_chaos is not None:
+            transport = chaos_net.ChaosTransport(
+                transport, self.net_chaos, self.host_id)
+        return transport
 
     def _start_monitor(self):
         peers = [m for m in self.members if m != self.host_id]
@@ -312,9 +465,8 @@ class PodSupervisor:
             if peers:
                 self._hb.start()
             return
-        sup_dir = os.path.join(self.lease_dir, 'sup')
         self._hb = PeerHeartbeat(
-            FileLeaseTransport(sup_dir, self.host_id), self.host_id,
+            self._monitor_transport(), self.host_id,
             peers=peers, interval=self.hb_interval,
             deadline=self.hb_deadline, startup_grace=self.hb_grace,
             on_dead=self._record_peer_dead, gen=self.gen, log=self.log)
@@ -366,6 +518,16 @@ class PodSupervisor:
         env[hb_mod.ENV_GRACE] = str(self.hb_grace)
         env[hb_mod.ENV_GEN] = str(self.gen)
         env['KFAC_POD_GEN'] = str(self.gen)
+        # lineage epoch: the trainer stamps it into world.json and its
+        # elastic_resume refuses state from a NEWER lineage (commit
+        # fencing — see ENV_LINEAGE)
+        env[ENV_LINEAGE] = str(self._current_lineage())
+        if self.net_chaos is not None:
+            # trainer heartbeat ids are RANKS, which drift from pod
+            # host ids across generations; export the current map so
+            # the partition matrix keeps cutting on stable host ids
+            env[chaos_net.ENV_NET_IDMAP] = ','.join(
+                f'{r}={m}' for r, m in enumerate(self.members))
         # tcp heartbeat pass-through (real pods — launch_tpu.sh defaults
         # multi-host runs to it): re-derive the peer map for the CURRENT
         # membership from the claim-published host addresses, so a
@@ -450,6 +612,18 @@ class PodSupervisor:
     def _grow_dir(self, gen):
         return os.path.join(self.lease_dir, f'grow-gen{gen}')
 
+    def _net_reachable(self, peers):
+        """Drop entries from hosts the partition matrix currently cuts
+        us off from: the drill's partition governs the PROTOCOL files
+        too, not just heartbeats — a minority that could still read the
+        majority's claims would not be partitioned at all."""
+        if self.net_chaos is None:
+            return peers
+        now = time.time()
+        return {h: p for h, p in peers.items()
+                if h == self.host_id
+                or not self.net_chaos.partitioned(h, self.host_id, now)}
+
     def _read_claims(self, claim_dir, prefix='survivor-'):
         import json
         out = {}
@@ -466,7 +640,7 @@ class PodSupervisor:
                 out[int(payload['host'])] = payload
             except (OSError, ValueError, KeyError):
                 continue
-        return out
+        return self._net_reachable(out)
 
     def _write_claim(self, claim_dir, prefix='survivor-', members=None):
         """``members``: incumbent grow claims publish the CURRENT
@@ -493,10 +667,12 @@ class PodSupervisor:
     def _join_announced(self):
         """{host: payload} of NON-member join announcements — the grow
         trigger. A member's own stale announcement (it was admitted and
-        the file lingered) is not a trigger."""
-        return {h: p for h, p in
-                read_join_announcements(self.lease_dir).items()
-                if h not in self.members}
+        the file lingered) is not a trigger; an announcement from a host
+        the partition matrix cuts us off from is invisible."""
+        return self._net_reachable(
+            {h: p for h, p in
+             read_join_announcements(self.lease_dir).items()
+             if h not in self.members})
 
     def _peer_grow_started(self):
         """True when a peer has claimed the next generation's GROW
@@ -512,14 +688,44 @@ class PodSupervisor:
         return bool(set(claims) - {self.host_id})
 
     def _shrink(self, dead):
-        """Run the survivor barrier; returns the new membership."""
+        """Run the survivor barrier. Returns True when the shrink
+        COMMITTED — the claimant set is a strict majority of this
+        generation's membership (graceful completions exempted), or
+        exactly half of it AND holds the lowest live host (the
+        deterministic even-split tiebreak). Returns False when quorum
+        was lost: WE are the minority side of a partition, and the
+        caller must fence this host (RC_FENCED) instead of relaunching
+        a rival generation."""
         next_gen = self.gen + 1
+        # hosts that announced graceful completion neither count toward
+        # nor against quorum: "finished and left" is not partition
+        # evidence, and without the exemption the LAST host of a
+        # winding-down pod would fence itself instead of finishing
+        departed = self._departed() & set(dead)
+        quorum_members = [m for m in self.members if m not in departed]
+        hard_dead = set(dead) - departed
+        if len(hard_dead) * 2 >= len(quorum_members) > 1:
+            # half or more of the live membership went unreachable at
+            # once: from the inside that is exactly what a network
+            # partition looks like — flag it BEFORE the barrier so the
+            # timeline pins suspicion ahead of the quorum verdict
+            self.log.warning(
+                'elastic: partition suspected — %d of %d members '
+                'unreachable (%s) [resilience: partition_suspected=1]',
+                len(hard_dead), len(quorum_members), sorted(hard_dead))
+            self.report.add_event('partition_suspected',
+                                  unreachable=sorted(hard_dead),
+                                  world=len(quorum_members))
         claim_dir = self._claim_dir(next_gen)
         self._write_claim(claim_dir)
         expected = set(self.members) - set(dead)
         start = self.clock.monotonic()
         while self.clock.monotonic() - start < self.shrink_timeout:
-            if expected <= set(self._read_claims(claim_dir)):
+            # a host that finishes cleanly MID-barrier never claims:
+            # drop fresh departures from the expected set instead of
+            # burning the whole timeout waiting for a ghost
+            if expected - self._departed() <= set(
+                    self._read_claims(claim_dir)):
                 break
             self.clock.sleep(self.poll_period)
         # settle: a late claim from a host we wrote off means it is
@@ -529,6 +735,48 @@ class PodSupervisor:
         claims.setdefault(self.host_id,
                           {'host': self.host_id, 'addr': self.host_addr})
         survivors = sorted(claims)
+        # THE QUORUM GATE: a symmetric partition lets each side
+        # corroborate the other's "death" internally, so both sides
+        # reach this point believing they are the survivors. Only the
+        # side holding a strict majority of generation g's membership
+        # may commit g+1; an exact half commits only if it holds the
+        # lowest host of the membership (deterministic — at most one
+        # side can). Deliberate availability tradeoff: when the half
+        # containing the lowest host genuinely DIED (not partitioned),
+        # the other half fences too — silence is indistinguishable
+        # from a partition, and fencing is the only answer that can
+        # never fork the run. A 2-host pod therefore only survives the
+        # HIGHER host's death; graceful completions are exempt above.
+        # The departure exemption is refreshed at DECISION time: a
+        # member that announced graceful completion while the barrier
+        # was open (clean exits never claim) is not partition evidence
+        # — without the refresh, the last live host of a winding-down
+        # pod fences itself because its peers "disappeared" mid-barrier
+        # (found by the partition drill's end-game).
+        departed_now = self._departed() - set(claims)
+        quorum_members = [m for m in self.members
+                          if m not in departed_now]
+        claimants = [h for h in survivors if h in quorum_members]
+        n, world = len(claimants), len(quorum_members)
+        has_quorum = (2 * n > world
+                      or (2 * n == world
+                          and min(quorum_members) in claimants))
+        if not has_quorum:
+            # withdraw our claim so the healed majority can never
+            # mistake this dead barrier for late corroboration
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(
+                    claim_dir, f'survivor-{self.host_id}.json'))
+            self.log.error(
+                'elastic: quorum lost at gen %d — claimants %s are a '
+                'minority of membership %s (tiebreak host %d) '
+                '[resilience: quorum_lost=1]', next_gen, claimants,
+                quorum_members, min(quorum_members))
+            self.report.add_event('quorum_lost', gen=next_gen,
+                                  claimants=claimants,
+                                  membership=list(quorum_members))
+            self.report.bump({'quorum_lost': 1})
+            return False
         old_world = len(self.members)
         dead_set = set(self.members) - set(survivors)
         self.members = survivors
@@ -536,6 +784,7 @@ class PodSupervisor:
                               for h, c in claims.items()}
         self.gen = next_gen
         self.shrinks += 1
+        self._bump_lineage()
         # scrub the dead hosts' sup leases: a later REJOIN would race
         # its first beats against the stale file, which reads to our
         # rebased monitor as a seen-then-silent peer (bypassing the
@@ -555,6 +804,7 @@ class PodSupervisor:
             'survivors': survivors, 'gen': next_gen,
             'dead': sorted(dead)})
         self._start_monitor()
+        return True
 
     # -- grow protocol ----------------------------------------------------
 
@@ -644,6 +894,7 @@ class PodSupervisor:
                               for h, c in claims.items()}
         self.gen = next_gen
         self.grows += 1
+        self._bump_lineage()
         # a host we once confirmed dead is back by AGREEMENT: forget the
         # death record, or _confirmed_dead would re-shrink the pod the
         # moment the rejoined host re-enters the membership
@@ -651,10 +902,14 @@ class PodSupervisor:
             for h in admitted:
                 self._lost.pop(h, None)
         # the announcements served their purpose; scrub so a LATER death
-        # of the rejoined host cannot replay them into a spurious grow
+        # of the rejoined host cannot replay them into a spurious grow.
+        # Done markers go too: a re-admitted host is live again and must
+        # count toward quorum like anyone else.
         for h in admitted:
             with contextlib.suppress(OSError):
                 os.remove(os.path.join(self.lease_dir, f'join-{h}.json'))
+            with contextlib.suppress(OSError):
+                os.remove(self._done_path(h))
         from kfac_pytorch_tpu.utils.runlog import resilience_suffix
         self.log.warning(
             'elastic: growing world %d -> %d members=%s gen=%d '
@@ -698,9 +953,8 @@ class PodSupervisor:
         # closes, and our advancing beats must already be on the
         # channel by then (also overwriting any stale lease our
         # previous life left). Peers rebase in after admission.
-        sup_dir = os.path.join(self.lease_dir, 'sup')
         self._hb = PeerHeartbeat(
-            FileLeaseTransport(sup_dir, self.host_id), self.host_id,
+            self._monitor_transport(), self.host_id,
             peers=[], interval=self.hb_interval,
             deadline=self.hb_deadline, startup_grace=self.hb_grace,
             on_dead=self._record_peer_dead, gen=self.gen, log=self.log)
@@ -774,6 +1028,12 @@ class PodSupervisor:
                                               for h, c in claims.items()}
                         self.gen = gen
                         self.joins += 1
+                        # adopt the pod's lineage: the incumbents bump
+                        # it at the grow commit; re-reading (plus the
+                        # per-relaunch re-read in _child_env) means a
+                        # joiner that raced the write self-heals
+                        self._lineage_mem = max(self._lineage_mem,
+                                                self._read_lineage())
                         self.log.warning(
                             'join: admitted into pod as rank %d — '
                             'world %d gen=%d members=%s%s',
@@ -807,18 +1067,27 @@ class PodSupervisor:
         self.report.bump({'join_failed': 1})
         return False
 
-    def _fence(self, rc):
+    def _fence(self, rc, why=None):
+        """Fence this host: kill the trainer, stop finalizing anything,
+        exit :data:`RC_FENCED`. The trainer dies with its supervisor, so
+        no checkpoint is committed after this point — and the lineage
+        epoch (never bumped on our side of the split) makes any state
+        the fork DID write before the fence refusable at resume time."""
         from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        why = why or ('the other hosts are shrinking around us and no '
+                      'peer looks dead from here — OUR heartbeats are '
+                      'not reaching them')
         self.log.error(
-            'pod-supervisor: the other hosts are shrinking around us and '
-            'no peer looks dead from here — OUR heartbeats are not '
-            'reaching them. Fencing this host (killing the trainer and '
-            'exiting) rather than split-braining the pod. '
-            '[resilience: fenced=1]%s', resilience_suffix(self.counts()))
-        self.report.add_event('fenced', gen=self.gen + 1)
+            'pod-supervisor: %s. Fencing this host (killing the trainer, '
+            'no further checkpoint commits, exiting rc=%d; trainer rc '
+            'was %s) rather than split-braining the pod; rejoin through '
+            '--join once the network heals. [resilience: fenced=1]%s',
+            why, RC_FENCED, rc, resilience_suffix(self.counts()))
+        self.report.add_event('fenced', gen=self.gen + 1, rc=RC_FENCED,
+                              trainer_rc=rc)
         self.report.bump({'fenced': 1})
         self._terminate_child()
-        return rc if rc else RC_PEER_DEAD
+        return RC_FENCED
 
     # -- main loop --------------------------------------------------------
 
@@ -850,6 +1119,15 @@ class PodSupervisor:
                 self._hb.stop()
             self.report.bump(self.counts())
             try:
+                # a later incarnation on the same host (a --join rejoin
+                # after a fence) must not CLOBBER the previous report —
+                # the fenced incarnation's forensics are exactly what an
+                # operator reads after a partition. One rotation level:
+                # the old report survives as <path>.prev.
+                with contextlib.suppress(OSError):
+                    if os.path.exists(self.incident_path):
+                        os.replace(self.incident_path,
+                                   self.incident_path + '.prev')
                 self.report.write(self.incident_path)
                 self.log.info('pod-supervisor: incident report written '
                               'to %s\n%s', self.incident_path,
@@ -931,6 +1209,10 @@ class PodSupervisor:
                               rc, resilience_suffix(self.counts()))
                 return rc if rc is not None else 0
             if reason == 'exit' and rc == 0:
+                # graceful departure: peers that outlive us must not
+                # read our silence as a death (or a partition) — a
+                # departed host is exempt from the shrink quorum
+                self._mark_done()
                 self.log.info('pod-supervisor: trainer finished '
                               'cleanly%s', resilience_suffix(self.counts()))
                 return 0
@@ -950,7 +1232,13 @@ class PodSupervisor:
                                        'left — giving up [resilience: '
                                        'gave_up=1]')
                         return RC_PEER_DEAD
-                    self._shrink(dead)
+                    if not self._shrink(dead):
+                        # quorum lost: we are the partition's minority
+                        # side — fencing is the only move that cannot
+                        # fork the run
+                        return self._fence(
+                            rc, why='the shrink barrier closed without '
+                                    'a quorum of the membership')
                     self.restarts += 1
                     continue
                 # the trainer cried peer-death but nobody looks dead from

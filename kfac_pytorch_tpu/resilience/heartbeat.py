@@ -295,15 +295,28 @@ class PeerHeartbeat:
             self._started_at = now
         try:
             payloads = self.transport.read_peers()
-        except OSError:
+        except (OSError, ValueError):
+            # a flaky transport (or a torn payload a wrapper failed to
+            # screen) costs one poll, never the monitor thread
             payloads = {}
         newly_dead = []
+        sync_samples = []
         with self._lock:
             for peer in self.peers:
                 if peer in self._dead:
                     continue
                 p = payloads.get(peer)
                 rec = self._seen.get(peer)
+                if (p is not None and isinstance(p.get('gen'), int)
+                        and p['gen'] < self.gen):
+                    # STALE GENERATION: a payload from before the last
+                    # elastic world change (a delayed/duplicated
+                    # delivery, or a dead incarnation's lingering lease)
+                    # must never refresh liveness — this monitor's
+                    # membership was agreed at a NEWER generation, and a
+                    # ghost keeping a slot alive would stall the shrink
+                    # the pod already needs
+                    p = None
                 if p is not None and isinstance(p.get('seq'), int):
                     # liveness = the (pid, gen, seq) identity CHANGED,
                     # not "seq grew": a crash-restarted peer resets its
@@ -311,11 +324,23 @@ class PeerHeartbeat:
                     # re-admitted after an elastic grow resets it under
                     # a new GENERATION (possibly a recycled pid) —
                     # judging either by the old process's high-water
-                    # mark would declare a host dead for coming back
+                    # mark would declare a host dead for coming back.
+                    # Duplicated or reordered deliveries change the
+                    # identity too, which is correct: ANY delivery
+                    # proves the peer's process is alive — and a frozen
+                    # identity redelivered forever still dies on
+                    # schedule (the record stops changing).
                     ident = (p.get('pid'), p.get('gen'), p['seq'])
                     if rec is None or ident != rec[0]:
                         rec = self._seen[peer] = [ident, now,
                                                   p.get('step')]
+                        if (self._seq % 8 == 1
+                                and isinstance(p.get('wall'),
+                                               (int, float))):
+                            # cross-host clock pair for the kfac-obs
+                            # offset solver: sender wall vs ours,
+                            # throttled to every 8th publish
+                            sync_samples.append((peer, p['wall']))
                 if rec is None:
                     silent_for = now - self._started_at
                     if silent_for <= self.startup_grace:
@@ -330,6 +355,16 @@ class PeerHeartbeat:
                         'never_seen': rec is None, 'wall': time.time()}
                 self._dead[peer] = info
                 newly_dead.append(peer)
+        if sync_samples:
+            # guarded exactly like the death instants: liveness must
+            # never depend on the trace layer
+            try:
+                from kfac_pytorch_tpu.obs import trace as _trace
+                for peer, peer_wall in sync_samples:
+                    _trace.instant('clock_sync', cat='meta', peer=peer,
+                                   peer_wall=peer_wall)
+            except Exception:  # noqa: BLE001
+                pass
         for peer in newly_dead:
             self._declare_dead(peer, self._dead[peer])
         return newly_dead
@@ -559,6 +594,11 @@ def heartbeat_from_env(log=None, on_dead=None):
         return None
     else:
         transport = FileLeaseTransport(lease_dir, host_id)
+    # network-chaos drill (KFAC_FAULT_NET_*): seeded drop/delay/dup/
+    # reorder schedules + the time-windowed partition matrix wrap the
+    # real transport; a no-op unless the env is armed
+    from kfac_pytorch_tpu.resilience import chaos_net
+    transport = chaos_net.maybe_wrap(transport, host_id)
     stop_step = os.environ.get(ENV_HB_STOP)
     gen = os.environ.get(ENV_GEN) or os.environ.get('KFAC_POD_GEN') or '0'
     return PeerHeartbeat(

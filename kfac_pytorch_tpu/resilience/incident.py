@@ -31,7 +31,8 @@ import time
 # per-epoch delta: aggregate by SUM.
 _CUMULATIVE = frozenset({
     'restarts', 'crashes', 'hangs', 'gave_up', 'fenced', 'shrinks',
-    'grows', 'joins', 'straggler_level',
+    'grows', 'joins', 'straggler_level', 'partition_suspected',
+    'quorum_lost',
 })
 
 # suffix keys that are event FIELDS riding along in a [resilience: ...]
@@ -56,6 +57,21 @@ _PATTERNS = (
     ('shrink', re.compile(
         r'elastic: shrinking world (?P<from>\d+) -> (?P<to>\d+) '
         r'survivors=(?P<survivors>\[[^\]]*\]) gen=(?P<gen>\d+)')),
+    # the partition story (quorum-gated membership): suspicion when
+    # half or more of the membership goes unreachable at once, the
+    # quorum verdict on the shrink barrier, and the losing side's
+    # self-fence — three stages so a partition timeline reads
+    # partition_suspected -> quorum_lost -> fenced alongside the
+    # majority's shrink
+    ('partition_suspected', re.compile(
+        r'elastic: partition suspected — (?P<unreachable>\d+) of '
+        r'(?P<world>\d+) members unreachable')),
+    ('quorum_lost', re.compile(
+        r'elastic: quorum lost at gen (?P<gen>\d+) — claimants '
+        r'(?P<claimants>\[[^\]]*\]) are a minority of membership '
+        r'(?P<membership>\[[^\]]*\])')),
+    ('fenced', re.compile(
+        r'Fencing this host \(killing the trainer')),
     # the grow cycle (elastic GROW / train-through-churn): a repaired
     # host's announcement, each supervisor's claim into the grow
     # barrier, the agreed enlargement, and the trainer-side upward
@@ -238,6 +254,9 @@ class IncidentReport:
             'gave_up': bool(self.counters.get('gave_up')
                             or any(e['kind'] == 'gave_up'
                                    for e in self.events)),
+            'fenced': bool(self.counters.get('fenced')
+                           or any(e['kind'] == 'fenced'
+                                  for e in self.events)),
             'counters': dict(sorted(self.counters.items())),
             'events': self.events,
         }
@@ -268,6 +287,9 @@ class IncidentReport:
                          f"{d['degrade_windows']}")
         if d['steps_lost']:
             lines.append(f"  steps lost to restarts: {d['steps_lost']}")
+        if d['fenced']:
+            lines.append('  HOST FENCED (rc 117) — quorum lost or '
+                         'uncorroborated shrink; rejoin via --join')
         if d['gave_up']:
             lines.append('  SUPERVISOR GAVE UP — run did not complete')
         if d['counters']:

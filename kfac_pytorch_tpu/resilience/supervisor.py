@@ -50,7 +50,8 @@ log = logging.getLogger(__name__)
 # resilience"); crash (113) is faults.CRASH_RC spelled as a literal so
 # this module stays importable without jax.
 STOP_RC_NAMES = {'hang': RC_HANG, 'peer_dead': 115, 'peer-dead': 115,
-                 'crash': 113, 'join_failed': 116, 'join-failed': 116}
+                 'crash': 113, 'join_failed': 116, 'join-failed': 116,
+                 'fenced': 117}
 
 
 def parse_stop_rc(value):

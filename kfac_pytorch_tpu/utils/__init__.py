@@ -8,9 +8,9 @@ from kfac_pytorch_tpu.utils.losses import (
     label_smoothing_cross_entropy, sample_pseudo_labels)
 from kfac_pytorch_tpu.utils.checkpoint import (
     save_checkpoint, restore_checkpoint, find_resume_epoch, auto_resume,
-    PreemptionGuard, wait_for_checkpoints, prune_checkpoints,
-    reshard_kfac_state, write_world_stamp, read_world_stamp,
-    read_world_stamp_info)
+    PreemptionGuard, StaleLineageError, wait_for_checkpoints,
+    prune_checkpoints, reshard_kfac_state, write_world_stamp,
+    read_world_stamp, read_world_stamp_info)
 from kfac_pytorch_tpu.utils.profiling import (
     trace, time_steps, exclude_parts_breakdown)
 
@@ -20,7 +20,8 @@ __all__ = [
     'inverse_sqrt', 'label_smoothing_cross_entropy', 'sample_pseudo_labels',
     'save_checkpoint', 'restore_checkpoint', 'find_resume_epoch',
     'auto_resume',
-    'PreemptionGuard', 'wait_for_checkpoints', 'prune_checkpoints',
+    'PreemptionGuard', 'StaleLineageError', 'wait_for_checkpoints',
+    'prune_checkpoints',
     'reshard_kfac_state', 'write_world_stamp', 'read_world_stamp',
     'read_world_stamp_info',
     'trace', 'time_steps', 'exclude_parts_breakdown',

@@ -194,7 +194,14 @@ def reshard_kfac_state(pre_old, pre_new, kfac_state):
         factors={k: jnp.asarray(v) for k, v in factors.items()})
 
 
-def write_world_stamp(base_dir, num_devices, gen=None):
+class StaleLineageError(RuntimeError):
+    """This process belongs to an abandoned (fenced) fork of the pod:
+    the on-disk ``world.json`` records a NEWER lineage epoch than the
+    one this process was launched with. Resuming — or re-stamping —
+    would clobber the surviving lineage's state, so both refuse."""
+
+
+def write_world_stamp(base_dir, num_devices, gen=None, lineage=None):
     """Record the K-FAC world size the checkpoints in ``base_dir`` were
     taken at (``world.json``, atomic, rank-0 only). The elastic resume
     path (``resilience.elastic.elastic_resume``) compares this stamp to
@@ -205,7 +212,16 @@ def write_world_stamp(base_dir, num_devices, gen=None):
     on a structure mismatch. ``gen`` (optional) records the pod
     generation the stamp was written under (``KFAC_POD_GEN`` from the
     pod supervisor) — provenance for churn forensics, not protocol
-    state."""
+    state.
+
+    ``lineage`` (optional, ``KFAC_LINEAGE`` from the pod supervisor) is
+    PROTOCOL state: the monotonic lineage epoch of the membership this
+    trainer belongs to. The stamp may never move backward — a writer at
+    a LOWER lineage than the one on disk is a fenced fork's straggler,
+    and overwriting here would be exactly the split-brain clobber the
+    quorum gate exists to prevent: it raises :class:`StaleLineageError`
+    instead (commit fencing's last line of defense; the first is that a
+    fenced supervisor never relaunches its trainer at all)."""
     if jax.process_index() != 0:
         return
     from kfac_pytorch_tpu.resilience import atomic_write_json
@@ -213,8 +229,39 @@ def write_world_stamp(base_dir, num_devices, gen=None):
     stamp = {'num_devices': int(num_devices)}
     if gen is not None:
         stamp['gen'] = int(gen)
-    atomic_write_json(os.path.join(os.path.abspath(base_dir),
-                                   'world.json'), stamp)
+    target = os.path.join(os.path.abspath(base_dir), 'world.json')
+    if lineage is None:
+        atomic_write_json(target, stamp)
+        return
+    # check-then-write must be atomic against a CONCURRENT higher-
+    # lineage writer (the race: a fenced straggler reads the old stamp,
+    # the majority writes the new one, the straggler's replace moves it
+    # backward) — serialize through an advisory lock next to the stamp.
+    # Best-effort: on filesystems without flock semantics (gcsfuse) the
+    # check still runs unserialized, and the OTHER two fencing layers
+    # (the fenced supervisor killing its trainer; elastic_resume
+    # refusing a newer-lineage stamp) carry the guarantee.
+    import contextlib
+    lock_cm = contextlib.nullcontext()
+    try:
+        import fcntl
+        lock_f = open(target + '.lock', 'w')
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        lock_cm = lock_f  # closing releases the lock
+    except (ImportError, OSError):
+        pass
+    with lock_cm:
+        existing = read_world_stamp_info(base_dir)
+        if (existing is not None
+                and isinstance(existing.get('lineage'), int)
+                and existing['lineage'] > int(lineage)):
+            raise StaleLineageError(
+                f'world stamp in {base_dir} is at lineage '
+                f'{existing["lineage"]} but this process is at lineage '
+                f'{int(lineage)}: refusing to move the stamp backward '
+                '(this host belongs to an abandoned fork of the pod)')
+        stamp['lineage'] = int(lineage)
+        atomic_write_json(target, stamp)
 
 
 def read_world_stamp_info(base_dir):

@@ -117,6 +117,15 @@ fi
 # pod runs the grow barrier, every trainer relaunches at the enlarged
 # world, and factor state reshards UP through elastic_resume. Exit 116
 # (join_failed) means the pod never answered within KFAC_JOIN_TIMEOUT.
+# Partitions: membership changes are QUORUM-GATED — the minority side
+# of a network partition exits 117 (fenced) instead of relaunching a
+# rival generation, stops finalizing checkpoints, and rejoins via
+# KFAC_POD_JOIN=1 once the network heals; the supervisor exports the
+# lineage epoch as KFAC_LINEAGE so a fenced fork's state is refused at
+# resume. Drill it deterministically with the KFAC_FAULT_NET_* network
+# chaos env (seeded drop/delay/dup/reorder + a time-windowed partition
+# matrix; see resilience/chaos_net.py and README "Network partitions")
+# — inherited by the supervisors and trainers like every KFAC_FAULT_*.
 if [ -n "$KFAC_POD_SUPERVISE" ]; then
   : "${KFAC_POD_LEASE_DIR:?KFAC_POD_SUPERVISE=1 needs KFAC_POD_LEASE_DIR (shared across hosts)}"
   exec "${PY:-python}" -m kfac_pytorch_tpu.resilience.elastic \

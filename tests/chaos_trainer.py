@@ -179,8 +179,12 @@ def main():
                 watchdog.disarm()
         checkpoint.save_checkpoint(args.checkpoint_dir, epoch, state,
                                    retry=io_retry)
+        # gen is provenance; lineage is PROTOCOL — the stamp refuses to
+        # move backward, and elastic_resume refuses a newer-lineage
+        # stamp, so a fenced fork can neither resume nor clobber
         checkpoint.write_world_stamp(args.checkpoint_dir, world,
-                                     gen=os.environ.get('KFAC_POD_GEN'))
+                                     gen=os.environ.get('KFAC_POD_GEN'),
+                                     lineage=os.environ.get('KFAC_LINEAGE'))
         print(f'EPOCH {epoch} step={int(state.step)} loss={loss:.4f}',
               flush=True)
         if tracer is not None:

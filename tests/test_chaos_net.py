@@ -1,0 +1,235 @@
+"""Deterministic network chaos (resilience/chaos_net.py).
+
+Everything here is wall-clock-free: the ChaosTransport takes injectable
+monotonic/wall clocks, and every fault decision is a pure function of
+``(seed, src, dst, seq)`` — two runs over the same poll sequence must
+produce IDENTICAL delivery traces, which is the acceptance pin for the
+partition drill's reproducibility.
+"""
+
+import json
+import os
+
+import pytest
+
+from kfac_pytorch_tpu.resilience import atomic_write_json, chaos_net
+from kfac_pytorch_tpu.resilience.chaos_net import (
+    ChaosTransport, NetFaultConfig, parse_idmap, parse_partition_spec)
+from kfac_pytorch_tpu.resilience.retry import ManualClock
+
+pytestmark = pytest.mark.core
+
+
+class ScriptedTransport:
+    """Inner transport the tests drive by hand."""
+
+    def __init__(self):
+        self.peers = {}
+        self.published = []
+        self.closed = False
+
+    def publish(self, payload):
+        self.published.append(payload)
+
+    def read_peers(self):
+        return {h: dict(p) for h, p in self.peers.items()}
+
+    def close(self):
+        self.closed = True
+
+
+def _drive(cfg, n=40, seed_payload=None):
+    """One scripted run: peer 1 publishes seq 1..n, one poll per second
+    on a manual clock. Returns (delivery trace, delivered seq list)."""
+    clock = ManualClock()
+    inner = ScriptedTransport()
+    t = ChaosTransport(inner, cfg, 0, clock=clock.monotonic,
+                       wall=clock.monotonic)
+    delivered = []
+    for seq in range(1, n + 1):
+        inner.peers[1] = dict(seed_payload or {}, host=1, seq=seq,
+                              pid=7, gen=0)
+        out = t.read_peers()
+        if 1 in out:
+            delivered.append(out[1]['seq'])
+        clock.sleep(1.0)
+    # drain: let delayed payloads arrive
+    for _ in range(10):
+        out = t.read_peers()
+        if 1 in out:
+            delivered.append(out[1]['seq'])
+        clock.sleep(1.0)
+    return list(t.trace), delivered
+
+
+def test_identical_seed_reproduces_identical_delivery_trace():
+    cfg = NetFaultConfig(seed=11, drop=0.2, delay=3.5, dup=0.3,
+                         reorder=0.6)
+    trace_a, delivered_a = _drive(cfg, n=60)
+    trace_b, delivered_b = _drive(cfg, n=60)
+    assert trace_a == trace_b
+    assert delivered_a == delivered_b
+    # the schedule genuinely exercised every fault kind at these rates
+    kinds = {k for k, _, _ in trace_a}
+    assert {'deliver', 'drop', 'dup', 'reorder'} <= kinds, kinds
+
+
+def test_different_seed_changes_the_schedule():
+    cfg = NetFaultConfig(seed=11, drop=0.3, delay=2.5, dup=0.25,
+                         reorder=0.25)
+    other = NetFaultConfig(seed=12, drop=0.3, delay=2.5, dup=0.25,
+                           reorder=0.25)
+    assert _drive(cfg)[0] != _drive(other)[0]
+
+
+def test_drop_one_starves_the_link_without_crashing():
+    trace, delivered = _drive(NetFaultConfig(seed=1, drop=1.0))
+    assert delivered == []
+    assert trace and all(k == 'drop' for k, _, _ in trace)
+
+
+def test_delay_holds_payloads_then_delivers_without_invention():
+    """Delayed payloads arrive late but intact: everything delivered
+    was genuinely published, and a pure-delay link never regresses the
+    LATEST delivered seq below what a stale repeat would show."""
+    trace, delivered = _drive(NetFaultConfig(seed=3, delay=3.0))
+    assert delivered, 'pure delay must still deliver'
+    assert set(delivered) <= set(range(1, 41))
+    # no drops/dups/reorders configured: none may appear
+    assert {k for k, _, _ in trace} <= {'deliver'}
+    fresh = [s for i, s in enumerate(delivered)
+             if i == 0 or s != delivered[i - 1]]
+    assert fresh == sorted(fresh)
+
+
+def test_duplicate_redelivers_stale_payload_between_fresh_ones():
+    trace, delivered = _drive(NetFaultConfig(seed=5, dup=1.0))
+    dups = [s for k, _, s in trace if k == 'dup']
+    assert dups, 'dup=1.0 must redeliver'
+    # a duplicated delivery repeats a seq AFTER it first appeared
+    for s in dups:
+        assert delivered.index(s) < len(delivered) - 1 or s == delivered[-1]
+
+
+def test_partition_window_cuts_only_between_groups():
+    cfg = NetFaultConfig(
+        seed=0, windows=parse_partition_spec('10:40=0,2|1'), t0=0.0)
+    assert cfg.partitioned(1, 0, 15.0)
+    assert cfg.partitioned(0, 1, 15.0)
+    assert not cfg.partitioned(2, 0, 15.0)     # same group
+    assert not cfg.partitioned(0, 1, 45.0)     # window over
+    assert not cfg.partitioned(0, 1, 9.9)      # window not yet open
+    assert not cfg.partitioned(0, 5, 15.0)     # unlisted host: connected
+    assert not cfg.partitioned(1, 1, 15.0)     # self
+
+
+def test_partition_applies_to_wrapped_reads():
+    clock = ManualClock()
+    inner = ScriptedTransport()
+    cfg = NetFaultConfig(seed=0,
+                         windows=parse_partition_spec('5:100=0|1'),
+                         t0=0.0)
+    t = ChaosTransport(inner, cfg, 0, clock=clock.monotonic,
+                       wall=clock.monotonic)
+    inner.peers[1] = {'host': 1, 'seq': 1, 'pid': 7}
+    assert 1 in t.read_peers()                 # before the window
+    clock.sleep(10.0)
+    inner.peers[1] = {'host': 1, 'seq': 2, 'pid': 7}
+    out = t.read_peers()                       # inside: link cut
+    assert 1 not in out
+    assert ('partition', 1, 2) in t.trace
+    # publish passes through untouched either way
+    t.publish({'host': 0, 'seq': 9})
+    assert inner.published[-1]['seq'] == 9
+
+
+def test_partition_file_cuts_and_heals_live(tmp_path):
+    part = tmp_path / 'partition.json'
+    cfg = NetFaultConfig(seed=0, partition_file=str(part))
+    assert not cfg.partitioned(0, 1, 50.0)     # no file: connected
+    atomic_write_json(str(part), {'windows': [
+        {'start': 40.0, 'end': 60.0, 'groups': [[0, 2], [1]]}]})
+    assert cfg.partitioned(0, 1, 50.0)
+    assert not cfg.partitioned(0, 2, 50.0)
+    assert not cfg.partitioned(0, 1, 65.0)     # window expired
+    os.remove(part)                            # HEAL: file gone
+    assert not cfg.partitioned(0, 1, 50.0)
+    # torn JSON reads as "no partition", never a crash
+    part.write_text('{"windows": [{"sta')
+    assert not cfg.partitioned(0, 1, 50.0)
+
+
+def test_idmap_translates_ranks_to_pod_hosts():
+    """After a shrink the trainer ranks drift from pod host ids: rank 1
+    is pod host 2. The partition matrix must keep cutting on POD host
+    ids through the supervisor-exported map."""
+    cfg = NetFaultConfig(seed=0,
+                         windows=parse_partition_spec('0:100=0,2|1'),
+                         t0=0.0, idmap=parse_idmap('0=0,1=2'))
+    # rank 0 (host 0) <-> rank 1 (host 2): SAME side, never cut
+    assert not cfg.partitioned(0, 1, 50.0)
+
+
+def test_from_env_strict_and_optional(monkeypatch):
+    for k in chaos_net.NET_ENVS:
+        monkeypatch.delenv(k, raising=False)
+    assert chaos_net.from_env() is None
+    monkeypatch.setenv(chaos_net.ENV_NET_SEED, '42')
+    monkeypatch.setenv(chaos_net.ENV_NET_DROP, '0.25')
+    monkeypatch.setenv(chaos_net.ENV_NET_PARTITION, '10:20=0|1')
+    monkeypatch.setenv(chaos_net.ENV_NET_T0, '1000')
+    cfg = chaos_net.from_env()
+    assert cfg.seed == 42 and cfg.drop == 0.25 and cfg.t0 == 1000.0
+    assert cfg.partitioned(0, 1, 1015.0)
+    for env, bad in ((chaos_net.ENV_NET_DROP, '1.5'),
+                     (chaos_net.ENV_NET_SEED, 'xyz'),
+                     (chaos_net.ENV_NET_PARTITION, '10=0|1'),
+                     (chaos_net.ENV_NET_PARTITION, '10:20=0'),
+                     (chaos_net.ENV_NET_PARTITION, '20:10=0|1'),
+                     (chaos_net.ENV_NET_PARTITION, '10:20=0|0,1')):
+        old = os.environ.get(env)
+        monkeypatch.setenv(env, bad)
+        with pytest.raises(ValueError):
+            chaos_net.from_env()
+        monkeypatch.setenv(env, old)
+
+
+def test_faults_from_env_registers_the_net_contract(monkeypatch):
+    """The STRICT faults.from_env must know the whole KFAC_FAULT_NET_*
+    surface (a typo'd drill fails loudly) and must re-raise malformed
+    sub-specs at build time."""
+    from kfac_pytorch_tpu import faults
+    for k in list(os.environ):
+        if k.startswith('KFAC_FAULT_'):
+            monkeypatch.delenv(k)
+    monkeypatch.setenv(chaos_net.ENV_NET_SEED, '1')
+    monkeypatch.setenv(chaos_net.ENV_NET_PARTITION, '5:9=0|1')
+    faults.from_env()  # well-formed: accepted
+    monkeypatch.setenv('KFAC_FAULT_NET_TYPO', '1')
+    with pytest.raises(ValueError, match='NET_TYPO'):
+        faults.from_env()
+    monkeypatch.delenv('KFAC_FAULT_NET_TYPO')
+    monkeypatch.setenv(chaos_net.ENV_NET_DELAY, '-3')
+    with pytest.raises(ValueError, match='NET_DELAY'):
+        faults.from_env()
+
+
+def test_maybe_wrap_and_close_pass_through(monkeypatch):
+    for k in chaos_net.NET_ENVS:
+        monkeypatch.delenv(k, raising=False)
+    inner = ScriptedTransport()
+    assert chaos_net.maybe_wrap(inner, 0) is inner  # env off: untouched
+    monkeypatch.setenv(chaos_net.ENV_NET_SEED, '7')
+    wrapped = chaos_net.maybe_wrap(inner, 0)
+    assert isinstance(wrapped, ChaosTransport)
+    wrapped.close()
+    assert inner.closed
+
+
+def test_partition_file_spec_roundtrip_shapes():
+    windows = parse_partition_spec('0:5=0|1;10:20=0,1|2,3')
+    assert len(windows) == 2
+    assert windows[1].groups == (frozenset({0, 1}), frozenset({2, 3}))
+    with pytest.raises(ValueError):
+        parse_idmap('0:1')
+    assert parse_idmap('0=0, 1=2') == {0: 0, 1: 2}

@@ -366,3 +366,138 @@ def test_heartbeat_from_env(tmp_path, monkeypatch):
     monkeypatch.setenv(hb_mod.ENV_HOSTS, '1')
     assert heartbeat_from_env() is None  # single host: no heartbeat
     assert resilience.RC_PEER_DEAD == RC_PEER_DEAD == 115
+
+
+def test_torn_lease_json_never_crashes_the_monitor(tmp_path):
+    """Satellite (ISSUE 7): a reader catching a file mid-replace (or a
+    genuinely torn write from a crashed peer) costs one poll, never the
+    monitor thread — and a later intact payload resumes liveness."""
+    c0 = ManualClock()
+    deaths = []
+    h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       interval=1.0, deadline=5.0, startup_grace=30.0,
+                       clock=c0.monotonic,
+                       on_dead=lambda p, i: deaths.append((p, i)))
+    t1 = FileLeaseTransport(tmp_path, 1)
+    t1.publish({'host': 1, 'seq': 1, 'pid': 9})
+    assert h0.poll_once() == []
+    # the peer's lease is torn mid-write: skip-and-retry, no crash
+    (tmp_path / 'hb-1.json').write_text('{"host": 1, "se')
+    for _ in range(3):
+        assert h0.poll_once() == []
+        c0.sleep(1.0)
+    # intact again before the deadline: still alive
+    t1.publish({'host': 1, 'seq': 2, 'pid': 9})
+    h0.poll_once()
+    assert deaths == []
+    # and a transport whose read_peers RAISES ValueError is survived
+    class TornTransport(FileLeaseTransport):
+        def read_peers(self):
+            raise ValueError('torn beyond parsing')
+    h0.transport = TornTransport(tmp_path, 0)
+    assert h0.poll_once() == []
+
+
+def test_stale_generation_payload_never_refreshes_liveness(tmp_path):
+    """TCP-hardening satellite: a payload from BEFORE the last elastic
+    world change (delayed, duplicated, or a dead incarnation's lease)
+    must not keep a slot alive — the (pid, gen, seq) identity only
+    counts at the monitor's own generation or newer."""
+    c0 = ManualClock()
+    deaths = []
+    h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       interval=1.0, deadline=4.0, startup_grace=6.0,
+                       clock=c0.monotonic, gen=2,
+                       on_dead=lambda p, i: deaths.append((p, i)))
+    t1 = FileLeaseTransport(tmp_path, 1)
+    # stale-generation stream: advancing seqs, but gen 1 < monitor gen 2
+    for seq in range(1, 10):
+        t1.publish({'host': 1, 'seq': seq, 'pid': 9, 'gen': 1})
+        h0.poll_once()
+        c0.sleep(1.0)
+    assert deaths and deaths[0][0] == 1, 'stale gen kept a ghost alive'
+    assert deaths[0][1]['never_seen'] is True
+    # current-generation payloads DO count (and a future gen tolerates
+    # a peer that committed the next world change slightly before us)
+    c1 = ManualClock()
+    deaths2 = []
+    h1 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                       interval=1.0, deadline=4.0, startup_grace=6.0,
+                       clock=c1.monotonic, gen=2,
+                       on_dead=lambda p, i: deaths2.append((p, i)))
+    for seq in range(1, 10):
+        t1.publish({'host': 1, 'seq': seq, 'pid': 9,
+                    'gen': 2 if seq < 5 else 3})
+        h1.poll_once()
+        c1.sleep(1.0)
+    assert deaths2 == []
+
+
+def _chaos_monitor(tmp_path, transport, cfg, clock, **kw):
+    from kfac_pytorch_tpu.resilience.chaos_net import ChaosTransport
+    deaths = []
+    wrapped = ChaosTransport(transport, cfg, 0, clock=clock.monotonic,
+                             wall=clock.monotonic)
+    kw.setdefault('interval', 1.0)
+    kw.setdefault('deadline', 6.0)
+    kw.setdefault('startup_grace', 30.0)
+    h = PeerHeartbeat(wrapped, 0, 2, clock=clock.monotonic,
+                      on_dead=lambda p, i: deaths.append((p, i)), **kw)
+    return h, wrapped, deaths
+
+
+def test_tcp_duplicated_reordered_payloads_keep_liveness_identity():
+    """TCP-hardening satellite: ChaosTransport duplication + reordering
+    over a REAL TcpHeartbeatTransport pair must never regress the
+    (pid, gen, seq) liveness identity into a false death while the
+    publisher advances — and a FROZEN publisher whose stale payloads
+    keep being redelivered still dies on schedule."""
+    from kfac_pytorch_tpu.resilience.chaos_net import NetFaultConfig
+    t0 = TcpHeartbeatTransport(0, 0, {}, bind_host='127.0.0.1')
+    t1 = TcpHeartbeatTransport(1, 0, {0: ('127.0.0.1', t0.port)},
+                               bind_host='127.0.0.1', timeout=2.0)
+    t0.peer_addrs = {1: ('127.0.0.1', t1.port)}
+    clock = ManualClock()
+    cfg = NetFaultConfig(seed=9, delay=2.5, dup=0.7, reorder=0.9)
+    h0, wrapped, deaths = _chaos_monitor(None, t0, cfg, clock)
+    try:
+        for seq in range(1, 25):
+            t1.publish({'host': 1, 'seq': seq, 'pid': 42, 'gen': 0})
+            h0.poll_once()
+            clock.sleep(1.0)
+        # duplicated/reordered deliveries happened, yet no false death
+        kinds = {k for k, _, _ in wrapped.trace}
+        assert 'dup' in kinds and 'reorder' in kinds, kinds
+        assert deaths == []
+        # publisher freezes: stale redeliveries of the same identity
+        # must NOT reset the silence clock — death within the deadline
+        # window (+ drained delay), not postponed indefinitely
+        polls_to_death = 0
+        while not deaths and polls_to_death < 30:
+            h0.poll_once()
+            clock.sleep(1.0)
+            polls_to_death += 1
+        assert deaths and deaths[0][0] == 1
+        # bound: residual delayed deliveries (<= delay) + one dup
+        # redelivery poll + the deadline itself + poll granularity
+        assert polls_to_death <= 2.5 + 1 + 6.0 + 2
+    finally:
+        t0.close()
+        t1.close()
+
+
+def test_heartbeat_from_env_wraps_transport_in_chaos(tmp_path,
+                                                     monkeypatch):
+    from kfac_pytorch_tpu.resilience import chaos_net
+    from kfac_pytorch_tpu.resilience import heartbeat as hb_mod
+    from kfac_pytorch_tpu.resilience.chaos_net import ChaosTransport
+    monkeypatch.setenv(hb_mod.ENV_DIR, str(tmp_path))
+    monkeypatch.setenv(hb_mod.ENV_HOST, '0')
+    monkeypatch.setenv(hb_mod.ENV_HOSTS, '2')
+    hb = heartbeat_from_env()
+    assert not isinstance(hb.transport, ChaosTransport)  # env off
+    monkeypatch.setenv(chaos_net.ENV_NET_SEED, '4')
+    monkeypatch.setenv(chaos_net.ENV_NET_IDMAP, '0=0,1=2')
+    hb = heartbeat_from_env()
+    assert isinstance(hb.transport, ChaosTransport)
+    assert hb.transport.cfg.idmap == {0: 0, 1: 2}

@@ -668,3 +668,119 @@ def test_training_dispatch_and_step_spans():
     assert 'ComputeFactor' in all_phases
     assert all_phases <= {'ComputeFactor', 'ComputeInverse',
                           'CommunicateInverse', 'Precondition'}
+
+
+# -- automatic clock-offset solving (ISSUE 7 satellite) ------------------------
+
+
+def _sync_trace(path, pid, rows):
+    """Write a minimal trace JSONL of clock_sync instants.
+    rows: [(receiver_wall, peer, peer_wall)]"""
+    with open(path, 'w') as f:
+        f.write(json.dumps({'ph': 'M', 'name': 'process_name',
+                            'pid': pid, 'tid': 0, 'ts': 0,
+                            'args': {'name': f'host{pid}'}}) + '\n')
+        for wall, peer, peer_wall in rows:
+            f.write(json.dumps({'name': 'clock_sync', 'ph': 'i',
+                                'cat': 'meta', 's': 'p',
+                                'ts': wall * 1e6, 'pid': pid, 'tid': 0,
+                                'args': {'peer': peer,
+                                         'peer_wall': peer_wall}})
+                    + '\n')
+
+
+def test_solve_offsets_recovers_injected_skew(tmp_path):
+    """Host 1's clock runs 3.5s AHEAD. The cross-host clock_sync pairs
+    (sender wall vs receiver wall at delivery, latency-biased upward)
+    must solve host 1's correction to ~-3.5s, anchored at host 0."""
+    T0, skew = 1_000_000.0, 3.5
+    rows0, rows1 = [], []
+    for i in range(6):
+        t = T0 + 10 * i
+        lat = 0.02 * (i + 1)        # varying latency; min ~0.02
+        # host 0 receives host 1's payload: stamped on 1's fast clock
+        rows0.append((t + lat, 1, t + skew))
+        # host 1 receives host 0's payload: its local clock reads fast
+        rows1.append((t + lat + skew, 0, t))
+    _sync_trace(tmp_path / 'trace-host0.jsonl', 0, rows0)
+    _sync_trace(tmp_path / 'trace-host1.jsonl', 1, rows1)
+    offsets = aggregate.solve_offsets([str(tmp_path / 'trace-host0.jsonl'),
+                                       str(tmp_path / 'trace-host1.jsonl')])
+    assert set(offsets) == {1}
+    assert offsets[1] == pytest.approx(-skew, abs=0.05)
+
+
+def test_solve_offsets_bfs_propagates_through_indirect_links(tmp_path):
+    """Host 2 only ever exchanged beats with host 1 (never with the
+    anchor host 0): its offset must still solve through the 0<->1<->2
+    link chain."""
+    T0 = 5_000.0
+    # host 1 runs +2.0s fast, host 2 +1.0s fast (both vs host 0)
+    _sync_trace(tmp_path / 't0.jsonl', 0, [(T0, 1, T0 + 2.0)])
+    _sync_trace(tmp_path / 't1.jsonl', 1,
+                [(T0 + 2.0, 0, T0), (T0 + 2.0, 2, T0 + 1.0)])
+    offsets = aggregate.solve_offsets([str(tmp_path / 't0.jsonl'),
+                                       str(tmp_path / 't1.jsonl')])
+    # e1 = +2.0 -> offset -2.0; e2 = e1 - (ts1 - peer_wall2) = 2 - 1 = 1
+    assert offsets[1] == pytest.approx(-2.0, abs=1e-6)
+    assert offsets[2] == pytest.approx(-1.0, abs=1e-6)
+
+
+def test_solve_offsets_falls_back_to_empty_without_pairs(tmp_path):
+    """No clock_sync pairs (tracing off, single host): the solver
+    returns {} and the timeline keeps its carry-forward alignment."""
+    rec = trace.TraceRecorder(str(tmp_path / 'plain.jsonl'), process_id=0)
+    with rec.span('kfac.step'):
+        pass
+    rec.flush()
+    assert aggregate.solve_offsets([str(tmp_path / 'plain.jsonl')]) == {}
+    log = tmp_path / 'host0.out'
+    log.write_text('EPOCH 0 step=5 loss=1.0\n')
+    assert aggregate.solve_offsets([str(log)]) == {}
+
+
+def test_heartbeat_emits_cross_host_clock_sync_pairs(tmp_path):
+    """The solver's inputs come from the heartbeat monitors: every 8th
+    publish with a fresh peer advance records a clock_sync instant
+    carrying (peer, peer_wall)."""
+    from kfac_pytorch_tpu.resilience.heartbeat import (
+        FileLeaseTransport, PeerHeartbeat)
+    from kfac_pytorch_tpu.resilience.retry import ManualClock
+    rec = trace.install(None)
+    try:
+        clock = ManualClock()
+        h0 = PeerHeartbeat(FileLeaseTransport(tmp_path, 0), 0, 2,
+                           interval=1.0, deadline=50.0,
+                           startup_grace=60.0, clock=clock.monotonic,
+                           on_dead=lambda p, i: None)
+        t1 = FileLeaseTransport(tmp_path, 1)
+        for seq in range(1, 20):
+            t1.publish({'host': 1, 'seq': seq, 'pid': 9, 'gen': 0,
+                        'wall': 123456.0 + seq})
+            h0.poll_once()
+            clock.sleep(1.0)
+        syncs = [e for e in rec.events()
+                 if e.get('name') == 'clock_sync'
+                 and (e.get('args') or {}).get('peer') == 1]
+        assert syncs, 'no cross-host clock_sync emitted'
+        assert all(isinstance(s['args']['peer_wall'], float)
+                   for s in syncs)
+        # throttled: every 8th publish, not every poll
+        assert len(syncs) <= 4
+    finally:
+        trace.uninstall()
+
+
+def test_aggregate_cli_solves_offsets_by_default(tmp_path, capsys):
+    _sync_trace(tmp_path / 'trace-host0.jsonl', 0,
+                [(1000.0, 1, 998.0)])
+    _sync_trace(tmp_path / 'trace-host1.jsonl', 1,
+                [(1002.0, 0, 1000.0)])
+    aggregate.main([str(tmp_path / 'trace-host0.jsonl'),
+                    str(tmp_path / 'trace-host1.jsonl')])
+    out = capsys.readouterr().out
+    assert 'clock offsets solved' in out and 'host1=' in out
+    aggregate.main(['--no-solve-offsets',
+                    str(tmp_path / 'trace-host0.jsonl')])
+    out = capsys.readouterr().out
+    assert 'clock offsets solved' not in out

@@ -540,3 +540,268 @@ def test_pod_survives_churn_kill_and_rejoin(tmp_path):
                       default=str)
         with open(os.path.join(churn_art, 'pod_trace.json'), 'w') as f:
             json.dump(aggregate.merged_chrome_trace(timeline), f)
+
+
+# ---------------------------------------------------------------------------
+# the partition drill (ISSUE 7): seeded 2|1 ChaosTransport partition ->
+# majority shrinks and trains on, minority fences rc=117 with zero
+# checkpoint commits, heal -> --join rejoin -> grow, schedule-equivalent
+# ---------------------------------------------------------------------------
+
+PART_HB_DEADLINE = 3.0
+PART_EPOCHS = 10
+PART_BATCH = 12      # divides worlds 1/2/3 (shard_map needs even shards)
+PART_EXAMPLES = 72   # 6 steps/epoch
+
+
+def _part_cmd(host_id, lease, ckpt_dir, join=False):
+    cmd = [sys.executable, '-m', 'kfac_pytorch_tpu.resilience.elastic',
+           '--host-id', str(host_id), '--num-hosts', '3',
+           '--lease-dir', str(lease),
+           '--max-restarts', '6', '--backoff-base', '0.2',
+           '--hb-interval', '0.25', '--hb-deadline',
+           str(PART_HB_DEADLINE),
+           '--hb-grace', '300', '--settle', '0.8',
+           '--shrink-timeout', '8', '--grow-timeout', '10']
+    if join:
+        cmd += ['--join', '--join-timeout', '300']
+    return cmd + ['--',
+                  sys.executable, TRAINER, '--epochs', str(PART_EPOCHS),
+                  '--batch-size', str(PART_BATCH),
+                  '--num-examples', str(PART_EXAMPLES),
+                  '--checkpoint-dir', str(ckpt_dir),
+                  '--num-hosts', '{num_hosts}', '--host-id', '{host_id}',
+                  '--step-deadline', '300']
+
+
+def _ckpt_snapshot(ckpt_dir):
+    """Names + world stamp of a checkpoint dir — the 'no checkpoint
+    finalized after the fence' witness."""
+    names = sorted(os.listdir(ckpt_dir)) if os.path.isdir(ckpt_dir) else []
+    stamp = None
+    try:
+        with open(os.path.join(str(ckpt_dir), 'world.json')) as f:
+            stamp = f.read()
+    except OSError:
+        pass
+    return names, stamp
+
+
+def test_pod_partition_quorum_fences_minority_then_rejoins(tmp_path):
+    """The split-brain drill: a seeded ChaosTransport partition cuts a
+    3-host pod 2|1 mid-run. The majority {0, 2} must pass the quorum
+    gate, shrink to world 2 and keep training; the minority {1} must
+    LOSE quorum and fence itself with RC_FENCED=117, finalizing zero
+    checkpoints after the fence. When the partition heals, the fenced
+    host rejoins through the ordinary --join grow lane and the run ends
+    schedule-equivalent to an unpartitioned control — with the whole
+    story (partition_suspected -> quorum_lost/fenced -> shrink -> join
+    -> grow) pinned on the merged kfac-obs timeline."""
+    from kfac_pytorch_tpu.resilience.elastic import RC_FENCED
+
+    p = subprocess.run(
+        [sys.executable, TRAINER, '--epochs', str(PART_EPOCHS),
+         '--batch-size', str(PART_BATCH),
+         '--num-examples', str(PART_EXAMPLES),
+         '--checkpoint-dir', str(tmp_path / 'ckpt_control')],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=540)
+    assert p.returncode == 0, p.stdout[-3000:]
+    control = _done_line(p.stdout)
+
+    lease = tmp_path / 'lease'
+    trace_dir = tmp_path / 'trace'
+    part_file = tmp_path / 'partition.json'
+    ckpts = {h: str(tmp_path / f'ckpt_h{h}') for h in range(3)}
+    outs = {h: tmp_path / f'host{h}.out' for h in range(3)}
+    rejoin_out = tmp_path / 'rejoin1.out'
+    # pace steps so the partition always lands mid-training; the chaos
+    # env arms the deterministic network layer in every process (the
+    # partition matrix lives in the live file the test writes below)
+    pod_env = _env(KFAC_FAULT_SLOW_STEP='0:999',
+                   KFAC_FAULT_SLOW_SECS='1.5',
+                   KFAC_TRACE_DIR=str(trace_dir),
+                   KFAC_FAULT_NET_SEED='7',
+                   KFAC_FAULT_NET_PARTITION_FILE=str(part_file))
+
+    def start(cmd, out_path):
+        f = open(out_path, 'wb')
+        proc = subprocess.Popen(cmd, env=pod_env, cwd=REPO, stdout=f,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        proc._outfile = f
+        return proc
+
+    procs = {}
+    rejoin = None
+    try:
+        for h in range(3):
+            procs[h] = start(_part_cmd(h, lease, ckpts[h]), outs[h])
+
+        # epoch 0 banked everywhere: resumable state exists, run is live
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs.values()):
+                pytest.fail('a pod member exited before the partition; '
+                            'host0 tail: ' + outs[0].read_text()[-3000:])
+            if all(_has_checkpoint(ckpts[h]) for h in range(3)):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail('epoch-0 checkpoints never appeared; host0 tail: '
+                        + outs[0].read_text()[-3000:])
+
+        # CUT: {0, 2} | {1}, written atomically into the live partition
+        # file every ChaosTransport/protocol reader polls
+        now = time.time()
+        tmp = str(part_file) + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump({'windows': [{'start': now, 'end': now + 3600,
+                                    'groups': [[0, 2], [1]]}]}, f)
+        os.replace(tmp, str(part_file))
+
+        # the minority loses quorum and fences with the dedicated rc
+        rc1 = procs[1].wait(timeout=240)
+        assert rc1 == RC_FENCED, (rc1, outs[1].read_text()[-4000:])
+        fence_snapshot = _ckpt_snapshot(ckpts[1])
+
+        # the majority commits the shrink and keeps training
+        majority = [('host0', procs[0]), ('host2', procs[2])]
+        _wait_count(outs[0], 'elastic: shrinking world 3 -> 2', 1, 240,
+                    majority)
+        _wait_count(outs[0], 'RESHARDED from_world=3 to_world=2', 1, 240,
+                    majority)
+        # the shrunken generation banks an epoch (stamp -> 2) so the
+        # rejoin genuinely reshards UP afterwards
+        _wait_stamp(ckpts[0], 2, 240, majority)
+
+        # zero checkpoint commits on the fenced host since the fence
+        assert _ckpt_snapshot(ckpts[1]) == fence_snapshot
+
+        # HEAL: remove the partition file, then rejoin via the grow lane
+        os.remove(part_file)
+        rejoin = start(_part_cmd(1, lease, ckpts[1], join=True),
+                       rejoin_out)
+        watch = majority + [('rejoin1', rejoin)]
+        _wait_count(outs[0], 'elastic: growing world 2 -> 3', 1, 300,
+                    watch)
+        _wait_count(outs[0], 'RESHARDED from_world=2 to_world=3', 1, 300,
+                    watch)
+
+        rc0 = procs[0].wait(timeout=600)
+        rc2 = procs[2].wait(timeout=600)
+        rcr = rejoin.wait(timeout=600)
+    finally:
+        for proc in list(procs.values()) + ([rejoin] if rejoin else []):
+            if proc.poll() is None:
+                _killpg(proc)
+            f = getattr(proc, '_outfile', None)
+            if f is not None:
+                f.close()
+
+    out0, out1, out2 = (outs[h].read_text() for h in range(3))
+    assert rc0 == 0, out0[-4000:]
+    assert rc2 == 0, out2[-4000:]
+    assert rcr == 0, rejoin_out.read_text()[-4000:]
+
+    # the minority's story: suspicion -> quorum verdict -> fence
+    assert 'partition suspected' in out1, out1[-4000:]
+    assert 'quorum lost' in out1, out1[-4000:]
+    assert 'Fencing this host' in out1, out1[-4000:]
+    # the majority never fences, never loses quorum, never gives up
+    for text in (out0, out2):
+        assert 'quorum lost' not in text
+        assert 'Fencing this host' not in text
+        assert 'giving up' not in text
+    # detection was heartbeat-speed, never the (300s) watchdog
+    assert 'declared dead' in out0
+    assert 'step deadline exceeded' not in out0
+
+    # the healed host rejoined through the ordinary join lane
+    rejoin_text = rejoin_out.read_text()
+    assert 'join: host 1 announcing to pod' in rejoin_text
+    assert 'join: admitted into pod' in rejoin_text, rejoin_text[-2000:]
+
+    # schedule equivalence across partition + fence + rejoin
+    assert _done_line(out0) == control
+
+    # incident JSON: the partition grammar landed as structured events.
+    # The FENCED incarnation's report was rotated to .prev when the
+    # rejoin incarnation wrote its own — both survive.
+    report = json.loads(
+        (lease / 'incident-host1.json.prev').read_text())
+    kinds = [e['kind'] for e in report['events']]
+    assert 'partition_suspected' in kinds
+    assert 'quorum_lost' in kinds
+    assert 'fenced' in kinds
+    assert report['fenced'] is True
+    assert report['counters'].get('quorum_lost', 0) >= 1
+    q = next(e for e in report['events'] if e['kind'] == 'quorum_lost')
+    assert q['claimants'] == [1] and q['membership'] == [0, 1, 2]
+    # the rejoin incarnation's own (clean) report is the current one
+    rejoin_report = json.loads((lease / 'incident-host1.json').read_text())
+    assert rejoin_report['fenced'] is False
+    assert any(e['kind'] == 'join_admitted'
+               for e in rejoin_report['events'])
+    report0 = json.loads((lease / 'incident-host0.json').read_text())
+    assert report0['fenced'] is False
+    assert report0['shrinks'] and report0['shrinks'][0]['from'] == 3
+
+    # lineage: the majority committed membership changes (shrink+grow),
+    # so its world stamp carries a monotonic lineage >= 2; the fenced
+    # fork never advanced past the pre-partition epoch
+    with open(os.path.join(ckpts[0], 'world.json')) as f:
+        stamp0 = json.load(f)
+    assert stamp0.get('lineage', 0) >= 2, stamp0
+
+    # kfac-obs: one merged timeline pins the causal story
+    import glob
+
+    from kfac_pytorch_tpu.obs import aggregate
+    paths = [str(o) for o in outs.values()] + [str(rejoin_out)]
+    paths += sorted(glob.glob(str(lease / 'incident-host*.json')))
+    traces = sorted(glob.glob(str(trace_dir / '*.jsonl')))
+    assert traces, 'trainers wrote no trace JSONL under KFAC_TRACE_DIR'
+    timeline = aggregate.build_timeline(paths + traces)
+    events = timeline['events']
+    kinds = [e['kind'] for e in events]
+
+    def first(kind, after=0, **match):
+        for i in range(after, len(events)):
+            e = events[i]
+            if e['kind'] == kind and all(
+                    e['detail'].get(k) == v for k, v in match.items()):
+                return i
+        raise AssertionError(
+            f'{kind} {match or ""} missing after index {after}; kinds: '
+            f'{sorted(set(kinds))}')
+
+    i_susp = first('partition_suspected')
+    i_qlost = first('quorum_lost', after=i_susp)
+    i_fence = first('fenced', after=i_susp)
+    i_shrink = first('shrink', after=i_susp)
+    i_join = first('join_announce',
+                   after=max(i_qlost, i_fence, i_shrink))
+    i_grow = first('grow', after=i_join)
+    walls = [events[i]['wall_aligned'] for i in
+             (i_susp, i_join, i_grow)]
+    assert all(w is not None for w in walls), walls
+    assert walls == sorted(walls), walls
+    # the chaos layer itself left solver inputs on the timeline sources
+    assert aggregate.solve_offsets(traces) is not None  # no crash
+
+    # CI artifact export: partition debris + aggregated timeline under
+    # partition/, alongside the churn drill's churn/ artifacts
+    art = os.environ.get('KFAC_DRILL_ARTIFACTS')
+    if art:
+        import shutil
+        part_art = os.path.join(art, 'partition')
+        os.makedirs(part_art, exist_ok=True)
+        for src in paths + traces:
+            shutil.copy(src, part_art)
+        with open(os.path.join(part_art, 'timeline.json'), 'w') as f:
+            json.dump({k: v for k, v in timeline.items()
+                       if not k.startswith('_')}, f, indent=2,
+                      default=str)
+        with open(os.path.join(part_art, 'pod_trace.json'), 'w') as f:
+            json.dump(aggregate.merged_chrome_trace(timeline), f)

@@ -1341,3 +1341,270 @@ def test_guard_final_save_runs_with_watchdog_paused(tmp_path, monkeypatch):
         guard.uninstall()
         wd.stop()
     assert (tmp_path / 'checkpoint-0.pkl').exists()
+
+
+# ---------------------------------------------------------------------------
+# quorum-gated shrink + lineage fencing (ISSUE 7: partition tolerance;
+# the real 3-host partition drill is in tests/test_pod_chaos.py, -m slow)
+# ---------------------------------------------------------------------------
+
+def _quorum_sup(tmp_path, host_id, num_hosts, lease='lease', **kw):
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    kw.setdefault('settle', 0.0)
+    kw.setdefault('shrink_timeout', 0.15)
+    kw.setdefault('poll_period', 0.01)
+    return PodSupervisor(['trainer'], host_id=host_id,
+                         num_hosts=num_hosts,
+                         lease_dir=str(tmp_path / lease), **kw)
+
+
+def _plant_claim(tmp_path, gen, host, lease='lease'):
+    d = tmp_path / lease / f'shrink-gen{gen}'
+    d.mkdir(parents=True, exist_ok=True)
+    resilience.atomic_write_json(str(d / f'survivor-{host}.json'),
+                                 {'host': host, 'addr': None})
+
+
+def test_shrink_quorum_minority_fences_instead_of_committing(tmp_path):
+    """The 2|1 partition seen from the MINORITY: both peers look dead,
+    the barrier closes with a single claimant — a strict minority of
+    the generation's membership. The shrink must NOT commit (no rival
+    generation, no lineage bump), and the events must carry the
+    partition grammar."""
+    import json
+    sup = _quorum_sup(tmp_path, 0, 3)
+    committed = sup._shrink({1: {}, 2: {}})
+    assert committed is False
+    assert sup.gen == 0 and sup.members == [0, 1, 2]
+    assert sup.shrinks == 0
+    assert sup._current_lineage() == 0  # a fenced side's lineage freezes
+    kinds = [e['kind'] for e in sup.report.events]
+    assert 'partition_suspected' in kinds
+    assert 'quorum_lost' in kinds
+    assert 'shrink' not in kinds
+    q = next(e for e in sup.report.events if e['kind'] == 'quorum_lost')
+    assert q['claimants'] == [0] and q['membership'] == [0, 1, 2]
+    # the dead barrier holds no claim of ours for a healed majority to
+    # misread as corroboration
+    assert not (tmp_path / 'lease' / 'shrink-gen1'
+                / 'survivor-0.json').exists()
+    assert sup.report.counters.get('quorum_lost') == 1
+
+
+def test_shrink_quorum_majority_commits_and_bumps_lineage(tmp_path):
+    """The same partition seen from the MAJORITY: two claimants out of
+    three members commit, the generation advances, and the lineage
+    epoch is persisted for commit fencing."""
+    import json
+    _plant_claim(tmp_path, 1, 2)
+    sup = _quorum_sup(tmp_path, 0, 3)
+    assert sup._shrink({1: {}}) is True
+    assert sup.members == [0, 2] and sup.gen == 1
+    assert sup._current_lineage() == 1
+    doc = json.loads((tmp_path / 'lease' / 'lineage.json').read_text())
+    assert doc['lineage'] == 1
+    # one host lost of three: not a partition-suspicion event
+    kinds = [e['kind'] for e in sup.report.events]
+    assert 'partition_suspected' not in kinds
+    assert 'shrink' in kinds
+    sup._hb.stop()
+
+
+def test_shrink_even_split_tiebreak_lowest_host_side_survives(tmp_path):
+    """The 2|2 even split: quorum is exactly half on both sides, and
+    the deterministic tiebreak — the side holding the LOWEST live host
+    of generation g's membership — must let exactly one side commit.
+    The partition matrix (ChaosTransport config injected directly, as
+    the drill's env would) keeps each side blind to the other's claims
+    even though they share the lease dir."""
+    import time
+    from kfac_pytorch_tpu.resilience.chaos_net import (
+        NetFaultConfig, parse_partition_spec)
+    cfg = NetFaultConfig(seed=0,
+                         windows=parse_partition_spec('0:100000=0,1|2,3'),
+                         t0=time.time())
+    # side A = {0, 1} (holds host 0), side B = {2, 3}
+    supA = _quorum_sup(tmp_path, 0, 4, net_chaos=cfg)
+    supB = _quorum_sup(tmp_path, 2, 4, net_chaos=cfg)
+    _plant_claim(tmp_path, 1, 1)   # A's partner already claimed
+    _plant_claim(tmp_path, 1, 3)   # B's partner already claimed
+    assert supA._shrink({2: {}, 3: {}}) is True
+    assert supA.members == [0, 1] and supA.gen == 1
+    assert supB._shrink({0: {}, 1: {}}) is False
+    assert supB.gen == 0 and supB.members == [0, 1, 2, 3]
+    kindsB = [e['kind'] for e in supB.report.events]
+    assert 'partition_suspected' in kindsB and 'quorum_lost' in kindsB
+    supA._hb.stop()
+
+
+def test_clean_exit_done_marker_exempts_from_quorum(tmp_path):
+    """Graceful completion is not partition evidence: the last live
+    host of a winding-down pod must commit its shrink (and finish), not
+    fence itself because the majority 'disappeared'."""
+    sup = _quorum_sup(tmp_path, 2, 3)
+    # hosts 0 and 1 finished and left their done markers
+    for h in (0, 1):
+        resilience.atomic_write_json(
+            str(tmp_path / 'lease' / f'done-{h}.json'),
+            {'host': h, 'gen': 0})
+    assert sup._shrink({0: {}, 1: {}}) is True
+    assert sup.members == [2] and sup.gen == 1
+    kinds = [e['kind'] for e in sup.report.events]
+    assert 'partition_suspected' not in kinds
+    assert 'quorum_lost' not in kinds
+
+
+def test_pod_supervisor_clean_exit_writes_done_marker(tmp_path):
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    sup = PodSupervisor([sys.executable, '-c', 'pass'], host_id=0,
+                        num_hosts=1, lease_dir=str(tmp_path / 'lease'),
+                        max_restarts=1, backoff_base=0.01,
+                        poll_period=0.02)
+    assert sup.run() == 0
+    assert (tmp_path / 'lease' / 'done-0.json').exists()
+
+
+def test_fence_on_uncorroborated_shrink_exits_117(tmp_path):
+    """The original fence path (peers shrinking around us, nobody looks
+    dead from here) now exits the dedicated RC_FENCED=117 — distinct
+    from peer_dead (115) so automation can react differently: heal +
+    --join, never blind relaunch."""
+    import threading
+    from kfac_pytorch_tpu.resilience.elastic import RC_FENCED, PodSupervisor
+    lease = tmp_path / 'lease'
+    sup = PodSupervisor([sys.executable, '-c',
+                         'import time; time.sleep(600)'],
+                        host_id=0, num_hosts=2, lease_dir=str(lease),
+                        max_restarts=1, backoff_base=0.01,
+                        hb_interval=0.05, hb_deadline=0.3,
+                        settle=0.05, shrink_timeout=0.5,
+                        poll_period=0.02, child_kill_grace=1.0)
+
+    def peer_claims():
+        # written AFTER startup (the gen-0 scrub would eat it), while
+        # our trainer is healthy: an uncorroborated next-gen claim set
+        import time
+        time.sleep(0.5)
+        _plant_claim(tmp_path, 1, 1)
+
+    t = threading.Thread(target=peer_claims)
+    t.start()
+    try:
+        rc = sup.run()
+    finally:
+        t.join()
+    assert rc == RC_FENCED == 117
+    import json
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    assert report['fenced'] is True
+    assert any(e['kind'] == 'fenced' for e in report['events'])
+
+
+def test_world_stamp_lineage_is_monotonic(tmp_path):
+    """Commit fencing at the write site: the stamp carries the lineage
+    epoch and refuses to move backward — a fenced fork's straggler
+    cannot clobber the surviving lineage's stamp."""
+    checkpoint.write_world_stamp(tmp_path, 3, gen=1, lineage=1)
+    info = checkpoint.read_world_stamp_info(tmp_path)
+    assert info['lineage'] == 1 and info['num_devices'] == 3
+    checkpoint.write_world_stamp(tmp_path, 2, gen=2, lineage=2)  # forward
+    assert checkpoint.read_world_stamp_info(tmp_path)['lineage'] == 2
+    with pytest.raises(checkpoint.StaleLineageError):
+        checkpoint.write_world_stamp(tmp_path, 3, gen=1, lineage=1)
+    # the refused write left the stamp untouched
+    assert checkpoint.read_world_stamp_info(tmp_path)['lineage'] == 2
+    # lineage-less writers (pre-elastic runs, KFAC_LINEAGE unset) are
+    # exempt: nothing to compare, reference behavior preserved
+    checkpoint.write_world_stamp(tmp_path, 4)
+    assert 'lineage' not in checkpoint.read_world_stamp_info(tmp_path)
+
+
+def test_elastic_resume_refuses_abandoned_fork(tmp_path, monkeypatch):
+    """Commit fencing at the resume site: a process at lineage L must
+    refuse checkpoints stamped with a NEWER lineage — it belongs to a
+    fork the pod abandoned, and 'resume then retrain then re-save'
+    would clobber the majority's state."""
+    monkeypatch.delenv('KFAC_LINEAGE', raising=False)
+    checkpoint.write_world_stamp(tmp_path, 2, lineage=3)
+    with pytest.raises(checkpoint.StaleLineageError):
+        resilience.elastic_resume(tmp_path, 5, None, None,
+                                  make_precond=None, lineage=1)
+    # same check picks the lineage up from the supervisor's env
+    monkeypatch.setenv('KFAC_LINEAGE', '2')
+    with pytest.raises(checkpoint.StaleLineageError):
+        resilience.elastic_resume(tmp_path, 5, None, None,
+                                  make_precond=None)
+    # at (or past) the stamp's lineage the path is open again — empty
+    # dir, so it just reports nothing restorable
+    restored, epoch, old = resilience.elastic_resume(
+        tmp_path, 5, None, None, make_precond=None, lineage=3)
+    assert restored is None and epoch is None
+
+
+def test_read_claims_skips_torn_json_and_filters_partition(tmp_path):
+    """Protocol-file readers tolerate torn writes (skip-and-retry) and
+    honor the partition matrix — a cut host's claims are invisible."""
+    import time
+    from kfac_pytorch_tpu.resilience.chaos_net import (
+        NetFaultConfig, parse_partition_spec)
+    cfg = NetFaultConfig(seed=0,
+                         windows=parse_partition_spec('0:100000=0|1'),
+                         t0=time.time())
+    sup = _quorum_sup(tmp_path, 0, 3, net_chaos=cfg)
+    d = tmp_path / 'lease' / 'shrink-gen1'
+    d.mkdir(parents=True)
+    resilience.atomic_write_json(str(d / 'survivor-2.json'),
+                                 {'host': 2, 'addr': None})
+    resilience.atomic_write_json(str(d / 'survivor-1.json'),
+                                 {'host': 1, 'addr': None})
+    (d / 'survivor-9.json').write_text('{"host": 9, "ad')  # torn
+    claims = sup._read_claims(str(d))
+    assert 2 in claims          # reachable, intact
+    assert 1 not in claims      # partitioned away
+    assert 9 not in claims      # torn: skipped, not crashed
+
+
+def test_child_env_exports_lineage_and_idmap(tmp_path):
+    from kfac_pytorch_tpu.resilience import chaos_net
+    from kfac_pytorch_tpu.resilience.chaos_net import NetFaultConfig
+    from kfac_pytorch_tpu.resilience.elastic import ENV_LINEAGE
+    sup = _quorum_sup(tmp_path, 0, 3, net_chaos=NetFaultConfig(seed=1))
+    sup.members = [0, 2]
+    sup.gen = 1
+    sup._lineage_mem = 1
+    env = sup._child_env()
+    assert env[ENV_LINEAGE] == '1'
+    # rank->pod-host map: rank 1 is pod host 2 after the shrink
+    assert env[chaos_net.ENV_NET_IDMAP] == '0=0,1=2'
+
+
+def test_lineage_persists_across_supervisor_incarnations(tmp_path):
+    """A whole-pod restart reusing the lease dir adopts the previous
+    incarnation's lineage (the file survives the gen-0 scrub), so its
+    trainers do not read their own checkpoints as 'a newer lineage'."""
+    sup = _quorum_sup(tmp_path, 0, 3)
+    sup.gen = 1
+    sup._bump_lineage()
+    sup.gen = 2
+    sup._bump_lineage()
+    assert sup._current_lineage() == 2
+    fresh = _quorum_sup(tmp_path, 0, 3)
+    fresh._clear_stale_protocol_files()
+    assert (tmp_path / 'lease' / 'lineage.json').exists()
+    assert fresh._current_lineage() == 2
+
+
+def test_two_host_pod_tiebreak_documented_tradeoff(tmp_path):
+    """The even-split tiebreak's availability contract, pinned: a
+    2-host pod survives the HIGHER host's death (host 0 holds the
+    tiebreak and shrinks on) but fences on the lowest host's death —
+    from the survivor's side that silence is indistinguishable from a
+    partition, and fencing is the only answer that can never fork the
+    run."""
+    sup0 = _quorum_sup(tmp_path, 0, 2, lease='a')
+    assert sup0._shrink({1: {}}) is True
+    assert sup0.members == [0] and sup0.gen == 1
+    sup1 = _quorum_sup(tmp_path, 1, 2, lease='b')
+    assert sup1._shrink({0: {}}) is False
+    assert sup1.gen == 0
+    assert any(e['kind'] == 'quorum_lost' for e in sup1.report.events)
