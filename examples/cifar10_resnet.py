@@ -72,6 +72,21 @@ def parse_args():
                         'ALL factors every --kfac-update-freq steps — '
                         'same staleness contract, no periodic eigh spike '
                         '(see README "Staggered refresh")')
+    p.add_argument('--kfac-comm-precision',
+                   default=os.environ.get('KFAC_COMM_PRECISION', 'fp32'),
+                   choices=['fp32', 'bf16', 'int8'],
+                   help='wire dtype of the K-FAC factor collectives '
+                        '(default from $KFAC_COMM_PRECISION): bf16 '
+                        'halves, int8 quarters the gather payloads; '
+                        'lossy stats reduces carry an error-feedback '
+                        'residual; the gradient allreduce is never '
+                        'compressed (see README "Communication '
+                        'compression")')
+    p.add_argument('--kfac-comm-prefetch', action='store_true',
+                   help='comm_inverse variants only: publish each '
+                        "inverse update's gathered decomposition for "
+                        'the NEXT step so the gather overlaps the pred '
+                        'einsums (one step of decomposition staleness)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-type', '--fisher-type', default='Femp',
                    choices=['Femp', 'F1mc'],
@@ -178,6 +193,8 @@ def main():
             basis_update_freq=(args.kfac_basis_update_freq or None),
             warm_start_basis=args.kfac_warm_start,
             stagger=args.kfac_stagger,
+            comm_precision=args.kfac_comm_precision,
+            comm_prefetch=args.kfac_comm_prefetch,
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_parts=args.exclude_parts,
             num_devices=args.num_devices,
@@ -219,7 +236,10 @@ def main():
             kfac_update_freq=args.kfac_update_freq,
             exclude_parts=args.exclude_parts, num_devices=nd,
             axis_name='batch' if nd > 1 else None,
-            assignment=args.assignment)
+            assignment=args.assignment,
+            # the restore target must match the checkpoint's state
+            # structure (an EF residual is carried iff lossy)
+            comm_precision=args.kfac_comm_precision)
         pre.setup(precond.plan.metas)
         return pre
 

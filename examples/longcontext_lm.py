@@ -69,6 +69,21 @@ def parse_args():
                         '(KFAC_EIGH_IMPL=subspace|auto|jacobi), Cholesky '
                         'variants Newton-Schulz-iterate the previous '
                         'inverse')
+    p.add_argument('--kfac-comm-precision',
+                   default=os.environ.get('KFAC_COMM_PRECISION', 'fp32'),
+                   choices=['fp32', 'bf16', 'int8'],
+                   help='wire dtype of the K-FAC factor collectives '
+                        '(default from $KFAC_COMM_PRECISION): bf16 '
+                        'halves, int8 quarters the gather payloads; '
+                        'lossy stats reduces carry an error-feedback '
+                        'residual; the gradient allreduce is never '
+                        'compressed (see README "Communication '
+                        'compression")')
+    p.add_argument('--kfac-comm-prefetch', action='store_true',
+                   help='comm_inverse variants only: publish each '
+                        "inverse update's gathered decomposition for "
+                        'the NEXT step so the gather overlaps the pred '
+                        'einsums (one step of decomposition staleness)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp',
                    choices=list(kfac.KFAC_VARIANTS))
@@ -82,6 +97,15 @@ def parse_args():
     p.add_argument('--log-dir', default='./logs')
     p.add_argument('--tb-dir', default=None,
                    help='TensorBoard scalar summaries (rank 0)')
+    # observability (kfac_pytorch_tpu/obs/)
+    p.add_argument('--trace', default=None, metavar='DIR',
+                   help='write Chrome-trace spans to DIR/trace-host<i>.'
+                        'jsonl and epoch metric snapshots to DIR/'
+                        'metrics.jsonl (defaults to $KFAC_TRACE_DIR '
+                        'when set); merge with kfac-obs')
+    p.add_argument('--prom-file', default=None, metavar='PATH',
+                   help='export the metrics registry as a Prometheus '
+                        'textfile at PATH after every epoch (rank 0)')
     return p.parse_args()
 
 
@@ -166,6 +190,8 @@ def main():
             basis_update_freq=(args.kfac_basis_update_freq or None),
             warm_start_basis=args.kfac_warm_start,
             factor_decay=args.stat_decay, kl_clip=args.kl_clip,
+            comm_precision=args.kfac_comm_precision,
+            comm_prefetch=args.kfac_comm_prefetch,
             num_devices=ndev, axis_name=kfac_axis,
             exclude_vocabulary_size=vocab)
 
@@ -181,10 +207,17 @@ def main():
         return optax.softmax_cross_entropy_with_integer_labels(
             outputs, batch['label']).mean()
 
+    # observability: trace recorder + metrics registry (epoch-line
+    # suffixes render through the registry, byte-compatible with the
+    # old hand-plumbed health_suffix) — same bootstrap as cifar/imagenet
+    from kfac_pytorch_tpu import obs
+    tracer, reg = obs.setup_trainer(trace_dir=args.trace,
+                                    prom_file=args.prom_file)
+
     bspec = P(data_axis, seq_axis)
     step = training.build_train_step(
         model, tx, precond, ce, axis_name=kfac_axis, mesh=mesh,
-        batch_specs={'input': bspec, 'label': bspec})
+        batch_specs={'input': bspec, 'label': bspec}, tracer=tracer)
 
     def eval_loss_local(params, batch):
         out = model.apply({'params': params}, batch['input'], train=False)
@@ -207,7 +240,9 @@ def main():
     rng = np.random.RandomState(args.seed)
     from kfac_pytorch_tpu.utils.summary import maybe_writer
     tb = maybe_writer(args.tb_dir)
-    monitor = metrics.HealthMonitor(log, state=state)
+    if tb is not None:
+        reg.add_exporter(obs.metrics.TensorBoardExporter(tb))
+    monitor = metrics.HealthMonitor(log, state=state, registry=reg)
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
         loss_m = metrics.Metric('loss')
@@ -241,14 +276,20 @@ def main():
             val_m.update(float(eval_step(state.params, vb)))
         ppl = math.exp(min(loss_m.avg, 20))
         vppl = math.exp(min(val_m.avg, 20))
-        from kfac_pytorch_tpu.utils.runlog import health_suffix
+        # one registry call renders the health/resilience suffixes
+        # byte-identically to the old hand-plumbed health_suffix
         log.info('epoch %d: train_ppl %.2f val_ppl %.2f (%.1fs)%s', epoch,
                  ppl, vppl, time.perf_counter() - t0,
-                 health_suffix(monitor.epoch_flush()))
+                 reg.epoch_suffixes())
+        monitor.epoch_flush()
+        reg.export(step=epoch)
+        if tracer is not None:
+            tracer.flush()
         if tb is not None:
             tb.add_scalar('train/ppl', ppl, epoch)
             tb.add_scalar('val/ppl', vppl, epoch)
             tb.flush()
+    reg.close()
 
 
 if __name__ == '__main__':

@@ -66,6 +66,21 @@ def parse_args():
                         '(KFAC_EIGH_IMPL=subspace|auto|jacobi), Cholesky '
                         'variants Newton-Schulz-iterate the previous '
                         'inverse')
+    p.add_argument('--kfac-comm-precision',
+                   default=os.environ.get('KFAC_COMM_PRECISION', 'fp32'),
+                   choices=['fp32', 'bf16', 'int8'],
+                   help='wire dtype of the K-FAC factor collectives '
+                        '(default from $KFAC_COMM_PRECISION): bf16 '
+                        'halves, int8 quarters the gather payloads; '
+                        'lossy stats reduces carry an error-feedback '
+                        'residual; the gradient allreduce is never '
+                        'compressed (see README "Communication '
+                        'compression")')
+    p.add_argument('--kfac-comm-prefetch', action='store_true',
+                   help='comm_inverse variants only: publish each '
+                        "inverse update's gathered decomposition for "
+                        'the NEXT step so the gather overlaps the pred '
+                        'einsums (one step of decomposition staleness)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp',
                    choices=list(kfac.KFAC_VARIANTS))
@@ -205,6 +220,8 @@ def main():
             kfac_update_freq=args.kfac_update_freq,
             basis_update_freq=(args.kfac_basis_update_freq or None),
             warm_start_basis=args.kfac_warm_start,
+            comm_precision=args.kfac_comm_precision,
+            comm_prefetch=args.kfac_comm_prefetch,
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_vocabulary_size=n_trg_vocab,  # tied pre-softmax (:297)
             exclude_parts=args.exclude_parts,

@@ -132,15 +132,28 @@ def stack_stats(plan, a_list, g_list):
 
 
 def update_factors(plan, factors_local, stats_stacked, factor_decay,
-                   stats_reduce, axis_name):
+                   stats_reduce, axis_name, comm_precision='fp32',
+                   comm_err=None):
     """Running-average update of the local factor shard.
 
     ``stats_reduce='pmean'``: MPD semantics — factors are the global-batch
     average (reference allreduce, inv.py:94-103).
     ``stats_reduce='local'``: DP semantics — the owner's local-batch stats
     only, no factor communication at all (reference: inv_dp.py:60-95).
+
+    ``comm_precision``: wire dtype of the stats reduce
+    (collectives.WIRE_DTYPES). The reduce is a REDUCE-SCATTER
+    (:func:`collectives.pmean_scatter_ef` — each device consumes only
+    its own device-major rows, so nothing is gathered back); lossy modes
+    fold the quantization error into ``comm_err`` (the per-device
+    error-feedback residual, keyed like the stats stack) — the residual
+    re-enters the next reduce, so every device's time-averaged
+    contribution to the factor EMAs stays unbiased. Returns
+    ``(new_factors, new_comm_err)``; ``comm_err`` passes through
+    untouched on the fp32 / local / world=1 paths.
     """
     new = {}
+    new_err = None if comm_err is None else dict(comm_err)
     for bdim in plan.bucket_dims:
         key = _key(bdim)
         b = plan.buckets[bdim]
@@ -150,13 +163,18 @@ def update_factors(plan, factors_local, stats_stacked, factor_decay,
             # compute, so xprof attribution matches time_breakdown.py's
             # exclude-parts subtraction
             with jax.named_scope('kfac.CommunicateFactor'):
-                stats = coll.pmean(stats, axis_name)
-        idx = coll.axis_index(axis_name)
-        local = lax.dynamic_slice_in_dim(stats, idx * b.per_dev, b.per_dev,
-                                         axis=0)
+                local, err = coll.pmean_scatter_ef(
+                    stats, axis_name, comm_precision,
+                    None if comm_err is None else comm_err[key])
+            if new_err is not None and err is not None:
+                new_err[key] = err
+        else:
+            idx = coll.axis_index(axis_name)
+            local = lax.dynamic_slice_in_dim(stats, idx * b.per_dev,
+                                             b.per_dev, axis=0)
         new[key] = ops.update_running_avg(local, factors_local[key],
                                           factor_decay)
-    return new
+    return new, new_err
 
 
 # ---------------------------------------------------------------------------
@@ -297,7 +315,8 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
 
 
 def refresh_decomposition(plan, factors_local, decomp_prev, eps, axis_name,
-                          comm_mode, communicate=True):
+                          comm_mode, communicate=True,
+                          comm_precision='fp32'):
     """Cheap eigen refresh: new eigenvalues in the RETAINED eigenbasis.
 
     E-KFAC-style amortization (George et al. 2018 re-estimate scalings in
@@ -323,7 +342,8 @@ def refresh_decomposition(plan, factors_local, decomp_prev, eps, axis_name,
         evals[key] = ops.clamp_eigvals(d, eps)
     if comm_mode == 'inverse':
         if communicate:
-            evals = {k: coll.all_gather_rows(v, axis_name)
+            evals = {k: coll.all_gather_rows_compressed(v, axis_name,
+                                                        comm_precision)
                      for k, v in evals.items()}
         else:
             evals = gather_decomposition(plan, evals, axis_name,
@@ -386,7 +406,8 @@ def compute_cohort_decomposition(plan, cohorts, factors_local, cohort_idx,
 
 def merge_cohort_decomposition(plan, cohorts, decomp_stored, cohort_new,
                                cohort_idx, axis_name, comm_mode, method,
-                               communicate=True, guard=True):
+                               communicate=True, guard=True,
+                               comm_precision='fp32'):
     """Scatter freshly decomposed cohort rows into the stored
     decomposition; every other row keeps its stored bits exactly.
 
@@ -413,7 +434,8 @@ def merge_cohort_decomposition(plan, cohorts, decomp_stored, cohort_new,
                             cohort_idx, axis=0)
             valid = jnp.take(jnp.asarray(cohorts.global_valid[bdim]),
                              cohort_idx, axis=0)
-            gather = lambda x: coll.all_gather_rows(x, axis_name)  # noqa: E731
+            gather = lambda x: coll.all_gather_rows_compressed(  # noqa: E731
+                x, axis_name, comm_precision)
         elif comm_mode == 'inverse':
             F, PR = cohorts.global_rows[bdim].shape
             P = plan.num_devices
@@ -487,7 +509,7 @@ def _layer_rows_padded(meta, acts, gs, batch_averaged, pg):
 
 def update_ekfac_scales(plan, decomp, acts, gs, batch_averaged,
                         scales_prev, factor_decay, stats_reduce,
-                        axis_name):
+                        axis_name, comm_precision='fp32'):
     """E-KFAC second-moment update in the current (replicated) eigenbasis
     — beyond the reference (George et al. 2018, 'ekfac' variant).
 
@@ -523,8 +545,11 @@ def update_ekfac_scales(plan, decomp, acts, gs, batch_averaged,
             member_scales.append(ops.ekfac_scales(arows, grows, qa, qg, n))
         s_new = jnp.stack(member_scales)
         if stats_reduce == 'pmean':
+            # lossy wire WITHOUT error feedback: the moments are EMAs of
+            # squared projections (no sign structure for EF to protect)
+            # and carrying a second residual tree is not worth the state
             with jax.named_scope('kfac.CommunicateFactor.scales'):
-                s_new = coll.pmean(s_new, axis_name)
+                s_new = coll.pmean_wire(s_new, axis_name, comm_precision)
         new[f'g{gi}'] = ops.update_running_avg(
             s_new, scales_prev[f'g{gi}'], factor_decay)
     return new
@@ -715,17 +740,27 @@ def guard_decomposition(decomp_new, decomp_prev, method):
     return out
 
 
-def gather_decomposition(plan, decomp_local, axis_name, communicate=True):
+def gather_decomposition(plan, decomp_local, axis_name, communicate=True,
+                         comm_precision='fp32'):
     """All-gather decomposition rows to every device (comm_inverse mode).
 
     ≙ per-owner broadcast of QA/dA/QG/dG or inverse factors (reference:
     eigen.py:122-134, inv.py:132-142). With ``communicate=False`` (the
     CommunicateInverse ablation) rows are placed at the owner's offset with
     zeros elsewhere — shapes stay global, zero comm.
+
+    ``comm_precision``: wire dtype of the gather — bf16 halves the
+    InverseComm payload, int8 quarters it with a per-row absmax scale
+    (collectives.all_gather_rows_compressed). The loss is each owner's
+    LOCAL quantization only (one contributor per row), and the pred path
+    damps the decomposition anyway — see README "Communication
+    compression" for when int8 is safe.
     """
     if communicate:
-        return jax.tree.map(lambda x: coll.all_gather_rows(x, axis_name),
-                            decomp_local)
+        return jax.tree.map(
+            lambda x: coll.all_gather_rows_compressed(x, axis_name,
+                                                      comm_precision),
+            decomp_local)
 
     def place(x):
         per_dev = x.shape[0]
@@ -795,7 +830,8 @@ def compute_pred_replicated(plan, decomp, grad_mats, damping, method,
 
 
 def compute_pred_local(plan, decomp_local, grad_mats, damping, method,
-                       axis_name, communicate=True, scales=None):
+                       axis_name, communicate=True, scales=None,
+                       comm_precision='fp32'):
     """Owner-computes preconditioning + all-gather of the results
     (comm_pred mode — the DP-KFAC flagship path: only final preconditioned
     gradients travel, reference inv_dp.py:126-138 + inv.py:164-175).
@@ -821,7 +857,8 @@ def compute_pred_local(plan, decomp_local, grad_mats, damping, method,
             invg = jnp.take(decomp_local['invs'][_key(pg.dg)], rg, axis=0)
             pred_loc = _pred_inv(invg, inva, g_loc, damping)
         if communicate:
-            gathered = coll.all_gather_rows(pred_loc, axis_name)
+            gathered = coll.all_gather_rows_compressed(pred_loc, axis_name,
+                                                       comm_precision)
         else:
             gathered = gather_decomposition(
                 plan, pred_loc, axis_name, communicate=False)

@@ -36,6 +36,61 @@ from kfac_pytorch_tpu.obs.trace import PHASE_TAXONOMY as _TIMER_LABELS
 #: for (perfmodel targets TPU v5e / "v5 lite").
 _MODEL_CHIP_KEYS = ('v5e', 'v5 lite', 'v5lite')
 
+#: comm_precision -> per-phase multiplier on the COMM phase predictions:
+#: the wire-dtype payload ratios of parallel/collectives.py, restated
+#: here because this module must stay importable without jax (the
+#: canonical constants live in collectives.WIRE_COMPRESSION /
+#: reduce_wire_dtype; cross-module agreement is pinned by
+#: tests/test_comm_precision.py). CommunicateFactor is the stats REDUCE
+#: — it floors at bf16 under 'int8' (integer all-reduce overflow);
+#: CommunicateInverse and PredComm (the comm_pred variants' gather of
+#: preconditioned gradients, ledger taxonomy of scripts/comm_count.py)
+#: are gathers and take the full wire factor. NOTE the 'Precondition'
+#: phase is deliberately NOT scaled: in the host timer taxonomy it is
+#: the joint compute+gather apply, and the single-chip perfmodel
+#: predicts no comm share for it — scaling the whole phase by a wire
+#: factor would shrink its COMPUTE prediction too. A future multi-chip
+#: model should predict the gather as a separate PredComm phase, which
+#: IS scaled here.
+COMM_WIRE_FACTORS = {
+    'fp32': {'CommunicateFactor': 1.0, 'CommunicateInverse': 1.0,
+             'PredComm': 1.0},
+    'bf16': {'CommunicateFactor': 0.5, 'CommunicateInverse': 0.5,
+             'PredComm': 0.5},
+    'int8': {'CommunicateFactor': 0.5, 'CommunicateInverse': 0.25,
+             'PredComm': 0.25},
+}
+
+#: the comm phases the compression factor applies to (compute phases
+#: and the gradient allreduce folded into Model are untouched by
+#: comm_precision; 'Precondition' is excluded — see the note above).
+_COMM_PHASES = ('CommunicateFactor', 'CommunicateInverse', 'PredComm')
+
+
+def scale_comm_scenarios(predicted_block, comm_precision):
+    """A drift scenario per wire dtype: return a deep-copied
+    ``perfmodel.predict_block()``-shaped dict whose per-scenario
+    CommunicateFactor/CommunicateInverse/PredComm phase predictions are
+    scaled by the :data:`COMM_WIRE_FACTORS` of ``comm_precision`` — so the
+    measured-vs-predicted gate covers compressed runs with an honest
+    band instead of flagging every compressed run as drift. fp32 (or an
+    unknown dtype) returns the block unchanged; blocks with no comm
+    phases (the single-chip perfmodel) pass through untouched."""
+    import copy
+    factors = COMM_WIRE_FACTORS.get(comm_precision)
+    if not factors or comm_precision == 'fp32' or not predicted_block:
+        return predicted_block
+    block = copy.deepcopy(predicted_block)
+    for scen in (block.get('scenarios') or {}).values():
+        if not isinstance(scen, dict):
+            continue
+        phases = scen.get('phases_s') or {}
+        for name in _COMM_PHASES:
+            if phases.get(name) is not None:
+                phases[name] = float(phases[name]) * factors[name]
+    block['comm_precision'] = comm_precision
+    return block
+
 
 def _timer_label_to_taxonomy(label):
     """'decomp+gather' -> 'ComputeInverse+CommunicateInverse' etc."""
@@ -102,7 +157,7 @@ def _predicted_phase(phases_s, name, variant):
 
 def drift_block(measured_s, predicted_block, *, platform=None,
                 variant='inverse_dp', anchor='central', tolerance=1.0,
-                source=None):
+                source=None, comm_precision='fp32'):
     """Assemble the ``drift`` block for a bench emission.
 
     Args:
@@ -117,11 +172,18 @@ def drift_block(measured_s, predicted_block, *, platform=None,
         phase counts as drifted (the gate's knob; 1.0 = the model's own
         falsification contract).
       source: free-form provenance string recorded in the block.
+      comm_precision: wire dtype of the measured run's factor
+        collectives — the comm-phase predictions are scaled by the
+        :data:`COMM_WIRE_FACTORS` first
+        (:func:`scale_comm_scenarios`), so a compressed run is judged
+        against its own honest band.
 
     Returns a dict; never raises on malformed inputs (a drift block
     must never take the bench down — errors are reported in-band).
     """
     try:
+        predicted_block = scale_comm_scenarios(predicted_block,
+                                               comm_precision)
         scenarios = (predicted_block or {}).get('scenarios') or {}
         per_scen = {name: scen.get('phases_s', {})
                     for name, scen in scenarios.items()
@@ -170,6 +232,7 @@ def drift_block(measured_s, predicted_block, *, platform=None,
             'platform': platform,
             'variant': variant,
             'comparable': comparable,
+            'comm_precision': comm_precision,
             'anchor_scenario': anchor,
             'tolerance': tolerance,
             'phases': phases,

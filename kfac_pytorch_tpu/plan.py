@@ -106,6 +106,56 @@ class FactorPlan:
     def num_layers(self):
         return len(self.metas)
 
+    def comm_volume(self, *, stats_reduce, method, comm_precision='fp32'):
+        """Analytic per-phase collective payload bytes of ONE full
+        factor+inverse K-FAC step under this layout — the model the
+        HLO-level ledger (scripts/comm_count.py) measures, stated in
+        closed form so ``scripts/comm_models.py`` and the drift gate can
+        reason about wire-dtype compression without compiling anything.
+
+        Returns ``{'FactorComm', 'InverseComm', 'PredComm'}`` -> bytes:
+
+        - FactorComm: the stats reduce-scatter result payload (MPD
+          variants only — each device receives its own row block in the
+          reduce wire dtype; int8 floors at bf16,
+          collectives.reduce_wire_dtype, and backends without native
+          bf16 reduction promote the wire to f32 — the model states the
+          intended wire);
+        - InverseComm: the decomposition gather (comm_inverse mode —
+          eigenbasis + eigenvalues, or inverse factors, in the gather
+          wire dtype; int8 adds the [rows] fp32 scale side channel);
+        - PredComm: the preconditioned-gradient gather (comm_pred mode).
+
+        Cadence is the caller's: FactorComm recurs every
+        ``fac_update_freq`` steps, InverseComm every
+        ``kfac_update_freq`` (or 1/F of it per step under stagger).
+        """
+        from kfac_pytorch_tpu.parallel import collectives as coll
+        coll.check_wire_dtype(comm_precision)
+        # one source of truth: payload widths from the collectives
+        # layer's own constants (fp32 is 4 bytes; the reduce wire goes
+        # through reduce_wire_dtype, which floors int8 at bf16)
+        wire = int(4 * coll.WIRE_COMPRESSION[comm_precision])
+        reduce_wire = int(4 * coll.WIRE_COMPRESSION[
+            coll.reduce_wire_dtype(comm_precision)])
+        scale_b = 4 if comm_precision == 'int8' else 0
+        factor = inverse = pred = 0
+        if stats_reduce == 'pmean':
+            factor = sum(b.per_dev * b.dim * b.dim * reduce_wire
+                         for b in self.buckets.values())
+        if self.comm_mode == 'inverse':
+            for b in self.buckets.values():
+                inverse += b.n_rows * b.dim * b.dim * wire
+                inverse += b.n_rows * scale_b
+                if method == 'eigh':
+                    inverse += b.n_rows * b.dim * wire + b.n_rows * scale_b
+        else:
+            for pg in self.pred_groups:
+                rows = self.num_devices * pg.k_per_dev
+                pred += rows * (pg.dg * pg.da * wire + scale_b)
+        return {'FactorComm': factor, 'InverseComm': inverse,
+                'PredComm': pred}
+
 
 def _slot_cost(dim):
     # eigh/cholesky cost model ~ D^3 (reference fits a linear+cubic model,

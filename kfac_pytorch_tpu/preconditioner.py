@@ -44,10 +44,21 @@ class KFACState(flax.struct.PyTreeNode):
     ``KFAC.state_pspecs``). The reference equivalents are the per-module
     dicts m_A/m_G/m_inv_A/m_inv_G/m_QA/m_dA/...
     (kfac_preconditioner_base.py:107-110).
+
+    ``comm_err`` is the error-feedback residual of the lossy factor-stats
+    reduce (``comm_precision`` in {'bf16','int8'} on an MPD variant):
+    per device, the quantization error of its LAST compressed stats
+    contribution, keyed like the stats stack and re-entered into the
+    next reduce (collectives.pmean_scatter_ef). None when no lossy reduce
+    exists (fp32, DP variants) — defaulted so pre-compression
+    constructions and checkpoints keep working unchanged. Like the
+    E-KFAC scales it is transport-transient: ``reshard_kfac_state``
+    zero-fills it on an elastic world change and it re-accumulates.
     """
     step: jnp.ndarray
     factors: Dict[str, jnp.ndarray]
     decomp: Dict[str, Dict[str, jnp.ndarray]]
+    comm_err: Optional[Dict[str, jnp.ndarray]] = None
 
 
 @flax.struct.dataclass
@@ -186,6 +197,32 @@ class KFAC:
         ekfac variants (those re-use the full-refresh structure).
         The first decomposition of a run is always a full one (the
         trainer's cold-start gate); staggering begins after it.
+      comm_precision: wire dtype of the factor collectives (beyond
+        reference — EF-SGD lineage, Seide et al. 2014 / Karimireddy et
+        al. 2019): 'fp32' (default, bit-identical to the uncompressed
+        path), 'bf16' (2x byte reduction on every factor collective), or
+        'int8' (4x on the gather collectives via per-row absmax scales;
+        the stats REDUCE floors at bf16 — an XLA all-reduce cannot
+        integer-accumulate without overflow). Lossy modes compensate the
+        stats reduce with an error-feedback residual carried in
+        ``KFACState.comm_err`` (folds into the factor EMAs — every
+        device's time-averaged contribution stays unbiased); the gathers
+        quantize per owner (one contributor per row — no accumulation
+        error). The gradient allreduce is NEVER compressed: the SGD
+        floor is untouched. ``axis_name=None`` stays a zero-comm,
+        zero-compression identity path.
+      comm_prefetch: comm_mode='inverse' only (beyond reference) —
+        extend PR 4's double-buffer to the FULL refresh: on an
+        inverse-update step the freshly gathered decomposition is
+        published for the NEXT step while THIS step preconditions with
+        the previous table, so the CommunicateInverse gather has no
+        same-step consumer and XLA can overlap it with the pred einsums
+        (one step of decomposition staleness, well inside the
+        ``kfac_update_freq`` contract — the same trade ``stagger``
+        already makes per cohort). The trainer keeps the first
+        decomposition of a run un-prefetched (a cold state would
+        precondition with zeros). Redundant (but harmless) with
+        ``stagger``, which is always double-buffered.
       health: the numerical-health guard (beyond reference, health.py).
         True (default) enables the in-engine screens with the default
         ladder: factor-EMA rows and decomposition rows that come back
@@ -207,7 +244,7 @@ class KFAC:
                  distribute_layer_factors=None, bucket_fn=None, eps=1e-10,
                  basis_update_freq=None, warm_start_basis=False,
                  warm_sweeps=None, cold_restart_every=50, stagger=False,
-                 health=True):
+                 health=True, comm_precision='fp32', comm_prefetch=False):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -284,6 +321,22 @@ class KFAC:
                     'or warm_start_basis (pick one; see README '
                     '"Staggered refresh")')
         self._cohorts = None
+        from kfac_pytorch_tpu.parallel import collectives as _coll
+        self.comm_precision = _coll.check_wire_dtype(comm_precision)
+        self.comm_prefetch = bool(comm_prefetch)
+        if self.comm_prefetch:
+            if self.comm_mode != 'inverse':
+                raise ValueError(
+                    "comm_prefetch applies to comm_mode='inverse' (the "
+                    'decomposition gathers); the comm_pred variants '
+                    'gather preconditioned gradients, which ARE the '
+                    "step's consumer and cannot be deferred")
+            if self.ekfac:
+                raise ValueError(
+                    'comm_prefetch is not supported for the ekfac '
+                    'variants: the scale moments must be estimated in '
+                    'the same basis the pred consumes, which prefetch '
+                    'splits across steps')
         self.health = health_lib.resolve(health)
         # deterministic fault injection (chaos tests): the env snapshot
         # happens here, at construction, so the traced step is static
@@ -380,19 +433,43 @@ class KFAC:
                     for d in plan.bucket_dims},
             }
         return KFACState(step=jnp.zeros((), jnp.int32), factors=factors,
-                         decomp=decomp)
+                         decomp=decomp, comm_err=self._zero_comm_err())
+
+    @property
+    def _tracks_comm_err(self):
+        """Does this config carry an error-feedback residual? Only the
+        lossy-wire MPD stats reduce compensates (the gathers have one
+        contributor per row — nothing accumulates to feed back)."""
+        return (self.comm_precision != 'fp32'
+                and self.stats_reduce == 'pmean')
+
+    def _zero_comm_err(self):
+        """Fresh EF residual: zeros shaped like the stats stack PER
+        DEVICE — globally ``[num_devices * n_rows, D, D]`` sharded over
+        the kfac axis, so each device's shard is its own residual for
+        the full stacked stats it contributes to the reduce."""
+        if not self._tracks_comm_err:
+            return None
+        return {str(d): jnp.zeros(
+                    (self.plan.num_devices * self.plan.buckets[d].n_rows,
+                     d, d), jnp.float32)
+                for d in self.plan.bucket_dims}
 
     def state_pspecs(self, axis_name=None):
         """PartitionSpecs matching the state layout: factor rows sharded
         over the kfac axis; decompositions sharded in comm_pred mode,
-        replicated (post-gather) in comm_inverse mode."""
+        replicated (post-gather) in comm_inverse mode; the EF residual
+        (per-device error state) sharded like the factors."""
         axis_name = axis_name or self.axis_name
         sharded = P(axis_name)
         replicated = P()
         factors = {k: sharded for k in (str(d) for d in self.plan.bucket_dims)}
         dspec = sharded if self.comm_mode == 'pred' else replicated
         decomp = jax.tree.map(lambda _: dspec, self._decomp_structure())
-        return KFACState(step=replicated, factors=factors, decomp=decomp)
+        comm_err = ({k: sharded for k in factors}
+                    if self._tracks_comm_err else None)
+        return KFACState(step=replicated, factors=factors, decomp=decomp,
+                         comm_err=comm_err)
 
     def _zero_scales(self, local=False):
         # replicated layout: one row per group member; comm_pred layout:
@@ -452,7 +529,7 @@ class KFAC:
              update_factors: bool = True, update_inverse: bool = True,
              update_basis: bool = True, warm_basis: bool = False,
              factors_only: bool = False, stagger_update: bool = False,
-             axis_name: str = '__default__'):
+             prefetch: bool = False, axis_name: str = '__default__'):
         """One K-FAC step: (state, grads, captured stats) ->
         (preconditioned grads, new state).
 
@@ -460,6 +537,14 @@ class KFAC:
         and ``update_inverse`` are STATIC — the trainer picks them from
         ``should_update_*`` (the steps-%-freq gating of
         kfac_preconditioner_base.py:198-213 moved to the host).
+
+        ``prefetch`` (STATIC; requires ``comm_prefetch=True``) applies
+        PR 4's double-buffer to a FULL inverse update: the freshly
+        gathered decomposition is published for the NEXT step while this
+        step preconditions with the previous stored table — the
+        CommunicateInverse gather has no same-step consumer. The trainer
+        sets it only once a prior decomposition exists (a cold state
+        would precondition with zeros).
 
         ``stagger_update`` (STATIC; requires ``stagger=True``) replaces
         the windowed full refresh: cohort ``state.step % kfac_update_freq``
@@ -487,6 +572,7 @@ class KFAC:
 
         factors = state.factors
         decomp = state.decomp
+        comm_err = state.comm_err
 
         if update_factors and not self.exclude_compute_factor:
             # named scopes mirror the reference's phase taxonomy
@@ -501,9 +587,19 @@ class KFAC:
                 reduce = 'local'
             with jax.named_scope('kfac.UpdateFactors'):
                 # the pmean inside carries its own CommunicateFactor scope
-                factors = engine.update_factors(
+                factors, comm_err = engine.update_factors(
                     plan, factors, stats, self.factor_decay, reduce,
-                    axis_name)
+                    axis_name, comm_precision=self.comm_precision,
+                    comm_err=comm_err)
+            if self.health is not None and comm_err is not None:
+                # a non-finite residual row resets to zero (the always-
+                # safe EF state: feedback is a correction, never load-
+                # bearing) instead of re-injecting NaN into every later
+                # stats reduce
+                with jax.named_scope('kfac.HealthGuard.comm_err'):
+                    comm_err = engine.where_finite_rows(
+                        comm_err,
+                        {k: jnp.zeros_like(v) for k, v in comm_err.items()})
             if self.health is not None:
                 # non-finite EMA rows keep the last good factor; a row
                 # whose STORED value is already corrupt (silent data
@@ -523,12 +619,13 @@ class KFAC:
             # before the first decomposition exists (an all-zero decomp
             # would zero the gradients)
             return grads, state.replace(step=state.step + 1,
-                                        factors=factors)
+                                        factors=factors, comm_err=comm_err)
 
         if self.exclude_compute_inverse:
             # ablation: no decomposition -> grads pass through
             # (kfac_preconditioner_base.py:206-226)
-            return grads, state.replace(step=state.step + 1, factors=factors)
+            return grads, state.replace(step=state.step + 1,
+                                        factors=factors, comm_err=comm_err)
 
         if stagger_update:
             update_inverse = False  # stagger replaces the windowed refresh
@@ -550,7 +647,8 @@ class KFAC:
                     decomp = engine.refresh_decomposition(
                         plan, factors, decomp_prev, self.eps, axis_name,
                         self.comm_mode,
-                        communicate=not self.exclude_communicate_inverse)
+                        communicate=not self.exclude_communicate_inverse,
+                        comm_precision=self.comm_precision)
                 if self.health is not None:
                     with jax.named_scope('kfac.HealthGuard.decomp'):
                         decomp = engine.guard_decomposition(
@@ -596,7 +694,8 @@ class KFAC:
                     with jax.named_scope('kfac.CommunicateInverse'):
                         new_decomp = engine.gather_decomposition(
                             plan, decomp_local, axis_name,
-                            communicate=not self.exclude_communicate_inverse)
+                            communicate=not self.exclude_communicate_inverse,
+                            comm_precision=self.comm_precision)
                     if self.ekfac:
                         # the EMA'd moments live in the OLD basis: carry
                         # them across the basis change by the squared-
@@ -634,7 +733,7 @@ class KFAC:
                         decomp['scales'] = engine.update_ekfac_scales(
                             plan, decomp, acts, gs, self.batch_averaged,
                             scales_prev, self.factor_decay, reduce,
-                            axis_name)
+                            axis_name, comm_precision=self.comm_precision)
                 if self.health is not None:
                     # non-finite moment rows keep the (rotated) previous
                     # moments; the pred path's zero-validity guard covers
@@ -648,6 +747,14 @@ class KFAC:
         # the state for the next step — the cohort eigh/gather has no
         # same-step consumer, so XLA can overlap it with the pred einsums
         pred_decomp = decomp
+        if prefetch and update_inverse:
+            # comm_prefetch: the same trade for a FULL inverse update —
+            # publish the freshly gathered table for the NEXT step,
+            # precondition THIS step with the stored one (one step of
+            # staleness; the gather overlaps the pred einsums)
+            assert self.comm_prefetch, \
+                'prefetch requires KFAC(comm_prefetch=True)'
+            pred_decomp = state.decomp
         if stagger_update:
             cohorts = self._cohorts
             assert cohorts is not None, \
@@ -667,7 +774,8 @@ class KFAC:
                     plan, cohorts, decomp, cohort_new, cohort_idx,
                     axis_name, self.comm_mode, self.method,
                     communicate=not self.exclude_communicate_inverse,
-                    guard=self.health is not None)
+                    guard=self.health is not None,
+                    comm_precision=self.comm_precision)
 
         grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
         with jax.named_scope('kfac.Precondition'):
@@ -680,11 +788,12 @@ class KFAC:
                     plan, pred_decomp, grad_mats, damping, self.method,
                     axis_name,
                     communicate=not self.exclude_communicate_inverse,
-                    scales=pred_decomp.get('scales') if self.ekfac else None)
+                    scales=pred_decomp.get('scales') if self.ekfac else None,
+                    comm_precision=self.comm_precision)
 
         new_grads = engine.preconditioned_grads(
             plan, grads, grad_mats, preds, lr, self.kl_clip,
             skip_clip=self.exclude_communicate_inverse)
         new_state = state.replace(step=state.step + 1, factors=factors,
-                                  decomp=decomp)
+                                  decomp=decomp, comm_err=comm_err)
         return new_grads, new_state

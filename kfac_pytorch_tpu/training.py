@@ -289,7 +289,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
 
     def one_step(state, batch, hyper, update_factors, update_inverse,
                  update_basis=True, warm_basis=False, factors_only=False,
-                 stagger_update=False):
+                 stagger_update=False, prefetch=False):
         x = batch['input']
         variables = {'params': state.params, **state.extra_vars}
         use_capture = precond is not None and update_factors
@@ -374,7 +374,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                     update_inverse=update_inverse,
                     update_basis=update_basis,
                     warm_basis=warm_basis, factors_only=factors_only,
-                    stagger_update=stagger_update,
+                    stagger_update=stagger_update, prefetch=prefetch,
                     axis_name=axis_name)
                 if health_cfg is None:
                     new_grads = pgrads
@@ -441,13 +441,14 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
 
     def make_variant(update_factors, update_inverse, update_basis=True,
                      warm_basis=False, factors_only=False,
-                     stagger_update=False):
+                     stagger_update=False, prefetch=False):
         fn = functools.partial(one_step, update_factors=update_factors,
                                update_inverse=update_inverse,
                                update_basis=update_basis,
                                warm_basis=warm_basis,
                                factors_only=factors_only,
-                               stagger_update=stagger_update)
+                               stagger_update=stagger_update,
+                               prefetch=prefetch)
         if axis_name is None:
             return jax.jit(fn, donate_argnums=(0,) if donate else ())
         kspecs = (precond.state_pspecs(axis_name) if precond is not None
@@ -493,6 +494,15 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             # or a hand-built state): done host-side BEFORE the jitted
             # call so every variant only ever sees one state structure
             state = state.replace(health=health_lib.HealthState.init())
+        if (precond is not None and state.kfac_state is not None
+                and getattr(precond, '_tracks_comm_err', False)
+                and state.kfac_state.comm_err is None):
+            # same one-time upgrade for the EF residual: a checkpoint
+            # taken before comm_precision was enabled (or at fp32)
+            # carries no residual — seed zeros host-side so every
+            # variant sees one state structure
+            state = state.replace(kfac_state=state.kfac_state.replace(
+                comm_err=precond._zero_comm_err()))
         if 'yes' not in seen_inverse:
             # one-time: a restored checkpoint may already carry a
             # decomposition (utils/checkpoint.py include_kfac=True)
@@ -501,6 +511,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                 and any(bool(jnp.any(x != 0))
                         for x in jax.tree.leaves(state.kfac_state.decomp)))
         st = False
+        pf = False
         if precond is None:
             uf = ui = False
             ub, warm = True, False
@@ -531,12 +542,18 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                       or precond.should_update_basis(
                           step, seen_inverse.get('last_full')))
                 warm = _warm_basis_gate(precond, seen_inverse, step, ui, ub)
+                # cross-step prefetch: publish this inverse update's
+                # gathered table for the NEXT step — only once a prior
+                # table exists (the first decomposition must be consumed
+                # same-step or the pred would read zeros)
+                pf = (getattr(precond, 'comm_prefetch', False) and ui
+                      and seen_inverse['yes'])
                 seen_inverse['yes'] = seen_inverse['yes'] or ui
                 if not ui:
                     ub, warm = True, False  # unused w/o an inverse update
                 if not ub:
                     warm = False        # refresh path has no eigh to warm
-        key = (uf, ui, ub, warm)
+        key = (uf, ui, ub, warm, pf)
         if st:
             # the cohort layout derives from kfac_update_freq: a
             # scheduler/straggler rescale rebases it here, and the cohort
@@ -551,7 +568,7 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             if key not in variants:
                 variants[key] = make_variant(uf, False, factors_only=True)
         if key not in variants:
-            variants[key] = make_variant(uf, ui, ub, warm)
+            variants[key] = make_variant(uf, ui, ub, warm, prefetch=pf)
         # host-visible phase set of THIS dispatch (consumed by
         # utils.metrics.PhaseTimers for the kfac_phase_ms epoch suffix)
         if precond is None:
@@ -572,6 +589,14 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                            else getattr(precond, 'lr', 0.0)),
             damping=jnp.float32(damping if damping is not None
                                 else getattr(precond, 'damping', 0.0)))
+        # does THIS dispatch publish a gathered table for the NEXT step?
+        # (stagger's double-buffered cohort gather, or comm_prefetch on a
+        # full inverse update) — recorded as overlapping schedule spans
+        # so a trace shows the CommunicateInverse gather riding under the
+        # pred einsums with no same-step consumer
+        prefetched_gather = (pf or st) and (
+            precond is not None and precond.comm_mode == 'inverse'
+            and 'gather' in step_fn.last_phases)
         try:
             if tracer is not None:
                 from kfac_pytorch_tpu.obs.trace import taxonomy_phases
@@ -579,6 +604,18 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                                  step=step,
                                  phases=taxonomy_phases(
                                      step_fn.last_phases)):
+                    if prefetched_gather:
+                        cohort = (step % layout.num_cohorts if st
+                                  else None)
+                        with tracer.span(
+                                'kfac.Precondition', cat='kfac.sched',
+                                step=step, table='stored'), \
+                             tracer.span(
+                                'kfac.CommunicateInverse.prefetch',
+                                cat='kfac.sched', step=step,
+                                cohort=cohort,
+                                consumer_step=step + 1):
+                            return variants[key](state, batch, hyper)
                     return variants[key](state, batch, hyper)
             return variants[key](state, batch, hyper)
         except Exception as e:

@@ -355,6 +355,27 @@ def _restore_checkpoint_once(base_dir, epoch, target_state):
         return pickle.load(f)
 
 
+def _saved_comm_err_zeros(path):
+    """Zero arrays shaped like a saved ``KFACState.comm_err`` subtree —
+    the restore placeholder for the comm_precision DOWNGRADE direction
+    (lossy-era checkpoint into an fp32-configured run, see
+    :func:`auto_resume`). ``None`` when the checkpoint carries no
+    residual, or when orbax is unavailable (the pickle path restores
+    without structure matching and never needs this)."""
+    if not _HAS_ORBAX or not os.path.isdir(path):
+        return None
+    try:
+        meta = ocp.StandardCheckpointer().metadata(path)
+        err = (meta.get('kfac_state') or {}).get('comm_err')
+        if not isinstance(err, dict) or not err:
+            return None
+        import jax.numpy as jnp
+        return {key: jnp.zeros(m.shape, m.dtype)
+                for key, m in err.items()}
+    except Exception:  # noqa: BLE001 — metadata unreadable: not ours
+        return None
+
+
 def auto_resume(base_dir, max_epoch, target_state, retry=None):
     """Corruption-tolerant auto-resume: ``(restored_state, epoch)``, or
     ``(None, None)`` when nothing restorable exists. ``retry`` (a
@@ -380,22 +401,67 @@ def auto_resume(base_dir, max_epoch, target_state, retry=None):
             return (restore_checkpoint(base_dir, epoch, target_state,
                                        retry=retry), epoch)
         except Exception:  # noqa: BLE001 — any unreadable ckpt: scan on
-            # NOT necessarily corruption: a checkpoint from pre-health
-            # code has no TrainState.health subtree and orbax rejects the
-            # structure mismatch. Retry against a health-less target —
-            # the trainer upgrades a None HealthState host-side on the
-            # first step (training.py), so the restored run is whole.
-            if getattr(target_state, 'health', None) is not None:
+            # NOT necessarily corruption: a structure mismatch from a
+            # checkpoint taken before an OPTIONAL state subtree existed
+            # — no TrainState.health (pre-health code) and/or no
+            # KFACState.comm_err (taken at fp32 before comm_precision
+            # was enabled) — makes orbax reject the restore. Retry
+            # against targets with those subtrees dropped: the trainer
+            # re-seeds a None HealthState AND a None EF residual
+            # host-side on the first step (training.py), so the
+            # restored run is whole either way.
+            for drop_err, drop_health, note in (
+                    (True, False, 'predates comm_precision (no EF '
+                                  'residual); residual starts at zero'),
+                    (False, True, 'predates the health guard (no '
+                                  'HealthState); counters start fresh'),
+                    (True, True, 'predates the health guard and '
+                                 'comm_precision; both start fresh')):
+                fb = target_state
+                if drop_err:
+                    k = getattr(fb, 'kfac_state', None)
+                    if k is None or getattr(k, 'comm_err', None) is None:
+                        continue
+                    fb = fb.replace(kfac_state=k.replace(comm_err=None))
+                if drop_health:
+                    if getattr(fb, 'health', None) is None:
+                        continue
+                    fb = fb.replace(health=None)
                 try:
-                    restored = restore_checkpoint(
-                        base_dir, epoch, target_state.replace(health=None),
-                        retry=retry)
-                    log.info('checkpoint-%d predates the health guard '
-                             '(no HealthState); counters start fresh',
-                             epoch)
+                    restored = restore_checkpoint(base_dir, epoch, fb,
+                                                  retry=retry)
+                    log.info('checkpoint-%d %s', epoch, note)
                     return restored, epoch
-                except Exception:  # noqa: BLE001 — genuinely unreadable
+                except Exception:  # noqa: BLE001 — try the next target
                     pass
+            # ... and the DOWNGRADE direction: the checkpoint CARRIES a
+            # comm_err residual (taken under a lossy comm_precision) but
+            # this run's target has none (fp32, or the knob reverted).
+            # Build a zero placeholder from the checkpoint's own saved
+            # shapes, restore, then discard the residual — it only
+            # compensates a lossy wire, so dropping it loses one step's
+            # quantization error at most, vs losing ALL progress to a
+            # 'unreadable' restart-from-scratch.
+            k = getattr(target_state, 'kfac_state', None)
+            if k is not None and getattr(k, 'comm_err', None) is None:
+                zeros = _saved_comm_err_zeros(_ckpt_dir(base_dir, epoch))
+                if zeros is not None:
+                    try:
+                        restored = restore_checkpoint(
+                            base_dir, epoch,
+                            target_state.replace(
+                                kfac_state=k.replace(comm_err=zeros)),
+                            retry=retry)
+                        restored = restored.replace(
+                            kfac_state=restored.kfac_state.replace(
+                                comm_err=None))
+                        log.info(
+                            'checkpoint-%d carries an EF residual '
+                            '(comm_err) the current comm_precision does '
+                            'not use; residual discarded', epoch)
+                        return restored, epoch
+                    except Exception:  # noqa: BLE001 — genuinely bad
+                        pass
             log.warning(
                 'checkpoint-%d in %s is unreadable; falling back to the '
                 'next-older epoch', epoch, base_dir, exc_info=True)

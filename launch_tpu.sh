@@ -50,6 +50,21 @@ export JAX_COMPILATION_CACHE_DIR XLA_PYTHON_CLIENT_PREALLOCATE
 #   kfac-obs "$KFAC_TRACE_DIR" logs/*.log -o timeline.json
 [ -n "$KFAC_TRACE_DIR" ] && export KFAC_TRACE_DIR
 
+# Communication compression: KFAC_COMM_PRECISION=fp32|bf16|int8 sets the
+# wire dtype of the K-FAC factor collectives on every trainer of the run
+# (the trainers read it as the --kfac-comm-precision default; an explicit
+# flag on the command line still wins). bf16 halves, int8 quarters the
+# gather payloads; the stats reduce carries an error-feedback residual
+# (KFACState.comm_err); the gradient allreduce is NEVER compressed. See
+# README "Communication compression" for when int8 is safe.
+if [ -n "$KFAC_COMM_PRECISION" ]; then
+  case "$KFAC_COMM_PRECISION" in
+    fp32|bf16|int8) export KFAC_COMM_PRECISION ;;
+    *) echo "launch_tpu.sh: KFAC_COMM_PRECISION must be fp32|bf16|int8," \
+            "got '$KFAC_COMM_PRECISION'" >&2; exit 1 ;;
+  esac
+fi
+
 if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi

@@ -13,6 +13,19 @@ collective-permute ops XLA actually emitted. MPD variants ('eigen',
 + preconditioned-output gather; SGD is the gradient-allreduce floor.
 
 Usage: KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=8 python scripts/comm_count.py
+
+Env knobs:
+  COMM_COUNT_VARIANTS   space-separated variant specs; a ':bf16'/':int8'
+                        suffix compiles the variant with that
+                        comm_precision wire dtype (e.g. 'eigen:bf16')
+  COMM_COUNT_JSON       write the machine-readable per-variant ledger
+                        (ops/bytes per collective kind + per-phase
+                        per-dtype breakdown) to this path
+  COMM_COUNT_ASSERT     fail unless the SGD floor contains only
+                        gradient allreduces, every variant's floor is
+                        byte-identical to SGD's, and each compressed
+                        spec shows >=40% K-FAC collective-byte reduction
+                        vs its fp32 counterpart (the CI smoke gate)
 """
 
 import collections
@@ -43,14 +56,37 @@ COLLECTIVE_LINE_RE = re.compile(
     r'= (.*?) ((?:all-reduce|all-gather|collective-permute|reduce-scatter|'
     r'all-to-all)(?:-start)?)\(')
 SHAPE_RE = re.compile(r'\b([a-z]\w*)\[([0-9,]*)\]')
+OP_NAME_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
 DTYPE_BYTES = {'f32': 4, 'bf16': 2, 'f16': 2, 'f64': 8, 's32': 4,
                'u32': 4, 's64': 8, 'u64': 8, 's8': 1, 'u8': 1, 'pred': 1,
                'f8e4m3fn': 1, 'f8e5m2': 1, 'c64': 8, 'c128': 16,
                's16': 2, 'u16': 2}
 _WARNED_DTYPES = set()
 
+#: op_name scope substring -> ledger phase (first match wins; the scopes
+#: are the engine's jax.named_scope taxonomy, which XLA carries through
+#: SPMD partitioning into each collective's metadata). Everything else —
+#: the autodiff gradient allreduce, the loss pmean, BN-stat syncs — is
+#: the 'grad_or_other' floor that MUST stay byte-identical under any
+#: comm_precision (compression never touches the SGD path).
+PHASE_OF_SCOPE = (
+    ('kfac.CommunicateFactor', 'FactorComm'),
+    ('kfac.CommunicateInverse', 'InverseComm'),
+    ('kfac.Precondition', 'PredComm'),
+    ('kfac.', 'KfacOther'),
+)
+FLOOR_PHASE = 'grad_or_other'
 
-def _payload_bytes(result_type, kind=''):
+
+def _phase_of(op_name):
+    for scope, phase in PHASE_OF_SCOPE:
+        if scope in (op_name or ''):
+            return phase
+    return FLOOR_PHASE
+
+
+def _payload_bytes_by_dtype(result_type, kind=''):
+    """{hlo dtype token: payload bytes} of one collective's result."""
     shapes = SHAPE_RE.findall(result_type)
     if kind.endswith('-start') and result_type.lstrip().startswith('('):
         # an async -start op's tuple result is (operand aliases...,
@@ -72,7 +108,7 @@ def _payload_bytes(result_type, kind=''):
                       f'length {len(shapes)} — even alias/output split '
                       'assumption failed; counting the FULL tuple (may '
                       'double this op\'s bytes)', file=sys.stderr)
-    total = 0
+    out = {}
     for dt, dims in shapes:
         size = DTYPE_BYTES.get(dt)
         if size is None:
@@ -85,8 +121,12 @@ def _payload_bytes(result_type, kind=''):
         for d in dims.split(','):
             if d:
                 n *= int(d)
-        total += n * size
-    return total
+        out[dt] = out.get(dt, 0) + n * size
+    return out
+
+
+def _payload_bytes(result_type, kind=''):
+    return sum(_payload_bytes_by_dtype(result_type, kind).values())
 
 
 def _ce(outputs, batch):
@@ -94,11 +134,21 @@ def _ce(outputs, batch):
         outputs, batch['label']).mean()
 
 
-def collective_counts(variant, ndev=8, model_name='resnet20', model=None,
-                      hw=32):
-    """({op_kind: count}, {op_kind: bytes}) over the compiled
-    (SPMD-partitioned) HLO of one full
-    factor+inverse+precondition+update step."""
+def parse_variant_spec(spec):
+    """'eigen' | 'eigen:bf16' -> (variant, comm_precision)."""
+    variant, _, precision = spec.partition(':')
+    return variant, (precision or 'fp32')
+
+
+def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
+                      hw=32, comm_precision='fp32', comm_prefetch=False):
+    """Machine-readable collective ledger over the compiled
+    (SPMD-partitioned) HLO of one full factor+inverse+precondition+update
+    step: op counts and payload bytes per collective kind, plus a
+    per-phase (named-scope taxonomy) x per-dtype breakdown — the
+    compiler-level proof that a ``comm_precision`` wire dtype shrinks
+    FactorComm/InverseComm/PredComm while the gradient-allreduce floor
+    stays byte-identical."""
     if len(jax.devices()) < ndev or ndev < 2:
         raise SystemExit(
             f'need a >=2-device mesh (have {len(jax.devices())}, asked '
@@ -118,7 +168,9 @@ def collective_counts(variant, ndev=8, model_name='resnet20', model=None,
         precond = kfac.KFAC(variant=variant, lr=0.1, damping=0.003,
                             fac_update_freq=1, kfac_update_freq=1,
                             num_devices=ndev, axis_name='batch',
-                            assignment='balanced')
+                            assignment='balanced',
+                            comm_precision=comm_precision,
+                            comm_prefetch=comm_prefetch)
     state = training.init_train_state(model, tx, precond,
                                       jax.random.PRNGKey(0),
                                       batch['input'])
@@ -131,14 +183,88 @@ def collective_counts(variant, ndev=8, model_name='resnet20', model=None,
     # program twice) and read the compiled SPMD module's text
     from kfac_pytorch_tpu.preconditioner import KFACHyperParams
     hyper = KFACHyperParams(lr=jnp.float32(0.1), damping=jnp.float32(0.003))
-    jitted = step.make_variant(precond is not None, precond is not None)
+    jitted = step.make_variant(precond is not None, precond is not None,
+                               prefetch=comm_prefetch)
     txt = jitted.lower(state, batch, hyper).compile().as_text()
     counts = collections.Counter()
     bytes_by_kind = collections.Counter()
-    for result_type, kind in COLLECTIVE_LINE_RE.findall(txt):
+    by_phase = {}
+    for line in txt.splitlines():
+        m = COLLECTIVE_LINE_RE.search(line)
+        if not m:
+            continue
+        result_type, kind = m.groups()
+        per_dtype = _payload_bytes_by_dtype(result_type, kind)
+        total = sum(per_dtype.values())
         counts[kind] += 1
-        bytes_by_kind[kind] += _payload_bytes(result_type, kind)
-    return dict(counts), dict(bytes_by_kind)
+        bytes_by_kind[kind] += total
+        om = OP_NAME_RE.search(line)
+        phase = _phase_of(om.group(1) if om else '')
+        rec = by_phase.setdefault(
+            phase, {'ops': 0, 'bytes': 0, 'by_dtype': {}})
+        rec['ops'] += 1
+        rec['bytes'] += total
+        for dt, b in per_dtype.items():
+            rec['by_dtype'][dt] = rec['by_dtype'].get(dt, 0) + b
+    return {
+        'variant': variant,
+        'comm_precision': comm_precision,
+        'comm_prefetch': bool(comm_prefetch),
+        'ops': dict(counts),
+        'bytes': dict(bytes_by_kind),
+        'by_phase': by_phase,
+        'total_bytes': int(sum(bytes_by_kind.values())),
+    }
+
+
+def collective_counts(variant, ndev=8, model_name='resnet20', model=None,
+                      hw=32, comm_precision='fp32'):
+    """({op_kind: count}, {op_kind: bytes}) over the compiled
+    (SPMD-partitioned) HLO of one full
+    factor+inverse+precondition+update step."""
+    led = collective_ledger(variant, ndev=ndev, model_name=model_name,
+                            model=model, hw=hw,
+                            comm_precision=comm_precision)
+    return led['ops'], led['bytes']
+
+
+def check_floor(ledgers):
+    """The smoke-job gate: (a) the 'sgd' ledger contains ONLY
+    gradient-path collectives (all-reduce kinds, no gathers, nothing
+    attributed to a K-FAC phase), and (b) every compressed spec's
+    'grad_or_other' floor phase is byte-identical to its fp32
+    counterpart's — a comm_precision wire dtype must never leak into the
+    gradient path. Raises AssertionError with the offending record."""
+    assert 'sgd' in ledgers, 'check_floor needs an sgd ledger'
+    sgd = ledgers['sgd']
+    bad = [k for k in sgd['ops']
+           if not k.startswith('all-reduce')]
+    assert not bad, f'unexpected collectives in the SGD floor: {bad}'
+    assert set(sgd['by_phase']) <= {FLOOR_PHASE}, (
+        'SGD ledger attributes collectives to a K-FAC phase: '
+        f'{sorted(sgd["by_phase"])}')
+    for spec, led in ledgers.items():
+        variant, precision = parse_variant_spec(spec)
+        if precision == 'fp32':
+            continue
+        # a compressed spec with no fp32 counterpart would make every
+        # check below vacuous — fail loudly instead of going green
+        # having asserted nothing (e.g. a CI edit that drops the fp32
+        # baselines to save time)
+        assert variant in ledgers, (
+            f'{spec}: no fp32 counterpart {variant!r} in the ledger set '
+            '— the floor/compression gates need the baseline; add '
+            f'{variant!r} to COMM_COUNT_VARIANTS')
+        floor = ledgers[variant]['by_phase'].get(
+            FLOOR_PHASE, {}).get('bytes', 0)
+        got = led['by_phase'].get(FLOOR_PHASE, {}).get('bytes', 0)
+        assert got == floor, (
+            f'{spec}: grad/other floor {got} B != {variant} (fp32) '
+            f'floor {floor} B — compression (or a regression) touched '
+            'the gradient path')
+        assert set(led['by_phase'][FLOOR_PHASE]['by_dtype']) == \
+            set(ledgers[variant]['by_phase'][FLOOR_PHASE]['by_dtype']), (
+            f'{spec}: floor phase dtype set changed vs {variant}')
 
 
 def main():
@@ -146,47 +272,90 @@ def main():
     model_name = os.environ.get('COMM_COUNT_MODEL', 'resnet20')
     print(f'model={model_name} ndev={ndev} (counts from the compiled '
           'SPMD module)')
-    variants = tuple(os.environ.get(
+    # variant specs: 'eigen' (fp32) or 'eigen:bf16' / 'eigen:int8'
+    # (compressed factor collectives, parallel/collectives.py wire dtypes)
+    specs = tuple(os.environ.get(
         'COMM_COUNT_VARIANTS',
         'sgd eigen inverse eigen_dp inverse_dp').split())
-    counts, volumes = {}, {}
-    for variant in variants:
-        counts[variant], volumes[variant] = collective_counts(
-            variant, ndev=ndev, model_name=model_name)
-        print(f'{variant:>12}: ops {counts[variant]}  '
-              f'MiB {{'
-              + ', '.join(f'{k}: {v / 2**20:.2f}'
-                          for k, v in volumes[variant].items())
-              + '}', flush=True)
+    ledgers = {}
+    for spec in specs:
+        variant, precision = parse_variant_spec(spec)
+        led = collective_ledger(variant, ndev=ndev, model_name=model_name,
+                                comm_precision=precision)
+        ledgers[spec] = led
+        phases = ', '.join(
+            f'{p}: {r["bytes"] / 2**20:.2f}'
+            for p, r in sorted(led['by_phase'].items()))
+        print(f'{spec:>17}: ops {led["ops"]}  MiB by phase {{{phases}}}',
+              flush=True)
 
-    kinds = sorted({k for r in counts.values() for k in r})
-    print('\nvariant       '
+    kinds = sorted({k for r in ledgers.values() for k in r['ops']})
+    print('\nvariant            '
           + '  '.join(f'{k + " (n/MiB)":>26}' for k in kinds))
-    for v in counts:
-        print(f'{v:<12} ' + '  '.join(
-            f'{counts[v].get(k, 0):>16}/{volumes[v].get(k, 0)/2**20:8.2f}'
+    for spec, led in ledgers.items():
+        print(f'{spec:<17} ' + '  '.join(
+            f'{led["ops"].get(k, 0):>16}/{led["bytes"].get(k, 0)/2**20:8.2f}'
             for k in kinds))
+
+    json_path = os.environ.get('COMM_COUNT_JSON')
+    if json_path:
+        import json
+        doc = {'model': model_name, 'ndev': ndev,
+               'sgd_floor_bytes': (ledgers['sgd']['total_bytes']
+                                   if 'sgd' in ledgers else None),
+               'variants': ledgers}
+        with open(json_path, 'w') as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+        print(f'\nwrote {json_path}')
 
     # the ledger analog (reference scripts/time_breakdown.py:27): K-FAC
     # comm VOLUME beyond the SGD gradient-allreduce floor
-    if 'sgd' not in volumes:
-        return
-    sgd_bytes = sum(volumes['sgd'].values())
-    print(f'\nSGD gradient-allreduce floor: {sgd_bytes / 2**20:.2f} MiB')
-    for variant in variants:
-        if variant == 'sgd':
-            continue
-        extra = sum(volumes[variant].values()) - sgd_bytes
-        print(f'{variant:>12}: +{extra / 2**20:8.2f} MiB K-FAC comm per '
-              'full factor+inverse step')
-    if 'eigen' not in volumes or 'eigen_dp' not in volumes:
-        return
-    e, edp = (sum(volumes['eigen'].values()) - sgd_bytes,
-              sum(volumes['eigen_dp'].values()) - sgd_bytes)
-    if e > 0:
-        print(f'\nDP-KFAC deletes {100 * (1 - edp / e):.0f}% of MPD '
-              "eigen's K-FAC comm volume — the FactorComm-deletion claim "
-              '(reference time_breakdown.py:27), compiler-verified')
+    if 'sgd' in ledgers:
+        sgd_bytes = ledgers['sgd']['total_bytes']
+        print(f'\nSGD gradient-allreduce floor: {sgd_bytes / 2**20:.2f} '
+              'MiB')
+        for spec, led in ledgers.items():
+            if spec == 'sgd':
+                continue
+            extra = led['total_bytes'] - sgd_bytes
+            print(f'{spec:>17}: +{extra / 2**20:8.2f} MiB K-FAC comm per '
+                  'full factor+inverse step')
+        # per-spec compression summary against its fp32 counterpart
+        for spec, led in ledgers.items():
+            variant, precision = parse_variant_spec(spec)
+            if precision == 'fp32' or variant not in ledgers:
+                continue
+            base = ledgers[variant]['total_bytes'] - sgd_bytes
+            comp = led['total_bytes'] - sgd_bytes
+            if base > 0:
+                print(f'{spec:>17}: {100 * (1 - comp / base):.0f}% K-FAC '
+                      f'collective-byte reduction vs {variant} (fp32)')
+        if 'eigen' in ledgers and 'eigen_dp' in ledgers:
+            e = ledgers['eigen']['total_bytes'] - sgd_bytes
+            edp = ledgers['eigen_dp']['total_bytes'] - sgd_bytes
+            if e > 0:
+                print(f'\nDP-KFAC deletes {100 * (1 - edp / e):.0f}% of '
+                      "MPD eigen's K-FAC comm volume — the FactorComm-"
+                      'deletion claim (reference time_breakdown.py:27), '
+                      'compiler-verified')
+
+    if os.environ.get('COMM_COUNT_ASSERT'):
+        check_floor(ledgers)
+        for spec, led in ledgers.items():
+            variant, precision = parse_variant_spec(spec)
+            if precision == 'fp32':
+                continue
+            assert variant in ledgers and 'sgd' in ledgers, (
+                f'{spec}: the >=40% reduction gate needs both the fp32 '
+                f'counterpart {variant!r} and the sgd floor in '
+                'COMM_COUNT_VARIANTS')
+            sgd_bytes = ledgers['sgd']['total_bytes']
+            base = ledgers[variant]['total_bytes'] - sgd_bytes
+            comp = led['total_bytes'] - sgd_bytes
+            assert base > 0 and comp <= 0.6 * base, (
+                f'{spec}: expected >=40% K-FAC collective-byte reduction '
+                f'vs {variant}, got {base} -> {comp}')
+        print('COMM_COUNT_ASSERT: floor + compression gates passed')
 
 
 if __name__ == '__main__':
