@@ -236,25 +236,15 @@ def _phase_breakdown(model, tx, batch, iters=10):
     return {k: round(v, 4) for k, v in bd.items()}
 
 
-def _micro_bench():
-    """CPU micro-benchmark of the stacked K-FAC step: steady-state vs
-    refresh-step wall time, with and without the staggered cohort
-    refresh, plus the eigh rows-per-step accounting.
-
-    Runs wherever a backend exists (the fallback path forces a 1-device
-    CPU via KFAC_PLATFORM); the model is a 6x192 MLP whose factor slots
-    land in comparable buckets, so the staggered schedule can actually
-    flatten the refresh spike (a single dominant factor would bound the
-    flattening at its own D^3). Every step is fenced
-    (utils/profiling.host_fence) so per-step walls are real.
-    """
+def _micro_model():
+    """The micro-bench workload: a 6x192 MLP whose factor slots land in
+    comparable buckets (so amortization schedules have something to
+    balance), with a deterministic synthetic batch. Shared by the
+    stagger micro-bench and the autotune leg."""
     import flax.linen as linen
 
     from kfac_pytorch_tpu import nn as knn
-    from kfac_pytorch_tpu.utils.profiling import host_fence
 
-    F = int(os.environ.get('BENCH_MICRO_FREQ', 4))
-    windows = int(os.environ.get('BENCH_MICRO_WINDOWS', 5))
     B, D_IN, WIDTH, DEPTH = 16, 48, 192, 6
 
     class MicroMLP(linen.Module):
@@ -267,7 +257,26 @@ def _micro_bench():
     rng = np.random.RandomState(0)
     batch = {'input': jnp.asarray(rng.randn(B, D_IN), jnp.float32),
              'label': jnp.asarray(rng.randint(0, 10, B))}
-    model = MicroMLP()
+    return MicroMLP(), batch, f'micro-mlp{DEPTH}x{WIDTH}', B
+
+
+def _micro_bench():
+    """CPU micro-benchmark of the stacked K-FAC step: steady-state vs
+    refresh-step wall time, with and without the staggered cohort
+    refresh, plus the eigh rows-per-step accounting.
+
+    Runs wherever a backend exists (the fallback path forces a 1-device
+    CPU via KFAC_PLATFORM); the model is a 6x192 MLP whose factor slots
+    land in comparable buckets, so the staggered schedule can actually
+    flatten the refresh spike (a single dominant factor would bound the
+    flattening at its own D^3). Every step is fenced
+    (utils/profiling.host_fence) so per-step walls are real.
+    """
+    from kfac_pytorch_tpu.utils.profiling import host_fence
+
+    F = int(os.environ.get('BENCH_MICRO_FREQ', 4))
+    windows = int(os.environ.get('BENCH_MICRO_WINDOWS', 5))
+    model, batch, model_name, B = _micro_model()
     tx = training.sgd(0.05, momentum=0.9)
 
     def run(stagger):
@@ -316,7 +325,7 @@ def _micro_bench():
     typ_ms = float(np.median(by_cohort))
     return {
         'platform': 'cpu_fallback',
-        'model': f'micro-mlp{DEPTH}x{WIDTH}', 'batch': B,
+        'model': model_name, 'batch': B,
         'variant': 'eigen_dp', 'kfac_update_freq': F,
         'timed_steps_per_mode': windows * F,
         'samples_per_sec': round(B * F / (sum(by_cohort) / 1e3), 2),
@@ -357,6 +366,98 @@ def _micro_bench():
     }
 
 
+def _micro_autotune():
+    """Closed-loop autotune leg of the CPU micro-bench: start the
+    eigen_dp micro config at the PESSIMAL cadence (kfac_update_freq=1 —
+    a full eigh every step, the configuration a hand-tuner would never
+    ship) and let the ``autotune.KnobController`` climb the bounded
+    frequency ladder from measured step times. Reports the decision
+    tail, the final knob state, and steady-state step time against the
+    best hand-configured cadence of the same sweep — the acceptance
+    comparison ``scripts/autotune_smoke.py`` gates on. Mirrors the
+    ``drift`` block wiring: the block lands in the bench extras even on
+    tunnel-down rounds, so the record always shows what the tuner chose.
+    """
+    from kfac_pytorch_tpu import autotune
+    from kfac_pytorch_tpu.utils.profiling import host_fence
+
+    model, batch, name, _ = _micro_model()
+    tx = training.sgd(0.05, momentum=0.9)
+    f_max = int(os.environ.get('BENCH_AUTOTUNE_FMAX', 8))
+    budget = int(os.environ.get('BENCH_AUTOTUNE_STEPS', 600))
+
+    def make(freq):
+        precond = kfac.KFAC(variant='eigen_dp', lr=0.05, damping=0.003,
+                            fac_update_freq=1, kfac_update_freq=freq,
+                            num_devices=1, axis_name=None)
+        state = training.init_train_state(model, tx, precond,
+                                          jax.random.PRNGKey(0),
+                                          batch['input'])
+        step = training.build_train_step(model, tx, precond, _ce)
+        return precond, state, step
+
+    def timed(step, state):
+        t0 = time.perf_counter()
+        state, m = step(state, batch, lr=0.05, damping=0.003)
+        host_fence(m)
+        return state, time.perf_counter() - t0
+
+    def steady_mean(step, state, n):
+        walls = []
+        for _ in range(n):
+            state, dt = timed(step, state)
+            walls.append(dt)
+        return state, sum(walls) / len(walls)
+
+    # the hand-configured sweep the closed loop replaces: per-cadence
+    # steady mean, warmed past every variant compile
+    hand = {}
+    ladder = []
+    f = 1
+    while f <= f_max:
+        ladder.append(f)
+        f *= 2
+    for F in ladder:
+        _, state, step = make(F)
+        for _ in range(F + 3):
+            state, _ = timed(step, state)
+        _, hand[F] = steady_mean(step, state, 2 * f_max)
+    best_f = min(hand, key=hand.get)
+
+    precond, state, step = make(1)
+    # window = 4 full refresh periods at the ladder top: enough samples
+    # per phase set that one noisy host window (GC pause, CI neighbor)
+    # cannot flip a probe verdict and strand the true optimum on
+    # cooldown — CPU wall times are the noisiest feed the controller
+    # sees, and the smoke gate rides this leg
+    ctl = autotune.KnobController(
+        precond, window=4 * f_max, settle=3, rel_improve=0.05,
+        dwell_windows=1, cooldown=2, steady_every=0,
+        tune=('kfac_update_freq',), freq_bounds=(1, f_max))
+    state, _ = timed(step, state)  # cold full decomposition + compile
+    steps_run = 0
+    while steps_run < budget and ctl.state != 'steady':
+        state, dt = timed(step, state)
+        ctl.record(step.last_phases, dt)
+        steps_run += 1
+    state, steady = steady_mean(step, state, 2 * f_max)
+    return {
+        'enabled': True, 'model': name, 'platform': 'cpu_fallback',
+        'initial_kfac_update_freq': 1,
+        'hand_sweep_mean_ms': {str(k): round(v * 1e3, 3)
+                               for k, v in hand.items()},
+        'hand_best': {'kfac_update_freq': best_f,
+                      'mean_ms': round(hand[best_f] * 1e3, 3)},
+        'final_kfac_update_freq': precond.kfac_update_freq,
+        'converged_to_hand_best': precond.kfac_update_freq == best_f,
+        'steady_mean_ms': round(steady * 1e3, 3),
+        'steady_over_hand_best': round(steady / hand[best_f], 4),
+        'steps_to_steady': steps_run,
+        'windows': ctl.windows,
+        'controller': ctl.report(),
+    }
+
+
 def _attach_drift(extra, measured=None, variant='inverse_dp',
                   platform=None, source=None):
     """Attach the measured-vs-predicted ``drift`` block (obs.drift) to
@@ -380,9 +481,10 @@ def _run_micro_mode():
     """BENCH_MICRO=1 entrypoint: emit the micro-bench as the round's
     metric (one JSON line, the standard partial-emission contract)."""
     _install_partial_emitter()
-    # same stable-key contract as main(): drift is an explicit null
-    # until (and unless) the measured-vs-predicted block computes
+    # same stable-key contract as main(): drift and autotune are
+    # explicit nulls until (and unless) their blocks compute
     PARTIAL['extra']['drift'] = None
+    PARTIAL['extra']['autotune'] = None
     _checkpoint()
     try:
         micro = _micro_bench()
@@ -402,6 +504,14 @@ def _run_micro_mode():
                           source='micro')
         except Exception:  # noqa: BLE001
             traceback.print_exc(file=sys.stderr)
+        # the closed-loop leg: what the tuner would have chosen for
+        # this workload, recorded even on tunnel-down rounds
+        # (BENCH_MICRO_AUTOTUNE=0 skips — the key stays an honest null)
+        if os.environ.get('BENCH_MICRO_AUTOTUNE', '1') != '0':
+            try:
+                PARTIAL['extra']['autotune'] = _micro_autotune()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc(file=sys.stderr)
         _checkpoint()
         _emit(PARTIAL, exit_code=0)
     except BaseException as e:  # noqa: BLE001 — the JSON line must go out
@@ -463,7 +573,7 @@ def _run(devices):
         'ekfac_iter_s_freq10_basis100',
         'kfac_overhead_vs_sgd_freq1', 'kfac_overhead_vs_sgd_freq10',
         'model_flops_per_iter', 'mfu_inverse_dp_freq1', 'peak_flops',
-        'phase_breakdown_s')})
+        'phase_breakdown_s', 'autotune')})
     extra['eigh_impl'] = os.environ.get('KFAC_EIGH_IMPL', 'xla')
     extra.update({'batch': BATCH, 'img': IMG, 'device': str(devices[0]),
                   'device_kind': getattr(devices[0], 'device_kind', None)})
@@ -663,6 +773,11 @@ def main():
                 # JSON pairs a measurement with the analytic model
                 if micro['extra'].get('drift') is not None:
                     PARTIAL['extra']['drift'] = micro['extra']['drift']
+                # ...and what the closed-loop tuner chose on the
+                # fallback platform (preseeded null in the contract)
+                if micro['extra'].get('autotune') is not None:
+                    PARTIAL['extra']['autotune'] = \
+                        micro['extra']['autotune']
                 # the hang stays on record, but as context — the metric
                 # itself is real (measured, on the fallback platform)
                 PARTIAL['extra']['backend_error'] = PARTIAL.pop('error')

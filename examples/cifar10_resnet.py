@@ -87,6 +87,14 @@ def parse_args():
                         "inverse update's gathered decomposition for "
                         'the NEXT step so the gather overlaps the pred '
                         'einsums (one step of decomposition staleness)')
+    p.add_argument('--kfac-autotune', action='store_true',
+                   default=os.environ.get('KFAC_AUTOTUNE', '') == '1',
+                   help='closed-loop autotuning: one online controller '
+                        'hill-climbs kfac/fac_update_freq and the comm '
+                        'wire dtype from measured step times through '
+                        'the knob arbiter, with perf-model drift-band '
+                        'vetoes (defaults on when $KFAC_AUTOTUNE=1; '
+                        'see README "Closed-loop autotuning")')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-type', '--fisher-type', default='Femp',
                    choices=['Femp', 'F1mc'],
@@ -257,6 +265,13 @@ def main():
         res = training.world_change_rescale(ow, nw, lr=args.base_lr,
                                             global_batch=args.batch_size)
         log.info(res.log_line())
+        # provenance: the elastic verdict rides the knob arbiter's
+        # record stream (composes nothing — the lr schedule stays
+        # trainer-owned) so the decision log shows WHY a cadence or lr
+        # changed around a world change
+        from kfac_pytorch_tpu import autotune
+        autotune.arbiter_for(precond).propose('elastic',
+                                              **res._asdict())
         if res.lr != args.base_lr:
             args.base_lr = res.lr
             rescaled.append(res)
@@ -300,6 +315,14 @@ def main():
     watchdog = None
     if args.step_deadline > 0:
         watchdog = resilience.StepWatchdog(args.step_deadline, log=log)
+    # closed-loop autotuner: proposes knob changes to the same arbiter
+    # the scheduler/governor feed (no predicted block here — the perf
+    # model describes the imagenet resnet50 anchor, not cifar: the
+    # drift gate stays out of the loop, decisions are measurement-only)
+    from kfac_pytorch_tpu import autotune
+    tuner = autotune.controller_from_args(
+        precond, enabled=args.kfac_autotune, trace_dir=args.trace,
+        variant=args.kfac_name, log=log)
 
     # observability: trace recorder (per-step spans + resilience
     # instants, flushed on the runlog SIGTERM/atexit chain) and the
@@ -308,7 +331,7 @@ def main():
     from kfac_pytorch_tpu import obs
     tracer, reg = obs.setup_trainer(trace_dir=args.trace,
                                     prom_file=args.prom_file,
-                                    governor=governor)
+                                    governor=governor, tuner=tuner)
 
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
@@ -316,7 +339,7 @@ def main():
                                      fisher_type=args.kfac_type,
                                      fisher_seed=args.seed,
                                      straggler=governor, heartbeat=hb,
-                                     tracer=tracer)
+                                     tracer=tracer, autotune=tuner)
 
     @jax.jit
     def eval_step(params, extra_vars, batch):

@@ -81,6 +81,16 @@ def parse_args():
                         "inverse update's gathered decomposition for "
                         'the NEXT step so the gather overlaps the pred '
                         'einsums (one step of decomposition staleness)')
+    p.add_argument('--kfac-autotune', action='store_true',
+                   default=os.environ.get('KFAC_AUTOTUNE', '') == '1',
+                   help='closed-loop autotuning: one online controller '
+                        'hill-climbs kfac/fac_update_freq and the comm '
+                        'wire dtype from measured step times through '
+                        'the knob arbiter; on the modeled workload '
+                        '(resnet50 bs32) every commit is vetoed by the '
+                        'perf-model drift band (defaults on when '
+                        '$KFAC_AUTOTUNE=1; see README "Closed-loop '
+                        'autotuning")')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp',
                    choices=list(kfac.KFAC_VARIANTS))
@@ -228,6 +238,19 @@ def main():
     watchdog = None
     if args.step_deadline > 0:
         watchdog = resilience.StepWatchdog(args.step_deadline, log=log)
+    # closed-loop autotuner: THIS trainer is the workload the analytic
+    # perf model describes (resnet50 bs32, perf_inputs_resnet50_bs32),
+    # so when the config matches the anchor the tuner runs drift-GATED —
+    # on the modeled chip a knob change whose measured phase ratios
+    # leave the [optimistic, conservative] band is vetoed, elsewhere
+    # the band is advisory; any other config tunes ungated
+    from kfac_pytorch_tpu import autotune, perfmodel
+    predicted = (perfmodel.predict_block()
+                 if args.model == 'resnet50'
+                 and args.batch_size == perfmodel.BATCH else None)
+    tuner = autotune.controller_from_args(
+        precond, enabled=args.kfac_autotune, trace_dir=args.trace,
+        predicted=predicted, variant=args.kfac_name, log=log)
 
     # auto-resume (reference: pytorch_imagenet_resnet.py:162-167,305-312),
     # hardened: an unreadable newest checkpoint (truncated write, storage
@@ -263,6 +286,13 @@ def main():
         res = training.world_change_rescale(ow, nw, lr=args.base_lr,
                                             global_batch=args.batch_size)
         log.info(res.log_line())
+        # provenance: the elastic verdict rides the knob arbiter's
+        # record stream (composes nothing — the lr schedule stays
+        # trainer-owned) so the decision log shows WHY a cadence or lr
+        # changed around a world change
+        from kfac_pytorch_tpu import autotune
+        autotune.arbiter_for(precond).propose('elastic',
+                                              **res._asdict())
         if res.lr != args.base_lr:
             args.base_lr = res.lr
             rescaled.append(res)
@@ -306,13 +336,13 @@ def main():
     from kfac_pytorch_tpu import obs
     tracer, reg = obs.setup_trainer(trace_dir=args.trace,
                                     prom_file=args.prom_file,
-                                    governor=governor)
+                                    governor=governor, tuner=tuner)
 
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
                                      extra_mutable=('batch_stats',),
                                      straggler=governor, heartbeat=hb,
-                                     tracer=tracer)
+                                     tracer=tracer, autotune=tuner)
 
     @jax.jit
     def eval_step(params, extra_vars, batch):

@@ -71,6 +71,14 @@ def parse_args():
                         "inverse update's gathered decomposition for "
                         'the NEXT step so the gather overlaps the pred '
                         'einsums (one step of decomposition staleness)')
+    p.add_argument('--kfac-autotune', action='store_true',
+                   default=os.environ.get('KFAC_AUTOTUNE', '') == '1',
+                   help='closed-loop autotuning: one online controller '
+                        'hill-climbs kfac/fac_update_freq and the comm '
+                        'wire dtype from measured step times through '
+                        'the knob arbiter (defaults on when '
+                        '$KFAC_AUTOTUNE=1; see README "Closed-loop '
+                        'autotuning")')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--kfac-name', default='eigen_dp',
                    choices=list(kfac.KFAC_VARIANTS))
@@ -224,13 +232,23 @@ def main():
     # suffixes render through the registry, byte-compatible with the
     # old hand-plumbed health_suffix)
     from kfac_pytorch_tpu import obs
+    # closed-loop autotuner: proposes knob changes to the single knob
+    # arbiter from measured step times (no predicted block — the perf
+    # model describes the imagenet resnet50 anchor, not this workload:
+    # decisions are measurement-only, the drift gate stays out)
+    from kfac_pytorch_tpu import autotune
+    tuner = autotune.controller_from_args(
+        precond, enabled=args.kfac_autotune, trace_dir=args.trace,
+        variant=args.kfac_name, log=log)
     tracer, reg = obs.setup_trainer(trace_dir=args.trace,
-                                    prom_file=args.prom_file)
+                                    prom_file=args.prom_file,
+                                    tuner=tuner)
 
     step = training.build_train_step(model, tx, precond, loss_fn,
                                      axis_name=axis, mesh=mesh,
                                      dropout_seed=args.seed + 2,
-                                     tracer=tracer)
+                                     tracer=tracer,
+                                     autotune=tuner)
 
     @jax.jit
     def eval_step(params, batch):

@@ -1,0 +1,872 @@
+"""Closed-loop autotuning: one online controller for every runtime knob.
+
+The reference leaves every performance knob — ``kfac_update_freq`` /
+``fac_update_freq``, the comm mode, the wire dtype — to hand-tuned shell
+configs (``configs/``, ``train_*.sh``; the paper tunes them per
+model/cluster by hand). This repo grew the three ingredients of a closed
+loop without the loop itself: ``perfmodel.py`` predicts per-phase costs,
+``obs/drift.py`` measures the gap, and three *independent* controllers
+mutated the same ``KFAC`` attributes with last-writer-wins semantics
+(``KFACParamScheduler._apply``, ``StragglerGovernor``'s stretch ladder,
+and the elastic rescale hooks). This module closes the loop in two
+layers:
+
+**The arbiter** (:class:`KnobArbiter`, one per preconditioner via
+:func:`arbiter_for`) is the ONLY writer of the preconditioner's runtime
+knobs. The former racing writers are now *proposers* feeding it:
+
+- ``schedule`` — :class:`~kfac_pytorch_tpu.scheduler.KFACParamScheduler`
+  proposes multiplicative ``damping_factor`` / ``freq_factor`` decays;
+- ``straggler`` — the
+  :class:`~kfac_pytorch_tpu.resilience.straggler.StragglerGovernor`
+  proposes an integer frequency ``stretch`` (1 = recovered);
+- ``tuner`` — the :class:`KnobController` below proposes absolute knob
+  values (update frequencies, ``comm_precision``);
+- ``elastic`` — ``world_change_rescale`` records its lr/batch verdict
+  for provenance (the lr schedule itself stays trainer-owned).
+
+Composition precedence (highest first): **straggler stretch** (a host
+emergency multiplies whatever else is in force), **tuner** (absolute
+frequency overrides replace schedule×base when set), **schedule**
+(multiplicative factors on the construction-time base), **base**. The
+arbiter applies the composed result ONCE per change — triggering
+``rebase_cohorts`` and the trainers' variant-cache invalidation exactly
+once — and detects external direct writes (legacy callers), adopting
+them as the new base rather than clobbering them (the old governor's
+collision rule, now in one place).
+
+**The tuner** (:class:`KnobController`) is the online policy: fed
+measured per-step wall times attributed by phase set (the
+``step_fn.last_phases`` taxonomy ``PhaseTimers`` already uses — or a
+deterministic synthetic feed in tests), it hill-climbs the bounded knob
+ladder (frequency doublings/halvings, the fp32→bf16→int8 wire ladder)
+one probe window at a time, with hysteresis (dwell windows after a
+commit, cooldown after a revert) so compiled variants churn rarely.
+Before any measurement exists it seeds from ``perfmodel.predict``
+priors. Every improving candidate must pass the ``obs/drift`` band
+gate before committing: on the modeled chip a measured phase ratio
+outside the [optimistic, conservative] band VETOES the change — the
+tuner can never silently regress a modeled phase; elsewhere the gate is
+advisory. Decisions emit trace instants, resilience counters, log lines
+in the shared ``incident.EVENT_PATTERNS`` grammar (so ``kfac-obs``
+renders tuning timelines for free), and an append-only JSONL decision
+log (the CI artifact).
+
+Stdlib-only at import time (jax / obs bridges are lazy and guarded), so
+the module stays importable from supervisors and analysis tools.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+
+#: the preconditioner attributes the arbiter owns. Nothing else in the
+#: repo may assign these on a KFAC instance (pinned by
+#: tests/test_autotune.py's setattr-guard test).
+KNOB_ATTRS = ('fac_update_freq', 'kfac_update_freq', 'damping',
+              'comm_precision')
+
+#: the wire-dtype ladder the tuner climbs (successive halving of the
+#: collective payload; collectives.WIRE_DTYPES order).
+COMM_PRECISIONS = ('fp32', 'bf16', 'int8')
+
+_APPLYING = threading.local()
+
+
+def in_apply():
+    """True while the arbiter is writing knobs (the setattr-guard hook
+    tests use to prove nothing else writes them)."""
+    return getattr(_APPLYING, 'depth', 0) > 0
+
+
+@contextlib.contextmanager
+def _applying():
+    _APPLYING.depth = getattr(_APPLYING, 'depth', 0) + 1
+    try:
+        yield
+    finally:
+        _APPLYING.depth -= 1
+
+
+def _capture(precond):
+    """Current knob values of ``precond`` (missing attrs -> None; the
+    governor's unit tests drive plain fake objects with only the freq
+    attributes)."""
+    return {
+        'fac_update_freq': getattr(precond, 'fac_update_freq', None),
+        'kfac_update_freq': getattr(precond, 'kfac_update_freq', None),
+        'damping': getattr(precond, 'damping', None),
+        'comm_precision': getattr(precond, 'comm_precision', None),
+    }
+
+
+def arbiter_for(precond):
+    """The one :class:`KnobArbiter` of ``precond`` (created on first
+    use, stored on the instance). Every knob mutation in the repo goes
+    through this accessor."""
+    arb = getattr(precond, '_knob_arbiter', None)
+    if arb is None:
+        arb = KnobArbiter(precond)
+        precond._knob_arbiter = arb
+    return arb
+
+
+class KnobArbiter:
+    """Single writer of a preconditioner's runtime knobs.
+
+    Proposers call :meth:`propose` with their slice of intent; the
+    arbiter recomposes the effective knob set and applies it once.
+    Thread-safe (the governor ticks on the trainer thread but the
+    heartbeat/watchdog machinery may narrate concurrently).
+    """
+
+    def __init__(self, precond, log=None):
+        self.precond = precond
+        self._lock = threading.RLock()
+        self.base = _capture(precond)
+        self.schedule = {'freq_factor': 1.0, 'damping_factor': 1.0}
+        self.stretch = 1
+        self.tuner = {}          # absolute overrides (freqs, comm_precision)
+        self.records = []        # provenance-only proposals (elastic)
+        self._applied = None     # what WE last wrote (external-write check)
+        self._invalidators = []  # run when a trace-affecting knob changes
+        self.changes = 0
+
+    # -- wiring ------------------------------------------------------------
+
+    def add_invalidator(self, fn):
+        """Register a callback run when a TRACE-affecting knob changes
+        (``comm_precision``): ``training.build_train_step`` registers its
+        variant-cache ``clear`` here so stale compiled programs can never
+        keep an old wire dtype. Frequency/damping changes do NOT
+        invalidate — they are host-side gating / traced scalars and
+        reuse the cache (the compile-count guard pins this)."""
+        if fn not in self._invalidators:
+            self._invalidators.append(fn)
+        return fn
+
+    # -- proposals ---------------------------------------------------------
+
+    def adopt_external(self):
+        """Detect a direct (non-arbiter) write of the knob attributes
+        and adopt the externally-written values — the external writer
+        is authoritative for the knobs it touched, and ONLY those: an
+        in-force schedule factor or straggler stretch on the untouched
+        knobs survives. Adopted bases divide out the live schedule
+        factor, so a later epoch advance applies its (cumulative)
+        factor INCREMENTALLY from the external value instead of
+        re-decaying an already-decayed base. An external frequency
+        write supersedes the stretch (the old governor collision rule:
+        the written cadence is the new unstretched base and the ladder
+        restarts from it — ``StragglerGovernor._degrade`` resets its
+        level when this returns True). Returns True when an adoption
+        happened."""
+        with self._lock:
+            if self._applied is None:
+                return False
+            cur = _capture(self.precond)
+            changed = [k for k in KNOB_ATTRS if cur[k] != self._applied[k]]
+            if not changed:
+                return False
+            if ('fac_update_freq' in changed
+                    or 'kfac_update_freq' in changed):
+                f = self.schedule['freq_factor'] or 1.0
+                for k in ('fac_update_freq', 'kfac_update_freq'):
+                    self.tuner.pop(k, None)
+                    self.base[k] = (None if cur[k] is None
+                                    else cur[k] / f)
+                self.stretch = 1
+            if 'damping' in changed:
+                self.tuner.pop('damping', None)
+                d = self.schedule['damping_factor'] or 1.0
+                self.base['damping'] = (None if cur['damping'] is None
+                                        else cur['damping'] / d)
+            if 'comm_precision' in changed:
+                self.tuner.pop('comm_precision', None)
+                self.base['comm_precision'] = cur['comm_precision']
+            self._applied = cur
+            return True
+
+    def propose(self, source, **kw):
+        """Fold one proposer's intent in and apply the composed knobs.
+
+        ``source``: 'schedule' (``freq_factor=``, ``damping_factor=``),
+        'straggler' (``stretch=`` int, 1 = recovered), 'tuner'
+        (absolute ``fac_update_freq=`` / ``kfac_update_freq=`` /
+        ``comm_precision=``; a None value clears that override), or
+        'elastic' (free-form provenance record — composes nothing).
+        Returns the dict of knob values now in force.
+        """
+        with self._lock:
+            self.adopt_external()
+            if source == 'schedule':
+                if 'freq_factor' in kw:
+                    self.schedule['freq_factor'] = float(kw['freq_factor'])
+                if 'damping_factor' in kw:
+                    self.schedule['damping_factor'] = \
+                        float(kw['damping_factor'])
+            elif source == 'straggler':
+                self.stretch = max(1, int(kw.get('stretch', 1)))
+            elif source == 'tuner':
+                for k, v in kw.items():
+                    if k not in KNOB_ATTRS:
+                        raise KeyError(f'unknown tuner knob {k!r} '
+                                       f'(knobs: {KNOB_ATTRS})')
+                    if v is None:
+                        self.tuner.pop(k, None)
+                    else:
+                        self.tuner[k] = v
+            elif source == 'elastic':
+                self.records.append(dict(kw))
+            else:
+                raise KeyError(f'unknown proposer {source!r}')
+            return self._commit(source)
+
+    # -- composition + the one write ---------------------------------------
+
+    def _effective(self):
+        eff = {}
+        f = self.schedule['freq_factor']
+        for k in ('fac_update_freq', 'kfac_update_freq'):
+            if self.base[k] is None:
+                eff[k] = None
+                continue
+            # tuner absolute override replaces base x schedule (the
+            # schedule part keeps the reference's int() truncation —
+            # kfac_preconditioner_base.py:295-301); the straggler
+            # stretch multiplies either: a host emergency composes on
+            # top of whatever cadence is in force
+            v = (self.tuner[k] if k in self.tuner
+                 else max(1, int(self.base[k] * f)))
+            eff[k] = max(1, int(v) * self.stretch)
+        if 'damping' in self.tuner:
+            eff['damping'] = float(self.tuner['damping'])
+        else:
+            eff['damping'] = (None if self.base['damping'] is None else
+                              self.base['damping']
+                              * self.schedule['damping_factor'])
+        eff['comm_precision'] = self.tuner.get(
+            'comm_precision', self.base['comm_precision'])
+        return eff
+
+    def _commit(self, source):
+        eff = self._effective()
+        cur = _capture(self.precond)
+        changed = [k for k in KNOB_ATTRS
+                   if eff[k] is not None and eff[k] != cur[k]]
+        if not changed:
+            self._applied = _capture(self.precond)
+            return eff
+        if 'comm_precision' in changed:
+            # validate BEFORE writing — an unknown wire dtype must not
+            # land on the preconditioner half-applied
+            try:
+                from kfac_pytorch_tpu.parallel import collectives as _coll
+                _coll.check_wire_dtype(eff['comm_precision'])
+            except ImportError:  # jax-free context (fake preconds)
+                pass
+        with _applying():
+            for k in changed:
+                setattr(self.precond, k, eff[k])
+        if ('fac_update_freq' in changed or 'kfac_update_freq' in changed):
+            # staggered cohort layout derives from kfac_update_freq:
+            # rebase ONCE per composed change (no-op when off/unchanged)
+            rebase = getattr(self.precond, 'rebase_cohorts', None)
+            if rebase is not None:
+                rebase()
+        if 'comm_precision' in changed:
+            # the wire dtype is baked into the traced programs (and the
+            # EF-residual state structure): every attached trainer's
+            # variant cache must retrace; training.step_fn re-seeds /
+            # drops KFACState.comm_err host-side on the next dispatch
+            for fn in list(self._invalidators):
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — one stale cache must
+                    pass           # not block the knob change
+        self.changes += 1
+        self._applied = _capture(self.precond)
+        try:
+            from kfac_pytorch_tpu.obs import trace as _trace
+            _trace.instant('knob_change', cat='autotune', source=source,
+                           **{k: eff[k] for k in changed})
+        except Exception:  # noqa: BLE001 — tracing never blocks a knob
+            pass
+        return eff
+
+
+# ---------------------------------------------------------------------------
+# the online tuner
+# ---------------------------------------------------------------------------
+
+#: PhaseTimers host labels -> exclude-parts ledger taxonomy, restated
+#: lazily from obs.trace (stdlib) inside the converter below.
+
+
+def _taxonomy_seconds(marginals):
+    """{'decomp+gather': s} host labels -> ledger taxonomy names
+    ('ComputeInverse+CommunicateInverse'), matching
+    ``obs.drift.measured_from_phase_timers`` semantics (seconds in,
+    seconds out)."""
+    from kfac_pytorch_tpu.obs.trace import PHASE_TAXONOMY
+    out = {}
+    for label, s in marginals.items():
+        if label in ('step_mean', 'step_max'):
+            out[label] = s
+        else:
+            out['+'.join(PHASE_TAXONOMY.get(p, p)
+                         for p in label.split('+'))] = s
+    return out
+
+
+def _robust_mean(samples):
+    """Mean with >3x-median outliers dropped — host noise (a GC pause,
+    a page fault) must not masquerade as a knob effect. Applied PER
+    phase set, so a refresh step's legitimate spike is judged against
+    other refresh steps, never discarded against cheap steady steps."""
+    s = sorted(samples)
+    med = s[len(s) // 2]
+    good = [x for x in samples if x <= 3 * med] or samples
+    return sum(good) / len(good)
+
+
+def _marginals(means):
+    """Per-phase marginal seconds by subtraction between observed phase
+    sets — the same derivation ``utils.metrics.PhaseTimers.epoch_flush``
+    uses (restated here so the controller stays importable without
+    jax; the subtraction rule is pinned against PhaseTimers by test).
+    ``means``: {frozenset(phases): mean seconds}."""
+    out = {}
+    for s in sorted(means, key=lambda k: (len(k), sorted(k))):
+        bases = [b for b in means if b < s]
+        if bases:
+            base = max(bases, key=lambda b: (len(b), tuple(sorted(b))))
+            label = '+'.join(sorted(s - base))
+            val = max(means[s] - means[base], 0.0)
+        else:
+            label = '+'.join(sorted(s)) if s else 'step'
+            val = means[s]
+        if label and label not in out:
+            out[label] = val
+    return out
+
+
+def comm_mode_bytes(plan, method, comm_precision='fp32'):
+    """Analytic collective bytes of the two comm modes under ``plan``'s
+    layout: ``{'inverse': bytes per REFRESH, 'pred': bytes per STEP}``.
+    Both roads come from ``plan.comm_volume`` (the ledger-pinned single
+    source of truth for wire bytes) via its ``comm_mode`` override —
+    the tuner never restates the byte formulas. Returns None when the
+    layout carries no collective payload (or no jax to price it)."""
+    try:
+        inverse = plan.comm_volume(
+            stats_reduce='none', method=method,
+            comm_precision=comm_precision,
+            comm_mode='inverse')['InverseComm']
+        pred = plan.comm_volume(
+            stats_reduce='none', method=method,
+            comm_precision=comm_precision, comm_mode='pred')['PredComm']
+    except Exception:  # noqa: BLE001 — advisory only, never blocks
+        return None
+    if not pred and not inverse:
+        return None
+    return {'inverse': inverse, 'pred': pred}
+
+
+def decide_comm_mode(bytes_by_mode, kfac_update_freq):
+    """Cheaper comm mode by amortized per-step collective bytes:
+    comm_inverse ships its gather once per ``kfac_update_freq`` steps,
+    comm_pred ships preconditioned gradients every step. Returns
+    ('inverse'|'pred', per_step_bytes dict)."""
+    per_step = {
+        'inverse': bytes_by_mode['inverse'] / max(1, int(kfac_update_freq)),
+        'pred': float(bytes_by_mode['pred']),
+    }
+    return min(per_step, key=per_step.get), per_step
+
+
+def prior_best_freq(predicted, variant, ladder, fac_update_freq=1,
+                    anchor='central', slack=0.02):
+    """Seed ``kfac_update_freq`` from the analytic perf model before any
+    measurement exists. Predicted steady step time (model + precondition
+    + factor/fac_freq + decomposition/F) is monotone in F — amortizing
+    more is never slower — so "fastest" alone would always pick the
+    ladder top and needlessly stale the preconditioner. The prior is
+    therefore the SMALLEST ladder value within ``slack`` (2%) of the
+    asymptotic steady time: maximum freshness once further stretching
+    is perf noise. Returns None when the block carries no usable phases
+    (the controller then starts from the configured value)."""
+    try:
+        from kfac_pytorch_tpu.perfmodel import prior_phase_costs
+        ph = prior_phase_costs(predicted, variant=variant, anchor=anchor)
+    except Exception:  # noqa: BLE001 — priors are best-effort
+        return None
+    if not ph:
+        return None
+
+    def steady(F):
+        return (ph['model'] + ph['precondition']
+                + ph['factor'] / max(1, fac_update_freq)
+                + ph['decomp'] / F)
+
+    floor = steady(max(ladder))
+    for F in sorted(ladder):
+        if steady(F) <= floor * (1.0 + slack):
+            return F
+    return max(ladder)
+
+
+class KnobController:
+    """Bounded online hill-climb over the runtime knob ladder.
+
+    Feed it one measurement per host step — either through
+    :meth:`tick` (inter-arrival timing on an injectable clock, the
+    ``training.build_train_step(autotune=...)`` wiring) or directly
+    through :meth:`record` (deterministic synthetic feeds in tests: no
+    wall clock anywhere). Every ``window`` recorded steps form one
+    probe window; the policy is:
+
+    - establish a baseline for the committed config, then probe ONE
+      neighboring knob value (frequency x2 / ÷2 within
+      ``freq_bounds``, or the next wire dtype on the ladder);
+    - commit the candidate only if its window beats the baseline by
+      ``rel_improve`` AND the drift gate does not veto; otherwise
+      revert and put that candidate on ``cooldown``;
+    - after a commit, dwell ``dwell_windows`` windows before the next
+      probe (hysteresis: no knob flap inside the dwell);
+    - when every candidate is exhausted or cooling, enter STEADY state
+      (re-probing only every ``steady_every`` windows — bounded probe
+      budget by construction).
+
+    Frequency tuning trades preconditioner freshness for step time —
+    ``freq_bounds`` caps how far the tuner may move from the
+    configured cadence (default: no lower than 1, no higher than 8x
+    the starting value). The drift veto consults
+    ``obs.drift.drift_block`` over the window's per-phase marginals:
+    verdict 'drift' (only possible on the modeled chip) rejects the
+    candidate; elsewhere the gate is advisory and violations are only
+    counted. While a straggler stretch is in force the controller
+    discards windows — a host emergency is not a tuning signal.
+    """
+
+    def __init__(self, precond, *, window=16, settle=2, rel_improve=0.03,
+                 dwell_windows=2, cooldown=6, steady_every=50,
+                 tune=('kfac_update_freq', 'fac_update_freq',
+                       'comm_precision'),
+                 freq_bounds=None, comm_precisions=COMM_PRECISIONS,
+                 predicted=None, platform=None, variant=None,
+                 anchor='central', decision_log=None, log=None,
+                 clock=time.monotonic):
+        if window < 2:
+            raise ValueError(f'window must be >= 2, got {window}')
+        self.precond = precond
+        self.arbiter = arbiter_for(precond)
+        self.window = int(window)
+        self.settle = int(settle)
+        self.rel_improve = float(rel_improve)
+        self.dwell_windows = int(dwell_windows)
+        self.cooldown = int(cooldown)
+        self.steady_every = int(steady_every)
+        self.tune = tuple(tune)
+        kf0 = int(getattr(precond, 'kfac_update_freq', 1) or 1)
+        self.freq_bounds = (tuple(freq_bounds) if freq_bounds
+                            else (1, max(8, kf0 * 8)))
+        self.comm_precisions = tuple(comm_precisions)
+        self.predicted = predicted
+        self.platform = platform
+        self.variant = variant or getattr(precond, 'variant', 'inverse_dp')
+        self.anchor = anchor
+        self.decision_log = decision_log
+        import logging
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self.clock = clock
+        # measurement state
+        self._acc = {}          # frozenset(phases) -> [seconds, ...]
+        self._n = 0
+        self._settle_left = self.settle
+        self._last = None
+        self._step = -1
+        # policy state
+        self.state = 'baseline'
+        self.baseline_t = None
+        self.windows = 0
+        self._candidate = None      # (knob, old, new)
+        self._cooldowns = {}        # (knob, value) -> retry-at window idx
+        self._rotation = 0
+        self._dwell_left = 0
+        self._steady_since = None
+        self._seeded = 'seed' if predicted is not None else 'done'
+        self.comm_mode_choice = None
+        # counters / artifacts
+        self.commits = 0
+        self.reverts = 0
+        self.vetoes = 0
+        self.advisory_violations = 0
+        self.decisions = deque(maxlen=256)
+        self.last_window = None
+
+    # -- feeds -------------------------------------------------------------
+
+    def tick(self, step=None, phases=()):
+        """Inter-arrival feed (the trainer wiring): measures the time
+        since the previous tick — the full host step, blocking metric
+        read included — and attributes it to the phase set of the
+        dispatch that interval covered. ``build_train_step`` ticks at
+        the top of ``step_fn``, BEFORE this step's dispatch updates
+        ``step_fn.last_phases`` — so the ``phases`` argument still
+        names the previous dispatch, which is exactly the one the
+        just-ended interval timed."""
+        now = self.clock()
+        if self._last is not None:
+            self.record(tuple(phases), now - self._last, step=step)
+        self._last = now
+
+    def record(self, phases, seconds, step=None):
+        """One measured step. ``phases`` is the host phase set
+        ('pred'/'stats'/'decomp'/'gather'); ``seconds`` its wall time.
+        Deterministic by construction — no clock is read here."""
+        self._step = int(step) if step is not None else self._step + 1
+        if self._seeded == 'seed':
+            self._seed()
+        if self._settle_left > 0:
+            # post-change settle: recompiles / first traces of a fresh
+            # knob set must not pollute the window
+            self._settle_left -= 1
+            return
+        if self.arbiter.stretch != 1:
+            # straggler emergency in force: not a tuning signal
+            self._reset_window()
+            return
+        self._acc.setdefault(frozenset(phases), []).append(float(seconds))
+        self._n += 1
+        if self._n >= self.window:
+            self._window_done()
+
+    # -- seeding -----------------------------------------------------------
+
+    def _freq_ladder(self):
+        lo, hi = self.freq_bounds
+        ladder, v = [], max(1, int(lo))
+        while v <= hi:
+            ladder.append(v)
+            v *= 2
+        return ladder or [max(1, int(lo))]
+
+    def _seed(self):
+        self._seeded = 'done'
+        if 'kfac_update_freq' not in self.tune:
+            return
+        best = prior_best_freq(
+            self.predicted, self.variant, self._freq_ladder(),
+            fac_update_freq=getattr(self.precond, 'fac_update_freq', 1)
+            or 1, anchor=self.anchor)
+        cur = getattr(self.precond, 'kfac_update_freq', None)
+        if best is None or cur is None or best == cur:
+            return
+        self.arbiter.propose('tuner', kfac_update_freq=best)
+        self._decision('seed', knob='kfac_update_freq', frm=cur, to=best)
+        self.log.info('autotune: seeded kfac_update_freq=%d from '
+                      'perfmodel prior (%s)', best, self.anchor)
+        self._instant('autotune_seed', kfac_update_freq=best)
+        self._settle_left = self.settle
+        # the seeded value becomes the config the first baseline measures
+
+    # -- the window --------------------------------------------------------
+
+    def _reset_window(self):
+        self._acc, self._n = {}, 0
+        self._settle_left = self.settle
+
+    def _window_done(self):
+        # the objective: mean step seconds over the window, with the
+        # outlier screen applied per phase set (a refresh step's real
+        # spike is weighed at its true frequency; host noise is not)
+        means = {k: _robust_mean(v) for k, v in self._acc.items()}
+        n = sum(len(v) for v in self._acc.values())
+        t = sum(means[k] * len(v) for k, v in self._acc.items()) / n
+        measured = _taxonomy_seconds(_marginals(means))
+        self.windows += 1
+        self.last_window = {'window': self.windows, 'time_s': t,
+                            'measured': measured,
+                            'knobs': _capture(self.precond)}
+        self._reset_window()
+        if self.state == 'baseline':
+            self.baseline_t = t
+            self._maybe_comm_mode(measured)
+            self._next_probe()
+        elif self.state == 'probe':
+            self._judge(t, measured)
+        elif self.state == 'dwell':
+            self.baseline_t = t  # track drift of the committed config
+            self._dwell_left -= 1
+            if self._dwell_left <= 0:
+                self._next_probe()
+        elif self.state == 'steady':
+            self.baseline_t = t
+            if (self.steady_every
+                    and self.windows - self._steady_since
+                    >= self.steady_every):
+                self._cooldowns.clear()
+                self._next_probe()
+
+    # -- candidates --------------------------------------------------------
+
+    def _candidates(self):
+        out = []
+        lo, hi = self.freq_bounds
+        for knob in self.tune:
+            if knob in ('kfac_update_freq', 'fac_update_freq'):
+                cur = getattr(self.precond, knob, None)
+                if cur is None:
+                    continue
+                if cur * 2 <= hi:
+                    out.append((knob, cur, cur * 2))
+                if cur // 2 >= lo and cur // 2 != cur:
+                    out.append((knob, cur, cur // 2))
+            elif knob == 'comm_precision':
+                cur = getattr(self.precond, 'comm_precision', None)
+                # wire compression only exists where collectives exist
+                if cur is None or getattr(self.precond, 'axis_name',
+                                          None) is None:
+                    continue
+                i = self.comm_precisions.index(cur) \
+                    if cur in self.comm_precisions else 0
+                if i + 1 < len(self.comm_precisions):
+                    out.append((knob, cur, self.comm_precisions[i + 1]))
+                if i > 0:
+                    out.append((knob, cur, self.comm_precisions[i - 1]))
+        return out
+
+    def _next_probe(self):
+        cands = self._candidates()
+        for i in range(len(cands)):
+            knob, old, new = cands[(self._rotation + i) % len(cands)]
+            if self._cooldowns.get((knob, new), 0) > self.windows:
+                continue
+            self._rotation = (self._rotation + i + 1) % max(1, len(cands))
+            self._candidate = (knob, old, new)
+            self.arbiter.propose('tuner', **{knob: new})
+            self.state = 'probe'
+            self._decision('probe', knob=knob, frm=old, to=new)
+            self.log.info('autotune: probing %s %s -> %s at step %d '
+                          '(window %d)', knob, old, new, self._step,
+                          self.windows)
+            self._instant('autotune_probe', knob=knob, to=str(new))
+            return
+        if self.state != 'steady':
+            self.state = 'steady'
+            self._steady_since = self.windows
+            k = _capture(self.precond)
+            self._decision('steady', knobs=k)
+            self.log.info(
+                'autotune: steady state — knobs fac=%d kfac=%d '
+                'comm_precision=%s after %d windows at step %d',
+                k['fac_update_freq'] or 0, k['kfac_update_freq'] or 0,
+                k['comm_precision'] or 'fp32', self.windows, self._step)
+            self._instant('autotune_steady', windows=self.windows)
+
+    def _judge(self, t, measured):
+        knob, old, new = self._candidate
+        improved = t < self.baseline_t * (1 - self.rel_improve)
+        vetoed = improved and self._drift_veto(measured, knob, new)
+        if improved and not vetoed:
+            self.commits += 1
+            self._bump('autotune_commits')
+            gain = 100.0 * (1 - t / self.baseline_t)
+            self._decision('commit', knob=knob, frm=old, to=new,
+                           before_s=self.baseline_t, after_s=t)
+            self.log.info(
+                'autotune: committed %s %s -> %s (step time %.6fs -> '
+                '%.6fs, -%.1f%%) at step %d', knob, old, new,
+                self.baseline_t, t, gain, self._step)
+            self._instant('autotune_commit', knob=knob, to=str(new))
+            self.baseline_t = t
+            self._candidate = None
+            self.state = 'dwell'
+            self._dwell_left = self.dwell_windows
+        else:
+            self.arbiter.propose('tuner', **{knob: old})
+            self.reverts += 1
+            self._bump('autotune_reverts')
+            self._cooldowns[(knob, new)] = self.windows + self.cooldown
+            if not vetoed:
+                self._decision('revert', knob=knob, frm=new, to=old,
+                               baseline_s=self.baseline_t, probe_s=t)
+                self.log.info(
+                    'autotune: reverted %s %s -> %s (no improvement: '
+                    '%.6fs -> %.6fs) at step %d', knob, new, old,
+                    self.baseline_t, t, self._step)
+                self._instant('autotune_revert', knob=knob, to=str(old))
+            self._candidate = None
+            self._settle_left = self.settle
+            self._next_probe()
+
+    # -- gates -------------------------------------------------------------
+
+    def _drift_veto(self, measured, knob, value):
+        """The obs/drift band gate over this window's phase marginals.
+        Verdict 'drift' — only reachable when the platform IS the chip
+        the perf model describes — vetoes the candidate; on any other
+        platform the gate is advisory (violations counted, commit
+        allowed). No predicted block = no gate."""
+        if not self.predicted:
+            return False
+        try:
+            from kfac_pytorch_tpu.obs import drift
+            verdict, violations = drift.gate(
+                {k: v for k, v in measured.items()
+                 if k not in ('step_mean', 'step_max')},
+                self.predicted, platform=self.platform,
+                variant=self.variant, anchor=self.anchor,
+                comm_precision=getattr(self.precond, 'comm_precision',
+                                       'fp32') or 'fp32',
+                source='autotune')
+            if verdict == 'drift':
+                self.vetoes += 1
+                self._bump('autotune_vetoes')
+                self._decision('veto', knob=knob, value=value,
+                               violations=violations)
+                self.log.warning(
+                    'autotune: drift veto — knob %s %s rejected '
+                    '(violations=%s) at step %d', knob, value,
+                    ','.join(violations), self._step)
+                self._instant('autotune_veto', knob=knob,
+                              violations=violations)
+                return True
+            if violations:
+                self.advisory_violations += len(violations)
+        except Exception:  # noqa: BLE001 — the gate must never take the
+            return False   # trainer down; an error gate is no gate
+        return False
+
+    def _maybe_comm_mode(self, measured):
+        """One-shot advisory comm-mode decision from the layout's
+        analytic per-step collective bytes at the current cadence
+        (comm_inverse amortizes its gather over kfac_update_freq steps;
+        comm_pred ships preconditioned grads every step). ADVISORY:
+        switching modes rebuilds the factor plan and the state layout —
+        the decision is recorded/logged for the operator, never applied
+        live."""
+        if self.comm_mode_choice is not None:
+            return
+        plan = getattr(self.precond, 'plan', None)
+        if plan is None or getattr(self.precond, 'axis_name', None) is None:
+            return
+        vols = comm_mode_bytes(plan, getattr(self.precond, 'method', None),
+                               getattr(self.precond, 'comm_precision',
+                                       'fp32') or 'fp32')
+        if not vols:
+            return
+        choice, per_step = decide_comm_mode(
+            vols, getattr(self.precond, 'kfac_update_freq', 1) or 1)
+        self.comm_mode_choice = choice
+        self._decision('comm_mode', mode=choice, per_step_bytes=per_step,
+                       current=getattr(self.precond, 'comm_mode', None))
+        self.log.info(
+            'autotune: comm_mode decision %s (inverse %.1f KiB/step vs '
+            'pred %.1f KiB/step) at step %d', choice,
+            per_step['inverse'] / 1024.0, per_step['pred'] / 1024.0,
+            self._step)
+        self._instant('autotune_comm_mode', mode=choice)
+
+    # -- artifacts ---------------------------------------------------------
+
+    def _decision(self, kind, **fields):
+        d = {'kind': kind, 'window': self.windows, 'step': self._step}
+        d.update(fields)
+        self.decisions.append(d)
+        if self.decision_log:
+            try:
+                dirn = os.path.dirname(self.decision_log)
+                if dirn:
+                    os.makedirs(dirn, exist_ok=True)
+                with open(self.decision_log, 'a') as f:
+                    f.write(json.dumps(d) + '\n')
+            except OSError:
+                pass
+        return d
+
+    def _instant(self, name, **args):
+        try:
+            from kfac_pytorch_tpu.obs import trace as _trace
+            _trace.instant(name, cat='autotune', step=self._step, **args)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _bump(self, name):
+        try:
+            from kfac_pytorch_tpu import resilience as _res
+            _res.counters.bump(name)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- reporting ---------------------------------------------------------
+
+    def counts(self):
+        """Counter dict in the resilience epoch-suffix shape (feeds the
+        registry collector like ``StragglerGovernor.counts``)."""
+        return {'autotune_commits': self.commits,
+                'autotune_reverts': self.reverts,
+                'autotune_vetoes': self.vetoes}
+
+    def collect(self, registry):
+        """``obs.metrics.Registry`` collector: current knob gauges +
+        cumulative decision counters."""
+        k = _capture(self.precond)
+        for name in ('fac_update_freq', 'kfac_update_freq'):
+            if k[name] is not None:
+                registry.gauge('autotune/' + name).set(k[name])
+        try:
+            from kfac_pytorch_tpu.parallel.collectives import \
+                WIRE_COMPRESSION
+            if k['comm_precision'] in WIRE_COMPRESSION:
+                registry.gauge('autotune/comm_wire_factor').set(
+                    WIRE_COMPRESSION[k['comm_precision']])
+        except ImportError:
+            pass
+        registry.counter('autotune/commits').set_total(self.commits)
+        registry.counter('autotune/reverts').set_total(self.reverts)
+        registry.counter('autotune/vetoes').set_total(self.vetoes)
+
+    def report(self):
+        """The ``autotune`` block for ``bench.py`` extras / smoke
+        artifacts: final knob state + the decision-log tail."""
+        return {
+            'enabled': True,
+            'state': self.state,
+            'windows': self.windows,
+            'knobs': _capture(self.precond),
+            'comm_mode_choice': self.comm_mode_choice,
+            'commits': self.commits,
+            'reverts': self.reverts,
+            'vetoes': self.vetoes,
+            'advisory_violations': self.advisory_violations,
+            'last_window_s': (self.last_window or {}).get('time_s'),
+            'decisions_tail': list(self.decisions)[-10:],
+        }
+
+
+def controller_from_args(precond, *, enabled, trace_dir=None,
+                         predicted=None, variant=None, log=None):
+    """The trainers' shared constructor: returns a
+    :class:`KnobController` (decision log under ``trace_dir`` when
+    tracing is on) or None. ``predicted`` should be the perf-model
+    block ONLY when the run matches the workload the model describes
+    (the imagenet resnet50 bs32 config) — the drift gate judges phase
+    ratios against it; other workloads run ungated (advisory-free)."""
+    if not enabled or precond is None:
+        return None
+    decision_log = (os.path.join(trace_dir, 'autotune-decisions.jsonl')
+                    if trace_dir else None)
+    platform = None
+    try:
+        import jax
+        platform = getattr(jax.devices()[0], 'device_kind', None)
+    except Exception:  # noqa: BLE001 — platform is advisory metadata
+        pass
+    return KnobController(precond, predicted=predicted, platform=platform,
+                          variant=variant, decision_log=decision_log,
+                          log=log)

@@ -40,16 +40,19 @@ from kfac_pytorch_tpu.obs import drift, metrics, trace
 __all__ = ['trace', 'metrics', 'drift', 'setup_trainer']
 
 
-def setup_trainer(trace_dir=None, prom_file=None, governor=None):
+def setup_trainer(trace_dir=None, prom_file=None, governor=None,
+                  tuner=None):
     """The example trainers' shared observability bootstrap.
 
     Installs the process-default trace recorder (``trace_dir`` wins
     over ``KFAC_TRACE_DIR``; None + no env = tracing off), builds the
     metrics registry with the resilience-counter collector (plus a
-    ``StragglerGovernor``'s counts when given), and attaches the
-    JSONL/Prometheus exporters the flags ask for. The TensorBoard
-    exporter is NOT attached here — the trainers construct their writer
-    later and add it themselves. Returns ``(tracer_or_None, registry)``.
+    ``StragglerGovernor``'s and an ``autotune.KnobController``'s counts
+    when given — the tuner also publishes its current knob gauges), and
+    attaches the JSONL/Prometheus exporters the flags ask for. The
+    TensorBoard exporter is NOT attached here — the trainers construct
+    their writer later and add it themselves. Returns
+    ``(tracer_or_None, registry)``.
     """
     if trace_dir:
         pid = int(_os.environ.get('JAX_PROCESS_ID', '0'))
@@ -58,8 +61,10 @@ def setup_trainer(trace_dir=None, prom_file=None, governor=None):
     else:
         tracer = trace.install_from_env()
     reg = metrics.Registry()
-    reg.add_collector(metrics.resilience_collector(
-        *((governor.counts,) if governor is not None else ())))
+    extra_counts = [c.counts for c in (governor, tuner) if c is not None]
+    reg.add_collector(metrics.resilience_collector(*extra_counts))
+    if tuner is not None:
+        reg.add_collector(tuner.collect)
     if trace_dir:
         reg.add_exporter(metrics.JsonlExporter(
             _os.path.join(trace_dir, 'metrics.jsonl')))

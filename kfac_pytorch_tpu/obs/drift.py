@@ -252,6 +252,18 @@ def drift_block(measured_s, predicted_block, *, platform=None,
                 'error': f'{type(e).__name__}: {e}'}
 
 
+def gate(measured_s, predicted_block, **kw):
+    """``(verdict, violations)`` shortcut over :func:`drift_block` for
+    callers that only consume the gate — the autotuner's commit veto:
+    'drift' (reachable only on the modeled chip) rejects a knob change,
+    'advisory'/'ok'/'no_overlap' let it through. Keyword args pass
+    through to :func:`drift_block` (platform / variant / anchor /
+    comm_precision / tolerance)."""
+    block = drift_block(measured_s, predicted_block, **kw)
+    g = block.get('gate') or {}
+    return g.get('verdict'), g.get('violations') or []
+
+
 def micro_measured(micro):
     """Adapter for the CPU-fallback micro-bench block: its steady step
     runs model+precondition+stats fused; the unstaggered refresh step

@@ -239,6 +239,33 @@ def predict(inputs=None):
     return out
 
 
+def prior_phase_costs(block, variant='inverse_dp', anchor='central'):
+    """Per-phase prior seconds for the autotuner's pre-measurement
+    seeding (``autotune.prior_best_freq``): pull the ``anchor``
+    scenario's phase predictions out of a ``predict_block()`` dict and
+    bind the decomposition phase to the variant's kernel (the fenced
+    full eigh for eigen/ekfac, the analytic Cholesky otherwise —
+    the same binding ``obs.drift._predicted_phase`` uses). Returns
+    ``{'model', 'precondition', 'factor', 'decomp'}`` seconds, or ``{}``
+    when the block carries no usable phases (the tuner then starts from
+    the configured cadence instead of a prior)."""
+    try:
+        ph = block['scenarios'][anchor]['phases_s']
+    except (KeyError, TypeError):
+        return {}
+    eigen = str(variant).startswith(('eigen', 'ekfac'))
+    out = {
+        'model': ph.get('Model'),
+        'precondition': ph.get('Precondition'),
+        'factor': ph.get('ComputeFactor'),
+        'decomp': ph.get('ComputeInverse_eigh_full' if eigen
+                         else 'ComputeInverse_chol'),
+    }
+    if any(v is None for v in out.values()):
+        return {}
+    return {k: float(v) for k, v in out.items()}
+
+
 def predict_block(inputs=None):
     """The self-describing block bench.py embeds in its JSON extras."""
     try:

@@ -106,7 +106,8 @@ class FactorPlan:
     def num_layers(self):
         return len(self.metas)
 
-    def comm_volume(self, *, stats_reduce, method, comm_precision='fp32'):
+    def comm_volume(self, *, stats_reduce, method, comm_precision='fp32',
+                    comm_mode=None):
         """Analytic per-phase collective payload bytes of ONE full
         factor+inverse K-FAC step under this layout — the model the
         HLO-level ledger (scripts/comm_count.py) measures, stated in
@@ -129,6 +130,10 @@ class FactorPlan:
         Cadence is the caller's: FactorComm recurs every
         ``fac_update_freq`` steps, InverseComm every
         ``kfac_update_freq`` (or 1/F of it per step under stagger).
+
+        ``comm_mode`` overrides the plan's own mode (the autotuner's
+        advisory comm-mode decision computes BOTH roads from one
+        layout); default None = this plan's mode.
         """
         from kfac_pytorch_tpu.parallel import collectives as coll
         coll.check_wire_dtype(comm_precision)
@@ -143,7 +148,7 @@ class FactorPlan:
         if stats_reduce == 'pmean':
             factor = sum(b.per_dev * b.dim * b.dim * reduce_wire
                          for b in self.buckets.values())
-        if self.comm_mode == 'inverse':
+        if (comm_mode or self.comm_mode) == 'inverse':
             for b in self.buckets.values():
                 inverse += b.n_rows * b.dim * b.dim * wire
                 inverse += b.n_rows * scale_b

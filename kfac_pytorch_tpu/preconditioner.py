@@ -347,6 +347,13 @@ class KFAC:
         self.exclude_communicate_factor = 'CommunicateFactor' in exclude_parts
         self.exclude_compute_factor = 'ComputeFactor' in exclude_parts
         self.plan = None
+        # the single writer of the runtime knobs (fac/kfac_update_freq,
+        # damping, comm_precision): lazily created by
+        # autotune.arbiter_for — KFACParamScheduler, the straggler
+        # governor and the online tuner all PROPOSE to it instead of
+        # assigning these attributes (tests/test_autotune.py pins that
+        # nothing else writes them)
+        self._knob_arbiter = None
 
     # -- setup ------------------------------------------------------------
 

@@ -95,6 +95,40 @@ _PATTERNS = (
         r'WORLD_RESCALE from_world=(?P<from>\d+) to_world=(?P<to>\d+) '
         r'global_batch=(?P<global_batch>\d+) '
         r'lr=(?P<lr>[\d.eE+-]+) lr_factor=(?P<lr_factor>[\d.eE+-]+)')),
+    # the closed-loop autotuner (kfac_pytorch_tpu/autotune.py): one
+    # event per controller decision — seed from the perf-model prior,
+    # probe/commit/revert of one knob candidate, the drift-band veto,
+    # steady-state arrival, and the advisory comm-mode verdict — so a
+    # kfac-obs timeline renders the whole tuning trajectory from the
+    # run logs with zero new aggregate code (the same shared-grammar
+    # contract the grow/partition stories use)
+    ('autotune_seed', re.compile(
+        r'autotune: seeded kfac_update_freq=(?P<kfac>\d+) from '
+        r'perfmodel prior \((?P<anchor>\w+)\)')),
+    ('autotune_probe', re.compile(
+        r'autotune: probing (?P<knob>[\w_]+) (?P<from>\S+) -> '
+        r'(?P<to>\S+) at step (?P<step>\d+) \(window (?P<window>\d+)\)')),
+    ('autotune_commit', re.compile(
+        r'autotune: committed (?P<knob>[\w_]+) (?P<from>\S+) -> '
+        r'(?P<to>\S+) \(step time (?P<before_s>[\d.]+)s -> '
+        r'(?P<after_s>[\d.]+)s, -(?P<gain_pct>[\d.]+)%\) at step '
+        r'(?P<step>\d+)')),
+    ('autotune_revert', re.compile(
+        r'autotune: reverted (?P<knob>[\w_]+) (?P<from>\S+) -> '
+        r'(?P<to>\S+) \(no improvement: (?P<baseline_s>[\d.]+)s -> '
+        r'(?P<probe_s>[\d.]+)s\) at step (?P<step>\d+)')),
+    ('autotune_veto', re.compile(
+        r'autotune: drift veto — knob (?P<knob>[\w_]+) (?P<value>\S+) '
+        r'rejected \(violations=(?P<violations>[^)]*)\) at step '
+        r'(?P<step>\d+)')),
+    ('autotune_steady', re.compile(
+        r'autotune: steady state — knobs fac=(?P<fac>\d+) '
+        r'kfac=(?P<kfac>\d+) comm_precision=(?P<comm_precision>\w+) '
+        r'after (?P<windows>\d+) windows at step (?P<step>\d+)')),
+    ('autotune_comm_mode', re.compile(
+        r'autotune: comm_mode decision (?P<mode>\w+) \(inverse '
+        r'(?P<inverse_kib>[\d.]+) KiB/step vs pred '
+        r'(?P<pred_kib>[\d.]+) KiB/step\) at step (?P<step>\d+)')),
     ('straggler_degrade', re.compile(
         r'straggler: step-time EMA (?P<ema_s>[\d.]+)s over budget '
         r'(?P<budget_s>[\d.]+)s(?: at step (?P<step>\d+))? — stretching '

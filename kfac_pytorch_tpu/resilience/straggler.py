@@ -17,6 +17,18 @@ level, capped at ``max_level``). EMA back under
 ``budget * recover_fraction``: restore the saved frequencies entirely.
 Same shape as health.py's damping ladder, one level up the stack.
 
+The governor is a PROPOSER: it never writes the frequency attributes
+itself — it proposes its stretch multiplier (``stretch**level``, 1 =
+recovered) to the preconditioner's single knob arbiter
+(``autotune.arbiter_for``), which composes it with the
+KFACParamScheduler's epoch factors and the online tuner's overrides.
+An epoch advance mid-stretch decays the BASE cadence while the stretch
+stays in force; recovery removes only the stretch — neither side can
+clobber the other (the last-writer-wins race the old direct writes
+had). A direct external write of the freqs (a legacy caller) is
+adopted by the arbiter as the new base, exactly the collision rule the
+governor used to implement locally.
+
 Clock and sleep are injectable so the chaos drill
 (``KFAC_FAULT_SLOW_STEP`` + a ManualClock) is deterministic — no
 wall-clock in the loop at all.
@@ -69,8 +81,6 @@ class StragglerGovernor:
         self.recoveries = 0
         self._seen = 0
         self._last = None
-        self._saved = None    # (fac, kfac) freqs at level 0
-        self._applied = None  # what WE last set (scheduler-collision check)
 
     # -- measurement ------------------------------------------------------
 
@@ -100,13 +110,16 @@ class StragglerGovernor:
     def _freqs(self):
         return (self.precond.fac_update_freq, self.precond.kfac_update_freq)
 
+    def _arbiter(self):
+        from kfac_pytorch_tpu import autotune
+        return autotune.arbiter_for(self.precond)
+
     def _degrade(self, step):
-        if self.level == 0:
-            self._saved = self._freqs()
-        elif self._applied is not None and self._freqs() != self._applied:
-            # someone else (KFACParamScheduler's epoch step) rewrote the
-            # freqs under us: treat the current values as the new base
-            self._saved = self._freqs()
+        arb = self._arbiter()
+        if arb.adopt_external():
+            # someone wrote the freqs directly (a legacy caller, not an
+            # arbiter proposer): the arbiter adopted them as the new
+            # base — restart the ladder from there
             self.level = 0
         self.level += 1
         self.degrades += 1
@@ -117,37 +130,28 @@ class StragglerGovernor:
                            ema_s=round(self.ema, 4), step=step)
         except Exception:  # noqa: BLE001 — tracing never blocks the ladder
             pass
-        factor = self.stretch ** self.level
-        self._applied = (max(1, self._saved[0] * factor),
-                         max(1, self._saved[1] * factor))
-        (self.precond.fac_update_freq,
-         self.precond.kfac_update_freq) = self._applied
+        arb.propose('straggler', stretch=self.stretch ** self.level)
+        fac, kfac = self._freqs()
         self.log.warning(
             'straggler: step-time EMA %.3fs over budget %.3fs%s — '
             'stretching update freqs to fac=%d kfac=%d (level %d/%d)',
             self.ema, self.budget,
             f' at step {step}' if step is not None else '',
-            self._applied[0], self._applied[1], self.level, self.max_level)
+            fac, kfac, self.level, self.max_level)
 
     def _recover(self, step):
-        if self._applied is not None and self._freqs() == self._applied:
-            (self.precond.fac_update_freq,
-             self.precond.kfac_update_freq) = self._saved
-            self.log.info(
-                'straggler: recovered (EMA %.3fs)%s — update freqs '
-                'restored to fac=%d kfac=%d', self.ema,
-                f' at step {step}' if step is not None else '',
-                self._saved[0], self._saved[1])
-        else:
-            # the scheduler re-based the freqs while we were degraded;
-            # its values are authoritative — just stand down
-            self.log.info(
-                'straggler: recovered (EMA %.3fs) — freqs were re-based '
-                'externally, leaving fac=%d kfac=%d', self.ema,
-                *self._freqs())
+        # removing the stretch leaves whatever base x schedule x tuner
+        # cadence is in force — a scheduler epoch advance (or an
+        # external rebase, adopted by the arbiter) mid-stretch is
+        # preserved, never clobbered with stale saved values
+        self._arbiter().propose('straggler', stretch=1)
+        fac, kfac = self._freqs()
+        self.log.info(
+            'straggler: recovered (EMA %.3fs)%s — update freqs '
+            'restored to fac=%d kfac=%d', self.ema,
+            f' at step {step}' if step is not None else '', fac, kfac)
         self.level = 0
         self.recoveries += 1
-        self._applied = None
         _res.counters.bump('straggler_recoveries')
         try:
             from kfac_pytorch_tpu.obs import trace as _trace

@@ -5,7 +5,17 @@ kfac_preconditioner_base.py:233-301) — multiplicative decay of damping and
 of the factor/inverse update frequencies at listed epochs. Here damping is
 a host float fed to the traced step as a scalar (no recompilation) and the
 frequencies gate which compiled step variant the trainer invokes.
+
+The scheduler is a PROPOSER, not a writer: it computes the epoch's
+multiplicative factors and hands them to the preconditioner's single
+knob arbiter (``autotune.arbiter_for``), which composes them with the
+straggler governor's stretch and the online tuner's overrides and
+applies the result once — an epoch advance while the governor is
+stretched can no longer clobber either side's intent (the
+last-writer-wins race this class used to be one half of).
 """
+
+from kfac_pytorch_tpu import autotune
 
 
 class KFACParamScheduler:
@@ -13,12 +23,13 @@ class KFACParamScheduler:
                  update_freq_alpha=1, update_freq_schedule=None,
                  start_epoch=0):
         self.kfac = kfac
-        self.damping_base = kfac.damping
+        # the bases the factors apply to live in the arbiter
+        # (autotune.arbiter_for(kfac).base), captured there so an
+        # external-write adoption can move them — this class holds no
+        # knob state of its own
         self.damping_alpha = damping_alpha
         self.damping_factor_func = self._factor_func(
             damping_schedule, damping_alpha)
-        self.fac_update_freq_base = kfac.fac_update_freq
-        self.kfac_update_freq_base = kfac.kfac_update_freq
         self.update_freq_factor_func = self._factor_func(
             update_freq_schedule, update_freq_alpha)
         self.epoch = start_epoch
@@ -39,19 +50,14 @@ class KFACParamScheduler:
         return factor
 
     def _apply(self):
-        self.kfac.damping = (self.damping_base
-                             * self.damping_factor_func(self.epoch))
-        f = self.update_freq_factor_func(self.epoch)
-        self.kfac.fac_update_freq = max(1, int(self.fac_update_freq_base * f))
-        self.kfac.kfac_update_freq = max(1, int(self.kfac_update_freq_base * f))
-        # staggered refresh: the cohort layout is derived from
-        # kfac_update_freq (one cohort per step of the window) — a
-        # rescaled frequency must rebase it, like the staleness-based
-        # last_full_step rebase of should_update_basis. No-op when
-        # stagger is off or the frequency didn't change.
-        rebase = getattr(self.kfac, 'rebase_cohorts', None)
-        if rebase is not None:
-            rebase()
+        # one arbiter applies the composed knob set (damping/freq bases
+        # x this schedule's factors x any straggler stretch or tuner
+        # override) and rebases the staggered cohort layout exactly once
+        # per change — this class never writes the KFAC attributes
+        autotune.arbiter_for(self.kfac).propose(
+            'schedule',
+            damping_factor=self.damping_factor_func(self.epoch),
+            freq_factor=self.update_freq_factor_func(self.epoch))
 
     def step(self, epoch=None):
         """Advance to ``epoch`` (or by one) and update the wrapped KFAC's

@@ -155,7 +155,8 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
                      dropout_seed=None, batch_specs=None, check_vma=None,
                      fisher_type='Femp', fisher_loss_fn=None,
                      fisher_sample_fn=None, fisher_seed=0, health='auto',
-                     straggler=None, heartbeat=None, tracer=None):
+                     straggler=None, heartbeat=None, tracer=None,
+                     autotune=None):
     """Build the per-iteration function family.
 
     Args:
@@ -246,6 +247,16 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         but keeps beating, which is exactly the split the pod needs —
         the heartbeat answers "alive?", the watchdog answers
         "progressing?".
+      autotune: an ``autotune.KnobController`` (or None). When set,
+        every host step ticks the controller with the inter-arrival
+        time of step_fn calls (the same full-host-step measurement the
+        straggler governor uses) attributed to the PREVIOUS dispatch's
+        phase set — the closed loop's measurement feed. The
+        controller's knob changes flow through the preconditioner's
+        single arbiter; a frequency change reuses this step_fn's
+        compiled variant cache, a ``comm_precision`` change clears it
+        (the arbiter invalidator registered below) so no stale program
+        can keep the old wire dtype.
       tracer: an ``obs.trace.TraceRecorder`` (or None). When set, every
         dispatch is recorded as a ``kfac.dispatch`` span carrying the
         step index and the dispatched phase set in the exclude-parts
@@ -478,6 +489,11 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         # the NEXT tick's interval, like any real stall would)
         if straggler is not None:
             straggler.tick(step)
+        if autotune is not None:
+            # the interval that just ended covered the PREVIOUS
+            # dispatch's phase set — attribute it there, like the
+            # PhaseTimers wall-time bucketing
+            autotune.tick(step, step_fn.last_phases)
         if heartbeat is not None:
             heartbeat.tick(step)
         # host-side chaos drills (all no-ops unless env-configured):
@@ -503,6 +519,18 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
             # variant sees one state structure
             state = state.replace(kfac_state=state.kfac_state.replace(
                 comm_err=precond._zero_comm_err()))
+        if (precond is not None and state.kfac_state is not None
+                and not getattr(precond, '_tracks_comm_err', False)
+                and state.kfac_state.comm_err is not None):
+            # the DOWNGRADE direction of the same upgrade: the autotuner
+            # (or a restart at fp32) switched the wire dtype off a lossy
+            # mode mid-run — drop the EF residual host-side so every
+            # variant sees one state structure; the residual is a
+            # correction term, never load-bearing (discarding it costs
+            # one reduce's worth of feedback, the same contract the
+            # lossy-checkpoint-into-fp32 restore already accepts)
+            state = state.replace(kfac_state=state.kfac_state.replace(
+                comm_err=None))
         if 'yes' not in seen_inverse:
             # one-time: a restored checkpoint may already carry a
             # decomposition (utils/checkpoint.py include_kfac=True)
@@ -657,6 +685,14 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     # it WITHOUT executing a step (AOT lower/compile only)
     step_fn.variants = variants
     step_fn.make_variant = make_variant
+    if precond is not None:
+        # trace-affecting knob changes (comm_precision through the knob
+        # arbiter — scheduler/straggler/tuner frequency changes are
+        # host-side gating and deliberately NOT invalidating) clear the
+        # compiled-variant cache so no stale program keeps the old wire
+        # dtype; the next dispatch retraces against the new config
+        from kfac_pytorch_tpu.autotune import arbiter_for
+        arbiter_for(precond).add_invalidator(variants.clear)
     return step_fn
 
 

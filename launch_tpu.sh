@@ -65,6 +65,22 @@ if [ -n "$KFAC_COMM_PRECISION" ]; then
   esac
 fi
 
+# Closed-loop autotuning: KFAC_AUTOTUNE=1 enables the online knob
+# controller in every trainer of the run (the trainers read it as the
+# --kfac-autotune default; an explicit flag still wins). The controller
+# hill-climbs kfac/fac_update_freq and the comm wire dtype from
+# measured step times through the single knob arbiter, with drift-band
+# vetoes on the modeled workload; decisions land in the run log
+# (kfac-obs renders them) and, under KFAC_TRACE_DIR, in
+# <dir>/autotune-decisions.jsonl. See README "Closed-loop autotuning".
+if [ -n "$KFAC_AUTOTUNE" ]; then
+  case "$KFAC_AUTOTUNE" in
+    0|1) export KFAC_AUTOTUNE ;;
+    *) echo "launch_tpu.sh: KFAC_AUTOTUNE must be 0 or 1," \
+            "got '$KFAC_AUTOTUNE'" >&2; exit 1 ;;
+  esac
+fi
+
 if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi

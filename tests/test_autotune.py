@@ -1,0 +1,841 @@
+"""Closed-loop autotuner (kfac_pytorch_tpu/autotune.py).
+
+Pins the tentpole contracts:
+
+1. The arbiter is the ONLY writer of the runtime knobs: the
+   KFACParamScheduler and the StragglerGovernor propose factors /
+   stretches and never assign ``fac_update_freq`` /
+   ``kfac_update_freq`` / ``damping`` themselves (a ``__setattr__``
+   guard proves every write happens inside ``arbiter._commit``), and
+   the composed result is schedule x stretch x tuner over the
+   construction-time base.
+2. The scheduler x governor interplay that used to be last-writer-wins
+   is now order-free: an epoch advance mid-stretch decays the BASE
+   while the stretch stays in force; recovery removes only the stretch
+   (ManualClock, fully deterministic).
+3. The controller converges to a planted optimum on a deterministic
+   synthetic phase-time feed (no wall clock anywhere), with hysteresis
+   (no knob flap inside the dwell window, cooldown after a revert,
+   bounded probing in steady state).
+4. The drift-band gate: on the modeled chip a measured phase ratio
+   outside [optimistic, conservative] VETOES an otherwise-improving
+   candidate; on any other platform the same feed commits (advisory).
+5. Knob changes reuse the compiled variant cache (frequency moves
+   compile nothing new when revisited) while a ``comm_precision``
+   change clears it through the registered invalidator — and the
+   mid-run fp32 -> bf16 -> fp32 wire switch keeps the EF-residual
+   state structure consistent and checkpoints restorable.
+6. Decisions are artifacts: JSONL decision log, ``report()`` block for
+   bench extras, and log lines in the shared ``incident``
+   event grammar (kfac-obs renders tuning timelines for free).
+"""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import autotune
+from kfac_pytorch_tpu.resilience.retry import ManualClock
+from kfac_pytorch_tpu.resilience.straggler import StragglerGovernor
+
+pytestmark = pytest.mark.core
+
+
+class _FakePrecond:
+    """Knob-attribute-only stand-in (jax-free, like the governor's)."""
+
+    def __init__(self, fac=1, kfac=10, damping=0.03,
+                 comm_precision=None, axis_name=None):
+        self.fac_update_freq = fac
+        self.kfac_update_freq = kfac
+        self.damping = damping
+        self.comm_precision = comm_precision
+        self.axis_name = axis_name
+
+
+class _GuardedPrecond(_FakePrecond):
+    """Asserts every knob write happens inside the arbiter's apply —
+    the single-writer enforcement of the acceptance criteria."""
+
+    def __init__(self, *a, **kw):
+        object.__setattr__(self, '_armed', False)
+        super().__init__(*a, **kw)
+        object.__setattr__(self, '_armed', True)
+
+    def __setattr__(self, name, value):
+        if name in autotune.KNOB_ATTRS and getattr(self, '_armed', False):
+            assert autotune.in_apply(), \
+                f'direct (non-arbiter) write of {name}'
+        object.__setattr__(self, name, value)
+
+
+# ---------------------------------------------------------------------------
+# the arbiter: composition, adoption, single-writer enforcement
+# ---------------------------------------------------------------------------
+
+def test_arbiter_composes_schedule_stretch_tuner():
+    pre = _FakePrecond(fac=1, kfac=10, damping=0.04)
+    arb = autotune.arbiter_for(pre)
+    assert autotune.arbiter_for(pre) is arb  # one per precond
+    arb.propose('schedule', freq_factor=2.0, damping_factor=0.5)
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (2, 20)
+    assert abs(pre.damping - 0.02) < 1e-12
+    arb.propose('straggler', stretch=4)
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (8, 80)
+    assert abs(pre.damping - 0.02) < 1e-12  # stretch leaves damping alone
+    # tuner absolute override replaces base x schedule, stretch still on
+    arb.propose('tuner', kfac_update_freq=5)
+    assert pre.kfac_update_freq == 20          # 5 x stretch 4
+    arb.propose('straggler', stretch=1)
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (2, 5)
+    # clearing the override returns to base x schedule
+    arb.propose('tuner', kfac_update_freq=None)
+    assert pre.kfac_update_freq == 20
+
+
+def test_arbiter_freq_floor_and_int_truncation():
+    # reference semantics: int() truncation then a floor of 1
+    pre = _FakePrecond(fac=1, kfac=2)
+    arb = autotune.arbiter_for(pre)
+    arb.propose('schedule', freq_factor=0.1)
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (1, 1)
+
+
+def test_arbiter_adopts_external_direct_write():
+    pre = _FakePrecond(fac=1, kfac=10)
+    arb = autotune.arbiter_for(pre)
+    arb.propose('straggler', stretch=2)
+    assert pre.kfac_update_freq == 20
+    # a legacy caller writes the attrs directly: adopted as the new
+    # base, stretch/schedule/tuner state reset (the old governor
+    # collision rule, now in one place)
+    pre.fac_update_freq, pre.kfac_update_freq = 4, 40
+    arb.propose('straggler', stretch=1)
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (4, 40)
+    assert arb.base['kfac_update_freq'] == 40
+
+
+def test_adoption_keeps_stretch_and_schedule_incremental():
+    """The adoption regressions: (a) an external write of ONE knob
+    must not bake an in-force straggler stretch into the untouched
+    frequency base — recovery still removes it; (b) a schedule advance
+    after adoption decays INCREMENTALLY from the adopted value, never
+    re-applying the whole cumulative factor to an already-decayed
+    base."""
+    # (a) damping written externally while the governor is stretched
+    pre = _FakePrecond(fac=1, kfac=10, damping=0.04)
+    arb = autotune.arbiter_for(pre)
+    arb.propose('straggler', stretch=4)
+    assert pre.kfac_update_freq == 40
+    pre.damping = 0.01                       # external, damping only
+    arb.propose('straggler', stretch=1)      # recovery
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (1, 10)
+    assert abs(pre.damping - 0.01) < 1e-12   # external value survives
+    # (b) epoch decay, external damping write, next epoch decay:
+    # cumulative factor 0.25 at epoch 2 applies as one more halving of
+    # the ADOPTED value (0.01 -> 0.005), not 0.01 * 0.25
+    pre2 = _FakePrecond(fac=1, kfac=10, damping=0.04)
+    arb2 = autotune.arbiter_for(pre2)
+    arb2.propose('schedule', damping_factor=0.5)   # epoch 1: 0.02
+    assert abs(pre2.damping - 0.02) < 1e-12
+    pre2.damping = 0.01                            # external mid-run
+    arb2.propose('schedule', damping_factor=0.25)  # epoch 2
+    assert abs(pre2.damping - 0.005) < 1e-12
+    # an external FREQ write supersedes the stretch (the old governor
+    # rule): the written cadence is the new unstretched base
+    pre3 = _FakePrecond(fac=1, kfac=10)
+    arb3 = autotune.arbiter_for(pre3)
+    arb3.propose('straggler', stretch=2)
+    pre3.fac_update_freq, pre3.kfac_update_freq = 4, 40
+    arb3.propose('straggler', stretch=2)     # still degraded
+    assert (pre3.fac_update_freq, pre3.kfac_update_freq) == (8, 80)
+    arb3.propose('straggler', stretch=1)
+    assert (pre3.fac_update_freq, pre3.kfac_update_freq) == (4, 40)
+
+
+def test_tuner_damping_override_applies_and_clears():
+    pre = _FakePrecond(fac=1, kfac=10, damping=0.04)
+    arb = autotune.arbiter_for(pre)
+    arb.propose('schedule', damping_factor=0.5)
+    assert abs(pre.damping - 0.02) < 1e-12
+    arb.propose('tuner', damping=0.007)      # absolute override
+    assert abs(pre.damping - 0.007) < 1e-12
+    arb.propose('schedule', damping_factor=0.25)  # override still wins
+    assert abs(pre.damping - 0.007) < 1e-12
+    arb.propose('tuner', damping=None)       # cleared -> base x schedule
+    assert abs(pre.damping - 0.01) < 1e-12
+
+
+def test_tick_attributes_interval_to_previous_dispatch():
+    """The trainer feed: build_train_step ticks BEFORE the dispatch
+    updates last_phases, so the phases argument names the dispatch the
+    just-ended interval covered — tick must attribute the interval to
+    the phases passed NOW (an off-by-one here buckets every refresh
+    spike under the preceding steady step's phase set, where the
+    outlier screen discards it)."""
+    pre = _FakePrecond(fac=1, kfac=4)
+    t = {'now': 0.0}
+    ctl = autotune.KnobController(pre, window=4, settle=0, tune=(),
+                                  clock=lambda: t['now'])
+    # dispatch sequence: refresh (10 s) then three steady (1 s) —
+    # each tick happens before the NEXT dispatch, carrying the phase
+    # set of the dispatch whose interval just ended
+    seq = [(('pred', 'stats', 'decomp', 'gather'), 10.0),
+           (('pred',), 1.0), (('pred',), 1.0), (('pred',), 1.0)]
+    ctl.tick(0, ())                       # first tick: nothing recorded
+    for i, (phases, dt) in enumerate(seq):
+        t['now'] += dt
+        ctl.tick(i + 1, phases)
+    acc = ctl.last_window['measured']
+    # the 10 s interval landed on the refresh phase set, not 'pred'
+    assert ctl.last_window['time_s'] == pytest.approx(3.25)
+    refresh_label = [k for k in acc if 'ComputeInverse' in k]
+    assert refresh_label, acc
+
+
+def test_arbiter_rejects_unknown_proposer_and_knob():
+    pre = _FakePrecond()
+    arb = autotune.arbiter_for(pre)
+    with pytest.raises(KeyError):
+        arb.propose('tuner', basis_update_freq=7)
+    with pytest.raises(KeyError):
+        arb.propose('cosmic_rays', stretch=2)
+
+
+def test_arbiter_elastic_records_compose_nothing():
+    pre = _FakePrecond(fac=2, kfac=20)
+    arb = autotune.arbiter_for(pre)
+    arb.propose('elastic', from_world=2, to_world=3, lr_factor=1.5)
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (2, 20)
+    assert arb.records == [{'from_world': 2, 'to_world': 3,
+                            'lr_factor': 1.5}]
+
+
+def test_arbiter_rebases_cohorts_once_per_change():
+    calls = []
+
+    class _P(_FakePrecond):
+        def rebase_cohorts(self):
+            calls.append(1)
+
+    pre = _P(fac=1, kfac=10)
+    arb = autotune.arbiter_for(pre)
+    arb.propose('straggler', stretch=2)       # freq change -> 1 rebase
+    assert len(calls) == 1
+    arb.propose('straggler', stretch=2)       # no-op -> no rebase
+    assert len(calls) == 1
+    arb.propose('schedule', damping_factor=0.5)   # damping only -> none
+    assert len(calls) == 1
+    arb.propose('tuner', kfac_update_freq=7)  # composed change -> 1 more
+    assert len(calls) == 2
+
+
+def test_arbiter_invalidator_fires_only_on_comm_precision():
+    pre = _FakePrecond(comm_precision='fp32')
+    arb = autotune.arbiter_for(pre)
+    cleared = []
+    arb.add_invalidator(lambda: cleared.append(1))
+    arb.propose('straggler', stretch=2)
+    assert not cleared                         # freq moves reuse cache
+    arb.propose('tuner', comm_precision='bf16')
+    assert len(cleared) == 1
+    assert pre.comm_precision == 'bf16'
+    arb.propose('tuner', comm_precision='bf16')
+    assert len(cleared) == 1                   # unchanged -> no clear
+
+
+def test_scheduler_and_governor_never_write_knobs_directly():
+    """The acceptance-criteria pin: every fac/kfac_update_freq/damping
+    mutation flows through the arbiter — asserted at the setattr level
+    while the real scheduler and governor run their full paths."""
+    from kfac_pytorch_tpu.scheduler import KFACParamScheduler
+    pre = _GuardedPrecond(fac=1, kfac=10, damping=0.03)
+    sched = KFACParamScheduler(pre, damping_alpha=0.5,
+                               damping_schedule=[1],
+                               update_freq_alpha=2,
+                               update_freq_schedule=[1])
+    clk = ManualClock()
+    gov = StragglerGovernor(pre, budget=1.0, decay=0.5, warmup=0,
+                            clock=clk.monotonic, sleep=clk.sleep)
+    sched.step(1)
+    for dt in (5.0, 5.0, 5.0):
+        gov.observe(dt)
+    assert gov.level >= 1
+    for _ in range(10):
+        gov.observe(0.01)
+    assert gov.level == 0
+    ctl = autotune.KnobController(pre, window=2, settle=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 80))
+    for _ in range(8):
+        ctl.record(('pred',), 0.01)
+    # all three proposers ran full cycles; _GuardedPrecond asserted
+    # in_apply() on every knob write along the way
+    assert autotune.arbiter_for(pre).changes >= 3
+
+
+def test_scheduler_epoch_mid_stretch_then_recover_ordering():
+    """The satellite regression: stretch -> epoch decay -> recover on a
+    ManualClock. The old direct writes lost one side's intent at each
+    hand-off; through the arbiter both survive in either order."""
+    from kfac_pytorch_tpu.scheduler import KFACParamScheduler
+    pre = _FakePrecond(fac=1, kfac=10, damping=0.03)
+    sched = KFACParamScheduler(pre, update_freq_alpha=2,
+                               update_freq_schedule=[1])
+    clk = ManualClock()
+    gov = StragglerGovernor(pre, budget=1.0, decay=0.5, warmup=0,
+                            stretch=2, clock=clk.monotonic,
+                            sleep=clk.sleep)
+    # 1) the governor stretches
+    for dt in (5.0, 5.0, 5.0):
+        gov.observe(dt)
+    level = gov.level
+    assert level >= 1
+    stretch = 2 ** level
+    assert pre.kfac_update_freq == 10 * stretch
+    # 2) an epoch advance mid-stretch: the schedule decays the BASE
+    #    while the stretch stays in force (neither clobbers the other)
+    sched.step(1)
+    assert pre.kfac_update_freq == 20 * stretch
+    assert pre.fac_update_freq == 2 * stretch
+    # 3) recovery removes ONLY the stretch: the epoch's cadence survives
+    for _ in range(10):
+        gov.observe(0.01)
+    assert gov.level == 0
+    assert (pre.fac_update_freq, pre.kfac_update_freq) == (2, 20)
+
+
+# ---------------------------------------------------------------------------
+# the controller: deterministic synthetic feeds (no wall clock)
+# ---------------------------------------------------------------------------
+
+def _feed(ctl, pre, model, steps):
+    """Drive ``ctl`` with a synthetic per-step cost model
+    ``model(kfac_update_freq, i_in_window) -> (phases, seconds)``;
+    returns steps actually fed."""
+    fed = 0
+    while fed < steps:
+        F = pre.kfac_update_freq
+        for i in range(F):
+            phases, cost = model(F, i)
+            ctl.record(phases, cost)
+            fed += 1
+            if fed >= steps:
+                break
+    return fed
+
+
+def _amortized(F, i):
+    """Refresh cost 0.5 amortized over the window: optimum = max freq."""
+    if i == 0:
+        return ('pred', 'stats', 'decomp', 'gather'), 0.51
+    return ('pred',), 0.01
+
+
+def test_controller_converges_to_planted_optimum():
+    pre = _FakePrecond(fac=1, kfac=1)
+    ctl = autotune.KnobController(pre, window=16, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 8))
+    _feed(ctl, pre, _amortized, 400)
+    assert pre.kfac_update_freq == 8          # the planted optimum
+    assert ctl.state == 'steady'
+    assert ctl.commits == 3                   # 1 -> 2 -> 4 -> 8
+    assert ctl.windows <= 30                  # bounded probe budget
+    k = ctl.report()
+    assert k['knobs']['kfac_update_freq'] == 8
+    assert k['state'] == 'steady'
+
+
+def test_controller_converges_down_from_pessimal_high_freq():
+    """Stale-side optimum: when every step's cost GROWS with the
+    cadence (a stand-in for staleness pricing), the controller must
+    climb DOWN the ladder too."""
+    pre = _FakePrecond(fac=1, kfac=8)
+
+    def model(F, i):
+        phases = ('pred', 'stats', 'decomp', 'gather') if i == 0 \
+            else ('pred',)
+        return phases, 0.01 + 0.002 * F + (0.001 if i == 0 else 0.0)
+
+    ctl = autotune.KnobController(pre, window=16, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 8))
+    _feed(ctl, pre, model, 600)
+    assert pre.kfac_update_freq == 1
+    assert ctl.state == 'steady'
+
+
+def test_controller_hysteresis_no_flap_on_flat_profile():
+    """A flat cost profile must settle, not oscillate: every probe
+    reverts (no >rel_improve gain), candidates go on cooldown, and the
+    controller reaches steady with the original knob intact."""
+    pre = _FakePrecond(fac=1, kfac=4)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=2,
+                                  cooldown=4, steady_every=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 8))
+    _feed(ctl, pre, lambda F, i: (('pred',), 0.01), 600)
+    assert ctl.state == 'steady'
+    assert pre.kfac_update_freq == 4
+    assert ctl.commits == 0
+    assert ctl.reverts == 2                   # 8 and 2 each tried once
+
+
+def test_controller_dwell_blocks_probes_after_commit():
+    """Hysteresis: after a commit the controller holds the committed
+    config for dwell_windows full windows before probing again."""
+    pre = _FakePrecond(fac=1, kfac=1)
+    ctl = autotune.KnobController(pre, window=16, settle=1,
+                                  rel_improve=0.03, dwell_windows=3,
+                                  cooldown=2, steady_every=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 8))
+    # run until the first commit lands
+    while ctl.commits == 0:
+        _feed(ctl, pre, _amortized, 16)
+    assert ctl.state == 'dwell'
+    committed = pre.kfac_update_freq
+    start = ctl.windows
+    while ctl.state == 'dwell':
+        # the knob may only change at the dwell->probe transition —
+        # while still dwelling it must hold the committed value
+        assert pre.kfac_update_freq == committed
+        _feed(ctl, pre, _amortized, 1)
+    assert ctl.windows - start >= 3
+
+
+def test_controller_discards_windows_under_straggler_stretch():
+    """A host emergency is not a tuning signal: while the governor's
+    stretch is in force the controller accumulates nothing."""
+    pre = _FakePrecond(fac=1, kfac=4)
+    arb = autotune.arbiter_for(pre)
+    ctl = autotune.KnobController(pre, window=4, settle=0,
+                                  tune=('kfac_update_freq',))
+    arb.propose('straggler', stretch=2)
+    for _ in range(40):
+        ctl.record(('pred',), 5.0)            # catastrophic step times
+    assert ctl.windows == 0 and ctl.state == 'baseline'
+    arb.propose('straggler', stretch=1)
+    for _ in range(6):
+        ctl.record(('pred',), 0.01)
+    assert ctl.windows >= 1                   # measuring again
+
+
+def test_controller_seeds_from_perfmodel_prior():
+    """Before any measurement: an eigen-variant predicted block (huge
+    fenced decomposition cost) seeds kfac_update_freq to the ladder
+    value minimizing predicted steady step time."""
+    from kfac_pytorch_tpu import perfmodel
+    pre = _FakePrecond(fac=1, kfac=1)
+    ctl = autotune.KnobController(pre, window=4, settle=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 512),
+                                  predicted=perfmodel.predict_block(),
+                                  variant='eigen_dp')
+    ctl.record(('pred',), 0.01)               # first record triggers seed
+    # decomp ~73 s vs model ~0.11 s: the prior pushes to the ladder top
+    assert pre.kfac_update_freq == 512
+    assert any(d['kind'] == 'seed' for d in ctl.decisions)
+
+
+def test_prior_best_freq_prefers_cheap_decomp_low_freq():
+    predicted = {'scenarios': {'central': {'phases_s': {
+        'Model': 0.1, 'Precondition': 0.01, 'ComputeFactor': 0.01,
+        'ComputeInverse_chol': 0.001,
+        'ComputeInverse_eigh_full': 50.0}}}}
+    # Cholesky variant: decomp negligible -> freq 1 is optimal
+    assert autotune.prior_best_freq(predicted, 'inverse_dp',
+                                    [1, 2, 4, 8]) == 1
+    # eigen variant: decomp dominant -> max freq
+    assert autotune.prior_best_freq(predicted, 'eigen_dp',
+                                    [1, 2, 4, 8]) == 8
+    assert autotune.prior_best_freq({'scenarios': {}}, 'eigen_dp',
+                                    [1, 2]) is None
+
+
+# ---------------------------------------------------------------------------
+# the drift gate: veto on the modeled chip, advisory elsewhere
+# ---------------------------------------------------------------------------
+
+def _veto_harness(platform):
+    """Probe window improves (passes the objective) but its measured
+    'Precondition' marginal sits far outside the predicted band."""
+    from kfac_pytorch_tpu import perfmodel
+    pre = _FakePrecond(fac=1, kfac=4)
+    ctl = autotune.KnobController(pre, window=4, settle=0,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 8),
+                                  predicted=perfmodel.predict_block(),
+                                  platform=platform, variant='eigen_dp')
+    ctl._seeded = 'done'                      # isolate the gate from seeding
+    for _ in range(4):                        # baseline window: 0.6 s steps
+        ctl.record(('pred',), 0.6)
+    assert ctl.state == 'probe'
+    for _ in range(4):                        # probe window: 0.5 s -> improved
+        ctl.record(('pred',), 0.5)
+    return pre, ctl
+
+
+def test_drift_veto_on_modeled_chip():
+    """0.5 s measured Precondition vs a ~0.008 s predicted band on the
+    modeled chip: the candidate improved the objective but is VETOED —
+    the tuner can never silently regress a modeled phase."""
+    pre, ctl = _veto_harness('TPU v5e')
+    assert ctl.vetoes == 1 and ctl.commits == 0
+    assert pre.kfac_update_freq != 8          # the vetoed value never stuck
+    veto = next(d for d in ctl.decisions if d['kind'] == 'veto')
+    assert veto['value'] == 8
+    assert 'Precondition' in veto['violations']
+
+
+def test_drift_gate_advisory_off_the_modeled_chip():
+    """The SAME feed on an unmodeled platform commits: the band is
+    advisory (violations counted, knob applied)."""
+    pre, ctl = _veto_harness('cpu_fallback')
+    assert ctl.vetoes == 0 and ctl.commits == 1
+    assert ctl.advisory_violations >= 1
+    assert pre.kfac_update_freq != 4          # the probe value stuck
+
+
+def test_no_predicted_block_means_no_gate():
+    pre = _FakePrecond(fac=1, kfac=4)
+    ctl = autotune.KnobController(pre, window=4, settle=0,
+                                  tune=('kfac_update_freq',))
+    assert ctl._drift_veto({'Precondition': 99.0}, 'kfac_update_freq',
+                           8) is False
+
+
+# ---------------------------------------------------------------------------
+# comm-mode decision (advisory, analytic)
+# ---------------------------------------------------------------------------
+
+def test_decide_comm_mode_amortization_crossover():
+    vols = {'inverse': 1000.0, 'pred': 100.0}
+    # at freq 1 the gather ships every step: pred is 10x cheaper
+    mode, per_step = autotune.decide_comm_mode(vols, 1)
+    assert mode == 'pred' and per_step['inverse'] == 1000.0
+    # at freq 100 the gather amortizes to 10 B/step: inverse wins
+    mode, per_step = autotune.decide_comm_mode(vols, 100)
+    assert mode == 'inverse' and per_step['inverse'] == 10.0
+
+
+def test_comm_mode_decision_recorded_once_from_plan():
+    from kfac_pytorch_tpu import plan as plan_mod
+
+    class _Bucket:
+        n_rows, dim = 4, 16
+
+    class _Pred:
+        dg, da, k_per_dev = 8, 8, 2
+
+    class _Plan:
+        # the real byte model (the tuner must price both roads through
+        # plan.comm_volume, never a restated formula)
+        comm_volume = plan_mod.FactorPlan.comm_volume
+        comm_mode = 'inverse'
+        buckets = {16: _Bucket()}
+        pred_groups = (_Pred(),)
+        num_devices = 2
+
+    pre = _FakePrecond(fac=1, kfac=8, comm_precision='fp32',
+                       axis_name='batch')
+    pre.plan = _Plan()
+    pre.method = 'chol'
+    pre.comm_mode = 'inverse'
+    ctl = autotune.KnobController(pre, window=2, settle=0, tune=())
+    for _ in range(4):
+        ctl.record(('pred',), 0.01)
+    assert ctl.comm_mode_choice in ('inverse', 'pred')
+    assert len([d for d in ctl.decisions
+                if d['kind'] == 'comm_mode']) == 1  # one-shot
+
+
+# ---------------------------------------------------------------------------
+# artifacts: decision log, counters, incident grammar
+# ---------------------------------------------------------------------------
+
+def test_decision_log_jsonl(tmp_path):
+    log_path = tmp_path / 'sub' / 'autotune-decisions.jsonl'
+    pre = _FakePrecond(fac=1, kfac=1)
+    ctl = autotune.KnobController(pre, window=16, settle=1,
+                                  dwell_windows=1, cooldown=2,
+                                  steady_every=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 8),
+                                  decision_log=str(log_path))
+    _feed(ctl, pre, _amortized, 400)
+    lines = [json.loads(ln) for ln in
+             log_path.read_text().splitlines()]
+    kinds = [d['kind'] for d in lines]
+    assert 'probe' in kinds and 'commit' in kinds and 'steady' in kinds
+    assert all('window' in d and 'step' in d for d in lines)
+
+
+def test_counts_and_registry_collector():
+    from kfac_pytorch_tpu.obs import metrics
+    pre = _FakePrecond(fac=1, kfac=1)
+    ctl = autotune.KnobController(pre, window=16, settle=1,
+                                  dwell_windows=1, cooldown=2,
+                                  steady_every=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 8))
+    _feed(ctl, pre, _amortized, 400)
+    c = ctl.counts()
+    assert c['autotune_commits'] == ctl.commits > 0
+    reg = metrics.Registry()
+    ctl.collect(reg)
+    snap = reg.snapshot()
+    assert snap['autotune/kfac_update_freq'] == pre.kfac_update_freq
+    assert snap['autotune/commits'] == ctl.commits
+
+
+def test_autotune_log_lines_speak_the_incident_grammar():
+    """The shared-grammar contract: the controller's run-log lines are
+    parsed into typed events by incident.EVENT_PATTERNS — kfac-obs
+    renders tuning timelines with zero new aggregate code."""
+    from kfac_pytorch_tpu.resilience.incident import IncidentReport
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    log = logging.getLogger('test_autotune_grammar')
+    log.setLevel(logging.INFO)
+    log.addHandler(_Capture())
+    try:
+        pre = _FakePrecond(fac=1, kfac=1)
+        ctl = autotune.KnobController(pre, window=16, settle=1,
+                                      dwell_windows=1, cooldown=2,
+                                      steady_every=0,
+                                      tune=('kfac_update_freq',),
+                                      freq_bounds=(1, 8), log=log)
+        _feed(ctl, pre, _amortized, 400)
+        # and one veto line (rig the gate through the harness)
+        _, vctl = _veto_harness('TPU v5e')
+        vctl.log = log
+    finally:
+        log.handlers.clear()
+    rep = IncidentReport(host_id=0).scrape_lines(records)
+    kinds = [e['kind'] for e in rep.events]
+    assert 'autotune_probe' in kinds
+    assert 'autotune_commit' in kinds
+    assert 'autotune_steady' in kinds
+    commit = next(e for e in rep.events if e['kind'] == 'autotune_commit')
+    assert commit['knob'] == 'kfac_update_freq'
+    steady = next(e for e in rep.events if e['kind'] == 'autotune_steady')
+    assert int(steady['kfac']) == pre.kfac_update_freq
+
+
+def test_veto_log_line_speaks_the_grammar():
+    from kfac_pytorch_tpu.resilience.incident import IncidentReport
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    log = logging.getLogger('test_autotune_veto_grammar')
+    log.setLevel(logging.INFO)
+    log.addHandler(_Capture())
+    try:
+        from kfac_pytorch_tpu import perfmodel
+        pre = _FakePrecond(fac=1, kfac=4)
+        ctl = autotune.KnobController(
+            pre, window=4, settle=0, rel_improve=0.03, dwell_windows=1,
+            cooldown=2, steady_every=0, tune=('kfac_update_freq',),
+            freq_bounds=(1, 8), predicted=perfmodel.predict_block(),
+            platform='TPU v5e', variant='eigen_dp', log=log)
+        ctl._seeded = 'done'
+        for _ in range(4):
+            ctl.record(('pred',), 0.6)
+        for _ in range(4):
+            ctl.record(('pred',), 0.5)
+    finally:
+        log.handlers.clear()
+    rep = IncidentReport(host_id=0).scrape_lines(records)
+    veto = [e for e in rep.events if e['kind'] == 'autotune_veto']
+    assert veto and veto[0]['knob'] == 'kfac_update_freq'
+
+
+# ---------------------------------------------------------------------------
+# jax integration: variant-cache reuse + the mid-run wire-dtype switch
+# ---------------------------------------------------------------------------
+
+def _jax_trainer(variant='eigen_dp', ndev=1, kfac_freq=2,
+                 comm_precision='fp32'):
+    import flax.linen as linen
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    import kfac_pytorch_tpu as kfac
+    from kfac_pytorch_tpu import nn as knn
+    from kfac_pytorch_tpu import training
+
+    class MLP(linen.Module):
+        @linen.compact
+        def __call__(self, x, train=True):
+            x = knn.Dense(8, name='fc1')(x)
+            x = linen.relu(x)
+            return knn.Dense(3, name='fc2')(x)
+
+    def ce(outputs, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            outputs, batch['label']).mean()
+
+    rng = np.random.RandomState(0)
+    batch = {'input': jnp.asarray(rng.randn(8, 5), jnp.float32),
+             'label': jnp.asarray(rng.randint(0, 3, 8))}
+    mesh = (Mesh(np.array(jax.devices()[:ndev]), ('batch',))
+            if ndev > 1 else None)
+    axis = 'batch' if ndev > 1 else None
+    model = MLP()
+    pre = kfac.KFAC(variant=variant, lr=0.05, damping=0.003,
+                    kfac_update_freq=kfac_freq, num_devices=ndev,
+                    axis_name=axis, bucket_fn=lambda d: 16,
+                    comm_precision=comm_precision)
+    tx = training.sgd(0.05, momentum=0.9)
+    state = training.init_train_state(model, tx, pre,
+                                      jax.random.PRNGKey(0),
+                                      batch['input'])
+    step = training.build_train_step(model, tx, pre, ce, axis_name=axis,
+                                     mesh=mesh)
+    return step, state, pre, batch
+
+
+def test_freq_knob_changes_reuse_variant_cache():
+    """The compile-count guard of the acceptance criteria: a tuner /
+    straggler / schedule frequency move through the arbiter compiles
+    NOTHING new — the frequency is host-side dispatch gating over the
+    same variant set — while a ``comm_precision`` change clears the
+    cache (the registered invalidator) so no stale program can keep
+    the old wire dtype."""
+    step, state, pre, batch = _jax_trainer(kfac_freq=2)
+    arb = autotune.arbiter_for(pre)
+    for _ in range(5):
+        state, _ = step(state, batch, lr=0.05, damping=0.003)
+    baseline = set(step.variants)
+    assert baseline                        # warmed past every variant
+    # a pure kfac_update_freq move (the tuner's bread and butter)
+    # re-times the SAME dispatch combos: zero new programs
+    arb.propose('tuner', kfac_update_freq=4)
+    for _ in range(9):
+        state, _ = step(state, batch, lr=0.05, damping=0.003)
+    assert set(step.variants) == baseline, (
+        sorted(map(str, set(step.variants) - baseline)))
+
+    # the full trajectory a controller run would drive: tuner overrides
+    # up and down the ladder, a schedule decay stretching the stats
+    # cadence, a straggler emergency + recovery. The FIRST pass may
+    # fill in dispatch combos the warmup never hit (stats-off steps) —
+    # that is the bounded variant set completing, not churn
+    def play(s):
+        moves = (('tuner', {'kfac_update_freq': 1}),
+                 ('schedule', {'freq_factor': 2.0, 'damping_factor': 0.5}),
+                 ('straggler', {'stretch': 2}),
+                 ('straggler', {'stretch': 1}),
+                 ('tuner', {'kfac_update_freq': 4}),
+                 ('schedule', {'freq_factor': 1.0, 'damping_factor': 1.0}))
+        for source, kw in moves:
+            arb.propose(source, **kw)
+            for _ in range(6):
+                s, _ = step(s, batch, lr=0.05, damping=0.003)
+        return s
+
+    state = play(state)
+    grown = set(step.variants)
+    assert baseline <= grown           # never cleared by a cadence move
+    # the compile-count guard proper: REPLAYING the whole trajectory —
+    # every cadence revisited — compiles exactly nothing
+    state = play(state)
+    assert set(step.variants) == grown, (
+        sorted(map(str, set(step.variants) - grown)))
+
+
+def test_mid_run_comm_precision_switch_fp32_bf16_fp32(tmp_path):
+    """The PR 8 follow-on satellite: the tuner switches the wire dtype
+    mid-run through the arbiter. fp32 -> bf16 must clear the compiled
+    variants and seed a zero EF residual host-side; bf16 -> fp32 must
+    drop it again; a checkpoint written in the bf16 era restores into
+    a bf16-era trainer byte-exactly; and the post-switch fp32 state
+    checkpoints/restores cleanly (structure = a never-compressed run)."""
+    import jax
+    import numpy as onp
+
+    from kfac_pytorch_tpu.utils.checkpoint import (restore_checkpoint,
+                                                   save_checkpoint)
+    step, state, pre, batch = _jax_trainer(variant='eigen', ndev=2,
+                                           kfac_freq=1)
+    arb = autotune.arbiter_for(pre)
+    for _ in range(3):
+        state, m = step(state, batch, lr=0.05, damping=0.003)
+    assert state.kfac_state.comm_err is None          # fp32: no residual
+    # -> bf16 (what a tuner commit of comm_precision does)
+    arb.propose('tuner', comm_precision='bf16')
+    assert not step.variants                          # cache cleared
+    for _ in range(3):
+        state, m = step(state, batch, lr=0.05, damping=0.003)
+    assert np.isfinite(float(m['loss']))
+    assert state.kfac_state.comm_err is not None      # EF residual live
+    assert pre._tracks_comm_err
+    save_checkpoint(str(tmp_path / 'bf16'), 0, state)
+    # -> back to fp32: residual dropped host-side, run keeps training
+    arb.propose('tuner', comm_precision='fp32')
+    assert not step.variants
+    for _ in range(3):
+        state, m = step(state, batch, lr=0.05, damping=0.003)
+    assert np.isfinite(float(m['loss']))
+    assert state.kfac_state.comm_err is None
+    # the post-switch state checkpoints like a never-compressed run
+    save_checkpoint(str(tmp_path / 'fp32'), 0, state)
+    f32_step, f32_fresh, _, _ = _jax_trainer(variant='eigen', ndev=2,
+                                             kfac_freq=1)
+    restored = restore_checkpoint(str(tmp_path / 'fp32'), 0, f32_fresh)
+    assert restored.kfac_state.comm_err is None
+    restored = jax.tree.map(onp.asarray, restored)
+    restored, m = f32_step(restored, batch, lr=0.05, damping=0.003)
+    assert np.isfinite(float(m['loss']))
+    # and the bf16-era checkpoint restores byte-exactly into a
+    # bf16-configured trainer (the switch stranded nothing)
+    b16_step, b16_fresh, _, _ = _jax_trainer(variant='eigen', ndev=2,
+                                             kfac_freq=1,
+                                             comm_precision='bf16')
+    restored16 = restore_checkpoint(str(tmp_path / 'bf16'), 0, b16_fresh)
+    assert restored16.kfac_state.comm_err is not None
+    restored16 = jax.tree.map(onp.asarray, restored16)
+    restored16, m = b16_step(restored16, batch, lr=0.05, damping=0.003)
+    assert np.isfinite(float(m['loss']))
+
+
+def test_controller_live_on_jax_trainer_converges():
+    """End-to-end: the controller rides a REAL jitted trainer through
+    ``record`` with a synthetic cost model keyed off the actual
+    dispatched phase set — the knob lands on the planted optimum and
+    every dispatch ran against a consistent compiled variant."""
+    step, state, pre, batch = _jax_trainer(kfac_freq=1)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('kfac_update_freq',),
+                                  freq_bounds=(1, 4))
+    for _ in range(250):
+        state, _ = step(state, batch, lr=0.05, damping=0.003)
+        phases = step.last_phases
+        cost = 0.41 if 'decomp' in phases else 0.01   # planted: amortize
+        ctl.record(phases, cost)
+        if ctl.state == 'steady':
+            break
+    assert pre.kfac_update_freq == 4
+    assert ctl.state == 'steady'
