@@ -111,9 +111,14 @@ def parse_args():
                         'jsonl and epoch metric snapshots to DIR/'
                         'metrics.jsonl (defaults to $KFAC_TRACE_DIR '
                         'when set); merge with kfac-obs')
-    p.add_argument('--prom-file', default=None, metavar='PATH',
+    p.add_argument('--prom-file',
+                   default=os.environ.get('KFAC_PROM_FILE'),
+                   metavar='PATH',
                    help='export the metrics registry as a Prometheus '
-                        'textfile at PATH after every epoch (rank 0)')
+                        'textfile at PATH after every epoch (rank 0; '
+                        'defaults to $KFAC_PROM_FILE — the training '
+                        'service sets it per tenant job, and the path '
+                        'is namespaced by tenant/job id either way)')
     return p.parse_args()
 
 

@@ -69,5 +69,10 @@ def setup_trainer(trace_dir=None, prom_file=None, governor=None,
         reg.add_exporter(metrics.JsonlExporter(
             _os.path.join(trace_dir, 'metrics.jsonl')))
     if prom_file:
-        reg.add_exporter(metrics.PrometheusTextfileExporter(prom_file))
+        # service namespacing: two tenant jobs handed the same textfile
+        # path (a shared default) must not clobber each other's
+        # exports — under KFAC_TENANT/KFAC_JOB_ID the path gains a
+        # per-job suffix; outside the service this is the identity
+        reg.add_exporter(metrics.PrometheusTextfileExporter(
+            metrics.namespaced_prom_path(prom_file)))
     return tracer, reg

@@ -191,7 +191,7 @@ def load_trace(path, host=None, spans=False):
     return events, raw
 
 
-def solve_offsets(paths):
+def solve_offsets(paths, recursive=False):
     """Per-host clock corrections from the cross-host ``clock_sync``
     trace pairs: ``{host: seconds_to_add}``.
 
@@ -216,7 +216,7 @@ def solve_offsets(paths):
     drills (one machine, offsets ~latency) and for steady-membership
     production pods; not a substitute for NTP discipline."""
     samples = {}
-    for path in expand_paths(paths):
+    for path in expand_paths(paths, recursive=recursive):
         if not str(path).endswith('.jsonl'):
             continue
         try:
@@ -280,19 +280,34 @@ def classify(path):
     return 'log'
 
 
-def expand_paths(paths):
-    """Directories expand to their trace/incident/log artifacts."""
+#: what a directory expands to — the four artifact classes a run leaves
+_DIR_PATTERNS = ('*.jsonl', 'incident*.json', '*.log', '*.out')
+
+
+def expand_paths(paths, recursive=False):
+    """Directories expand to their trace/incident/log artifacts.
+    ``recursive`` walks subdirectories too — the per-tenant service
+    namespaces nest artifacts one level down
+    (``tenants/<tenant>/job-*/{logs,trace,lease}/...``), and a tenant's
+    whole story should be one ``kfac-obs -r tenants/<tenant>`` away."""
     out = []
     for p in paths:
         if os.path.isdir(p):
-            for pat in ('*.jsonl', 'incident*.json', '*.log', '*.out'):
-                out.extend(sorted(glob.glob(os.path.join(p, pat))))
+            dirs = [p]
+            if recursive:
+                for root, subdirs, _ in os.walk(p):
+                    subdirs.sort()
+                    dirs.extend(os.path.join(root, d) for d in subdirs)
+                dirs = sorted(set(dirs))
+            for d in dirs:
+                for pat in _DIR_PATTERNS:
+                    out.extend(sorted(glob.glob(os.path.join(d, pat))))
         else:
             out.append(p)
     return out
 
 
-def build_timeline(paths, offsets=None, spans=False):
+def build_timeline(paths, offsets=None, spans=False, recursive=False):
     """Merge artifacts into one ordered timeline.
 
     Returns ``{'sources': [...], 'events': [...]}`` with events sorted
@@ -302,7 +317,7 @@ def build_timeline(paths, offsets=None, spans=False):
     sources = []
     all_events = []
     trace_events = []
-    for idx, path in enumerate(expand_paths(paths)):
+    for idx, path in enumerate(expand_paths(paths, recursive=recursive)):
         kind = classify(path)
         sources.append({'path': str(path), 'kind': kind})
         if kind == 'trace':
@@ -380,17 +395,66 @@ def render(timeline, limit=None):
     lines = [f'pod timeline — {len(events)} events from '
              f'{len(timeline["sources"])} source(s)']
     shown = events if limit is None else events[:limit]
-    for e in shown:
-        wall = e.get('wall_aligned')
-        stamp = (time.strftime('%H:%M:%S', time.localtime(wall))
-                 + f'.{int(wall % 1 * 1000):03d}' if wall is not None
-                 else '--:--:--.---')
-        host = f'host{e["host"]}' if e['host'] is not None else 'host?'
-        detail = ' '.join(f'{k}={v}' for k, v in e['detail'].items())
-        lines.append(f'  {stamp}  {host:<6} {e["kind"]:<20} {detail}')
+    lines.extend(event_line(e) for e in shown)
     if limit is not None and len(events) > limit:
         lines.append(f'  ... {len(events) - limit} more')
     return '\n'.join(lines)
+
+
+def event_line(e):
+    """One rendered timeline line (the ``render`` body, reusable by
+    the follow loop)."""
+    wall = e.get('wall_aligned')
+    stamp = (time.strftime('%H:%M:%S', time.localtime(wall))
+             + f'.{int(wall % 1 * 1000):03d}' if wall is not None
+             else '--:--:--.---')
+    host = f'host{e["host"]}' if e['host'] is not None else 'host?'
+    detail = ' '.join(f'{k}={v}' for k, v in e['detail'].items())
+    return f'  {stamp}  {host:<6} {e["kind"]:<20} {detail}'
+
+
+def follow(paths, *, interval=1.0, duration=None, offsets=None,
+           recursive=False, spans=False, out=None, clock=time,
+           stop=None):
+    """Live timeline: rebuild every ``interval`` seconds and print only
+    the events not seen before — ``kfac-obs --follow`` is tail(1) for a
+    whole pod (or, with ``-r`` over a tenant namespace, for one
+    tenant's jobs across admits, failures, requeues and dones).
+
+    Events are keyed by ``(source, line, kind, wall)`` — run logs and
+    trace JSONL are append-only, and incident reports are rewritten
+    atomically with a growing event list, so a new key IS a new event.
+    The wall stamp is part of the key because an incident report can be
+    ROTATED mid-follow (a requeued job's fresh supervisor incarnation
+    moves it to ``.prev`` and starts over): the new incarnation's event
+    at the same index must not be swallowed by the old one's key. Runs
+    until ``duration`` elapses, ``stop()`` returns true, or Ctrl-C;
+    returns the final timeline.
+    """
+    out = out if out is not None else sys.stdout
+    seen = set()
+    start = clock.monotonic()
+    timeline = {'sources': [], 'events': []}
+    while True:
+        timeline = build_timeline(paths, offsets=offsets,
+                                  spans=spans, recursive=recursive)
+        fresh = []
+        for e in timeline['events']:
+            key = (e['source'], e['line'], e['kind'], e.get('wall'))
+            if key not in seen:
+                seen.add(key)
+                fresh.append(e)
+        for e in fresh:
+            print(event_line(e), file=out, flush=True)
+        if stop is not None and stop():
+            return timeline
+        if (duration is not None
+                and clock.monotonic() - start >= duration):
+            return timeline
+        try:
+            clock.sleep(interval)
+        except KeyboardInterrupt:  # pragma: no cover — interactive
+            return timeline
 
 
 def _parse_offset(value):
@@ -429,16 +493,43 @@ def main(argv=None):
     p.add_argument('--limit', type=int, default=None,
                    help='print at most N events (full set still goes '
                         'to -o)')
+    p.add_argument('-r', '--recursive', action='store_true',
+                   help='expand directories recursively (the service '
+                        'tenant namespaces nest artifacts: '
+                        'kfac-obs -r <service>/tenants/<tenant>)')
+    p.add_argument('--follow', action='store_true',
+                   help='live mode: re-scan every --interval seconds '
+                        'and print only new events (Ctrl-C to stop); '
+                        'the service status endpoint is '
+                        'kfac-obs -r --follow <service>/tenants/<t>')
+    p.add_argument('--interval', type=float, default=2.0,
+                   help='--follow re-scan period (seconds)')
+    p.add_argument('--for', type=float, default=None, dest='duration',
+                   help='stop --follow after this many seconds '
+                        '(default: run until interrupted)')
     args = p.parse_args(argv)
-    offsets = {} if args.no_solve_offsets else solve_offsets(args.paths)
+    offsets = ({} if args.no_solve_offsets
+               else solve_offsets(args.paths,
+                                  recursive=args.recursive))
     if offsets:
         print('clock offsets solved from clock_sync pairs: '
               + ' '.join(f'host{h}={o:+.4f}s'
                          for h, o in sorted(offsets.items())))
     offsets.update(dict(args.offset))
-    timeline = build_timeline(args.paths, offsets=offsets,
-                              spans=args.spans)
-    print(render(timeline, limit=args.limit))
+    if args.follow:
+        # the final rebuild's timeline still feeds -o/--trace-out
+        # below, so a bounded follow (--for) leaves the same artifacts
+        # a one-shot invocation would
+        timeline = follow(args.paths, interval=args.interval,
+                          duration=args.duration, offsets=offsets,
+                          spans=args.spans, recursive=args.recursive)
+        print(f'followed {len(timeline["events"])} event(s) from '
+              f'{len(timeline["sources"])} source(s)')
+    else:
+        timeline = build_timeline(args.paths, offsets=offsets,
+                                  spans=args.spans,
+                                  recursive=args.recursive)
+        print(render(timeline, limit=args.limit))
     if args.out:
         doc = {k: v for k, v in timeline.items()
                if not k.startswith('_')}

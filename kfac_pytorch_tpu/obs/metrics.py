@@ -379,14 +379,64 @@ class TensorBoardExporter:
             self._writer.close()
 
 
+def job_namespace(env=None):
+    """``'<tenant>-<job>'`` from the training service's per-job env
+    (``KFAC_TENANT`` / ``KFAC_JOB_ID``), or None outside the service."""
+    env = env if env is not None else os.environ
+    tenant = (env.get('KFAC_TENANT') or '').strip()
+    job = (env.get('KFAC_JOB_ID') or '').strip()
+    if not tenant and not job:
+        return None
+    return '-'.join(p for p in (tenant, job) if p)
+
+
+def namespaced_prom_path(path, env=None):
+    """Namespace a Prometheus textfile path by tenant/job id.
+
+    Two trainers exporting to the same textfile path silently clobber
+    each other — the node-exporter collector sees whichever rename
+    landed last, and both tenants read each other's gauges. Under the
+    service env the default path therefore gains a ``<tenant>-<job>``
+    suffix before the extension (``metrics.prom`` ->
+    ``metrics-alice-job-000003.prom``); a path that already names the
+    job is left alone, and outside the service this is the identity."""
+    ns = job_namespace(env)
+    if not path or not ns:
+        return path
+    head, base = os.path.split(path)
+    if ns in base:
+        return path
+    root, ext = os.path.splitext(base)
+    return os.path.join(head, f'{root}-{ns}{ext}')
+
+
 class PrometheusTextfileExporter:
     """Standard Prometheus text exposition written atomically (tmp +
     rename — the node-exporter textfile collector reads these mid-run).
     Metric names are sanitized to the Prometheus charset and prefixed
-    ``kfac_``."""
+    ``kfac_``.
+
+    In-process collision guard: two live exporters on one path would
+    interleave renames and each epoch's file would alternate between
+    two unrelated metric sets — construction fails loudly instead
+    (release the path with :meth:`close`). The CROSS-process case is
+    handled by :func:`namespaced_prom_path` giving each service job its
+    own file."""
+
+    _claimed = {}   # abspath -> id(exporter)
 
     def __init__(self, path):
         self.path = path
+        self._claim_key = os.path.abspath(path)
+        holder = PrometheusTextfileExporter._claimed.get(self._claim_key)
+        if holder is not None:
+            raise ValueError(
+                f'Prometheus textfile {path!r} is already exported by '
+                'another live registry in this process — two writers '
+                'would clobber each other\'s epochs. Namespace the '
+                'path (namespaced_prom_path / KFAC_TENANT+KFAC_JOB_ID) '
+                'or close the other exporter first.')
+        PrometheusTextfileExporter._claimed[self._claim_key] = id(self)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -426,7 +476,9 @@ class PrometheusTextfileExporter:
         os.replace(tmp, self.path)
 
     def close(self):
-        pass
+        if PrometheusTextfileExporter._claimed.get(self._claim_key) \
+                == id(self):
+            del PrometheusTextfileExporter._claimed[self._claim_key]
 
 
 # -- built-in collectors ------------------------------------------------------

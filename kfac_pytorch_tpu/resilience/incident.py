@@ -129,6 +129,35 @@ _PATTERNS = (
         r'autotune: comm_mode decision (?P<mode>\w+) \(inverse '
         r'(?P<inverse_kib>[\d.]+) KiB/step vs pred '
         r'(?P<pred_kib>[\d.]+) KiB/step\) at step (?P<step>\d+)')),
+    # the multi-tenant training service (kfac_pytorch_tpu/service/):
+    # one event per job-lifecycle edge — admission onto pod capacity,
+    # a requeue after a classified failure, the terminal done/lost
+    # verdicts, and live capacity-pool changes — so a tenant's whole
+    # story (admit -> failure -> requeue -> done) renders on the
+    # kfac-obs timeline from the service log alone, same shared-
+    # grammar contract the grow/partition/autotune stories use
+    ('job_admit', re.compile(
+        r'service: job_admit job=(?P<job>\d+) tenant=(?P<tenant>[\w-]+) '
+        r'trainer=(?P<trainer>[\w-]+) host=(?P<on>[\w,-]+) '
+        r'attempt=(?P<attempt>\d+) port=(?P<port>\d+)')),
+    ('job_requeue', re.compile(
+        r'service: job_requeue job=(?P<job>\d+) '
+        r'tenant=(?P<tenant>[\w-]+) rc=(?P<rc>-?\d+) '
+        r'class=(?P<why>[\w-]+) attempt=(?P<attempt>\d+) '
+        r'backoff_s=(?P<backoff_s>[\d.]+)')),
+    ('job_done', re.compile(
+        r'service: job_done job=(?P<job>\d+) tenant=(?P<tenant>[\w-]+) '
+        r'attempts=(?P<attempts>\d+)')),
+    ('job_lost', re.compile(
+        r'service: job_lost job=(?P<job>\d+) tenant=(?P<tenant>[\w-]+) '
+        r'rc=(?P<rc>-?\d+) class=(?P<why>[\w-]+) '
+        r'attempts=(?P<attempts>\d+)')),
+    ('pool_shrink', re.compile(
+        r'service: pool_shrink slots=(?P<from>\d+) -> (?P<to>\d+) '
+        r'lost=(?P<lost>\[[^\]]*\])')),
+    ('pool_grow', re.compile(
+        r'service: pool_grow slots=(?P<from>\d+) -> (?P<to>\d+) '
+        r'added=(?P<added>\[[^\]]*\])')),
     ('straggler_degrade', re.compile(
         r'straggler: step-time EMA (?P<ema_s>[\d.]+)s over budget '
         r'(?P<budget_s>[\d.]+)s(?: at step (?P<step>\d+))? — stretching '

@@ -1,0 +1,57 @@
+"""Multi-tenant training service: the thin layer ABOVE the pod.
+
+Everything below the waterline already exists — per-host supervisors
+(:mod:`..resilience.supervisor`), elastic pods with shrink/grow/fencing
+(:mod:`..resilience.elastic`), structured incidents, Prometheus /
+TensorBoard / trace exporters and the ``kfac-obs`` timeline
+(:mod:`..obs`). What was missing is the part a platform operator
+actually touches: *submit a job, forget about it, read its status*.
+This package is that layer — ROADMAP item 5, "production scale,
+millions of users":
+
+- :mod:`spec` — the tenant-facing job spec: JSON naming a tenant, one
+  of the six ``examples/`` trainers, CLI knobs (incl.
+  ``--kfac-autotune``), a priority and a retry budget. Validation is
+  STRICT (unknown keys, malformed tenants, unregistered trainers and
+  unsafe argv all fail at submit time, not at launch time three hours
+  later).
+- :mod:`queue` — a durable, crash-safe job queue on plain files: every
+  job is one atomically-written (tmp + rename) JSON state file carrying
+  a MONOTONIC job epoch (the PR-7 lineage pattern applied per job), so
+  a SIGKILLed scheduler restarts with no lost and no duplicated jobs,
+  and a stale observation of a dead generation can requeue a job at
+  most once. Readers tolerate torn JSON the same way every protocol
+  reader in :mod:`..resilience` does: skip, retry next poll, never
+  delete.
+- :mod:`scheduler` — the admission controller (``kfac-serve``): packs
+  queued jobs onto the available pod capacity (a live, re-read
+  ``hosts.json`` — capacity can shrink or grow mid-run), launches each
+  job under ``kfac-pod-supervise``, classifies exits through the
+  existing rc grammar (0 done / 114 hang / 115 peer-dead / 116
+  join-failed / 117 fenced), requeues with backoff on pod failure, and
+  gives every job a per-tenant namespace (run logs, trace dir,
+  Prometheus textfile, checkpoints, lease dir) plus a collision-free
+  ``KFAC_HB_PORT`` block so jobs sharing a host never fight over
+  heartbeat ports or lease files.
+
+Service events land in the run log in the shared incident grammar
+(``job_admit`` / ``job_requeue`` / ``job_done`` / ``job_lost`` /
+``pool_shrink``), so ``kfac-obs`` — including the new ``--follow``
+live mode — renders a tenant's whole story (admit -> failure ->
+requeue -> done) with zero service-specific aggregation code.
+
+Everything here is dependency-free stdlib: the scheduler must run on a
+controller node with no accelerator stack at all.
+"""
+
+from kfac_pytorch_tpu.service.spec import (  # noqa: F401
+    SpecError, JobSpec, TRAINERS, validate_spec)
+from kfac_pytorch_tpu.service.queue import JobQueue  # noqa: F401
+from kfac_pytorch_tpu.service.scheduler import (  # noqa: F401
+    AdmissionController, PortAllocator, PortConflictError, classify_rc)
+
+__all__ = [
+    'SpecError', 'JobSpec', 'TRAINERS', 'validate_spec', 'JobQueue',
+    'AdmissionController', 'PortAllocator', 'PortConflictError',
+    'classify_rc',
+]

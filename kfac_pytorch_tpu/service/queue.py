@@ -1,0 +1,276 @@
+"""Durable crash-safe job queue on plain files.
+
+Design constraints (the tentpole's hard ones):
+
+- **A SIGKILLed scheduler restarts with no lost and no duplicated
+  jobs.** Submission is a SPOOL write (``incoming/spec-<unique>.json``,
+  atomic tmp + rename); the scheduler INGESTS spool files into
+  numbered job state files (``jobs/job-<id>.json``) and only then
+  removes the spool entry. A crash between the two leaves the spool
+  file behind — the restarted ingest sees its ``origin`` already
+  recorded on an existing job and just completes the cleanup, so the
+  job exists exactly once. Jobs that were RUNNING when the scheduler
+  died are its own children — they died with it — and
+  :meth:`JobQueue.recover` requeues them (zero lost).
+
+- **Monotonic job epochs** (the PR-7 lineage pattern applied per job):
+  every state transition rewrites the job file atomically with
+  ``epoch + 1``, and :meth:`JobQueue.transition` refuses to apply a
+  transition computed against a stale epoch. That is what makes the
+  scheduler's requeue *fencing-aware*: when a fenced pod generation
+  collapses and several per-host supervisor exits are observed for the
+  same job, the first observation's requeue bumps the epoch and every
+  later one no-ops — the job re-enters the queue exactly once.
+
+- **Torn-JSON tolerance**: the same discipline every protocol reader
+  in :mod:`..resilience` follows — an unreadable state file is skipped
+  this poll and retried next poll, never deleted. Writers are atomic
+  (``resilience.atomic_write_json``), so a torn read means a reader
+  raced a crash, and the artifact is still the source of truth.
+
+One scheduler process owns the ``jobs/`` directory; the spool accepts
+concurrent submitters (each spool name is unique by construction).
+"""
+
+import json
+import os
+import random
+import time
+
+from kfac_pytorch_tpu.resilience import atomic_write_json
+from kfac_pytorch_tpu.service.spec import SpecError, validate_spec
+
+#: job lifecycle states. ``lost`` is terminal-with-alarm: the retry
+#: budget is spent and an operator must look (the ``job_lost`` incident
+#: line is the alarm); ``done`` is the only happy terminal state.
+STATES = ('queued', 'running', 'done', 'lost')
+
+
+def _read_json(path):
+    """Torn-tolerant read: one immediate retry (the writer may be
+    mid-rename), then None — the caller skips and re-polls."""
+    for _ in range(2):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except ValueError:
+            time.sleep(0.01)
+            continue
+        except OSError:
+            return None
+    return None
+
+
+class JobQueue:
+    """The durable queue under ``service_dir``.
+
+    Layout::
+
+        service_dir/
+          incoming/spec-*.json     submission spool (any process writes)
+          jobs/job-<id>.json       one state file per job (scheduler owns)
+          rejected/...             invalid submissions, kept for forensics
+          tenants/<tenant>/job-<id>/   per-job namespaces (scheduler)
+    """
+
+    def __init__(self, service_dir, *, trainers=None, wall=time.time,
+                 create=True):
+        """``create=False``: read-only attach (``kfac-serve status``) —
+        inspecting a mistyped path must not scaffold a service dir
+        there."""
+        self.service_dir = str(service_dir)
+        self.incoming = os.path.join(self.service_dir, 'incoming')
+        self.jobs_dir = os.path.join(self.service_dir, 'jobs')
+        self.rejected = os.path.join(self.service_dir, 'rejected')
+        self.trainers = trainers
+        self.wall = wall
+        if create:
+            for d in (self.incoming, self.jobs_dir, self.rejected):
+                os.makedirs(d, exist_ok=True)
+
+    # -- submission (any process) -----------------------------------------
+
+    def submit(self, payload):
+        """Validate ``payload`` and drop it in the spool. Returns the
+        spool filename. Raises :class:`SpecError` on an invalid spec —
+        rejection happens at the submitter, with every problem named."""
+        spec = validate_spec(payload, trainers=self.trainers)
+        name = (f'spec-{int(self.wall() * 1e6):016d}-{os.getpid()}'
+                f'-{random.randrange(16 ** 6):06x}.json')
+        atomic_write_json(os.path.join(self.incoming, name),
+                          spec.to_dict(), indent=2)
+        return name
+
+    # -- ingest (scheduler only) ------------------------------------------
+
+    def _job_path(self, job_id):
+        return os.path.join(self.jobs_dir, f'job-{int(job_id):06d}.json')
+
+    def _known_origins(self):
+        return {j.get('origin') for j in self.jobs() if j.get('origin')}
+
+    def ingest(self, log=None):
+        """Move spool entries into numbered job files. Returns the list
+        of newly-created job records. Idempotent across crashes: a
+        spool file whose ``origin`` already has a job is cleanup-only,
+        an unreadable spool file waits for the next poll, an INVALID
+        one (validation is re-run here — the registry may differ from
+        the submitter's) moves to ``rejected/`` with the reason."""
+        try:
+            names = sorted(os.listdir(self.incoming))
+        except OSError:
+            return []
+        if not names:
+            return []
+        origins = self._known_origins()
+        next_id = 1 + max((j['id'] for j in self.jobs()), default=0)
+        created = []
+        for name in names:
+            spool = os.path.join(self.incoming, name)
+            if name in origins:
+                # crashed after the job write, before the spool remove
+                try:
+                    os.remove(spool)
+                except OSError:
+                    pass
+                continue
+            payload = _read_json(spool)
+            if payload is None:
+                continue  # torn mid-write: re-poll
+            try:
+                spec = validate_spec(payload, trainers=self.trainers)
+            except SpecError as e:
+                try:
+                    os.replace(spool, os.path.join(self.rejected, name))
+                    atomic_write_json(
+                        os.path.join(self.rejected, name + '.reason'),
+                        {'problems': e.problems})
+                except OSError:
+                    pass
+                if log is not None:
+                    log.error('service: rejected %s: %s', name, e)
+                continue
+            record = {
+                'id': next_id, 'epoch': 0, 'state': 'queued',
+                'spec': spec.to_dict(), 'origin': name,
+                'submitted': self.wall(), 'attempt': 0, 'requeues': 0,
+                'not_before': 0.0, 'history': [],
+            }
+            atomic_write_json(self._job_path(next_id), record, indent=2)
+            try:
+                os.remove(spool)
+            except OSError:
+                pass  # restart-time origin check completes the cleanup
+            created.append(record)
+            next_id += 1
+        return created
+
+    # -- reads -------------------------------------------------------------
+
+    def jobs(self):
+        """All readable job records, id-ordered. Torn files are skipped
+        (retried next poll), never deleted."""
+        try:
+            names = sorted(os.listdir(self.jobs_dir))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not (name.startswith('job-') and name.endswith('.json')):
+                continue
+            rec = _read_json(os.path.join(self.jobs_dir, name))
+            if isinstance(rec, dict) and isinstance(rec.get('id'), int):
+                out.append(rec)
+        return sorted(out, key=lambda r: r['id'])
+
+    def read(self, job_id):
+        return _read_json(self._job_path(job_id))
+
+    # -- transitions (scheduler only) --------------------------------------
+
+    def transition(self, record, to_state, **fields):
+        """Apply one state transition computed against ``record``.
+
+        The epoch CAS: the on-disk epoch must equal ``record['epoch']``
+        or the transition is REFUSED (returns None) — the record the
+        caller reasoned from is stale, someone already moved the job.
+        This is what bounds a fenced generation's requeue to exactly
+        once: every observer of the dead generation holds the same
+        epoch, the first transition bumps it, the rest no-op. On
+        success returns the new record (epoch + 1, history appended).
+        """
+        if to_state not in STATES:
+            raise ValueError(f'unknown state {to_state!r} '
+                             f'(states: {STATES})')
+        on_disk = self.read(record['id'])
+        if on_disk is None or on_disk.get('epoch') != record.get('epoch'):
+            return None
+        new = dict(on_disk)
+        new.update(fields)
+        new['epoch'] = on_disk['epoch'] + 1
+        new['state'] = to_state
+        new.setdefault('history', [])
+        new['history'] = list(new['history']) + [{
+            'wall': self.wall(), 'from': on_disk['state'],
+            'to': to_state, 'epoch': new['epoch'],
+            **{k: v for k, v in fields.items()
+               if isinstance(v, (str, int, float, bool))}}]
+        atomic_write_json(self._job_path(record['id']), new, indent=2)
+        return new
+
+    def claim(self, record, **fields):
+        """queued -> running (attempt bumped)."""
+        return self.transition(record, 'running',
+                               attempt=record.get('attempt', 0) + 1,
+                               **fields)
+
+    def requeue(self, record, *, rc, reason, backoff_s=0.0, **fields):
+        """running -> queued with backoff; None when the epoch moved
+        (someone else already requeued this observation — the
+        exactly-once guarantee)."""
+        return self.transition(
+            record, 'queued', last_rc=rc, last_reason=reason,
+            requeues=record.get('requeues', 0) + 1,
+            not_before=self.wall() + float(backoff_s), **fields)
+
+    def mark_done(self, record, **fields):
+        return self.transition(record, 'done', **fields)
+
+    def mark_lost(self, record, *, rc, reason, **fields):
+        return self.transition(record, 'lost', last_rc=rc,
+                               last_reason=reason, **fields)
+
+    # -- restart recovery --------------------------------------------------
+
+    def recover(self, log=None):
+        """Scheduler-restart sweep: every RUNNING job's processes were
+        this scheduler's children and died with it — requeue them all
+        (no backoff: nothing is crash-looping, the scheduler is).
+        Returns the requeued records. The requeue is charged to the
+        scheduler, not the job's retry budget (``requeues`` counts
+        real pod failures; a bounced controller must not burn a
+        tenant's budget)."""
+        out = []
+        for rec in self.jobs():
+            if rec.get('state') != 'running':
+                continue
+            new = self.transition(rec, 'queued', last_rc=None,
+                                  last_reason='scheduler_restart',
+                                  not_before=0.0)
+            if new is not None:
+                out.append(new)
+                if log is not None:
+                    log.warning(
+                        'service: recovered job=%d tenant=%s from a '
+                        'dead scheduler — requeued at epoch %d',
+                        new['id'], new['spec']['tenant'], new['epoch'])
+        return out
+
+    # -- status ------------------------------------------------------------
+
+    def counts(self):
+        c = {s: 0 for s in STATES}
+        for rec in self.jobs():
+            c[rec.get('state', 'queued')] = \
+                c.get(rec.get('state', 'queued'), 0) + 1
+        return c

@@ -1,0 +1,706 @@
+"""``kfac-serve`` — admission control and job recovery for the
+multi-tenant training service.
+
+The controller owns one service directory (the :class:`~.queue.JobQueue`
+layout) and a live capacity pool, and runs one loop::
+
+    ingest spool -> re-read capacity -> reap exits -> admit queued jobs
+
+Capacity is a ``hosts.json`` file (``{"hosts": {"h0": 2, "h1": 2}}``)
+re-read every cycle with the usual torn-JSON tolerance: an operator (or
+a drill) can shrink or grow the pool mid-run by rewriting it
+atomically. Losing a host is the service-level analogue of the pod
+layer's peer death — every job with ranks on the lost host is killed
+(SIGKILL to the process group, exactly how the host would have died)
+and requeued WITHOUT charging the tenant's retry budget; the pool
+change lands in the run log as ``pool_shrink`` / ``pool_grow`` in the
+shared incident grammar.
+
+Each admitted job launches under ``kfac-pod-supervise`` (one per host
+rank), so everything the resilience stack already does — crash/hang
+restarts, heartbeat peer death, elastic shrink/grow, quorum fencing —
+happens INSIDE the job; the service only judges the supervisors' final
+verdicts through the existing rc grammar:
+
+====  ============  =========================================
+rc    class         service reaction
+====  ============  =========================================
+0     done          ``job_done`` (any rank finishing cleanly
+                    completes the job — a shrunken pod's
+                    survivors carry the schedule)
+113   crash         requeue with backoff (budgeted)
+114   hang          requeue with backoff (budgeted)
+115   peer_dead     requeue with backoff (budgeted)
+116   join_failed   requeue with backoff (budgeted)
+117   fenced        requeue with backoff (budgeted) — the
+                    epoch CAS bounds a collapsed generation's
+                    many fenced exits to ONE requeue
+<0    signal        requeue with backoff (budgeted)
+====  ============  =========================================
+
+Per-tenant namespaces: every job gets
+``tenants/<tenant>/job-<id>/{lease,trace,ckpt,logs}`` plus
+``KFAC_TENANT`` / ``KFAC_JOB_ID`` / ``KFAC_TRACE_DIR`` /
+``KFAC_PROM_FILE`` in its environment, so run logs, traces and metric
+exports can never collide across tenants — and ``kfac-obs -r --follow
+tenants/<tenant>`` is a live per-tenant status endpoint. Jobs sharing
+a host additionally get disjoint ``KFAC_HB_PORT`` blocks from the
+:class:`PortAllocator`; an EXPLICIT port pinned by two co-resident
+specs is a loud admission failure, never a silent bind race.
+"""
+
+import argparse
+import contextlib
+import json
+import logging
+import os
+import signal as _signal
+import subprocess
+import sys
+import time
+
+from kfac_pytorch_tpu.resilience import atomic_write_json
+from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK
+from kfac_pytorch_tpu.service.queue import JobQueue, _read_json
+from kfac_pytorch_tpu.service.spec import TRAINERS, validate_spec
+
+log = logging.getLogger(__name__)
+
+#: the exit-code grammar the whole resilience stack speaks (supervisor
+#: STOP_RC_NAMES inverted, plus 0); anything else nonzero is a crash.
+RC_CLASSES = {0: 'done', 113: 'crash', 114: 'hang', 115: 'peer_dead',
+              116: 'join_failed', 117: 'fenced'}
+
+
+def classify_rc(rc):
+    """rc -> class name ('done' / 'hang' / ... / 'signal' / 'crash')."""
+    if rc is None:
+        return 'unknown'
+    if rc in RC_CLASSES:
+        return RC_CLASSES[rc]
+    return 'signal' if rc < 0 else 'crash'
+
+
+class PortConflictError(RuntimeError):
+    """Two co-scheduled jobs explicitly pinned the same heartbeat
+    port — an unservable spec, surfaced loudly at admission."""
+
+
+class PortAllocator:
+    """Disjoint per-job ``KFAC_HB_PORT`` blocks.
+
+    Every multi-rank job's TCP heartbeat responders bind
+    ``KFAC_HB_PORT`` on their host; two jobs sharing a host with the
+    same port silently cross-talk (or lose the bind race). Derived
+    allocations are spaced ``stride`` apart starting at ``base`` and
+    can never collide; a spec that PINS the port (``env:
+    {"KFAC_HB_PORT": ...}``) is honored but checked — a pin that
+    collides with any other live job's port raises
+    :class:`PortConflictError` instead of launching a doomed pod.
+    """
+
+    def __init__(self, base=8600, stride=16):
+        self.base = int(base)
+        self.stride = int(stride)
+        self._claims = {}   # job_id -> (port, explicit)
+
+    def claim(self, job_id, explicit=None):
+        in_use = {p for p, _ in self._claims.values()}
+        if explicit is not None:
+            explicit = int(explicit)
+            if explicit in in_use:
+                other = next(j for j, (p, _) in self._claims.items()
+                             if p == explicit)
+                raise PortConflictError(
+                    f'job {job_id} explicitly pins KFAC_HB_PORT='
+                    f'{explicit}, already held by job {other} — two '
+                    'jobs sharing a host cannot share a heartbeat '
+                    'port; drop the pin (the service derives disjoint '
+                    'blocks) or pick a free one')
+            self._claims[job_id] = (explicit, True)
+            return explicit
+        idx = 0
+        while True:
+            port = self.base + idx * self.stride
+            if port not in in_use:
+                self._claims[job_id] = (port, False)
+                return port
+            idx += 1
+
+    def release(self, job_id):
+        self._claims.pop(job_id, None)
+
+
+class _Run:
+    """One admitted job's live half: processes, placement, namespace."""
+
+    def __init__(self, record, ranks, port, ns):
+        self.record = record          # the claimed (running) record
+        self.ranks = ranks            # rank -> capacity host name
+        self.port = port
+        self.ns = ns                  # namespace paths dict
+        self.procs = {}               # rank -> Popen
+        self.files = []               # open log file handles
+        self.exits = {}               # rank -> rc (observed)
+
+    def hosts(self):
+        return sorted(set(self.ranks.values()))
+
+
+class AdmissionController:
+    """The service scheduler. One instance owns ``service_dir``."""
+
+    def __init__(self, service_dir, *, hosts=None, trainers=None,
+                 repo_root=None, base_port=8600, port_stride=16,
+                 max_restarts=3, hb_interval=1.0, hb_deadline=5.0,
+                 backoff_base=2.0, backoff_max=60.0, poll_period=0.5,
+                 supervisor_args=(), popen=subprocess.Popen,
+                 killer=None, clock=None, wall=time.time, env=None,
+                 log=None):
+        self.service_dir = str(service_dir)
+        self.trainers = dict(TRAINERS)
+        if trainers:
+            self.trainers.update(trainers)
+        self.queue = JobQueue(self.service_dir, trainers=self.trainers,
+                              wall=wall)
+        self.repo_root = repo_root or os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        self.ports = PortAllocator(base=base_port, stride=port_stride)
+        self.max_restarts = int(max_restarts)
+        self.hb_interval = float(hb_interval)
+        self.hb_deadline = float(hb_deadline)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.poll_period = float(poll_period)
+        self.supervisor_args = list(supervisor_args)
+        self.popen = popen
+        self.killer = killer or self._kill_group
+        self.clock = clock or REAL_CLOCK
+        self.wall = wall
+        self.env = env
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self.running = {}            # job_id -> _Run
+        self._stop = False
+        self._warned_unplaceable = set()
+        self.hosts_path = os.path.join(self.service_dir, 'hosts.json')
+        self.hosts = self._init_hosts(hosts)
+
+    # -- capacity ----------------------------------------------------------
+
+    def _init_hosts(self, hosts):
+        on_disk = self._read_hosts_file()
+        if on_disk is not None:
+            return on_disk
+        hosts = dict(hosts) if hosts else {'h0': 1}
+        atomic_write_json(self.hosts_path, {'hosts': hosts}, indent=2)
+        return hosts
+
+    def _read_hosts_file(self):
+        doc = _read_json(self.hosts_path)
+        if not isinstance(doc, dict):
+            return None
+        raw = doc.get('hosts')
+        if not isinstance(raw, dict) or not raw:
+            return None
+        out = {}
+        for name, slots in raw.items():
+            if isinstance(name, str) and isinstance(slots, int) \
+                    and slots > 0:
+                out[name] = slots
+        return out or None
+
+    def _refresh_hosts(self):
+        """Adopt a live capacity edit; a lost host kills + requeues its
+        jobs (uncharged — capacity loss is the operator's event, not
+        the tenant's)."""
+        now = self._read_hosts_file()
+        if now is None or now == self.hosts:
+            return
+        old_slots = sum(self.hosts.values())
+        new_slots = sum(now.values())
+        lost = sorted(set(self.hosts) - set(now))
+        added = sorted(set(now) - set(self.hosts))
+        self.hosts = now
+        # slot-count-only edits (h0: 2 -> 1, a drain) must land on the
+        # timeline too, not just whole-host removals; a drained host's
+        # jobs finish in place (over-commitment bleeds off naturally),
+        # a REMOVED host's jobs are killed and requeued
+        if lost or new_slots < old_slots:
+            self.log.warning('service: pool_shrink slots=%d -> %d '
+                             'lost=%s', old_slots, new_slots, lost)
+        if lost:
+            for run in list(self.running.values()):
+                if not set(run.hosts()) & set(lost):
+                    continue
+                if any(p.poll() == 0 for p in run.procs.values()):
+                    # the job FINISHED before its host disappeared
+                    # (step() reaps before refreshing, but the exit
+                    # can land mid-cycle): let the next reap mark it
+                    # done — requeueing would re-run a completed job
+                    continue
+                self._kill_run(run)
+                self._requeue(run, rc=-int(_signal.SIGKILL),
+                              klass='host_lost', charge=False)
+        if added or new_slots > old_slots:
+            self.log.warning('service: pool_grow slots=%d -> %d '
+                             'added=%s', old_slots, new_slots, added)
+        self._warned_unplaceable.clear()
+
+    def _used_slots(self):
+        used = {h: 0 for h in self.hosts}
+        for run in self.running.values():
+            for h in run.ranks.values():
+                used[h] = used.get(h, 0) + 1
+        return used
+
+    def _place(self, n_ranks):
+        """rank -> host placement for ``n_ranks`` slots, spreading
+        across the freest hosts first; None when the pool cannot hold
+        the job right now."""
+        used = self._used_slots()
+        free = [[self.hosts[h] - used.get(h, 0), h] for h in
+                sorted(self.hosts)]
+        if sum(max(0, f) for f, _ in free) < n_ranks:
+            return None
+        ranks = {}
+        for rank in range(n_ranks):
+            free.sort(key=lambda e: (-e[0], e[1]))
+            if free[0][0] <= 0:
+                return None
+            ranks[rank] = free[0][1]
+            free[0][0] -= 1
+        return ranks
+
+    # -- launch ------------------------------------------------------------
+
+    def _namespace(self, record):
+        tenant = record['spec']['tenant']
+        job = f'job-{record["id"]:06d}'
+        root = os.path.join(self.service_dir, 'tenants', tenant, job)
+        ns = {'ns': root,
+              'lease': os.path.join(root, 'lease'),
+              'trace': os.path.join(root, 'trace'),
+              'ckpt': os.path.join(root, 'ckpt'),
+              'logs': os.path.join(root, 'logs')}
+        for d in ns.values():
+            os.makedirs(d, exist_ok=True)
+        return ns
+
+    def _subst(self, arg, ns):
+        for key in ('ns', 'lease', 'trace', 'ckpt', 'logs'):
+            arg = arg.replace('{%s}' % key, ns[key])
+        return arg
+
+    def _job_env(self, record, ns, port):
+        env = dict(self.env if self.env is not None else os.environ)
+        env.update(record['spec'].get('env') or {})
+        tenant = record['spec']['tenant']
+        env['KFAC_TENANT'] = tenant
+        env['KFAC_JOB_ID'] = f'job-{record["id"]:06d}'
+        env['KFAC_TRACE_DIR'] = ns['trace']
+        # export the ALREADY-namespaced filename: the trainer-side
+        # namespacing (obs.setup_trainer) is then the identity, so the
+        # path a consumer reads from $KFAC_PROM_FILE is the path the
+        # exporter really writes
+        from kfac_pytorch_tpu.obs.metrics import namespaced_prom_path
+        env['KFAC_PROM_FILE'] = namespaced_prom_path(
+            os.path.join(ns['ns'], 'metrics.prom'), env)
+        env['KFAC_HB_PORT'] = str(port)
+        return env
+
+    def _rank_argv(self, record, ns, rank):
+        spec = validate_spec(record['spec'], trainers=self.trainers)
+        script = self.trainers[spec.trainer]
+        if not os.path.isabs(script):
+            script = os.path.join(self.repo_root, script)
+        trainer = [self._subst(a, ns) for a in
+                   spec.trainer_argv()]
+        # NOTE: the service's requeue backoff (--backoff-base/max) is
+        # deliberately NOT forwarded — the supervisor's intra-job
+        # restart backoff is a different policy and keeps its own
+        # defaults (override per deployment via --sup-arg)
+        return [sys.executable, '-m',
+                'kfac_pytorch_tpu.resilience.elastic',
+                '--host-id', str(rank),
+                '--num-hosts', str(spec.hosts),
+                '--lease-dir', ns['lease'],
+                '--max-restarts', str(self.max_restarts),
+                '--hb-interval', str(self.hb_interval),
+                '--hb-deadline', str(self.hb_deadline),
+                *self.supervisor_args,
+                '--', sys.executable, script, *trainer]
+
+    def _admit(self, record, ranks):
+        spec = record['spec']
+        ns = self._namespace(record)
+        try:
+            port = self.ports.claim(record['id'],
+                                    explicit=(spec.get('env') or {})
+                                    .get('KFAC_HB_PORT'))
+        except PortConflictError as e:
+            # loud, terminal, and attributed: an unservable pin must
+            # page the tenant, not crash-loop the pod
+            self.log.error('service: %s', e)
+            lost = self.queue.mark_lost(record, rc=None,
+                                        reason='port_conflict')
+            if lost is not None:
+                self.log.error(
+                    'service: job_lost job=%d tenant=%s rc=%d '
+                    'class=%s attempts=%d', record['id'],
+                    spec['tenant'], -1, 'port_conflict',
+                    record.get('attempt', 0))
+            return False
+        run = _Run(record, ranks, port, ns)
+        env = self._job_env(record, ns, port)
+        claimed = self.queue.claim(
+            record, placement={str(r): h for r, h in ranks.items()},
+            port=port, ns=ns['ns'])
+        if claimed is None:          # stale record: someone moved it
+            self.ports.release(record['id'])
+            return False
+        run.record = claimed
+        pids = []
+        try:
+            for rank in sorted(ranks):
+                argv = self._rank_argv(claimed, ns, rank)
+                out = open(os.path.join(
+                    ns['logs'], f'host{rank}.out'), 'ab')
+                run.files.append(out)
+                proc = self.popen(argv, env=env, cwd=self.repo_root,
+                                  stdout=out, stderr=subprocess.STDOUT,
+                                  start_new_session=True)
+                run.procs[rank] = proc
+                pids.append(proc.pid)
+        except OSError as e:
+            # a mid-launch failure (EMFILE, a vanished script, a full
+            # disk) must not crash the loop OR orphan the ranks that
+            # DID spawn: kill them, release the port, requeue the job
+            # uncharged — the fault is the controller node's
+            self.log.error('service: launch of job=%d failed mid-'
+                           'spawn: %s', record['id'], e)
+            self._kill_run(run)
+            self.ports.release(record['id'])
+            self.queue.requeue(claimed, rc=None, reason='launch_failed')
+            return False
+        # pids land in the state file so an operator (or the drill) can
+        # find the process group behind a job id
+        updated = self.queue.transition(claimed, 'running', pids=pids)
+        run.record = updated if updated is not None else claimed
+        self.running[record['id']] = run
+        self.log.warning(
+            'service: job_admit job=%d tenant=%s trainer=%s host=%s '
+            'attempt=%d port=%d', record['id'], spec['tenant'],
+            spec['trainer'], ','.join(run.hosts()),
+            run.record.get('attempt', 0), port)
+        return True
+
+    # -- reaping -----------------------------------------------------------
+
+    def _kill_group(self, proc):
+        with contextlib.suppress(ProcessLookupError, PermissionError,
+                                 OSError):
+            os.killpg(os.getpgid(proc.pid), _signal.SIGKILL)
+
+    def _kill_run(self, run):
+        for proc in run.procs.values():
+            if proc.poll() is None:
+                self.killer(proc)
+                with contextlib.suppress(Exception):
+                    proc.wait()
+        for f in run.files:
+            with contextlib.suppress(Exception):
+                f.close()
+
+    def _finish(self, run):
+        self.running.pop(run.record['id'], None)
+        self.ports.release(run.record['id'])
+        for f in run.files:
+            with contextlib.suppress(Exception):
+                f.close()
+
+    def _requeue(self, run, *, rc, klass, charge=True):
+        """One job-level requeue for one observed failure. The queue's
+        epoch CAS makes this exactly-once per observation — a fenced
+        generation reporting 117 from every host still re-enters the
+        queue a single time."""
+        record = run.record
+        spec = record['spec']
+        budget = spec.get('retry_budget', 2)
+        charged = record.get('charged_requeues', 0)
+        if charge and charged >= budget:
+            lost = self.queue.mark_lost(record, rc=rc, reason=klass)
+            if lost is not None:
+                self.log.error(
+                    'service: job_lost job=%d tenant=%s rc=%d class=%s '
+                    'attempts=%d', record['id'], spec['tenant'],
+                    rc if rc is not None else -1, klass,
+                    record.get('attempt', 0))
+            self._finish(run)
+            return
+        backoff = 0.0
+        if charge:
+            backoff = min(self.backoff_max,
+                          self.backoff_base * (2 ** charged))
+        new = self.queue.requeue(
+            record, rc=rc, reason=klass, backoff_s=backoff,
+            charged_requeues=charged + (1 if charge else 0))
+        if new is not None:
+            self.log.warning(
+                'service: job_requeue job=%d tenant=%s rc=%d class=%s '
+                'attempt=%d backoff_s=%.1f', record['id'],
+                spec['tenant'], rc if rc is not None else -1, klass,
+                record.get('attempt', 0), backoff)
+        self._finish(run)
+
+    def _reap(self):
+        for run in list(self.running.values()):
+            for rank, proc in run.procs.items():
+                if rank in run.exits:
+                    continue
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                run.exits[rank] = rc
+                if rc == 0:
+                    # one clean DONE completes the job: a shrunken
+                    # pod's survivors carried the whole schedule (the
+                    # elastic layer's schedule-equivalence contract),
+                    # so remaining ranks are wound down, not failed
+                    self._kill_run(run)
+                    done = self.queue.mark_done(
+                        run.record, exit_rcs=dict(
+                            (str(r), c) for r, c in run.exits.items()))
+                    if done is not None:
+                        self.log.warning(
+                            'service: job_done job=%d tenant=%s '
+                            'attempts=%d', run.record['id'],
+                            run.record['spec']['tenant'],
+                            run.record.get('attempt', 0))
+                    self._finish(run)
+                    break
+                self.log.warning(
+                    'service: job=%d rank=%d exited rc=%d (%s), %d '
+                    'rank(s) still up', run.record['id'], rank, rc,
+                    classify_rc(rc),
+                    sum(1 for p in run.procs.values()
+                        if p.poll() is None))
+            else:
+                if (run.record['id'] in self.running
+                        and len(run.exits) == len(run.procs)):
+                    # every rank down, none clean: the generation is
+                    # gone — one classification, one requeue
+                    rc = next(iter(run.exits.values()))
+                    for c in run.exits.values():
+                        if classify_rc(c) == 'fenced':
+                            rc = c
+                            break
+                    self._requeue(run, rc=rc, klass=classify_rc(rc))
+
+    # -- the loop ----------------------------------------------------------
+
+    def step(self):
+        """One scheduling cycle; returns True while there is (or may
+        be) work left."""
+        self.queue.ingest(log=self.log)
+        # reap BEFORE refreshing capacity: a job that already finished
+        # on a just-removed host must be marked done, not requeued
+        self._reap()
+        self._refresh_hosts()
+        now = self.wall()
+        queued = [r for r in self.queue.jobs()
+                  if r['state'] == 'queued'
+                  and r.get('not_before', 0) <= now]
+        queued.sort(key=lambda r: (-r['spec'].get('priority', 0),
+                                   r['id']))
+        for record in queued:
+            ranks = self._place(record['spec'].get('hosts', 1))
+            if ranks is None:
+                need = record['spec'].get('hosts', 1)
+                if (record['id'] not in self._warned_unplaceable
+                        and need > sum(self.hosts.values())):
+                    self._warned_unplaceable.add(record['id'])
+                    self.log.warning(
+                        'service: job=%d needs %d slot(s) but the pool '
+                        'has %d — waiting for capacity', record['id'],
+                        need, sum(self.hosts.values()))
+                continue
+            self._admit(record, ranks)
+        counts = self.queue.counts()
+        return bool(self.running or counts.get('queued'))
+
+    def run(self, *, drain=False, max_seconds=None):
+        """Loop until stopped. ``drain``: exit once the queue is empty
+        and nothing is running (the drill/CI mode). ``max_seconds``:
+        hard bound. On exit every live child is killed and requeued so
+        the NEXT scheduler finds a consistent queue."""
+        self.queue.recover(log=self.log)
+        start = self.clock.monotonic()
+        try:
+            while not self._stop:
+                busy = self.step()
+                if drain and not busy and not os.listdir(
+                        self.queue.incoming):
+                    return 0
+                if (max_seconds is not None
+                        and self.clock.monotonic() - start
+                        >= max_seconds):
+                    return 0 if drain and not busy else 1
+                self.clock.sleep(self.poll_period)
+        finally:
+            for run in list(self.running.values()):
+                self._kill_run(run)
+                self._requeue(run, rc=-int(_signal.SIGKILL),
+                              klass='scheduler_stop', charge=False)
+        return 0
+
+    def stop(self):
+        self._stop = True
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _parse_hosts(value):
+    hosts = {}
+    for part in value.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            name, slots = part.split('=', 1)
+            hosts[name.strip()] = int(slots)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f'hosts must be "name=slots,..." — got {value!r}') \
+                from None
+    if not hosts:
+        raise argparse.ArgumentTypeError('empty hosts spec')
+    return hosts
+
+
+def _parse_trainer(value):
+    try:
+        name, script = value.split('=', 1)
+        return name.strip(), script.strip()
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f'trainer must be "name=path", got {value!r}') from None
+
+
+def _setup_logging(service_dir):
+    """asctime-stamped (the kfac-obs alignment format), mirrored to
+    <service_dir>/service.log — the file IS a timeline source."""
+    root = logging.getLogger()
+    if not root.handlers:
+        logging.basicConfig(level=logging.INFO,
+                            format='%(asctime)s %(message)s')
+    os.makedirs(service_dir, exist_ok=True)
+    fh = logging.FileHandler(os.path.join(service_dir, 'service.log'))
+    fh.setFormatter(logging.Formatter('%(asctime)s %(message)s'))
+    root.addHandler(fh)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='kfac-serve',
+        description='Multi-tenant K-FAC training service: durable job '
+                    'queue + admission control over pod capacity.')
+    sub = p.add_subparsers(dest='cmd', required=True)
+
+    pr = sub.add_parser('run', help='run the scheduler loop')
+    pr.add_argument('--service-dir', required=True)
+    pr.add_argument('--slots', type=int, default=None,
+                    help='shorthand for a single-host pool of N slots')
+    pr.add_argument('--hosts', type=_parse_hosts, default=None,
+                    metavar='h0=2,h1=2',
+                    help='named capacity pool (ignored when '
+                         'hosts.json already exists — edit that file '
+                         'to change capacity live)')
+    pr.add_argument('--trainer', type=_parse_trainer, action='append',
+                    default=[], metavar='NAME=SCRIPT',
+                    help='extend the trainer registry (drills register '
+                         'their miniature trainer here)')
+    pr.add_argument('--poll', type=float, default=0.5)
+    pr.add_argument('--max-restarts', type=int, default=3,
+                    help='per-job supervisor restart budget (intra-job; '
+                         'the spec retry_budget is the service-level '
+                         'requeue budget)')
+    pr.add_argument('--hb-interval', type=float, default=1.0)
+    pr.add_argument('--hb-deadline', type=float, default=5.0)
+    pr.add_argument('--backoff-base', type=float, default=2.0)
+    pr.add_argument('--backoff-max', type=float, default=60.0)
+    pr.add_argument('--sup-arg', action='append', default=[],
+                    help='extra kfac-pod-supervise flag (repeatable, '
+                         'e.g. --sup-arg=--settle=1)')
+    pr.add_argument('--drain', action='store_true',
+                    help='exit 0 once the queue is empty and idle')
+    pr.add_argument('--max-seconds', type=float, default=None)
+
+    ps = sub.add_parser('submit', help='validate a spec and spool it')
+    ps.add_argument('--service-dir', required=True)
+    ps.add_argument('--trainer', type=_parse_trainer, action='append',
+                    default=[], metavar='NAME=SCRIPT',
+                    help='extend the trainer registry for validation '
+                         '(match the flags the running scheduler was '
+                         'given — ingest re-validates against its own '
+                         'registry either way)')
+    ps.add_argument('spec', help='spec JSON file (- for stdin)')
+
+    pt = sub.add_parser('status', help='print the queue state')
+    pt.add_argument('--service-dir', required=True)
+
+    args = p.parse_args(argv)
+
+    if args.cmd == 'submit':
+        raw = (sys.stdin.read() if args.spec == '-'
+               else open(args.spec).read())
+        queue = JobQueue(args.service_dir,
+                         trainers={**TRAINERS, **dict(args.trainer)})
+        name = queue.submit(json.loads(raw))
+        print(f'spooled {name}')
+        return 0
+
+    if args.cmd == 'status':
+        # read-only: go straight to the queue (instantiating the
+        # controller would initialize hosts.json as a side effect)
+        queue = JobQueue(args.service_dir, create=False)
+        print(f'service {args.service_dir} — '
+              + ' '.join(f'{k}={v}' for k, v in
+                         sorted(queue.counts().items())))
+        for rec in queue.jobs():
+            spec = rec['spec']
+            print(f'  job-{rec["id"]:06d}  {rec["state"]:<8} '
+                  f'tenant={spec["tenant"]:<12} '
+                  f'trainer={spec["trainer"]} '
+                  f'attempt={rec.get("attempt", 0)} '
+                  f'requeues={rec.get("requeues", 0)} '
+                  f'epoch={rec.get("epoch", 0)}')
+        return 0
+
+    _setup_logging(args.service_dir)
+    hosts = args.hosts
+    if hosts is None and args.slots is not None:
+        hosts = {'h0': args.slots}
+    sup_args = []
+    for a in args.sup_arg:
+        sup_args.extend(a.split('=', 1) if a.startswith('--') and '='
+                        in a else [a])
+    ctl = AdmissionController(
+        args.service_dir, hosts=hosts, trainers=dict(args.trainer),
+        poll_period=args.poll, max_restarts=args.max_restarts,
+        hb_interval=args.hb_interval, hb_deadline=args.hb_deadline,
+        backoff_base=args.backoff_base, backoff_max=args.backoff_max,
+        supervisor_args=sup_args)
+
+    def _stop(signum, frame):
+        ctl.stop()
+    with contextlib.suppress(ValueError):
+        _signal.signal(_signal.SIGTERM, _stop)
+        _signal.signal(_signal.SIGINT, _stop)
+    return ctl.run(drain=args.drain, max_seconds=args.max_seconds)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,191 @@
+"""Job specs: what a tenant asks the training service to run.
+
+A spec is plain JSON. The contract is deliberately narrow — a tenant
+names a TRAINER (one of the six ``examples/`` programs), not an
+arbitrary command, and every field is validated strictly at submit
+time: a malformed spec is rejected before it ever reaches the queue,
+because "the scheduler crashed at 3am on job 4123's argv" is exactly
+the class of incident this service exists to prevent.
+
+Schema (README "Training service")::
+
+    {
+      "tenant":  "alice",                  # required: [a-z0-9][a-z0-9_-]*
+      "trainer": "cifar10_resnet",         # required: a registered trainer
+      "args":    ["--epochs", "3", "--checkpoint-dir", "{ckpt}"],
+      "knobs":   {"kfac_autotune": true, "kfac_update_freq": 10},
+      "env":     {"KFAC_COMM_PRECISION": "bf16"},
+      "hosts":   1,                        # pod size (>= 1)
+      "priority": 0,                       # higher admits first
+      "retry_budget": 2,                   # requeues before job_lost
+      "name":    "nightly-sweep"           # optional label
+    }
+
+``knobs`` is the structured face of the trainer CLI: each key becomes
+``--key-with-dashes`` (value ``true`` -> a bare flag, e.g.
+``kfac_autotune: true`` -> ``--kfac-autotune``; a scalar -> flag +
+value; ``false``/``null`` -> omitted). ``args`` is the free-form tail
+for anything the knob map does not cover; both support the scheduler's
+path placeholders (``{ckpt}``, ``{ns}``, ``{trace}`` — the job's
+per-tenant namespace) plus the pod supervisor's ``{host_id}`` /
+``{num_hosts}`` / ``{gen}``. ``env`` may only set ``KFAC_*`` / ``JAX_*``
+variables — a spec must not be able to rewrite PATH on the host.
+"""
+
+import re
+
+#: the six example trainers a spec may name, mapped to their repo-
+#: relative scripts. The scheduler may extend this registry (drills
+#: register their miniature trainer); specs are validated against the
+#: registry in force at submit/ingest time.
+TRAINERS = {
+    'cifar10_resnet': 'examples/cifar10_resnet.py',
+    'imagenet_resnet': 'examples/imagenet_resnet.py',
+    'longcontext_lm': 'examples/longcontext_lm.py',
+    'multi30k_transformer': 'examples/multi30k_transformer.py',
+    'squad_bert': 'examples/squad_bert.py',
+    'wikitext_rnn': 'examples/wikitext_rnn.py',
+}
+
+_TENANT = re.compile(r'^[a-z0-9][a-z0-9_-]{0,62}$')
+_KNOB = re.compile(r'^[a-z][a-z0-9_]{0,62}$')
+_ENVKEY = re.compile(r'^(KFAC|JAX)_[A-Z0-9_]{1,62}$')
+_FIELDS = frozenset({'tenant', 'trainer', 'args', 'knobs', 'env',
+                     'hosts', 'priority', 'retry_budget', 'name'})
+
+
+class SpecError(ValueError):
+    """A job spec failed validation; ``problems`` lists every failure
+    (a tenant fixing a spec should see all of them at once, not one
+    per round trip)."""
+
+    def __init__(self, problems):
+        self.problems = list(problems)
+        super().__init__('invalid job spec: ' + '; '.join(self.problems))
+
+
+class JobSpec:
+    """A validated job spec. Construct through :func:`validate_spec`."""
+
+    def __init__(self, tenant, trainer, args=(), knobs=None, env=None,
+                 hosts=1, priority=0, retry_budget=2, name=None):
+        self.tenant = tenant
+        self.trainer = trainer
+        self.args = tuple(args)
+        self.knobs = dict(knobs or {})
+        self.env = dict(env or {})
+        self.hosts = int(hosts)
+        self.priority = int(priority)
+        self.retry_budget = int(retry_budget)
+        self.name = name
+
+    def to_dict(self):
+        d = {'tenant': self.tenant, 'trainer': self.trainer,
+             'args': list(self.args), 'knobs': dict(self.knobs),
+             'env': dict(self.env), 'hosts': self.hosts,
+             'priority': self.priority,
+             'retry_budget': self.retry_budget}
+        if self.name is not None:
+            d['name'] = self.name
+        return d
+
+    def trainer_argv(self):
+        """The trainer's CLI tail: knob flags first (stable sorted
+        order — two submissions of one spec must build one argv), then
+        the free-form ``args``. The script path itself is resolved
+        from the scheduler's registry at LAUNCH time, not here."""
+        argv = []
+        for key in sorted(self.knobs):
+            val = self.knobs[key]
+            if val is False or val is None:
+                continue
+            flag = '--' + key.replace('_', '-')
+            if val is True:
+                argv.append(flag)
+            else:
+                argv.extend([flag, str(val)])
+        argv.extend(self.args)
+        return argv
+
+
+def _check_scalar(problems, what, val):
+    if not isinstance(val, (str, int, float)) or isinstance(val, bool):
+        problems.append(f'{what} must be a string or number, got '
+                        f'{type(val).__name__}')
+    elif isinstance(val, str) and ('\n' in val or '\x00' in val):
+        problems.append(f'{what} contains a newline/NUL')
+
+
+def validate_spec(payload, trainers=None):
+    """``dict`` -> :class:`JobSpec`, or raise :class:`SpecError` with
+    EVERY problem found. ``trainers``: the registry in force (default
+    :data:`TRAINERS`)."""
+    trainers = trainers if trainers is not None else TRAINERS
+    problems = []
+    if not isinstance(payload, dict):
+        raise SpecError([f'spec must be a JSON object, got '
+                         f'{type(payload).__name__}'])
+    unknown = sorted(set(payload) - _FIELDS)
+    if unknown:
+        problems.append(f'unknown field(s) {unknown} '
+                        f'(allowed: {sorted(_FIELDS)})')
+    tenant = payload.get('tenant')
+    if not isinstance(tenant, str) or not _TENANT.match(tenant or ''):
+        problems.append("'tenant' must match [a-z0-9][a-z0-9_-]* "
+                        f'(<= 63 chars), got {tenant!r}')
+    trainer = payload.get('trainer')
+    if not isinstance(trainer, str) or trainer not in trainers:
+        problems.append(f"'trainer' must be one of "
+                        f'{sorted(trainers)}, got {trainer!r}')
+    args = payload.get('args', [])
+    if not isinstance(args, (list, tuple)):
+        problems.append("'args' must be a list of strings")
+        args = []
+    for i, a in enumerate(args):
+        if not isinstance(a, str):
+            problems.append(f'args[{i}] must be a string, got '
+                            f'{type(a).__name__}')
+        elif '\n' in a or '\x00' in a:
+            problems.append(f'args[{i}] contains a newline/NUL')
+    knobs = payload.get('knobs', {})
+    if not isinstance(knobs, dict):
+        problems.append("'knobs' must be an object")
+        knobs = {}
+    for k, v in knobs.items():
+        if not isinstance(k, str) or not _KNOB.match(k):
+            problems.append(f'knob name {k!r} must match '
+                            '[a-z][a-z0-9_]*')
+        if not isinstance(v, bool) and v is not None:
+            _check_scalar(problems, f'knob {k!r}', v)
+    env = payload.get('env', {})
+    if not isinstance(env, dict):
+        problems.append("'env' must be an object")
+        env = {}
+    for k, v in env.items():
+        if not isinstance(k, str) or not _ENVKEY.match(k):
+            problems.append(f'env key {k!r} must match KFAC_*/JAX_* '
+                            '(a spec cannot set arbitrary host env)')
+        if not isinstance(v, str):
+            problems.append(f'env[{k!r}] must be a string')
+    hosts = payload.get('hosts', 1)
+    if not isinstance(hosts, int) or isinstance(hosts, bool) or hosts < 1:
+        problems.append(f"'hosts' must be an integer >= 1, got {hosts!r}")
+        hosts = 1
+    priority = payload.get('priority', 0)
+    if not isinstance(priority, int) or isinstance(priority, bool):
+        problems.append(f"'priority' must be an integer, got {priority!r}")
+        priority = 0
+    retry = payload.get('retry_budget', 2)
+    if not isinstance(retry, int) or isinstance(retry, bool) or retry < 0:
+        problems.append(f"'retry_budget' must be an integer >= 0, "
+                        f'got {retry!r}')
+        retry = 2
+    name = payload.get('name')
+    if name is not None and (not isinstance(name, str)
+                             or len(name) > 128 or '\n' in name):
+        problems.append(f"'name' must be a short single-line string")
+    if problems:
+        raise SpecError(problems)
+    return JobSpec(tenant=tenant, trainer=trainer, args=args,
+                   knobs=knobs, env=env, hosts=hosts, priority=priority,
+                   retry_budget=retry, name=name)

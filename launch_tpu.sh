@@ -85,6 +85,22 @@ if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi
 
+# Training service (kfac-serve, kfac_pytorch_tpu/service/): when this
+# launch is one tenant job of the multi-tenant service, the scheduler
+# exports the per-job namespace env — pass it through so every child
+# (supervisor + trainer) logs, traces and exports metrics into the
+# job's own tenant directory instead of a shared path:
+#   KFAC_TENANT     tenant name (metrics/prom paths are namespaced by it)
+#   KFAC_JOB_ID     job-NNNNNN (ditto)
+#   KFAC_PROM_FILE  the job's Prometheus textfile (trainers default
+#                   --prom-file to it)
+# KFAC_HB_PORT is also service-assigned per job (disjoint blocks), so
+# jobs sharing a host never fight over heartbeat responder ports — the
+# ${KFAC_HB_PORT:-8478} default below only applies outside the service.
+[ -n "$KFAC_TENANT" ] && export KFAC_TENANT
+[ -n "$KFAC_JOB_ID" ] && export KFAC_JOB_ID
+[ -n "$KFAC_PROM_FILE" ] && export KFAC_PROM_FILE
+
 # Peer-heartbeat transport (KFAC_HB_*, resilience/heartbeat.py).
 # Contract consumed by heartbeat_from_env in every trainer:
 #   KFAC_HB_TRANSPORT  file | tcp  (default: tcp when the pod has >1

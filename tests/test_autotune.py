@@ -839,3 +839,92 @@ def test_controller_live_on_jax_trainer_converges():
             break
     assert pre.kfac_update_freq == 4
     assert ctl.state == 'steady'
+
+
+# ---------------------------------------------------------------------------
+# knob-arbiter state across generations (elastic shrink -> relaunch)
+# ---------------------------------------------------------------------------
+# An elastic shrink kills the trainer and relaunches it at the new
+# world size: a NEW process, a NEW preconditioner, a NEW arbiter — but
+# the tuner's artifacts must survive the generation boundary. Two
+# contracts, previously only asserted within one generation:
+#
+# - the decision log is APPEND-only across relaunches (same
+#   KFAC_TRACE_DIR -> same autotune-decisions.jsonl), so generation
+#   1's trajectory lands after generation 0's instead of clobbering it;
+# - a relaunch that restores the adopted knob values (the pod
+#   supervisor re-exports them; elastic_resume re-applies state) gets
+#   an arbiter whose BASE is the adopted cadence — a later schedule
+#   advance composes incrementally from it, and the tuner does not
+#   regress to the cold-start default.
+
+
+def test_decision_log_appends_across_generations(tmp_path):
+    log_path = tmp_path / 'trace' / 'autotune-decisions.jsonl'
+
+    def make_ctl(pre):
+        return autotune.KnobController(
+            pre, window=16, settle=1, dwell_windows=1, cooldown=2,
+            steady_every=0, tune=('kfac_update_freq',),
+            freq_bounds=(1, 8), decision_log=str(log_path))
+
+    # generation 0: converge to the planted optimum, decisions logged
+    pre0 = _FakePrecond(fac=1, kfac=1)
+    _feed(make_ctl(pre0), pre0, _amortized, 400)
+    assert pre0.kfac_update_freq == 8
+    gen0 = log_path.read_text().splitlines()
+    assert any(json.loads(ln)['kind'] == 'commit' for ln in gen0)
+
+    # shrink -> relaunch: fresh precond restored to the adopted knobs,
+    # fresh controller pointed at the SAME decision log
+    adopted = autotune._capture(pre0)
+    pre1 = _FakePrecond(fac=adopted['fac_update_freq'],
+                        kfac=adopted['kfac_update_freq'],
+                        damping=adopted['damping'])
+    _feed(make_ctl(pre1), pre1, _amortized, 120)
+
+    lines = log_path.read_text().splitlines()
+    # generation 0's trajectory is intact (append, never truncate) and
+    # generation 1 wrote after it
+    assert lines[:len(gen0)] == gen0
+    assert len(lines) > len(gen0)
+    # the relaunched window counter restarting (a fresh controller)
+    # marks the generation boundary in the artifact itself
+    gen1 = [json.loads(ln) for ln in lines[len(gen0):]]
+    assert gen1[0]['window'] <= 1
+    # and the adopted cadence holds — no regression to the cold default
+    assert pre1.kfac_update_freq == 8
+
+
+def test_arbiter_adopted_base_survives_relaunch_composition():
+    # generation 0: the tuner committed an absolute override
+    pre0 = _FakePrecond(fac=1, kfac=2, damping=0.04)
+    arb0 = autotune.arbiter_for(pre0)
+    arb0.propose('tuner', kfac_update_freq=8)
+    assert pre0.kfac_update_freq == 8
+
+    # relaunch: the restored knob values are the new construction-time
+    # base (single-writer enforcement stays on through the guard)
+    adopted = autotune._capture(pre0)
+    pre1 = _GuardedPrecond(fac=adopted['fac_update_freq'],
+                           kfac=adopted['kfac_update_freq'],
+                           damping=adopted['damping'])
+    arb1 = autotune.arbiter_for(pre1)
+    assert arb1.base['kfac_update_freq'] == 8
+    assert arb1.base['damping'] == pytest.approx(0.04)
+
+    # an epoch-schedule advance in the new generation composes
+    # INCREMENTALLY from the adopted base, not the old generation's
+    # pre-tuner default (2)
+    arb1.propose('schedule', freq_factor=2.0)
+    assert pre1.kfac_update_freq == 16
+    # elastic provenance records compose nothing (record-only lane)
+    arb1.propose('elastic', gen=1, world=2)
+    assert pre1.kfac_update_freq == 16
+    assert arb1.records and arb1.records[-1]['gen'] == 1
+    # a straggler stretch then multiplies the adopted-base schedule,
+    # and recovery restores exactly the composed value
+    arb1.propose('straggler', stretch=2)
+    assert pre1.kfac_update_freq == 32
+    arb1.propose('straggler', stretch=1)
+    assert pre1.kfac_update_freq == 16

@@ -1,0 +1,675 @@
+"""Multi-tenant training service (kfac_pytorch_tpu/service/).
+
+Pins the tentpole contracts with NO subprocesses (the real-process
+drill lives in tests/test_service_chaos.py behind -m slow):
+
+1. Spec validation is strict and total: unknown fields, malformed
+   tenants, unregistered trainers, unsafe argv/env all fail at submit
+   time, with EVERY problem named in one error.
+2. The queue is durable and crash-safe: submission spools atomically,
+   ingest is idempotent across a crash between job-write and
+   spool-remove (no duplicated jobs), torn job files are skipped and
+   retried (never deleted), and a scheduler restart requeues every
+   RUNNING job (no lost jobs) without charging the tenant's budget.
+3. Monotonic job epochs make every transition a CAS: a stale
+   observation cannot move a job — which is exactly what bounds a
+   fenced generation's many per-host exits to ONE requeue.
+4. The admission controller packs jobs onto live capacity, launches
+   one kfac-pod-supervise per rank with a per-tenant namespace and a
+   per-job heartbeat-port block; an EXPLICIT port pinned by two
+   co-resident specs fails loudly instead of bind-racing.
+5. Exits classify through the existing rc grammar (0/113/114/115/116/
+   117/signals) into requeue-with-backoff or job_lost at budget
+   exhaustion; a capacity loss (pool_shrink) kills + requeues
+   uncharged.
+6. Service events land in the shared incident grammar, so kfac-obs
+   renders admit -> failure -> requeue -> done per tenant — and the
+   new --follow mode tails them live.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+
+import pytest
+
+from kfac_pytorch_tpu.obs import aggregate, metrics
+from kfac_pytorch_tpu.resilience.incident import IncidentReport
+from kfac_pytorch_tpu.service import (
+    AdmissionController, JobQueue, PortAllocator, PortConflictError,
+    SpecError, classify_rc, validate_spec)
+
+pytestmark = pytest.mark.core
+
+
+# ---------------------------------------------------------------------------
+# spec validation
+# ---------------------------------------------------------------------------
+
+def _spec(**over):
+    base = {'tenant': 'alice', 'trainer': 'cifar10_resnet',
+            'args': ['--epochs', '3'], 'knobs': {'kfac_autotune': True},
+            'hosts': 1, 'priority': 0, 'retry_budget': 2}
+    base.update(over)
+    return base
+
+
+def test_spec_roundtrip_and_argv():
+    spec = validate_spec(_spec(knobs={'kfac_autotune': True,
+                                      'kfac_update_freq': 10,
+                                      'trace': None,
+                                      'speed': False}))
+    assert spec.tenant == 'alice'
+    argv = spec.trainer_argv()
+    # bare flag for True, flag+value for scalars, False/None omitted,
+    # knobs (sorted) before free-form args
+    assert argv == ['--kfac-autotune', '--kfac-update-freq', '10',
+                    '--epochs', '3']
+    assert validate_spec(spec.to_dict()).to_dict() == spec.to_dict()
+
+
+def test_spec_rejects_everything_at_once():
+    bad = {'tenant': 'Not Valid!', 'trainer': 'rm -rf /',
+           'args': ['ok', 7, 'has\nnewline'], 'knobs': {'BAD-KNOB': 1},
+           'env': {'PATH': '/evil'}, 'hosts': 0, 'retry_budget': -1,
+           'surprise': True}
+    with pytest.raises(SpecError) as ei:
+        validate_spec(bad)
+    text = str(ei.value)
+    for frag in ('tenant', 'trainer', 'args[1]', 'args[2]', 'BAD-KNOB',
+                 "env key 'PATH'", "'hosts'", "'retry_budget'",
+                 'surprise'):
+        assert frag in text, (frag, text)
+
+
+def test_spec_env_allows_only_kfac_jax():
+    spec = validate_spec(_spec(env={'KFAC_COMM_PRECISION': 'bf16',
+                                    'JAX_PLATFORMS': 'cpu'}))
+    assert spec.env['KFAC_COMM_PRECISION'] == 'bf16'
+    with pytest.raises(SpecError):
+        validate_spec(_spec(env={'LD_PRELOAD': 'x'}))
+
+
+def test_spec_registry_extension():
+    with pytest.raises(SpecError):
+        validate_spec(_spec(trainer='mini'))
+    spec = validate_spec(_spec(trainer='mini'),
+                         trainers={'mini': 'tests/chaos_trainer.py'})
+    assert spec.trainer == 'mini'
+
+
+# ---------------------------------------------------------------------------
+# the durable queue
+# ---------------------------------------------------------------------------
+
+def test_queue_submit_ingest_assigns_ids(tmp_path):
+    q = JobQueue(tmp_path)
+    q.submit(_spec())
+    q.submit(_spec(tenant='bob'))
+    created = q.ingest()
+    assert [r['id'] for r in created] == [1, 2]
+    assert not os.listdir(q.incoming)
+    jobs = q.jobs()
+    assert [(r['id'], r['state'], r['epoch']) for r in jobs] == \
+        [(1, 'queued', 0), (2, 'queued', 0)]
+    assert jobs[0]['spec']['tenant'] == 'alice'
+
+
+def test_queue_ingest_idempotent_across_crash(tmp_path):
+    """Crash between job-file write and spool remove: the restarted
+    ingest completes the cleanup WITHOUT duplicating the job."""
+    q = JobQueue(tmp_path)
+    name = q.submit(_spec())
+    q.ingest()
+    assert len(q.jobs()) == 1
+    # resurrect the spool entry exactly as a crash would have left it
+    from kfac_pytorch_tpu.resilience import atomic_write_json
+    atomic_write_json(os.path.join(q.incoming, name), _spec())
+    assert q.ingest() == []
+    assert len(q.jobs()) == 1          # no duplicate
+    assert not os.listdir(q.incoming)  # cleanup completed
+
+
+def test_queue_rejects_invalid_spool_to_rejected(tmp_path):
+    q = JobQueue(tmp_path)
+    from kfac_pytorch_tpu.resilience import atomic_write_json
+    atomic_write_json(os.path.join(q.incoming, 'spec-bad.json'),
+                      {'tenant': 'x y', 'trainer': 'nope'})
+    assert q.ingest() == []
+    assert not os.listdir(q.incoming)
+    names = os.listdir(q.rejected)
+    assert 'spec-bad.json' in names
+    reason = json.load(open(os.path.join(q.rejected,
+                                         'spec-bad.json.reason')))
+    assert reason['problems']
+
+
+def test_queue_torn_job_file_skipped_never_deleted(tmp_path):
+    q = JobQueue(tmp_path)
+    q.submit(_spec())
+    q.ingest()
+    torn = os.path.join(q.jobs_dir, 'job-000099.json')
+    with open(torn, 'w') as f:
+        f.write('{"id": 99, "state": "que')   # torn mid-write
+    jobs = q.jobs()
+    assert [r['id'] for r in jobs] == [1]     # the good job still reads
+    assert os.path.exists(torn)               # never deleted
+
+
+def test_queue_transition_epoch_cas(tmp_path):
+    """The fencing-aware requeue bound: two observers holding the same
+    epoch — the first transition wins, the second no-ops."""
+    q = JobQueue(tmp_path)
+    q.submit(_spec())
+    rec = q.ingest()[0]
+    running = q.claim(rec)
+    assert running['epoch'] == 1 and running['attempt'] == 1
+    # two copies of the SAME observation (e.g. two fenced host exits)
+    obs_a, obs_b = dict(running), dict(running)
+    first = q.requeue(obs_a, rc=117, reason='fenced', backoff_s=1.0)
+    assert first is not None and first['requeues'] == 1
+    assert q.requeue(obs_b, rc=117, reason='fenced') is None
+    assert q.read(rec['id'])['requeues'] == 1  # exactly once
+
+
+def test_queue_recover_requeues_running_jobs(tmp_path):
+    q = JobQueue(tmp_path)
+    q.submit(_spec())
+    q.submit(_spec(tenant='bob'))
+    a, b = q.ingest()
+    q.claim(a)
+    recovered = JobQueue(tmp_path).recover()
+    assert [r['id'] for r in recovered] == [a['id']]
+    states = {r['id']: r['state'] for r in q.jobs()}
+    assert states == {a['id']: 'queued', b['id']: 'queued'}
+    # a bounced controller never burns the tenant's budget
+    assert q.read(a['id']).get('charged_requeues', 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# rc grammar + port allocation
+# ---------------------------------------------------------------------------
+
+def test_classify_rc_grammar():
+    assert classify_rc(0) == 'done'
+    assert classify_rc(113) == 'crash'
+    assert classify_rc(114) == 'hang'
+    assert classify_rc(115) == 'peer_dead'
+    assert classify_rc(116) == 'join_failed'
+    assert classify_rc(117) == 'fenced'
+    assert classify_rc(-9) == 'signal'
+    assert classify_rc(1) == 'crash'
+    assert classify_rc(None) == 'unknown'
+
+
+def test_port_allocator_disjoint_blocks_and_explicit_conflict():
+    alloc = PortAllocator(base=8600, stride=16)
+    assert alloc.claim(1) == 8600
+    assert alloc.claim(2) == 8616
+    alloc.release(1)
+    assert alloc.claim(3) == 8600          # freed blocks are reusable
+    assert alloc.claim(4, explicit=9000) == 9000
+    with pytest.raises(PortConflictError):
+        alloc.claim(5, explicit=9000)      # explicit double-pin: loud
+    with pytest.raises(PortConflictError):
+        alloc.claim(6, explicit=8616)      # pin onto a derived block
+
+
+# ---------------------------------------------------------------------------
+# the admission controller (fake processes — no subprocess anywhere)
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    _next_pid = 50000
+
+    def __init__(self):
+        _FakeProc._next_pid += 1
+        self.pid = _FakeProc._next_pid
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        return self.rc if self.rc is not None else 0
+
+
+class _FakePopen:
+    """Records every launch; hands out settable fake processes."""
+
+    def __init__(self):
+        self.launches = []   # (argv, env)
+        self.procs = []
+
+    def __call__(self, argv, env=None, **kw):
+        proc = _FakeProc()
+        self.launches.append((list(argv), dict(env or {})))
+        self.procs.append(proc)
+        return proc
+
+
+def _controller(tmp_path, *, hosts=None, popen=None, wall=None, **kw):
+    popen = popen or _FakePopen()
+    killed = []
+    ctl = AdmissionController(
+        tmp_path / 'svc', hosts=hosts or {'h0': 2},
+        trainers={'mini': 'tests/chaos_trainer.py'},
+        popen=popen, killer=lambda p: killed.append(p.pid),
+        wall=wall or time.time, backoff_base=0.5, backoff_max=4.0,
+        log=logging.getLogger('svc-test'), **kw)
+    ctl._test_killed = killed
+    return ctl, popen
+
+
+def _mini(**over):
+    return _spec(trainer='mini',
+                 args=['--epochs', '2', '--checkpoint-dir', '{ckpt}'],
+                 knobs={}, **over)
+
+
+def test_admit_namespaces_env_and_ports(tmp_path):
+    ctl, popen = _controller(tmp_path)
+    ctl.queue.submit(_mini())
+    ctl.queue.submit(_mini(tenant='bob'))
+    ctl.step()
+    assert len(popen.launches) == 2
+    (argv_a, env_a), (argv_b, env_b) = popen.launches
+    # one kfac-pod-supervise per rank, trainer script resolved from the
+    # extended registry, {ckpt} substituted into the tenant namespace
+    assert 'kfac_pytorch_tpu.resilience.elastic' in argv_a
+    assert any(a.endswith('tests/chaos_trainer.py') for a in argv_a)
+    ckpt = argv_a[argv_a.index('--checkpoint-dir') + 1]
+    assert '{ckpt}' not in ckpt
+    assert os.path.join('tenants', 'alice', 'job-000001', 'ckpt') in ckpt
+    # per-tenant env namespace
+    assert env_a['KFAC_TENANT'] == 'alice'
+    assert env_a['KFAC_JOB_ID'] == 'job-000001'
+    assert 'alice' in env_a['KFAC_TRACE_DIR']
+    # the advertised prom path IS the file the exporter writes: the
+    # scheduler exports it pre-namespaced, trainer-side namespacing is
+    # then the identity
+    assert env_a['KFAC_PROM_FILE'].endswith(
+        'metrics-alice-job-000001.prom')
+    assert metrics.namespaced_prom_path(
+        env_a['KFAC_PROM_FILE'],
+        {'KFAC_TENANT': 'alice', 'KFAC_JOB_ID': 'job-000001'}) \
+        == env_a['KFAC_PROM_FILE']
+    assert env_b['KFAC_TENANT'] == 'bob'
+    # per-job lease subdirectory + disjoint heartbeat port blocks for
+    # two jobs sharing host h0 (the satellite-1 collision fix)
+    lease_a = argv_a[argv_a.index('--lease-dir') + 1]
+    lease_b = argv_b[argv_b.index('--lease-dir') + 1]
+    assert lease_a != lease_b
+    assert env_a['KFAC_HB_PORT'] != env_b['KFAC_HB_PORT']
+    jobs = {r['id']: r for r in ctl.queue.jobs()}
+    assert jobs[1]['state'] == 'running' and jobs[1]['port'] == 8600
+    assert jobs[2]['port'] == 8616
+    assert jobs[1]['placement'] == {'0': 'h0'}
+
+
+def test_admit_respects_capacity_and_priority(tmp_path):
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1})
+    ctl.queue.submit(_mini())                      # job 1, priority 0
+    ctl.queue.submit(_mini(tenant='bob', priority=5))  # job 2
+    ctl.step()
+    # one slot: only the HIGH-priority job runs
+    assert len(popen.launches) == 1
+    assert popen.launches[0][1]['KFAC_TENANT'] == 'bob'
+    assert ctl.queue.read(1)['state'] == 'queued'
+    # completion frees the slot; the next cycle admits the other job
+    popen.procs[0].rc = 0
+    ctl.step()
+    assert ctl.queue.read(2)['state'] == 'done'
+    assert len(popen.launches) == 2
+    assert popen.launches[1][1]['KFAC_TENANT'] == 'alice'
+
+
+def test_explicit_port_conflict_fails_loudly(tmp_path, caplog):
+    ctl, popen = _controller(tmp_path)
+    ctl.queue.submit(_mini(env={'KFAC_HB_PORT': '9100'}))
+    ctl.queue.submit(_mini(tenant='bob', env={'KFAC_HB_PORT': '9100'}))
+    with caplog.at_level(logging.ERROR, logger='svc-test'):
+        ctl.step()
+    assert len(popen.launches) == 1       # the pinned winner launched
+    assert ctl.queue.read(1)['state'] == 'running'
+    lost = ctl.queue.read(2)
+    assert lost['state'] == 'lost'
+    assert lost['last_reason'] == 'port_conflict'
+    assert 'KFAC_HB_PORT=9100' in caplog.text
+    assert 'job_lost' in caplog.text
+
+
+def test_reap_classifies_requeues_with_backoff_then_loses(tmp_path,
+                                                          caplog):
+    now = [1000.0]
+    ctl, popen = _controller(tmp_path, wall=lambda: now[0])
+    ctl.queue.submit(_mini(retry_budget=1))
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        popen.procs[0].rc = 114            # watchdog hang verdict
+        ctl.step()
+        rec = ctl.queue.read(1)
+        assert rec['state'] == 'queued'
+        assert rec['last_reason'] == 'hang'
+        assert rec['charged_requeues'] == 1
+        assert rec['not_before'] == pytest.approx(1000.5)  # backoff
+        # not ready yet: nothing admits before the backoff expires
+        ctl.step()
+        assert len(popen.launches) == 1
+        now[0] += 1.0
+        ctl.step()                         # relaunch (attempt 2)
+        assert len(popen.launches) == 2
+        popen.procs[1].rc = 115            # peer death this time
+        ctl.step()                         # budget (1) spent -> lost
+    rec = ctl.queue.read(1)
+    assert rec['state'] == 'lost'
+    assert rec['last_reason'] == 'peer_dead'
+    assert 'job_requeue job=1 tenant=alice rc=114 class=hang' \
+        in caplog.text
+    assert 'job_lost job=1 tenant=alice rc=115 class=peer_dead' \
+        in caplog.text
+
+
+def test_fenced_generation_requeues_exactly_once(tmp_path, caplog):
+    """Both ranks of a 2-host job exit fenced (117): ONE requeue."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1, 'h1': 1})
+    ctl.queue.submit(_mini(hosts=2))
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        assert len(popen.launches) == 2    # one supervisor per rank
+        assert ctl.queue.read(1)['placement'] == {'0': 'h0', '1': 'h1'}
+        popen.procs[0].rc = 117
+        popen.procs[1].rc = 117
+        ctl.step()
+    rec = ctl.queue.read(1)
+    assert rec['state'] == 'queued'
+    assert rec['last_reason'] == 'fenced'
+    assert rec['requeues'] == 1            # exactly once
+    assert caplog.text.count('job_requeue job=1') == 1
+
+
+def test_one_clean_rank_completes_a_shrunken_job(tmp_path):
+    """A 2-host job whose pod shrank: the fenced rank exits 117, the
+    survivor carries the schedule to DONE — the job is DONE, and the
+    already-dead rank is not double-judged."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1, 'h1': 1})
+    ctl.queue.submit(_mini(hosts=2))
+    ctl.step()
+    popen.procs[0].rc = 117                # fenced rank first
+    ctl.step()
+    assert ctl.queue.read(1)['state'] == 'running'  # survivor still up
+    popen.procs[1].rc = 0                  # survivor finishes
+    ctl.step()
+    rec = ctl.queue.read(1)
+    assert rec['state'] == 'done'
+    assert rec['exit_rcs'] == {'0': 117, '1': 0}
+
+
+def test_pool_shrink_kills_and_requeues_uncharged(tmp_path, caplog):
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1, 'h1': 1})
+    ctl.queue.submit(_mini())
+    ctl.queue.submit(_mini(tenant='bob'))
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        assert len(popen.launches) == 2
+        victim_host = ctl.queue.read(1)['placement']['0']
+        keep = {h: s for h, s in ctl.hosts.items() if h != victim_host}
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        atomic_write_json(ctl.hosts_path, {'hosts': keep})
+        ctl.step()
+    rec = ctl.queue.read(1)
+    assert rec['state'] == 'queued'
+    assert rec['last_reason'] == 'host_lost'
+    assert rec.get('charged_requeues', 0) == 0   # not the tenant's fault
+    assert rec['not_before'] <= time.time()      # no backoff either
+    assert popen.procs[0].pid in ctl._test_killed  # SIGKILLed the group
+    assert 'pool_shrink slots=2 -> 1' in caplog.text
+    assert ctl.queue.read(2)['state'] == 'running'  # bystander untouched
+    # grow the pool back: the displaced job re-admits
+    from kfac_pytorch_tpu.resilience import atomic_write_json
+    atomic_write_json(ctl.hosts_path,
+                      {'hosts': {victim_host: 1, **keep}})
+    ctl.step()
+    assert ctl.queue.read(1)['state'] == 'running'
+    assert 'pool_grow' in caplog.text
+
+
+def test_pool_slot_drain_logs_without_displacement(tmp_path, caplog):
+    """A slot-count-only capacity edit (h0: 2 -> 1, a drain) lands on
+    the timeline as pool_shrink but displaces nothing — the job
+    finishes in place and over-commitment bleeds off."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 2})
+    ctl.queue.submit(_mini())
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        atomic_write_json(ctl.hosts_path, {'hosts': {'h0': 1}})
+        ctl.step()
+    assert 'pool_shrink slots=2 -> 1 lost=[]' in caplog.text
+    assert ctl.queue.read(1)['state'] == 'running'
+    assert not ctl._test_killed
+    # and growing the slot count back logs pool_grow
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        atomic_write_json(ctl.hosts_path, {'hosts': {'h0': 2}})
+        ctl.step()
+    assert 'pool_grow slots=1 -> 2' in caplog.text
+
+
+def test_host_loss_after_clean_exit_is_done_not_requeued(tmp_path):
+    """The reap-before-refresh ordering: a job that FINISHED on a host
+    removed in the same cycle is marked done — requeueing it would
+    re-run a completed schedule (the zero-duplicated contract)."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1})
+    ctl.queue.submit(_mini())
+    ctl.step()
+    popen.procs[0].rc = 0                  # finished...
+    from kfac_pytorch_tpu.resilience import atomic_write_json
+    atomic_write_json(ctl.hosts_path, {'hosts': {'h1': 1}})  # ...host gone
+    ctl.step()
+    rec = ctl.queue.read(1)
+    assert rec['state'] == 'done'
+    assert rec['requeues'] == 0
+
+
+def test_mid_spawn_failure_requeues_and_kills_spawned_ranks(tmp_path,
+                                                           caplog):
+    """A launch that dies between rank spawns (EMFILE, vanished
+    script) must kill the ranks that DID start and requeue the job —
+    never crash the loop or orphan a half-admitted process group."""
+    class _FailingPopen(_FakePopen):
+        def __call__(self, argv, env=None, **kw):
+            if len(self.launches) == 1:
+                raise OSError('spawn failed (simulated EMFILE)')
+            return super().__call__(argv, env=env, **kw)
+
+    popen = _FailingPopen()
+    ctl, popen = _controller(tmp_path, hosts={'h0': 2}, popen=popen)
+    ctl.queue.submit(_mini(hosts=2))
+    with caplog.at_level(logging.ERROR, logger='svc-test'):
+        ctl.step()                         # must not raise
+    rec = ctl.queue.read(1)
+    assert rec['state'] == 'queued'
+    assert rec['last_reason'] == 'launch_failed'
+    assert popen.procs[0].pid in ctl._test_killed
+    assert 'failed mid-spawn' in caplog.text
+    assert 1 not in ctl.running
+
+
+def test_queue_read_only_attach_creates_nothing(tmp_path):
+    missing = tmp_path / 'nope'
+    q = JobQueue(missing, create=False)
+    assert q.jobs() == [] and q.counts()['queued'] == 0
+    assert not missing.exists()
+
+
+def test_scheduler_restart_recovers_without_losing_jobs(tmp_path):
+    ctl, popen = _controller(tmp_path)
+    ctl.queue.submit(_mini())
+    ctl.step()
+    assert ctl.queue.read(1)['state'] == 'running'
+    # a NEW controller over the same service dir (the old one was
+    # SIGKILLed): recover() requeues, the next step relaunches
+    ctl2, popen2 = _controller(tmp_path)
+    ctl2.queue.recover(log=ctl2.log)
+    assert ctl2.queue.read(1)['state'] == 'queued'
+    ctl2.step()
+    rec = ctl2.queue.read(1)
+    assert rec['state'] == 'running' and rec['attempt'] == 2
+    assert len(popen2.launches) == 1
+
+
+# ---------------------------------------------------------------------------
+# prometheus namespacing + collision (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_prom_path_namespaced_by_tenant_job(tmp_path):
+    env = {'KFAC_TENANT': 'alice', 'KFAC_JOB_ID': 'job-000003'}
+    p = str(tmp_path / 'metrics.prom')
+    out = metrics.namespaced_prom_path(p, env)
+    assert out == str(tmp_path / 'metrics-alice-job-000003.prom')
+    # already-namespaced and service-free paths are left alone
+    assert metrics.namespaced_prom_path(out, env) == out
+    assert metrics.namespaced_prom_path(p, {}) == p
+    assert metrics.namespaced_prom_path(None, env) is None
+
+
+def test_prom_exporter_collision_guard(tmp_path):
+    path = str(tmp_path / 'node.prom')
+    a = metrics.PrometheusTextfileExporter(path)
+    with pytest.raises(ValueError, match='already exported'):
+        metrics.PrometheusTextfileExporter(path)
+    a.close()
+    b = metrics.PrometheusTextfileExporter(path)   # released -> fine
+    b.close()
+
+
+def test_two_tenant_jobs_same_default_path_do_not_clobber(tmp_path):
+    """The satellite-2 scenario end-to-end: two jobs handed the SAME
+    textfile path export side by side once namespaced."""
+    shared = str(tmp_path / 'metrics.prom')
+    paths = []
+    for tenant, job in (('alice', 'job-000001'), ('bob', 'job-000002')):
+        env = {'KFAC_TENANT': tenant, 'KFAC_JOB_ID': job}
+        exp = metrics.PrometheusTextfileExporter(
+            metrics.namespaced_prom_path(shared, env))
+        exp.export({'loss': 1.0}, step=1, wall=0.0,
+                   kinds={'loss': 'gauge'})
+        paths.append(exp.path)
+        exp.close()
+    assert len(set(paths)) == 2
+    for p in paths:
+        assert os.path.exists(p)
+        assert 'kfac_loss 1.0' in open(p).read()
+
+
+# ---------------------------------------------------------------------------
+# the shared incident grammar + kfac-obs (follow, recursion)
+# ---------------------------------------------------------------------------
+
+SERVICE_LOG = """\
+2026-08-03 10:00:01,000 service: pool_grow slots=0 -> 3 added=['h0', 'h1', 'h2']
+2026-08-03 10:00:02,000 service: job_admit job=1 tenant=alice trainer=mini host=h0 attempt=1 port=8600
+2026-08-03 10:00:03,000 service: job_admit job=2 tenant=bob trainer=mini host=h1 attempt=1 port=8616
+2026-08-03 10:01:00,000 service: pool_shrink slots=3 -> 2 lost=['h0']
+2026-08-03 10:01:00,500 service: job_requeue job=1 tenant=alice rc=-9 class=host_lost attempt=1 backoff_s=0.0
+2026-08-03 10:01:05,000 service: job_admit job=1 tenant=alice trainer=mini host=h1 attempt=2 port=8600
+2026-08-03 10:02:00,000 service: job_done job=1 tenant=alice attempts=2
+2026-08-03 10:02:01,000 service: job_lost job=2 tenant=bob rc=117 class=fenced attempts=3
+"""
+
+
+def test_incident_grammar_scrapes_service_events(tmp_path):
+    log_path = tmp_path / 'service.log'
+    log_path.write_text(SERVICE_LOG)
+    report = IncidentReport().scrape_path(str(log_path))
+    kinds = [e['kind'] for e in report.events]
+    assert kinds.count('job_admit') == 3
+    assert 'job_requeue' in kinds and 'job_done' in kinds
+    assert 'job_lost' in kinds
+    assert 'pool_shrink' in kinds and 'pool_grow' in kinds
+    req = next(e for e in report.events if e['kind'] == 'job_requeue')
+    assert req['job'] == 1 and req['tenant'] == 'alice'
+    assert req['rc'] == -9 and req['why'] == 'host_lost'
+    lost = next(e for e in report.events if e['kind'] == 'job_lost')
+    assert lost['rc'] == 117 and lost['why'] == 'fenced'
+
+
+def test_obs_timeline_orders_admit_failure_requeue_done(tmp_path):
+    log_path = tmp_path / 'service.log'
+    log_path.write_text(SERVICE_LOG)
+    timeline = aggregate.build_timeline([str(log_path)])
+    alice = [e for e in timeline['events']
+             if e['detail'].get('tenant') == 'alice']
+    kinds = [e['kind'] for e in alice]
+    assert kinds == ['job_admit', 'job_requeue', 'job_admit',
+                     'job_done']
+    walls = [e['wall_aligned'] for e in alice]
+    assert walls == sorted(walls) and all(w is not None for w in walls)
+
+
+def test_obs_recursive_expansion_finds_nested_namespaces(tmp_path):
+    ns = tmp_path / 'tenants' / 'alice' / 'job-000001' / 'logs'
+    ns.mkdir(parents=True)
+    (ns / 'host0.out').write_text('DONE final_step=8 epochs=2\n')
+    (tmp_path / 'service.log').write_text(SERVICE_LOG)
+    flat = aggregate.expand_paths([str(tmp_path)])
+    assert str(ns / 'host0.out') not in flat
+    deep = aggregate.expand_paths([str(tmp_path)], recursive=True)
+    assert str(ns / 'host0.out') in deep
+    assert str(tmp_path / 'service.log') in deep
+    timeline = aggregate.build_timeline([str(tmp_path)], recursive=True)
+    kinds = {e['kind'] for e in timeline['events']}
+    assert 'run_done' in kinds and 'job_admit' in kinds
+
+
+def test_obs_follow_streams_new_events(tmp_path):
+    import io
+    log_path = tmp_path / 'service.log'
+    lines = SERVICE_LOG.splitlines(keepends=True)
+    log_path.write_text(''.join(lines[:3]))
+
+    def append_later():
+        time.sleep(0.15)
+        with open(log_path, 'a') as f:
+            f.writelines(lines[3:])
+
+    t = threading.Thread(target=append_later)
+    t.start()
+    out = io.StringIO()
+    timeline = aggregate.follow([str(log_path)], interval=0.05,
+                                duration=0.6, out=out)
+    t.join()
+    text = out.getvalue()
+    # early events printed once, late events picked up live
+    assert text.count('job_admit') == 3
+    assert 'job_done' in text and 'pool_shrink' in text
+    assert len(timeline['events']) == 8
+
+
+def test_obs_follow_survives_incident_rotation(tmp_path):
+    """A requeued job's fresh supervisor incarnation rotates
+    incident.json to .prev and starts over: the new incarnation's
+    event at the same index/kind must still stream (the dedup key
+    carries the wall stamp)."""
+    import io
+
+    from kfac_pytorch_tpu.resilience import atomic_write_json
+    inc = tmp_path / 'incident-host0.json'
+    atomic_write_json(str(inc), {'host_id': 0, 'events': [
+        {'kind': 'launch', 'wall': 100.0, 'gen': 0}]})
+
+    def rotate_later():
+        time.sleep(0.15)
+        os.replace(inc, str(inc) + '.prev')
+        atomic_write_json(str(inc), {'host_id': 0, 'events': [
+            {'kind': 'launch', 'wall': 200.0, 'gen': 0}]})
+
+    t = threading.Thread(target=rotate_later)
+    t.start()
+    out = io.StringIO()
+    aggregate.follow([str(inc)], interval=0.05, duration=0.6, out=out)
+    t.join()
+    assert out.getvalue().count('launch') == 2

@@ -1,0 +1,260 @@
+"""The multi-tenant service chaos drill (``-m slow``) — the tentpole's
+acceptance run, with REAL processes end to end.
+
+Three tenant jobs (alice / bob / carol, one job each) are submitted to
+a ``kfac-serve`` scheduler subprocess packing a 3-host pool
+(``hosts.json``: h0/h1/h2, two slots each — the drill's "3-host pod").
+Each job runs the miniature-but-real chaos trainer under its own
+``kfac-pod-supervise``, in its own tenant namespace, with its own
+heartbeat-port block. Mid-run, one job's host is LOST: the pool file
+drops it and the scheduler SIGKILLs that job's whole process group —
+exactly how a vanished host looks from the controller. The service
+must:
+
+- log ``pool_shrink`` and requeue the displaced job (uncharged — a
+  capacity loss is not the tenant's fault) exactly once,
+- re-admit it onto the surviving hosts (now co-located with another
+  tenant's job — the per-job lease dirs and port blocks keep them
+  apart),
+- let it RESUME from its own checkpoints (not restart the schedule),
+- and finish ALL THREE jobs: zero lost, zero duplicated, every
+  tenant's DONE line schedule-equivalent to an undisturbed control,
+- with ``kfac-obs`` rendering each tenant's admit -> failure ->
+  requeue -> done story from the service log + tenant namespace, and
+  the ``--follow`` endpoint streaming the same events live.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, 'tests', 'chaos_trainer.py')
+
+EPOCHS = 8
+BATCH = 8
+EXAMPLES = 32          # 4 steps/epoch
+TENANTS = ('alice', 'bob', 'carol')
+
+
+def _env(**extra):
+    base = {k: v for k, v in os.environ.items()
+            if not (k.startswith('KFAC_FAULT_')
+                    or k.startswith('KFAC_HB_')
+                    or k in ('KFAC_TENANT', 'KFAC_JOB_ID',
+                             'KFAC_PROM_FILE', 'KFAC_TRACE_DIR'))}
+    base['JAX_PLATFORMS'] = 'cpu'
+    base.update(extra)
+    return base
+
+
+def _done_line(text):
+    lines = [ln for ln in text.splitlines() if ln.startswith('DONE ')]
+    assert lines, f'no DONE line; tail: {text[-3000:]}'
+    return lines[-1]
+
+
+def _trainer_args():
+    return ['--epochs', str(EPOCHS), '--batch-size', str(BATCH),
+            '--num-examples', str(EXAMPLES),
+            '--checkpoint-dir', '{ckpt}',
+            '--num-hosts', '{num_hosts}', '--host-id', '{host_id}']
+
+
+def _spec(tenant):
+    return {'tenant': tenant, 'trainer': 'mini',
+            'args': _trainer_args(), 'hosts': 1, 'retry_budget': 2}
+
+
+def test_service_survives_host_loss_zero_jobs_lost(tmp_path):
+    from kfac_pytorch_tpu.obs import aggregate
+    from kfac_pytorch_tpu.resilience import atomic_write_json
+    from kfac_pytorch_tpu.service import JobQueue
+
+    # the undisturbed control fixes the schedule contract every tenant
+    # job must end with — displaced or not
+    p = subprocess.run(
+        [sys.executable, TRAINER, '--epochs', str(EPOCHS),
+         '--batch-size', str(BATCH), '--num-examples', str(EXAMPLES),
+         '--checkpoint-dir', str(tmp_path / 'ckpt_control')],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=540)
+    assert p.returncode == 0, p.stdout[-3000:]
+    control = _done_line(p.stdout)
+
+    svc = tmp_path / 'svc'
+    queue = JobQueue(svc, trainers={'mini': TRAINER})
+    for tenant in TENANTS:
+        queue.submit(_spec(tenant))
+
+    # pace the trainers so the host loss always lands mid-schedule
+    sched_env = _env(KFAC_FAULT_SLOW_STEP='0:999',
+                     KFAC_FAULT_SLOW_SECS='0.5')
+    svc_out = tmp_path / 'svc.out'
+    sched_cmd = [
+        sys.executable, '-m', 'kfac_pytorch_tpu.service.scheduler',
+        'run', '--service-dir', str(svc),
+        '--hosts', 'h0=2,h1=2,h2=2',
+        '--trainer', f'mini={TRAINER}',
+        '--poll', '0.3', '--backoff-base', '0.3', '--backoff-max', '2',
+        '--max-restarts', '2', '--hb-interval', '0.3',
+        '--hb-deadline', '3', '--drain', '--max-seconds', '900']
+    f_out = open(svc_out, 'wb')
+    sched = subprocess.Popen(sched_cmd, env=sched_env, cwd=REPO,
+                             stdout=f_out, stderr=subprocess.STDOUT,
+                             start_new_session=True)
+
+    def _fail(msg):
+        tail = svc_out.read_text()[-3000:] if svc_out.exists() else ''
+        pytest.fail(f'{msg}; scheduler tail: {tail}')
+
+    def _ckpt0(rec):
+        ckpt = os.path.join(rec.get('ns', ''), 'ckpt')
+        return (os.path.isdir(os.path.join(ckpt, 'checkpoint-0'))
+                or os.path.exists(os.path.join(ckpt,
+                                               'checkpoint-0.pkl')))
+
+    victim = None
+    try:
+        # every job admitted and mid-flight (epoch 0 banked, not done)
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if sched.poll() is not None:
+                _fail(f'scheduler exited rc={sched.returncode} before '
+                      'the host loss')
+            jobs = queue.jobs()
+            running = [r for r in jobs if r['state'] == 'running']
+            if (len(jobs) == 3 and len(running) == 3
+                    and all(_ckpt0(r) for r in running)):
+                break
+            time.sleep(0.5)
+        else:
+            _fail('3 running jobs with banked checkpoints never '
+                  'appeared')
+
+        # the drill's SIGKILL: drop the victim's host from the pool.
+        # The scheduler kills the job's whole process group (SIGKILL)
+        # and requeues it — a vanished host, as seen from the service.
+        victim = next(r for r in queue.jobs()
+                      if r['state'] == 'running')
+        victim_tenant = victim['spec']['tenant']
+        victim_host = victim['placement']['0']
+        hosts = {h: 2 for h in ('h0', 'h1', 'h2') if h != victim_host}
+        atomic_write_json(str(svc / 'hosts.json'), {'hosts': hosts})
+
+        rc = sched.wait(timeout=900)
+        assert rc == 0, _fail(f'scheduler rc={rc}')
+    finally:
+        if sched.poll() is None:
+            try:
+                os.killpg(os.getpgid(sched.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        f_out.close()
+
+    # -- zero lost, zero duplicated -------------------------------------
+    jobs = queue.jobs()
+    assert len(jobs) == 3, [r['id'] for r in jobs]
+    assert all(r['state'] == 'done' for r in jobs), \
+        [(r['id'], r['state']) for r in jobs]
+    by_tenant = {r['spec']['tenant']: r for r in jobs}
+    assert set(by_tenant) == set(TENANTS)
+    displaced = by_tenant[victim_tenant]
+    assert displaced['requeues'] == 1
+    assert displaced['last_reason'] == 'host_lost'
+    assert displaced['attempt'] == 2
+    assert displaced.get('charged_requeues', 0) == 0
+    for tenant in TENANTS:
+        if tenant != victim_tenant:
+            assert by_tenant[tenant]['requeues'] == 0
+            assert by_tenant[tenant]['attempt'] == 1
+    # jobs that shared a host got disjoint heartbeat-port blocks
+    assert len({r['port'] for r in jobs}) == 3
+
+    service_log = (svc / 'service.log').read_text()
+    assert 'pool_shrink' in service_log
+    assert service_log.count(
+        f'job_requeue job={displaced["id"]}') == 1   # exactly once
+    assert 'job_lost' not in service_log
+    assert service_log.count('job_done') == 3
+
+    # -- every tenant finished schedule-equivalent; the displaced job
+    # RESUMED from its own checkpoints instead of restarting ------------
+    for tenant, rec in by_tenant.items():
+        log = os.path.join(rec['ns'], 'logs', 'host0.out')
+        text = open(log, errors='replace').read()
+        assert _done_line(text) == control, (tenant, text[-2000:])
+        if tenant == victim_tenant:
+            assert 'RESUMED from=checkpoint-' in text, text[-3000:]
+
+    # -- kfac-obs: the per-tenant timeline tells the whole story --------
+    displaced_ns = by_tenant[victim_tenant]['ns']
+    timeline = aggregate.build_timeline(
+        [str(svc / 'service.log'), displaced_ns], recursive=True)
+    events = [e for e in timeline['events']
+              if e['detail'].get('tenant') in (victim_tenant, None)]
+
+    def first(kind, after=0, **match):
+        for i in range(after, len(events)):
+            e = events[i]
+            if e['kind'] == kind and all(
+                    e['detail'].get(k) == v for k, v in match.items()):
+                return i
+        raise AssertionError(
+            f'{kind} {match or ""} missing after {after}; kinds: '
+            f'{sorted({e["kind"] for e in events})}')
+
+    i_admit = first('job_admit', attempt=1, tenant=victim_tenant)
+    i_shrink = first('pool_shrink', after=i_admit)
+    i_requeue = first('job_requeue', after=i_admit,
+                      tenant=victim_tenant)
+    i_readmit = first('job_admit', after=i_requeue, attempt=2,
+                      tenant=victim_tenant)
+    i_done = first('job_done', after=i_readmit, tenant=victim_tenant)
+    order = [i_admit, i_shrink, i_requeue, i_readmit, i_done]
+    assert order == sorted(order), order
+    walls = [events[i]['wall_aligned'] for i in order]
+    assert all(w is not None for w in walls) and walls == sorted(walls)
+    # the trainer's own protocol events merged in from the namespace
+    kinds = {e['kind'] for e in timeline['events']}
+    assert 'run_done' in kinds and 'resumed' in kinds
+
+    # -- the --follow live endpoint replays the same story --------------
+    import io
+    out = io.StringIO()
+    aggregate.follow([str(svc / 'service.log'), displaced_ns],
+                     interval=0.1, duration=0.3, recursive=True,
+                     out=out)
+    followed = out.getvalue()
+    assert 'job_requeue' in followed and 'job_done' in followed
+
+    # -- CI artifact export: queue state + per-tenant timelines ---------
+    art = os.environ.get('KFAC_DRILL_ARTIFACTS')
+    if art:
+        import shutil
+        root = os.path.join(art, 'service')
+        os.makedirs(root, exist_ok=True)
+        shutil.copy(svc / 'service.log', root)
+        shutil.copy(svc_out, root)
+        shutil.copytree(queue.jobs_dir,
+                        os.path.join(root, 'queue-state'),
+                        dirs_exist_ok=True)
+        for tenant, rec in by_tenant.items():
+            tdir = os.path.join(root, tenant)
+            os.makedirs(tdir, exist_ok=True)
+            shutil.copytree(os.path.join(rec['ns'], 'logs'),
+                            os.path.join(tdir, 'logs'),
+                            dirs_exist_ok=True)
+            t = aggregate.build_timeline(
+                [str(svc / 'service.log'), rec['ns']], recursive=True)
+            with open(os.path.join(tdir, 'timeline.json'), 'w') as f:
+                json.dump({k: v for k, v in t.items()
+                           if not k.startswith('_')}, f, indent=2,
+                          default=str)
