@@ -458,6 +458,138 @@ def _micro_autotune():
     }
 
 
+def _micro_decomp():
+    """Decomposition-wall leg of the CPU micro-bench (ROADMAP item 5):
+
+    (a) MEASURED steady-state step time of the ``decomp_impl`` ladder
+    rungs at one refresh cadence — the cold XLA kernels (QDWH eigh for
+    eigen_dp, batched Cholesky for inverse_dp) vs their warm iterative
+    replacements (subspace tracking / Newton-Schulz), each timed over
+    full refresh windows so the decomposition cost lands in the mean at
+    its true cadence. The acceptance comparison: the iterative rungs'
+    steady state beats the full-eigh rung's at the same
+    ``kfac_update_freq``.
+
+    (b) the sharded-vs-owner-local cohort CRITICAL PATH on an
+    imbalanced plan (one device owns every large factor — the
+    real-world trigger), computed from the static cohort/shard tables:
+    the padded per-device Σ rows·D³ each compiled program actually
+    executes per step. Deterministic host arithmetic — no mesh needed,
+    so the number is exact on tunnel-down rounds too (the wire price of
+    the shard exchange is the separately-pinned DecompComm ledger,
+    scripts/comm_count.py).
+    """
+    from kfac_pytorch_tpu.utils.profiling import host_fence
+
+    F = int(os.environ.get('BENCH_DECOMP_FREQ', 4))
+    windows = int(os.environ.get('BENCH_DECOMP_WINDOWS', 3))
+    model, batch, model_name, B = _micro_model()
+    tx = training.sgd(0.05, momentum=0.9)
+
+    def steady_ms(variant, impl):
+        precond = kfac.KFAC(variant=variant, lr=0.05, damping=0.003,
+                            fac_update_freq=1, kfac_update_freq=F,
+                            num_devices=1, axis_name=None,
+                            decomp_impl=impl)
+        state = training.init_train_state(model, tx, precond,
+                                          jax.random.PRNGKey(0),
+                                          batch['input'])
+        step = training.build_train_step(model, tx, precond, _ce)
+        # warm past TWO full windows: the cold full at step 0, the
+        # refresh variants, and (for iterative impls) the first WARM
+        # full must all be compiled before the timed windows
+        for _ in range(2 * F + 2):
+            state, m = step(state, batch, lr=0.05, damping=0.003)
+        host_fence(m)
+        # per-position minima across windows (the same noise-stripping
+        # the stagger micro uses: each position reruns one program;
+        # anything above its min is host noise), then the window mean —
+        # refresh steps weighed at exactly 1/F
+        walls = [[] for _ in range(F)]
+        for i in range(windows * F):
+            t0 = time.perf_counter()
+            state, m = step(state, batch, lr=0.05, damping=0.003)
+            host_fence(m)
+            walls[i % F].append(time.perf_counter() - t0)
+        return sum(min(w) for w in walls) / F * 1e3
+
+    ladder = {
+        'eigen_dp:xla': ('eigen_dp', 'xla'),
+        'eigen_dp:subspace': ('eigen_dp', 'subspace'),
+        'inverse_dp:xla': ('inverse_dp', 'xla'),
+        'inverse_dp:newton_schulz': ('inverse_dp', 'newton_schulz'),
+    }
+    impl_ms = {k: round(steady_ms(v, i), 3) for k, (v, i) in ladder.items()}
+    full_eigh = impl_ms['eigen_dp:xla']
+    best_iter = min(impl_ms['eigen_dp:subspace'],
+                    impl_ms['inverse_dp:newton_schulz'])
+
+    # (b) static critical-path tables on the imbalanced plan: every
+    # 512-factor layer sits at index i % 4 == 0, so round-robin
+    # ownership puts ALL large rows on device 0 of a 4-device plan
+    from kfac_pytorch_tpu.capture import LayerMeta
+    from kfac_pytorch_tpu.plan import (build_cohorts, build_decomp_shard,
+                                       build_plan)
+    P = 4
+    dims = [(512, 512) if i % P == 0 else (48, 48) for i in range(16)]
+    metas = {}
+    for i, (di, do) in enumerate(dims):
+        m = LayerMeta(name=f'l{i}', path=(f'l{i}',), kind='dense',
+                      use_bias=False, in_dim=di, out_dim=do,
+                      kernel_shape=(di, do))
+        metas[m.name] = m
+    plan = build_plan(metas, num_devices=P, comm_mode='pred')
+    cohorts = build_cohorts(plan, F)
+    shard = build_decomp_shard(plan, cohorts)
+    owner_cost = sum(t.shape[2] * d ** 3 for d, t in cohorts.rows.items())
+    shard_cost = sum(t.shape[2] * d ** 3 for d, t in shard.src.items())
+    counts = shard.shard_count
+    mean_rows = float(counts.mean()) if counts.size else 0.0
+    return {
+        'platform': 'cpu_fallback',
+        'model': model_name, 'kfac_update_freq': F,
+        'timed_steps_per_impl': windows * F,
+        'impl_steady_ms': impl_ms,
+        'full_eigh_ms': full_eigh,
+        # the acceptance bit: the inverse-free ladder's best rung under
+        # the full-eigh rung at the same refresh cadence. On THIS
+        # platform that is Newton-Schulz — CPU LAPACK syevd is fast, so
+        # the subspace tracker's GEMMs lose here, while on the modeled
+        # chip the fenced QDWH constants (seconds per refresh,
+        # perfmodel.FENCED_EIGH_POINTS) put BOTH iterative rungs orders
+        # of magnitude under full eigh (the predicted block's
+        # ComputeInverse_subspace/_ns vs ComputeInverse_eigh_full)
+        'iterative_beats_full_eigh': bool(best_iter < full_eigh),
+        'best_iterative_ms': best_iter,
+        # regression guard on the NS rung ITSELF: full-eigh is an easy
+        # yardstick (cold Cholesky already beats it), so also bound NS
+        # against its own method's cold kernel — 1.5x slack absorbs the
+        # CPU noise floor (NS ~= Cholesky here) while catching a 2x
+        # kernel regression that the eigh comparison would mask
+        'ns_within_1p5x_cholesky': bool(
+            impl_ms['inverse_dp:newton_schulz']
+            < 1.5 * impl_ms['inverse_dp:xla']),
+        'note': ('cpu_fallback: kernel ranking is platform-specific — '
+                 'LAPACK eigh is fast on CPU; the iterative rungs are '
+                 'shaped for the chip, where QDWH eigh is '
+                 'iteration-bound (see predicted.scenarios.*.phases_s)'),
+        'shard': {
+            'devices': P, 'layers': len(dims),
+            'imbalance': 'all 512-dim factors owned by device 0',
+            'owner_cohort_cost_d3': int(owner_cost),
+            'sharded_cohort_cost_d3': int(shard_cost),
+            'critical_path_ratio': round(shard_cost / owner_cost, 4),
+            'sharded_below_owner': bool(shard_cost < owner_cost),
+            'rows_per_device': {
+                'max': int(counts.max()) if counts.size else 0,
+                'mean': round(mean_rows, 2),
+                'within_2x_mean': bool(
+                    counts.max() <= 2 * max(mean_rows, 1.0)),
+            },
+        },
+    }
+
+
 def _attach_drift(extra, measured=None, variant='inverse_dp',
                   platform=None, source=None):
     """Attach the measured-vs-predicted ``drift`` block (obs.drift) to
@@ -481,10 +613,11 @@ def _run_micro_mode():
     """BENCH_MICRO=1 entrypoint: emit the micro-bench as the round's
     metric (one JSON line, the standard partial-emission contract)."""
     _install_partial_emitter()
-    # same stable-key contract as main(): drift and autotune are
-    # explicit nulls until (and unless) their blocks compute
+    # same stable-key contract as main(): drift, autotune and decomp
+    # are explicit nulls until (and unless) their blocks compute
     PARTIAL['extra']['drift'] = None
     PARTIAL['extra']['autotune'] = None
+    PARTIAL['extra']['decomp'] = None
     _checkpoint()
     try:
         micro = _micro_bench()
@@ -510,6 +643,14 @@ def _run_micro_mode():
         if os.environ.get('BENCH_MICRO_AUTOTUNE', '1') != '0':
             try:
                 PARTIAL['extra']['autotune'] = _micro_autotune()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc(file=sys.stderr)
+        # the decomposition-wall leg: decomp_impl ladder steady-state
+        # + the sharded-vs-owner cohort critical path on an imbalanced
+        # plan (BENCH_MICRO_DECOMP=0 skips — the key stays null)
+        if os.environ.get('BENCH_MICRO_DECOMP', '1') != '0':
+            try:
+                PARTIAL['extra']['decomp'] = _micro_decomp()
             except Exception:  # noqa: BLE001
                 traceback.print_exc(file=sys.stderr)
         _checkpoint()
@@ -573,7 +714,7 @@ def _run(devices):
         'ekfac_iter_s_freq10_basis100',
         'kfac_overhead_vs_sgd_freq1', 'kfac_overhead_vs_sgd_freq10',
         'model_flops_per_iter', 'mfu_inverse_dp_freq1', 'peak_flops',
-        'phase_breakdown_s', 'autotune')})
+        'phase_breakdown_s', 'autotune', 'decomp')})
     extra['eigh_impl'] = os.environ.get('KFAC_EIGH_IMPL', 'xla')
     extra.update({'batch': BATCH, 'img': IMG, 'device': str(devices[0]),
                   'device_kind': getattr(devices[0], 'device_kind', None)})
@@ -778,6 +919,10 @@ def main():
                 if micro['extra'].get('autotune') is not None:
                     PARTIAL['extra']['autotune'] = \
                         micro['extra']['autotune']
+                # ...and the decomposition-wall leg (decomp_impl
+                # ladder + shard critical path, preseeded null)
+                if micro['extra'].get('decomp') is not None:
+                    PARTIAL['extra']['decomp'] = micro['extra']['decomp']
                 # the hang stays on record, but as context — the metric
                 # itself is real (measured, on the fallback platform)
                 PARTIAL['extra']['backend_error'] = PARTIAL.pop('error')

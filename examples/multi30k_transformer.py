@@ -81,6 +81,29 @@ def parse_args():
                         "inverse update's gathered decomposition for "
                         'the NEXT step so the gather overlaps the pred '
                         'einsums (one step of decomposition staleness)')
+    p.add_argument('--kfac-decomp-impl',
+                   default=os.environ.get('KFAC_DECOMP_IMPL') or None,
+                   choices=['xla', 'auto', 'jacobi', 'subspace',
+                            'newton_schulz'],
+                   help='decomposition kernel (default from '
+                        '$KFAC_DECOMP_IMPL; unset = the legacy '
+                        'KFAC_EIGH_IMPL env contract): xla = cold '
+                        'QDWH eigh / Cholesky; subspace|jacobi (eigh '
+                        'variants) and newton_schulz (Cholesky '
+                        'variants) are warm iterative kernels that '
+                        'replace the decomposition with GEMMs; auto '
+                        'picks the warm kernel for the variant. An '
+                        'explicit value makes this a live autotuner '
+                        'ladder rung (see README "Attacking the '
+                        'decomposition wall")')
+    p.add_argument('--kfac-decomp-shard', action='store_true',
+                   default=os.environ.get('KFAC_DECOMP_SHARD', '') == '1',
+                   help='mesh-sharded decomposition: repartition each '
+                        'refresh cohort cost-balanced across ALL '
+                        'devices instead of owner-local (~P x shorter '
+                        'decomposition critical path for two bounded '
+                        'DecompComm gathers per step; implies '
+                        '--kfac-stagger semantics)')
     p.add_argument('--kfac-autotune', action='store_true',
                    default=os.environ.get('KFAC_AUTOTUNE', '') == '1',
                    help='closed-loop autotuning: one online controller '
@@ -235,6 +258,8 @@ def main():
             warm_start_basis=args.kfac_warm_start,
             comm_precision=args.kfac_comm_precision,
             comm_prefetch=args.kfac_comm_prefetch,
+            decomp_impl=args.kfac_decomp_impl,
+            decomp_shard=args.kfac_decomp_shard,
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_vocabulary_size=n_trg_vocab,  # tied pre-softmax (:297)
             exclude_parts=args.exclude_parts,
@@ -311,6 +336,11 @@ def main():
         autotune=tuner)
 
     monitor = utils.HealthMonitor(log, state=state, registry=reg)
+    if tuner is not None:
+        # numerical-health gate for the tuner: a knob probe window that
+        # skipped batches or fell back to raw SGD never commits, however
+        # fast it looked (the decomp_impl ladder's accuracy backstop)
+        tuner.quality_gate = monitor.quality_signal
 
     def run_epoch(state, epoch):
         m = utils.Metric('loss')

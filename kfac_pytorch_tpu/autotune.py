@@ -67,11 +67,19 @@ from collections import deque
 #: repo may assign these on a KFAC instance (pinned by
 #: tests/test_autotune.py's setattr-guard test).
 KNOB_ATTRS = ('fac_update_freq', 'kfac_update_freq', 'damping',
-              'comm_precision')
+              'comm_precision', 'decomp_impl')
 
 #: the wire-dtype ladder the tuner climbs (successive halving of the
 #: collective payload; collectives.WIRE_DTYPES order).
 COMM_PRECISIONS = ('fp32', 'bf16', 'int8')
+
+#: the decomposition-implementation ladder (the inverse-free lane of
+#: ROADMAP item 5): per method, the cold kernel vs its warm iterative
+#: replacement. Restates preconditioner.DECOMP_IMPLS (this module must
+#: stay stdlib-importable; agreement pinned by tests/test_autotune.py).
+DECOMP_IMPLS = ('xla', 'auto', 'jacobi', 'subspace', 'newton_schulz')
+DECOMP_LADDERS = {'eigh': ('xla', 'subspace'),
+                  'cholesky': ('xla', 'newton_schulz')}
 
 _APPLYING = threading.local()
 
@@ -100,6 +108,7 @@ def _capture(precond):
         'kfac_update_freq': getattr(precond, 'kfac_update_freq', None),
         'damping': getattr(precond, 'damping', None),
         'comm_precision': getattr(precond, 'comm_precision', None),
+        'decomp_impl': getattr(precond, 'decomp_impl', None),
     }
 
 
@@ -139,7 +148,8 @@ class KnobArbiter:
 
     def add_invalidator(self, fn):
         """Register a callback run when a TRACE-affecting knob changes
-        (``comm_precision``): ``training.build_train_step`` registers its
+        (``comm_precision``, ``decomp_impl``):
+        ``training.build_train_step`` registers its
         variant-cache ``clear`` here so stale compiled programs can never
         keep an old wire dtype. Frequency/damping changes do NOT
         invalidate — they are host-side gating / traced scalars and
@@ -187,6 +197,9 @@ class KnobArbiter:
             if 'comm_precision' in changed:
                 self.tuner.pop('comm_precision', None)
                 self.base['comm_precision'] = cur['comm_precision']
+            if 'decomp_impl' in changed:
+                self.tuner.pop('decomp_impl', None)
+                self.base['decomp_impl'] = cur['decomp_impl']
             self._applied = cur
             return True
 
@@ -250,6 +263,8 @@ class KnobArbiter:
                               * self.schedule['damping_factor'])
         eff['comm_precision'] = self.tuner.get(
             'comm_precision', self.base['comm_precision'])
+        eff['decomp_impl'] = self.tuner.get(
+            'decomp_impl', self.base['decomp_impl'])
         return eff
 
     def _commit(self, source):
@@ -268,6 +283,11 @@ class KnobArbiter:
                 _coll.check_wire_dtype(eff['comm_precision'])
             except ImportError:  # jax-free context (fake preconds)
                 pass
+        if ('decomp_impl' in changed
+                and eff['decomp_impl'] not in DECOMP_IMPLS):
+            raise ValueError(
+                f'decomp_impl must be one of {DECOMP_IMPLS}, '
+                f'got {eff["decomp_impl"]!r}')
         with _applying():
             for k in changed:
                 setattr(self.precond, k, eff[k])
@@ -277,8 +297,9 @@ class KnobArbiter:
             rebase = getattr(self.precond, 'rebase_cohorts', None)
             if rebase is not None:
                 rebase()
-        if 'comm_precision' in changed:
-            # the wire dtype is baked into the traced programs (and the
+        if 'comm_precision' in changed or 'decomp_impl' in changed:
+            # the wire dtype AND the decomposition kernel are baked
+            # into the traced programs (comm_precision also into the
             # EF-residual state structure): every attached trainer's
             # variant cache must retrace; training.step_fn re-seeds /
             # drops KFACState.comm_err host-side on the next dispatch
@@ -389,7 +410,7 @@ def decide_comm_mode(bytes_by_mode, kfac_update_freq):
 
 
 def prior_best_freq(predicted, variant, ladder, fac_update_freq=1,
-                    anchor='central', slack=0.02):
+                    anchor='central', slack=0.02, decomp_impl=None):
     """Seed ``kfac_update_freq`` from the analytic perf model before any
     measurement exists. Predicted steady step time (model + precondition
     + factor/fac_freq + decomposition/F) is monotone in F — amortizing
@@ -401,7 +422,8 @@ def prior_best_freq(predicted, variant, ladder, fac_update_freq=1,
     (the controller then starts from the configured value)."""
     try:
         from kfac_pytorch_tpu.perfmodel import prior_phase_costs
-        ph = prior_phase_costs(predicted, variant=variant, anchor=anchor)
+        ph = prior_phase_costs(predicted, variant=variant, anchor=anchor,
+                               decomp_impl=decomp_impl)
     except Exception:  # noqa: BLE001 — priors are best-effort
         return None
     if not ph:
@@ -455,11 +477,11 @@ class KnobController:
     def __init__(self, precond, *, window=16, settle=2, rel_improve=0.03,
                  dwell_windows=2, cooldown=6, steady_every=50,
                  tune=('kfac_update_freq', 'fac_update_freq',
-                       'comm_precision'),
+                       'comm_precision', 'decomp_impl'),
                  freq_bounds=None, comm_precisions=COMM_PRECISIONS,
                  predicted=None, platform=None, variant=None,
                  anchor='central', decision_log=None, log=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, quality_gate=None):
         if window < 2:
             raise ValueError(f'window must be >= 2, got {window}')
         self.precond = precond
@@ -479,6 +501,18 @@ class KnobController:
         self.platform = platform
         self.variant = variant or getattr(precond, 'variant', 'inverse_dp')
         self.anchor = anchor
+        # numerical-health gate: a zero-arg callable returning a
+        # monotone "badness" counter (e.g. the HealthMonitor's skipped-
+        # batch + escalation total). Sampled when a probe starts and
+        # when it is judged: an otherwise-improving candidate whose
+        # probe window raised the counter is VETOED — a knob rung that
+        # regresses accuracy (NS residual-gate fallbacks manifest as
+        # health events) can never commit on speed alone. None = no
+        # gate (the engine's per-row acceptance gates still protect the
+        # math; this gate protects the TUNING DECISION).
+        self.quality_gate = quality_gate
+        self._probe_quality = None
+        self.quality_vetoes = 0
         self.decision_log = decision_log
         import logging
         self.log = log if log is not None else logging.getLogger(__name__)
@@ -557,12 +591,19 @@ class KnobController:
 
     def _seed(self):
         self._seeded = 'done'
+        # kernel first: the freq prior prices the decomposition phase
+        # at the kernel the run will actually execute
+        self._seed_decomp_impl()
+        self._seed_freq()
+
+    def _seed_freq(self):
         if 'kfac_update_freq' not in self.tune:
             return
         best = prior_best_freq(
             self.predicted, self.variant, self._freq_ladder(),
             fac_update_freq=getattr(self.precond, 'fac_update_freq', 1)
-            or 1, anchor=self.anchor)
+            or 1, anchor=self.anchor,
+            decomp_impl=getattr(self.precond, 'decomp_impl', None))
         cur = getattr(self.precond, 'kfac_update_freq', None)
         if best is None or cur is None or best == cur:
             return
@@ -573,6 +614,39 @@ class KnobController:
         self._instant('autotune_seed', kfac_update_freq=best)
         self._settle_left = self.settle
         # the seeded value becomes the config the first baseline measures
+
+    def _seed_decomp_impl(self):
+        """Seed the decomposition-kernel rung from the perf model's
+        GEMM-roofline priors (perfmodel.decomp_impl_priors): when the
+        iterative kernel's predicted decomposition phase undercuts the
+        cold kernel's, start there — the fenced eigh constants say the
+        gap is seconds-per-refresh on the modeled chip, too expensive
+        to discover by probing alone."""
+        if 'decomp_impl' not in self.tune:
+            return
+        cur = getattr(self.precond, 'decomp_impl', None)
+        method = getattr(self.precond, 'method', None)
+        if cur is None or method not in DECOMP_LADDERS:
+            return
+        try:
+            from kfac_pytorch_tpu.perfmodel import decomp_impl_priors
+            priors = decomp_impl_priors(self.predicted, method,
+                                        anchor=self.anchor)
+        except Exception:  # noqa: BLE001 — priors are best-effort
+            return
+        if not priors:
+            return
+        best = min(priors, key=priors.get)
+        eff = (DECOMP_LADDERS[method][1] if cur == 'auto' else cur)
+        if best == eff:
+            return
+        self.arbiter.propose('tuner', decomp_impl=best)
+        self._decision('seed', knob='decomp_impl', frm=cur, to=best,
+                       prior_s=priors)
+        self.log.info('autotune: seeded decomp_impl=%s from perfmodel '
+                      'prior (%s)', best, self.anchor)
+        self._instant('autotune_seed', decomp_impl=best)
+        self._settle_left = self.settle
 
     # -- the window --------------------------------------------------------
 
@@ -638,6 +712,21 @@ class KnobController:
                     out.append((knob, cur, self.comm_precisions[i + 1]))
                 if i > 0:
                     out.append((knob, cur, self.comm_precisions[i - 1]))
+            elif knob == 'decomp_impl':
+                # the inverse-free ladder: per-method cold kernel vs
+                # its warm iterative replacement. Tunable only when the
+                # knob was EXPLICITLY configured (None = the legacy
+                # KFAC_EIGH_IMPL env contract, which the tuner must not
+                # silently take over) on a real preconditioner (fake
+                # knob-only stand-ins carry no method)
+                cur = getattr(self.precond, 'decomp_impl', None)
+                method = getattr(self.precond, 'method', None)
+                ladder = DECOMP_LADDERS.get(method)
+                if cur is None or ladder is None:
+                    continue
+                # 'auto' sits on the method's warm rung
+                eff = ladder[1] if cur == 'auto' else cur
+                out.extend((knob, cur, v) for v in ladder if v != eff)
         return out
 
     def _next_probe(self):
@@ -648,6 +737,7 @@ class KnobController:
                 continue
             self._rotation = (self._rotation + i + 1) % max(1, len(cands))
             self._candidate = (knob, old, new)
+            self._probe_quality = self._quality()
             self.arbiter.propose('tuner', **{knob: new})
             self.state = 'probe'
             self._decision('probe', knob=knob, frm=old, to=new)
@@ -668,10 +758,38 @@ class KnobController:
                 k['comm_precision'] or 'fp32', self.windows, self._step)
             self._instant('autotune_steady', windows=self.windows)
 
+    def _quality(self):
+        """Sample the numerical-health gate counter (None = no gate /
+        gate errored — an erroring gate must never take tuning down)."""
+        if self.quality_gate is None:
+            return None
+        try:
+            return float(self.quality_gate())
+        except Exception:  # noqa: BLE001
+            return None
+
     def _judge(self, t, measured):
         knob, old, new = self._candidate
         improved = t < self.baseline_t * (1 - self.rel_improve)
         vetoed = improved and self._drift_veto(measured, knob, new)
+        if improved and not vetoed:
+            q0, q1 = self._probe_quality, self._quality()
+            if q0 is not None and q1 is not None and q1 > q0:
+                # the probe window regressed accuracy (health events
+                # fired): a faster-but-wrong rung never commits
+                vetoed = True
+                self.vetoes += 1
+                self.quality_vetoes += 1
+                self._bump('autotune_vetoes')
+                self._decision('veto', knob=knob, value=new,
+                               reason='quality',
+                               health_events=q1 - q0)
+                self.log.warning(
+                    'autotune: quality veto — knob %s %s rejected '
+                    '(+%g health events in the probe window) at step '
+                    '%d', knob, new, q1 - q0, self._step)
+                self._instant('autotune_veto', knob=knob,
+                              violations=['quality'])
         if improved and not vetoed:
             self.commits += 1
             self._bump('autotune_commits')
@@ -723,6 +841,12 @@ class KnobController:
                 variant=self.variant, anchor=self.anchor,
                 comm_precision=getattr(self.precond, 'comm_precision',
                                        'fp32') or 'fp32',
+                # bind ComputeInverse to the kernel the probe actually
+                # ran — without this, committing an iterative rung on
+                # the modeled chip would land seconds under the fenced
+                # full-eigh band and the gate would veto the very win
+                # it exists to protect
+                decomp_impl=getattr(self.precond, 'decomp_impl', None),
                 source='autotune')
             if verdict == 'drift':
                 self.vetoes += 1
@@ -819,6 +943,16 @@ class KnobController:
         for name in ('fac_update_freq', 'kfac_update_freq'):
             if k[name] is not None:
                 registry.gauge('autotune/' + name).set(k[name])
+        if k['decomp_impl'] is not None:
+            # gauge by ladder index (0 = cold kernel, 1 = iterative)
+            method = getattr(self.precond, 'method', None)
+            ladder = DECOMP_LADDERS.get(method)
+            if ladder:
+                eff = ladder[1] if k['decomp_impl'] == 'auto' \
+                    else k['decomp_impl']
+                if eff in ladder:
+                    registry.gauge('autotune/decomp_impl_rung').set(
+                        ladder.index(eff))
         try:
             from kfac_pytorch_tpu.parallel.collectives import \
                 WIRE_COMPRESSION
@@ -843,6 +977,7 @@ class KnobController:
             'commits': self.commits,
             'reverts': self.reverts,
             'vetoes': self.vetoes,
+            'quality_vetoes': self.quality_vetoes,
             'advisory_violations': self.advisory_violations,
             'last_window_s': (self.last_window or {}).get('time_s'),
             'decisions_tail': list(self.decisions)[-10:],
@@ -850,13 +985,20 @@ class KnobController:
 
 
 def controller_from_args(precond, *, enabled, trace_dir=None,
-                         predicted=None, variant=None, log=None):
+                         predicted=None, variant=None, log=None,
+                         quality_gate=None):
     """The trainers' shared constructor: returns a
     :class:`KnobController` (decision log under ``trace_dir`` when
     tracing is on) or None. ``predicted`` should be the perf-model
     block ONLY when the run matches the workload the model describes
     (the imagenet resnet50 bs32 config) — the drift gate judges phase
-    ratios against it; other workloads run ungated (advisory-free)."""
+    ratios against it; other workloads run ungated (advisory-free).
+    ``quality_gate``: a zero-arg monotone badness counter — a probe
+    window that raised it never commits, whatever its step time said.
+    The trainers construct the tuner BEFORE the HealthMonitor exists,
+    so they late-bind the same hook instead
+    (``tuner.quality_gate = monitor.quality_signal``); this parameter
+    serves callers whose counter already exists at construction."""
     if not enabled or precond is None:
         return None
     decision_log = (os.path.join(trace_dir, 'autotune-decisions.jsonl')
@@ -869,4 +1011,4 @@ def controller_from_args(precond, *, enabled, trace_dir=None,
         pass
     return KnobController(precond, predicted=predicted, platform=platform,
                           variant=variant, decision_log=decision_log,
-                          log=log)
+                          log=log, quality_gate=quality_gate)

@@ -255,7 +255,7 @@ NS_ACCEPT_RESID = 0.05
 
 def compute_decomposition(plan, factors_local, damping, method, eps,
                           axis_name, basis_local=None, warm_sweeps=None,
-                          invs_prev_local=None):
+                          invs_prev_local=None, impl=None):
     """Batched eigh or pi-damped Cholesky inverse of the local factor rows.
 
     eigh parity: eigen.py:98-119 / eigen_dp.py:62-75 (eigenvalue clamp
@@ -278,13 +278,19 @@ def compute_decomposition(plan, factors_local, damping, method, eps,
     (zero/stale seeds fail and fall back to the batched Cholesky inside
     ``lax.cond``, so the fallback costs nothing when tracking is
     healthy). ``warm_sweeps`` overrides the NS iteration count.
+
+    impl: the eigh kernel selector forwarded to ``ops.sym_eig``
+    ('xla'/'jacobi'/'subspace'/'auto'; None reads KFAC_EIGH_IMPL — the
+    legacy env path). The preconditioner's ``decomp_impl`` knob routes
+    through here so the autotuner's ladder rung is a traced-program
+    choice, not an ambient env read.
     """
     if method == 'eigh':
         evals, evecs = {}, {}
         for bdim in plan.bucket_dims:
             key = _key(bdim)
             basis = None if basis_local is None else basis_local[key]
-            d, q = ops.sym_eig(factors_local[key], basis=basis,
+            d, q = ops.sym_eig(factors_local[key], impl=impl, basis=basis,
                                sweeps=warm_sweeps if basis is not None
                                else None)
             evals[key] = ops.clamp_eigvals(d, eps)
@@ -361,7 +367,9 @@ def _cohort_table(tbl, cohort_idx, axis_name):
 
 
 def compute_cohort_decomposition(plan, cohorts, factors_local, cohort_idx,
-                                 damping, method, eps, axis_name):
+                                 damping, method, eps, axis_name,
+                                 impl=None, decomp_prev=None,
+                                 comm_mode=None, warm_sweeps=None):
     """Decompose ONLY this step's cohort rows of the local factor shard.
 
     The staggered counterpart of :func:`compute_decomposition`:
@@ -377,19 +385,40 @@ def compute_cohort_decomposition(plan, cohorts, factors_local, cohort_idx,
     Cholesky pi-damping uses fresh traces of ALL local rows (O(D) per
     slot) so each cohort row is damped exactly as the full path would
     damp it at this step.
+
+    impl / decomp_prev / comm_mode: the ``decomp_impl`` iterative-
+    kernel route for the staggered path. With an iterative impl and the
+    stored decomposition (``decomp_prev`` + its ``comm_mode`` layout)
+    the cohort rows warm-start from their own stored basis/inverse —
+    the trainer only staggers after the first full decomposition, so a
+    stored seed always exists; never-decomposed rows degrade safely
+    (identity basis via ``local_evecs``, zero NS seed fails the
+    residual gate and falls back to Cholesky).
     """
     sel = {bdim: _cohort_table(cohorts.rows[bdim], cohort_idx, axis_name)
            for bdim in plan.bucket_dims}
     if method == 'eigh':
+        basis_local = None
+        if (impl in ('subspace', 'jacobi', 'auto')
+                and decomp_prev is not None):
+            basis_local = local_evecs(plan, decomp_prev, axis_name,
+                                      comm_mode)
         evals, evecs = {}, {}
         for bdim in plan.bucket_dims:
             key = _key(bdim)
             f = jnp.take(factors_local[key], sel[bdim], axis=0)
-            d, q = ops.sym_eig(f)
+            basis = (None if basis_local is None
+                     else jnp.take(basis_local[key], sel[bdim], axis=0))
+            d, q = ops.sym_eig(f, impl=impl, basis=basis,
+                               sweeps=warm_sweeps if basis is not None
+                               else None)
             evals[key] = ops.clamp_eigvals(d, eps)
             evecs[key] = q
         return {'evals': evals, 'evecs': evecs}
 
+    invs_prev = None
+    if impl == 'newton_schulz' and decomp_prev is not None:
+        invs_prev = local_invs(plan, decomp_prev, axis_name, comm_mode)
     flat_avg = _local_trace_avgs(plan, factors_local, axis_name)
     invs = {}
     for bdim in plan.bucket_dims:
@@ -400,8 +429,186 @@ def compute_cohort_decomposition(plan, cohorts, factors_local, cohort_idx,
             cohorts.mate_flat[bdim], cohort_idx, axis_name))
         damp_vec = jnp.sqrt(damping * own_avg / mate_avg)
         f = jnp.take(factors_local[key], sel[bdim], axis=0)
-        invs[key] = ops.psd_inverse(ops.add_scaled_identity(f, damp_vec))
+        damped = ops.add_scaled_identity(f, damp_vec)
+        if invs_prev is None:
+            invs[key] = ops.psd_inverse(damped)
+        else:
+            invs[key] = ops.warm_inverse(
+                damped, jnp.take(invs_prev[key], sel[bdim], axis=0),
+                iters=2 if warm_sweeps is None else max(int(warm_sweeps),
+                                                        1),
+                accept_resid=NS_ACCEPT_RESID)
     return {'invs': invs}
+
+
+def _damped_cohort_factors(plan, cohorts, factors_local, cohort_idx,
+                           damping, method, axis_name):
+    """This device's cohort factor rows, damped exactly as the cohort
+    decomposition would damp them (cholesky pi-damping; eigh rows ship
+    raw — the eigh path damps in the pred denominators). The shard
+    exchange sends THESE matrices, so the remote decomposition is
+    bit-equivalent to the owner-local one."""
+    flat_avg = None
+    if method != 'eigh':
+        flat_avg = _local_trace_avgs(plan, factors_local, axis_name)
+    out = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        sel = _cohort_table(cohorts.rows[bdim], cohort_idx, axis_name)
+        f = jnp.take(factors_local[key], sel, axis=0)
+        if method != 'eigh':
+            own_avg = jnp.take(flat_avg, _cohort_table(
+                cohorts.own_flat[bdim], cohort_idx, axis_name))
+            mate_avg = jnp.take(flat_avg, _cohort_table(
+                cohorts.mate_flat[bdim], cohort_idx, axis_name))
+            f = ops.add_scaled_identity(
+                f, jnp.sqrt(damping * own_avg / mate_avg))
+        out[key] = f
+    return out
+
+
+def compute_shard_decomposition(plan, cohorts, shard, factors_local,
+                                cohort_idx, damping, method, eps,
+                                axis_name, impl=None, decomp_prev=None,
+                                comm_mode=None, warm_sweeps=None,
+                                comm_precision='fp32'):
+    """Mesh-sharded cohort decomposition: the active cohort's rows are
+    decomposed balanced across ALL devices instead of owner-local.
+
+    Three phases, all driven by the static ``plan.DecompShardPlan``
+    tables at a TRACED cohort index (one compiled program, like the
+    cohort path):
+
+    1. each owner damps its cohort rows and the cohort is all-gathered
+       (``kfac.DecompComm`` — P*R_b matrices per bucket on the wire);
+    2. each device decomposes the ``S_b`` gathered slots its shard
+       table names — ``Σ_b S_b·D³`` per-device work instead of the
+       owner-local ``Σ_b R_b·D³``, the ~P× critical-path shrink;
+    3. the results return via :func:`merge_shard_decomposition`'s
+       second DecompComm gather.
+
+    Returns this device's local results (``[S_b, ...]`` per bucket).
+    Warm seeds (``decomp_impl`` iterative kernels) are read from the
+    stored decomposition through the ``src_global`` row table —
+    available only in the replicated comm_mode='inverse' layout, where
+    every device holds every row's previous value; comm_pred shards the
+    store, so its shard path always runs the cold kernel.
+    """
+    damped = _damped_cohort_factors(plan, cohorts, factors_local,
+                                    cohort_idx, damping, method, axis_name)
+    out_d, out_q, out_i = {}, {}, {}
+    warm_ok = decomp_prev is not None and comm_mode == 'inverse'
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        gathered = coll.decomp_exchange_gather(damped[key], axis_name,
+                                               comm_precision)
+        src = _cohort_table(shard.src[bdim], cohort_idx, axis_name)
+        mine = jnp.take(gathered, src, axis=0)
+        if method == 'eigh':
+            basis = None
+            if impl in ('subspace', 'jacobi', 'auto') and warm_ok:
+                rows = _cohort_table(shard.src_global[bdim], cohort_idx,
+                                     axis_name)
+                q = jnp.take(decomp_prev['evecs'][key], rows, axis=0)
+                valid = jnp.any(q != 0, axis=(-2, -1), keepdims=True)
+                basis = jnp.where(valid, q,
+                                  jnp.eye(q.shape[-1], dtype=q.dtype))
+            d, q = ops.sym_eig(mine, impl=impl, basis=basis,
+                               sweeps=warm_sweeps if basis is not None
+                               else None)
+            out_d[key] = ops.clamp_eigvals(d, eps)
+            out_q[key] = q
+        else:
+            seed = None
+            if impl == 'newton_schulz' and warm_ok:
+                rows = _cohort_table(shard.src_global[bdim], cohort_idx,
+                                     axis_name)
+                seed = jnp.take(decomp_prev['invs'][key], rows, axis=0)
+            if seed is None:
+                out_i[key] = ops.psd_inverse(mine)
+            else:
+                out_i[key] = ops.warm_inverse(
+                    mine, seed,
+                    iters=2 if warm_sweeps is None
+                    else max(int(warm_sweeps), 1),
+                    accept_resid=NS_ACCEPT_RESID)
+    if method == 'eigh':
+        return {'evals': out_d, 'evecs': out_q}
+    return {'invs': out_i}
+
+
+def merge_shard_decomposition(plan, shard, decomp_stored, shard_new,
+                              cohort_idx, axis_name, comm_mode, method,
+                              guard=True, comm_precision='fp32'):
+    """Return the sharded cohort's results to their stored rows.
+
+    The results are all-gathered (the second ``kfac.DecompComm`` leg)
+    and every stored row GATHERS its fresh value through the static
+    ``res_slot`` table — rows outside the cohort keep their stored bits
+    exactly (their table entry is invalid, the ``where`` keeps the
+    stored value), and because the merge is a gather there are no
+    scatter collisions to order: the result is deterministic by
+    construction. ``guard``: per-row non-finite screen, the staggered
+    health contract (a blown remote decomposition row keeps the last
+    good stored row).
+    """
+    F = shard.num_cohorts
+    P = plan.num_devices
+
+    def tables(bdim):
+        if comm_mode == 'inverse':
+            slots = jnp.take(jnp.asarray(shard.res_slot[bdim]),
+                             cohort_idx, axis=0)
+            valid = jnp.take(jnp.asarray(shard.res_valid[bdim]),
+                             cohort_idx, axis=0)
+        else:
+            per_dev = plan.buckets[bdim].per_dev
+            slots = _cohort_table(
+                shard.res_slot[bdim].reshape(F, P, per_dev),
+                cohort_idx, axis_name)
+            valid = _cohort_table(
+                shard.res_valid[bdim].reshape(F, P, per_dev),
+                cohort_idx, axis_name)
+        return slots, valid
+
+    def pick(ok, fresh, stored):
+        okr = ok.reshape(ok.shape + (1,) * (stored.ndim - 1))
+        return jnp.where(okr, fresh, stored)
+
+    out = dict(decomp_stored)
+    if method == 'eigh':
+        new_d, new_q = {}, {}
+        for bdim in plan.bucket_dims:
+            key = _key(bdim)
+            dg = coll.decomp_exchange_gather(shard_new['evals'][key],
+                                             axis_name, comm_precision)
+            qg = coll.decomp_exchange_gather(shard_new['evecs'][key],
+                                             axis_name, comm_precision)
+            slots, ok = tables(bdim)
+            fresh_d = jnp.take(dg, slots, axis=0)
+            fresh_q = jnp.take(qg, slots, axis=0)
+            if guard:
+                # joint screen: a row commits its (evals, evecs) pair
+                # together or not at all — a half-committed pair would
+                # precondition in a basis its spectrum does not match
+                ok = jnp.logical_and(ok, jnp.logical_and(
+                    _rows_finite(fresh_d), _rows_finite(fresh_q)))
+            new_d[key] = pick(ok, fresh_d, decomp_stored['evals'][key])
+            new_q[key] = pick(ok, fresh_q, decomp_stored['evecs'][key])
+        out['evals'], out['evecs'] = new_d, new_q
+        return out
+    new_i = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        xg = coll.decomp_exchange_gather(shard_new['invs'][key],
+                                         axis_name, comm_precision)
+        slots, ok = tables(bdim)
+        fresh = jnp.take(xg, slots, axis=0)
+        if guard:
+            ok = jnp.logical_and(ok, _rows_finite(fresh))
+        new_i[key] = pick(ok, fresh, decomp_stored['invs'][key])
+    out['invs'] = new_i
+    return out
 
 
 def merge_cohort_decomposition(plan, cohorts, decomp_stored, cohort_new,

@@ -135,17 +135,27 @@ def measured_from_bench_extras(extra):
     return out
 
 
-def _predicted_phase(phases_s, name, variant):
+def _predicted_phase(phases_s, name, variant, decomp_impl=None):
     """Predicted seconds for one (possibly joint) taxonomy name, or
     None when any component has no prediction. 'ComputeInverse' binds
     to the variant's decomposition kernel (Cholesky for inverse_*,
-    the fenced full eigh for eigen_*)."""
+    the fenced full eigh for eigen_*); an iterative ``decomp_impl``
+    rebinds to its GEMM-roofline rung ('ComputeInverse_subspace' /
+    'ComputeInverse_ns') — without the rebind, a run on the iterative
+    rung would land seconds under the fenced full-eigh band and the
+    gate would read the speedup as drift."""
+    eigen = variant.startswith('eigen') or variant.startswith('ekfac')
     total = 0.0
     for part in name.split('+'):
         if part == 'ComputeInverse':
-            key = ('ComputeInverse_eigh_full' if variant.startswith('eigen')
-                   or variant.startswith('ekfac')
-                   else 'ComputeInverse_chol')
+            if decomp_impl in ('subspace', 'jacobi', 'auto') and eigen:
+                key = 'ComputeInverse_subspace'
+            elif decomp_impl in ('newton_schulz', 'auto') and not eigen:
+                key = 'ComputeInverse_ns'
+            elif eigen:
+                key = 'ComputeInverse_eigh_full'
+            else:
+                key = 'ComputeInverse_chol'
         else:
             key = part
         v = phases_s.get(key)
@@ -157,7 +167,7 @@ def _predicted_phase(phases_s, name, variant):
 
 def drift_block(measured_s, predicted_block, *, platform=None,
                 variant='inverse_dp', anchor='central', tolerance=1.0,
-                source=None, comm_precision='fp32'):
+                source=None, comm_precision='fp32', decomp_impl=None):
     """Assemble the ``drift`` block for a bench emission.
 
     Args:
@@ -177,6 +187,11 @@ def drift_block(measured_s, predicted_block, *, platform=None,
         :data:`COMM_WIRE_FACTORS` first
         (:func:`scale_comm_scenarios`), so a compressed run is judged
         against its own honest band.
+      decomp_impl: the decomposition kernel the measured run selected
+        (KFAC ``decomp_impl`` knob) — rebinds the ComputeInverse
+        prediction to the matching rung (see
+        :func:`_predicted_phase`), so an iterative-kernel run is
+        judged against its own roofline, not the cold kernel's.
 
     Returns a dict; never raises on malformed inputs (a drift block
     must never take the bench down — errors are reported in-band).
@@ -195,7 +210,7 @@ def drift_block(measured_s, predicted_block, *, platform=None,
         for name, meas in sorted((measured_s or {}).items()):
             if meas is None:
                 continue
-            pred = {scen: _predicted_phase(ph, name, variant)
+            pred = {scen: _predicted_phase(ph, name, variant, decomp_impl)
                     for scen, ph in per_scen.items()}
             pred = {k: v for k, v in pred.items() if v is not None}
             entry = {'measured_s': round(float(meas), 6),
@@ -233,6 +248,7 @@ def drift_block(measured_s, predicted_block, *, platform=None,
             'variant': variant,
             'comparable': comparable,
             'comm_precision': comm_precision,
+            'decomp_impl': decomp_impl,
             'anchor_scenario': anchor,
             'tolerance': tolerance,
             'phases': phases,

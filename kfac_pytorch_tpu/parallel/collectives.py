@@ -202,6 +202,21 @@ def pmean_scatter_ef(x, axis_name, comm_precision, residual):
     return red / n, new_residual
 
 
+def decomp_exchange_gather(x, axis_name, comm_precision='fp32'):
+    """The mesh-sharded decomposition exchange collective: an
+    :func:`all_gather_rows_compressed` under the ``kfac.DecompComm``
+    named scope, so BOTH legs of the shard round trip (damped cohort
+    factors out, decomposed results back) land in their own ledger
+    phase — scripts/comm_count.py attributes by op_name scope, and the
+    first-match taxonomy puts DecompComm ahead of the
+    CommunicateInverse scope these gathers would otherwise inherit
+    from the surrounding stagger phase. The byte price is modeled in
+    closed form by ``FactorPlan.comm_volume(decomp_shard=...)`` and the
+    two must agree byte-for-byte (the COMM_COUNT_ASSERT pin)."""
+    with jax.named_scope('kfac.DecompComm'):
+        return all_gather_rows_compressed(x, axis_name, comm_precision)
+
+
 def all_gather_rows_compressed(x, axis_name, comm_precision='fp32'):
     """:func:`all_gather_rows` over a low-precision wire. bf16 ships the
     payload as bitcast uint16 (2 bytes — the integer wire survives every

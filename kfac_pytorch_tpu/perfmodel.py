@@ -93,6 +93,23 @@ F32_PENALTY = 4.0
 #: phase is reconstructed analytically from the plan's bucket table.
 CHOLESKY_FLOPS_PER_MATRIX = lambda d: (7.0 / 3.0) * d ** 3  # noqa: E731
 
+#: analytic FLOPs of the ITERATIVE decomposition kernels per dxd matrix
+#: (the inverse-free ladder rungs, ops/linalg.py) — pure batched GEMMs,
+#: so unlike QDWH eigh they roofline honestly at the MXU rate:
+#:
+#: - subspace_eigh, per tracking step (default 2): X@Q + Q^T(XQ) +
+#:   Q@K (3 GEMMs, 2d^3 each) and CholeskyQR2 = 2 x (Gram 2d^3 +
+#:   cholesky d^3/3 + triangular solve d^3) ~= 6.7d^3 -> ~12.7d^3 per
+#:   step; plus the final Rayleigh X@Q + diag contraction ~= 3d^3.
+#: - newton_schulz_inverse, per iteration (default 2): A@X + X@(2I-AX)
+#:   (2 GEMMs, 2d^3 each) -> 4d^3; plus the residual check A@X ~= 2d^3
+#:   (the Cholesky fallback sits behind a lax.cond and costs nothing on
+#:   the healthy path).
+SUBSPACE_FLOPS_PER_MATRIX = \
+    lambda d, steps=2: (12.7 * steps + 3.0) * d ** 3  # noqa: E731
+NEWTON_SCHULZ_FLOPS_PER_MATRIX = \
+    lambda d, iters=2: (4.0 * iters + 2.0) * d ** 3   # noqa: E731
+
 _INPUTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             'data', 'perf_inputs_resnet50_bs32.json')
 
@@ -145,6 +162,11 @@ def phase_costs(inputs):
     chol_flops = sum(r * CHOLESKY_FLOPS_PER_MATRIX(d) for r, d in buckets)
     # bytes: read factors + write inverses, f32: 2 * rows * d^2 * 4 B
     chol_bytes = sum(2 * r * d * d * 4 for r, d in buckets)
+    # iterative decomp_impl rungs: reads factor + seed, writes result
+    sub_flops = sum(r * SUBSPACE_FLOPS_PER_MATRIX(d) for r, d in buckets)
+    ns_flops = sum(r * NEWTON_SCHULZ_FLOPS_PER_MATRIX(d)
+                   for r, d in buckets)
+    iter_bytes = sum(3 * r * d * d * 4 for r, d in buckets)
     return {
         'model': (prog['sgd']['flops'], prog['sgd']['bytes']),
         'precondition': diff('inverse_dp_base', 'sgd'),
@@ -153,7 +175,33 @@ def phase_costs(inputs):
         'refresh': diff('eigen_dp_refresh', 'eigen_dp_factor'),
         'ekfac_scales': diff('ekfac_factor', 'eigen_dp_factor'),
         'inverse_chol': (chol_flops, chol_bytes),
+        'inverse_subspace': (sub_flops, iter_bytes),
+        'inverse_ns': (ns_flops, iter_bytes),
     }
+
+
+def decomp_impl_priors(block, method, anchor='central'):
+    """{rung: predicted decomposition seconds} for the method's
+    decomp_impl ladder, from a ``predict_block()`` dict — the
+    autotuner's seeding input (``KnobController._seed_decomp_impl``).
+    eigh: fenced QDWH full vs the subspace tracker; cholesky: analytic
+    Cholesky vs Newton-Schulz. Returns {} when the block carries no
+    usable phases (the tuner then probes from the configured rung)."""
+    try:
+        ph = block['scenarios'][anchor]['phases_s']
+    except (KeyError, TypeError):
+        return {}
+    if method == 'eigh':
+        out = {'xla': ph.get('ComputeInverse_eigh_full'),
+               'subspace': ph.get('ComputeInverse_subspace')}
+    elif method == 'cholesky':
+        out = {'xla': ph.get('ComputeInverse_chol'),
+               'newton_schulz': ph.get('ComputeInverse_ns')}
+    else:
+        return {}
+    if any(v is None for v in out.values()):
+        return {}
+    return {k: float(v) for k, v in out.items()}
 
 
 def predict(inputs=None):
@@ -200,6 +248,8 @@ def predict(inputs=None):
         chol = t('inverse_chol', f32)
         refresh = t('refresh', f32)
         scales = t('ekfac_scales', f32)
+        sub = t('inverse_subspace', f32)
+        ns = t('inverse_ns', f32)
 
         variants = {
             'sgd': model,
@@ -233,19 +283,27 @@ def predict(inputs=None):
             'ComputeFactor': round(fac, 4),
             'ComputeInverse_chol': round(chol, 4),
             'ComputeInverse_eigh_full': round(eigh_full_s, 2),
+            # the inverse-free ladder rungs (warm kernels, GEMM
+            # roofline at the f32 rate — what the decomp_impl knob
+            # buys on the modeled chip vs the fenced QDWH seconds)
+            'ComputeInverse_subspace': round(sub, 6),
+            'ComputeInverse_ns': round(ns, 6),
             'EigenRefresh': round(refresh, 4),
             'EkfacScales': round(scales, 4),
         }
     return out
 
 
-def prior_phase_costs(block, variant='inverse_dp', anchor='central'):
+def prior_phase_costs(block, variant='inverse_dp', anchor='central',
+                      decomp_impl=None):
     """Per-phase prior seconds for the autotuner's pre-measurement
     seeding (``autotune.prior_best_freq``): pull the ``anchor``
     scenario's phase predictions out of a ``predict_block()`` dict and
     bind the decomposition phase to the variant's kernel (the fenced
     full eigh for eigen/ekfac, the analytic Cholesky otherwise —
-    the same binding ``obs.drift._predicted_phase`` uses). Returns
+    the same binding ``obs.drift._predicted_phase`` uses). An iterative
+    ``decomp_impl`` rebinds to its GEMM-roofline rung, so the freq
+    prior prices the kernel the run will actually execute. Returns
     ``{'model', 'precondition', 'factor', 'decomp'}`` seconds, or ``{}``
     when the block carries no usable phases (the tuner then starts from
     the configured cadence instead of a prior)."""
@@ -254,12 +312,17 @@ def prior_phase_costs(block, variant='inverse_dp', anchor='central'):
     except (KeyError, TypeError):
         return {}
     eigen = str(variant).startswith(('eigen', 'ekfac'))
+    decomp_key = ('ComputeInverse_eigh_full' if eigen
+                  else 'ComputeInverse_chol')
+    if decomp_impl in ('subspace', 'jacobi', 'auto') and eigen:
+        decomp_key = 'ComputeInverse_subspace'
+    elif decomp_impl in ('newton_schulz', 'auto') and not eigen:
+        decomp_key = 'ComputeInverse_ns'
     out = {
         'model': ph.get('Model'),
         'precondition': ph.get('Precondition'),
         'factor': ph.get('ComputeFactor'),
-        'decomp': ph.get('ComputeInverse_eigh_full' if eigen
-                         else 'ComputeInverse_chol'),
+        'decomp': ph.get(decomp_key),
     }
     if any(v is None for v in out.values()):
         return {}
@@ -296,6 +359,12 @@ def predict_block(inputs=None):
                                      '(largest ResNet-50 bucket 4608)'},
                 'cholesky_flops': '7/3 d^3 per matrix (analytic; LAPACK '
                                   'custom calls carry no XLA flop count)',
+                'iterative_decomp_flops': (
+                    'subspace ~(12.7*steps+3) d^3, newton_schulz '
+                    '~(4*iters+2) d^3 per matrix at the defaults '
+                    '(steps=iters=2) — pure GEMMs, rooflined at the '
+                    'f32 rate; the decomp_impl ladder priors '
+                    '(ops/linalg.py kernels, autotune seeding)'),
                 'bytes_proxy_bias': (
                     'the CPU-derived bytes-accessed totals overstate TPU '
                     'HBM traffic (pre-fusion buffer counting, f32-'

@@ -107,14 +107,15 @@ class FactorPlan:
         return len(self.metas)
 
     def comm_volume(self, *, stats_reduce, method, comm_precision='fp32',
-                    comm_mode=None):
+                    comm_mode=None, decomp_shard=None):
         """Analytic per-phase collective payload bytes of ONE full
         factor+inverse K-FAC step under this layout — the model the
         HLO-level ledger (scripts/comm_count.py) measures, stated in
         closed form so ``scripts/comm_models.py`` and the drift gate can
         reason about wire-dtype compression without compiling anything.
 
-        Returns ``{'FactorComm', 'InverseComm', 'PredComm'}`` -> bytes:
+        Returns ``{'FactorComm', 'InverseComm', 'PredComm',
+        'DecompComm'}`` -> bytes:
 
         - FactorComm: the stats reduce-scatter result payload (MPD
           variants only — each device receives its own row block in the
@@ -125,11 +126,22 @@ class FactorPlan:
         - InverseComm: the decomposition gather (comm_inverse mode —
           eigenbasis + eigenvalues, or inverse factors, in the gather
           wire dtype; int8 adds the [rows] fp32 scale side channel);
-        - PredComm: the preconditioned-gradient gather (comm_pred mode).
+        - PredComm: the preconditioned-gradient gather (comm_pred mode);
+        - DecompComm: the mesh-sharded decomposition exchange
+          (``decomp_shard``: a :class:`DecompShardPlan`) — per step, the
+          damped-cohort gather (``P*R_b`` rows out) plus the result
+          gather back (``P*S_b`` rows; eigh adds the eigenvalue
+          vectors). 0 without a shard plan. Under ``decomp_shard`` the
+          shard gathers REPLACE the staggered InverseComm merge gather
+          (every shard collective carries the ``kfac.DecompComm`` named
+          scope, which is how scripts/comm_count.py pins this number
+          byte-for-byte against the compiled HLO).
 
         Cadence is the caller's: FactorComm recurs every
         ``fac_update_freq`` steps, InverseComm every
-        ``kfac_update_freq`` (or 1/F of it per step under stagger).
+        ``kfac_update_freq`` (or 1/F of it per step under stagger);
+        DecompComm is per-step (the staggered schedule decomposes one
+        cohort every step).
 
         ``comm_mode`` overrides the plan's own mode (the autotuner's
         advisory comm-mode decision computes BOTH roads from one
@@ -144,7 +156,7 @@ class FactorPlan:
         reduce_wire = int(4 * coll.WIRE_COMPRESSION[
             coll.reduce_wire_dtype(comm_precision)])
         scale_b = 4 if comm_precision == 'int8' else 0
-        factor = inverse = pred = 0
+        factor = inverse = pred = decomp = 0
         if stats_reduce == 'pmean':
             factor = sum(b.per_dev * b.dim * b.dim * reduce_wire
                          for b in self.buckets.values())
@@ -158,8 +170,23 @@ class FactorPlan:
             for pg in self.pred_groups:
                 rows = self.num_devices * pg.k_per_dev
                 pred += rows * (pg.dg * pg.da * wire + scale_b)
+        if decomp_shard is not None:
+            # the shard exchange REPLACES the staggered InverseComm
+            # merge gather in the compiled program — pricing both would
+            # over-count a sharded step by the whole InverseComm payload
+            inverse = 0
+            P = self.num_devices
+            for bdim in self.bucket_dims:
+                r_b = decomp_shard.gather_rows(bdim)
+                s_b = decomp_shard.shard_rows(bdim)
+                # damped-cohort gather out: P*R_b matrices
+                decomp += P * r_b * (bdim * bdim * wire + scale_b)
+                # result gather back: P*S_b matrices (+ eigh evals)
+                decomp += P * s_b * (bdim * bdim * wire + scale_b)
+                if method == 'eigh':
+                    decomp += P * s_b * (bdim * wire + scale_b)
         return {'FactorComm': factor, 'InverseComm': inverse,
-                'PredComm': pred}
+                'PredComm': pred, 'DecompComm': decomp}
 
 
 def _slot_cost(dim):
@@ -302,6 +329,148 @@ def build_cohorts(plan: 'FactorPlan', num_cohorts: int) -> CohortPlan:
                       global_rows=grows, global_valid=gvalid,
                       own_flat=own_flat, mate_flat=mate_flat,
                       cohort_cost=cohort_cost, cohort_count=cohort_count)
+
+
+@dataclasses.dataclass
+class DecompShardPlan:
+    """Mesh-sharded decomposition layout: the active cohort's rows
+    repartitioned across ALL ``P`` devices, cost-balanced by the same
+    D³ model the cohorts use — so the most-loaded owner's cohort stops
+    being the whole decomposition critical path while its peers idle.
+
+    The work description is static, like the cohort tables: for cohort
+    ``f`` the owners' damped cohort rows are all-gathered (device d's
+    slot j of the gather sits at flat index ``d*R_b + j``), device p
+    decomposes the ``S_b`` gathered slots named by ``src[f, p]``, the
+    results are all-gathered back (device p's slot j at ``p*S_b + j``)
+    and each stored row GATHERS its fresh value through ``res_slot`` —
+    a pure gather-merge, so there are no scatter collisions to order.
+
+    ``S_b = max over (cohort, device)`` of assigned rows, so the padded
+    per-device decomposition work drops from ``Σ_b R_b·D³`` (owner-
+    local: every device pays the most-loaded owner's static shape) to
+    ``Σ_b S_b·D³ ≈ (1/P)·Σ_b total cohort rows·D³`` — the ~P× critical-
+    path claim, bought for the two DecompComm gathers
+    (``FactorPlan.comm_volume`` prices them; scripts/comm_count.py
+    pins the price against the compiled HLO).
+    """
+    num_cohorts: int
+    # per bucket, [F, P, S_b]: index into the flattened gathered cohort
+    # array [P*R_b] that device p decomposes on cohort f
+    src: Dict[int, np.ndarray]
+    src_valid: Dict[int, np.ndarray]         # [F, P, S_b] bool
+    # per bucket, [F, P, S_b]: the STORED global row each src slot
+    # refreshes (valid slots only; padding points at row 0) — the warm-
+    # seed lookup for the iterative kernels under comm_mode='inverse'
+    src_global: Dict[int, np.ndarray]
+    # merge gather tables, per bucket [F, n_rows]: where each stored
+    # global row's fresh value sits in the result gather [P*S_b]
+    # (comm_pred merges reshape to [F, P, per_dev] and take the local
+    # block — global rows are device-major)
+    res_slot: Dict[int, np.ndarray]
+    res_valid: Dict[int, np.ndarray]         # [F, n_rows] bool
+    shard_cost: np.ndarray                   # [F, P] Σ D³ assigned
+    shard_count: np.ndarray                  # [F, P] valid rows assigned
+    # per bucket: R_b, the per-device rows of the damped-cohort gather
+    # (the cohort tables' static shape — carried for the byte model)
+    cohort_rows: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+    def gather_rows(self, bdim):
+        """R_b: per-device rows of the damped-cohort gather."""
+        return self.cohort_rows[bdim]
+
+    def shard_rows(self, bdim):
+        """S_b: per-device rows decomposed (and gathered back)."""
+        return self.src[bdim].shape[2]
+
+    def max_rows_per_step(self):
+        """Max over (cohort, device) of genuinely decomposed rows."""
+        return int(self.shard_count.max()) if self.shard_count.size else 0
+
+    def padded_rows_per_step(self):
+        """Static per-device rows decomposed every step (Σ_b S_b)."""
+        return int(sum(t.shape[2] for t in self.src.values()))
+
+
+def build_decomp_shard(plan: 'FactorPlan',
+                       cohorts: CohortPlan) -> DecompShardPlan:
+    """Partition every cohort's valid rows across ALL devices — the
+    cross-device extension of ``build_cohorts``' D³ cost model.
+
+    The compiled shard program is UNIFORM: every device decomposes
+    exactly ``S_b`` (padded) rows of bucket b per step, so the true
+    per-device cost is ``Σ_b S_b·D³`` regardless of which rows are
+    valid — minimizing the critical path means minimizing every
+    ``S_b`` independently, and within a bucket all rows cost the same
+    D³. The optimal assignment is therefore per-(cohort, bucket)
+    round-robin: ``S_b = ceil(cohort rows of b / P)``, the information-
+    theoretic floor, versus owner-local's ``R_b = max over owners`` —
+    equal when ownership is balanced, up to P× smaller when one device
+    owns the bucket (the real-world trigger: a model whose only large
+    factors sit on one owner). A rotating start device spreads the
+    remainder rows so per-device VALID row counts stay within 2× of
+    the mean across the whole plan (pinned by
+    tests/test_decomp_shard.py).
+    """
+    F, P = cohorts.num_cohorts, plan.num_devices
+    shard_cost = np.zeros((F, P), dtype=np.float64)
+    shard_count = np.zeros((F, P), dtype=np.int64)
+    # (bucket -> per-cohort per-device assigned items)
+    assigned: Dict[int, list] = {b: [[[] for _ in range(P)]
+                                     for _ in range(F)]
+                                 for b in plan.bucket_dims}
+    for f in range(F):
+        for b_idx, bdim in enumerate(plan.bucket_dims):
+            b = plan.buckets[bdim]
+            rows, valid = cohorts.rows[bdim][f], cohorts.valid[bdim][f]
+            R = rows.shape[1]
+            items = []  # (src_flat, global_row), owner-major order
+            for d in range(P):
+                for j in range(R):
+                    if valid[d, j]:
+                        items.append((d * R + j,
+                                      d * b.per_dev + int(rows[d, j])))
+            # rotate the start device per (cohort, bucket) so remainder
+            # rows don't pile onto device 0 across buckets/cohorts
+            start = (f + b_idx) % P
+            for i, item in enumerate(items):
+                p = (start + i) % P
+                assigned[bdim][f][p].append(item)
+                shard_cost[f, p] += _slot_cost(bdim)
+                shard_count[f, p] += 1
+
+    src, src_valid, src_global, res_slot, res_valid = {}, {}, {}, {}, {}
+    for bdim in plan.bucket_dims:
+        b = plan.buckets[bdim]
+        S = max(1, max(len(assigned[bdim][f][p])
+                       for f in range(F) for p in range(P)))
+        s_tbl = np.zeros((F, P, S), dtype=np.int32)
+        v_tbl = np.zeros((F, P, S), dtype=bool)
+        g_tbl = np.zeros((F, P, S), dtype=np.int32)
+        slot_tbl = np.zeros((F, b.n_rows), dtype=np.int32)
+        rvalid_tbl = np.zeros((F, b.n_rows), dtype=bool)
+        for f in range(F):
+            for p in range(P):
+                for j, (src_flat, grow) in enumerate(assigned[bdim][f][p]):
+                    s_tbl[f, p, j] = src_flat
+                    v_tbl[f, p, j] = True
+                    g_tbl[f, p, j] = grow
+                    slot_tbl[f, grow] = p * S + j
+                    rvalid_tbl[f, grow] = True
+                # padding slots keep src 0 (a real gathered matrix —
+                # decomposable; the result is never gathered into any
+                # stored row because no res_slot points at it)
+        src[bdim] = s_tbl
+        src_valid[bdim] = v_tbl
+        src_global[bdim] = g_tbl
+        res_slot[bdim] = slot_tbl
+        res_valid[bdim] = rvalid_tbl
+    return DecompShardPlan(
+        num_cohorts=F, src=src, src_valid=src_valid,
+        src_global=src_global, res_slot=res_slot, res_valid=res_valid,
+        shard_cost=shard_cost, shard_count=shard_count,
+        cohort_rows={b: cohorts.rows[b].shape[2]
+                     for b in plan.bucket_dims})
 
 
 def build_plan(metas: Dict[str, LayerMeta], num_devices: int, comm_mode: str,

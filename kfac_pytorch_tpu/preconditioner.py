@@ -33,7 +33,23 @@ from jax.sharding import PartitionSpec as P
 
 from kfac_pytorch_tpu import engine, faults
 from kfac_pytorch_tpu import health as health_lib
-from kfac_pytorch_tpu.plan import build_cohorts, build_plan, default_bucket_fn
+from kfac_pytorch_tpu.plan import (build_cohorts, build_decomp_shard,
+                                   build_plan, default_bucket_fn)
+
+#: decomposition-implementation knob values (the autotuner's ladder
+#: restates this tuple in autotune.DECOMP_IMPLS — it must stay
+#: stdlib-importable; cross-module agreement is pinned by test).
+#: 'xla' = the cold kernel (QDWH eigh / batched Cholesky); 'subspace' /
+#: 'jacobi' = warm eigh kernels (eigh variants only); 'newton_schulz' =
+#: the warm GEMM inverse (cholesky variants only); 'auto' resolves per
+#: method to the MXU-shaped warm kernel.
+DECOMP_IMPLS = ('xla', 'auto', 'jacobi', 'subspace', 'newton_schulz')
+
+#: impls that warm-start from the stored decomposition — an explicit
+#: iterative ``decomp_impl`` implies warm seeding without requiring
+#: ``warm_start_basis`` (the tuner flips the knob mid-run; the seeds
+#: are what make the iterative rung cheap).
+_WARM_IMPLS = ('auto', 'jacobi', 'subspace', 'newton_schulz')
 
 
 class KFACState(flax.struct.PyTreeNode):
@@ -223,6 +239,37 @@ class KFAC:
         decomposition of a run un-prefetched (a cold state would
         precondition with zeros). Redundant (but harmless) with
         ``stagger``, which is always double-buffered.
+      decomp_impl: the decomposition implementation, promoted to a
+        first-class runtime knob (beyond reference — autotune.KNOB_ATTRS
+        rung; README "Attacking the decomposition wall"): 'xla' (the
+        cold kernel — QDWH eigh / batched Cholesky), 'subspace' or
+        'jacobi' (warm eigh kernels, eigh variants only),
+        'newton_schulz' (the warm GEMM inverse, Cholesky variants
+        only), or 'auto' (the MXU-shaped warm kernel for the method).
+        An EXPLICIT iterative value implies warm seeding from the
+        stored decomposition — no separate ``warm_start_basis`` needed
+        (the per-row NS acceptance gate / subspace degeneracy handling
+        keep accuracy safe; see ops/linalg.py). None (default)
+        preserves the legacy KFAC_EIGH_IMPL env contract exactly. The
+        KnobController ladders this attribute through the arbiter; a
+        change retraces the step (the arbiter fires the variant-cache
+        invalidators, like comm_precision).
+      decomp_shard: mesh-sharded decomposition (beyond reference — the
+        tentpole of ROADMAP item 5): the active refresh cohort's rows
+        are repartitioned cost-balanced (D³ model) across ALL devices
+        instead of decomposed owner-local, shrinking the per-step
+        decomposition critical path from ``Σ_b R_b·D³`` to
+        ``Σ_b S_b·D³ ≈ 1/P`` of the cohort total — the most-loaded
+        owner's cohort stops serializing its idle peers. Costs two
+        bounded ``DecompComm`` gathers per step (damped cohort factors
+        out, results back), priced in closed form by
+        ``FactorPlan.comm_volume(decomp_shard=...)`` and pinned
+        byte-for-byte against the compiled HLO by
+        scripts/comm_count.py. Implies ``stagger=True`` (the cohort
+        tables ARE the work description) and therefore inherits
+        stagger's exclusions; incompatible with the
+        CommunicateInverse ablation. ``axis_name=None`` degenerates to
+        the owner-local path bit-exactly.
       health: the numerical-health guard (beyond reference, health.py).
         True (default) enables the in-engine screens with the default
         ladder: factor-EMA rows and decomposition rows that come back
@@ -244,7 +291,8 @@ class KFAC:
                  distribute_layer_factors=None, bucket_fn=None, eps=1e-10,
                  basis_update_freq=None, warm_start_basis=False,
                  warm_sweeps=None, cold_restart_every=50, stagger=False,
-                 health=True, comm_precision='fp32', comm_prefetch=False):
+                 health=True, comm_precision='fp32', comm_prefetch=False,
+                 decomp_impl=None, decomp_shard=False):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -307,7 +355,41 @@ class KFAC:
             raise ValueError('cold_restart_every must be a positive int '
                              f'(got {cold_restart_every!r})')
         self.cold_restart_every = cold_restart_every
+        # decomposition-implementation knob (tentpole b): an EXPLICIT
+        # value routes through the traced programs (ops.sym_eig impl /
+        # the NS warm inverse) and joins the autotuner's KNOB_ATTRS
+        # ladder; None preserves the legacy KFAC_EIGH_IMPL env path
+        # exactly (env read at trace time, warm only with
+        # warm_start_basis) so existing configs are untouched
+        if decomp_impl is not None:
+            if decomp_impl not in DECOMP_IMPLS:
+                raise ValueError(
+                    f'decomp_impl must be one of {DECOMP_IMPLS}, '
+                    f'got {decomp_impl!r}')
+            if (decomp_impl in ('subspace', 'jacobi')
+                    and self.method != 'eigh'):
+                raise ValueError(
+                    f'decomp_impl={decomp_impl!r} is an eigh kernel; '
+                    f'variant {variant!r} decomposes by Cholesky — use '
+                    "'newton_schulz' (or 'auto') there")
+            if decomp_impl == 'newton_schulz' and self.method != 'cholesky':
+                raise ValueError(
+                    "decomp_impl='newton_schulz' replaces the Cholesky "
+                    f'inverse; variant {variant!r} eigendecomposes — '
+                    "use 'subspace' (or 'auto') there")
+        self.decomp_impl = decomp_impl
+        self.decomp_shard = bool(decomp_shard)
+        if self.decomp_shard and not stagger:
+            # sharding repartitions the ACTIVE COHORT's rows — it is a
+            # stagger-family feature, so the flag implies the staggered
+            # schedule (and inherits its exclusions below)
+            stagger = True
         self.stagger = bool(stagger)
+        if self.decomp_shard and 'CommunicateInverse' in exclude_parts:
+            raise ValueError(
+                'decomp_shard IS a communication pattern — the '
+                'CommunicateInverse ablation cannot exclude the shard '
+                'exchange (drop decomp_shard for that ablation)')
         if self.stagger:
             if self.ekfac:
                 raise ValueError(
@@ -321,6 +403,7 @@ class KFAC:
                     'or warm_start_basis (pick one; see README '
                     '"Staggered refresh")')
         self._cohorts = None
+        self._shard_plan = None
         from kfac_pytorch_tpu.parallel import collectives as _coll
         self.comm_precision = _coll.check_wire_dtype(comm_precision)
         self.comm_prefetch = bool(comm_prefetch)
@@ -403,12 +486,40 @@ class KFAC:
         f = max(1, int(self.kfac_update_freq))
         if self._cohorts is None or self._cohorts.num_cohorts != f:
             self._cohorts = build_cohorts(self.plan, f)
+            self._shard_plan = None
+        if self.decomp_shard and self._shard_plan is None:
+            self._shard_plan = build_decomp_shard(self.plan, self._cohorts)
         return self._cohorts
 
     @property
     def cohorts(self):
         """The current staggered cohort layout (plan.CohortPlan)."""
         return self._cohorts
+
+    @property
+    def decomp_shard_plan(self):
+        """The mesh-sharded decomposition layout
+        (plan.DecompShardPlan), or None when ``decomp_shard`` is off."""
+        return self._shard_plan
+
+    @property
+    def resolved_decomp_impl(self):
+        """The kernel the traced step actually selects: 'auto' resolves
+        per method (subspace for eigh, Newton-Schulz for Cholesky);
+        None stays None — engine falls back to the legacy
+        KFAC_EIGH_IMPL env read."""
+        impl = self.decomp_impl
+        if impl == 'auto':
+            return 'subspace' if self.method == 'eigh' else 'newton_schulz'
+        return impl
+
+    @property
+    def warm_impl(self):
+        """Does the EXPLICIT decomp_impl warm-start from the stored
+        decomposition? (The trainer's warm gate ORs this with
+        ``warm_start_basis`` — an env-selected impl deliberately does
+        NOT auto-warm, preserving the legacy contract.)"""
+        return self.decomp_impl in _WARM_IMPLS
 
     def init(self):
         """Initial state: identity factors (reference initializes running
@@ -663,11 +774,13 @@ class KFAC:
                 # basis unchanged -> stored moments stay valid as-is
             else:
                 basis_local = invs_prev = None
-                if self.warm_start_basis and warm_basis:
+                if (self.warm_start_basis or self.warm_impl) and warm_basis:
                     # warm_basis is STATIC, set by the trainer only after
                     # a full decomposition exists (a zero basis would
                     # silently corrupt the rotated eigh problem; a zero
-                    # inverse seed is caught by the NS residual gate)
+                    # inverse seed is caught by the NS residual gate).
+                    # An explicit iterative decomp_impl implies warm
+                    # seeding — that is what makes its rung cheap
                     if self.method == 'eigh':
                         basis_local = engine.local_evecs(
                             plan, decomp, axis_name, self.comm_mode)
@@ -679,7 +792,8 @@ class KFAC:
                         plan, factors, damping, self.method, self.eps,
                         axis_name, basis_local=basis_local,
                         warm_sweeps=self.warm_sweeps,
-                        invs_prev_local=invs_prev)
+                        invs_prev_local=invs_prev,
+                        impl=self.resolved_decomp_impl)
                 # chaos drill: simulated eigh/Cholesky blowup, injected
                 # BEFORE the guard so the guard is what survives it
                 decomp_local = faults.corrupt_decomposition(
@@ -768,21 +882,53 @@ class KFAC:
                 'stagger_update requires KFAC(stagger=True) + setup()'
             cohort_idx = jnp.mod(jnp.asarray(state.step, jnp.int32),
                                  jnp.int32(cohorts.num_cohorts))
-            with jax.named_scope('kfac.ComputeInverse.stagger'):
-                cohort_new = engine.compute_cohort_decomposition(
-                    plan, cohorts, factors, cohort_idx, damping,
-                    self.method, self.eps, axis_name)
-            # chaos drill parity with the full path: blowups injected
-            # BEFORE the merge's per-row screen, which is what heals them
-            cohort_new = faults.corrupt_decomposition(
-                self._faults, state.step, cohort_new)
-            with jax.named_scope('kfac.CommunicateInverse.stagger'):
-                decomp = engine.merge_cohort_decomposition(
-                    plan, cohorts, decomp, cohort_new, cohort_idx,
-                    axis_name, self.comm_mode, self.method,
-                    communicate=not self.exclude_communicate_inverse,
-                    guard=self.health is not None,
-                    comm_precision=self.comm_precision)
+            if self.decomp_shard:
+                # tentpole: the cohort's rows decompose balanced across
+                # ALL devices (plan.build_decomp_shard) — the shard
+                # exchange's two gathers carry the kfac.DecompComm
+                # scope for the HLO byte ledger
+                shard = self._shard_plan
+                assert shard is not None, \
+                    'decomp_shard requires setup() (rebase_cohorts)'
+                with jax.named_scope('kfac.ComputeInverse.stagger'):
+                    shard_new = engine.compute_shard_decomposition(
+                        plan, cohorts, shard, factors, cohort_idx,
+                        damping, self.method, self.eps, axis_name,
+                        impl=self.resolved_decomp_impl,
+                        decomp_prev=decomp, comm_mode=self.comm_mode,
+                        warm_sweeps=self.warm_sweeps,
+                        comm_precision=self.comm_precision)
+                # chaos drill parity: blowups injected BEFORE the
+                # merge's per-row screen, which is what heals them
+                shard_new = faults.corrupt_decomposition(
+                    self._faults, state.step, shard_new)
+                with jax.named_scope('kfac.CommunicateInverse.stagger'):
+                    decomp = engine.merge_shard_decomposition(
+                        plan, shard, decomp, shard_new, cohort_idx,
+                        axis_name, self.comm_mode, self.method,
+                        guard=self.health is not None,
+                        comm_precision=self.comm_precision)
+            else:
+                with jax.named_scope('kfac.ComputeInverse.stagger'):
+                    cohort_new = engine.compute_cohort_decomposition(
+                        plan, cohorts, factors, cohort_idx, damping,
+                        self.method, self.eps, axis_name,
+                        impl=self.resolved_decomp_impl,
+                        decomp_prev=(decomp if self.warm_impl else None),
+                        comm_mode=self.comm_mode,
+                        warm_sweeps=self.warm_sweeps)
+                # chaos drill parity with the full path: blowups
+                # injected BEFORE the merge's per-row screen, which is
+                # what heals them
+                cohort_new = faults.corrupt_decomposition(
+                    self._faults, state.step, cohort_new)
+                with jax.named_scope('kfac.CommunicateInverse.stagger'):
+                    decomp = engine.merge_cohort_decomposition(
+                        plan, cohorts, decomp, cohort_new, cohort_idx,
+                        axis_name, self.comm_mode, self.method,
+                        communicate=not self.exclude_communicate_inverse,
+                        guard=self.health is not None,
+                        comm_precision=self.comm_precision)
 
         grad_mats = [engine.layer_grad_matrix(m, grads) for m in plan.metas]
         with jax.named_scope('kfac.Precondition'):

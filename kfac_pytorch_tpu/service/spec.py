@@ -47,6 +47,21 @@ TRAINERS = {
     'wikitext_rnn': 'examples/wikitext_rnn.py',
 }
 
+#: the K-FAC knob surface of the example trainers — every ``kfac_*``
+#: knob a spec may set, kept in lockstep with the trainers'
+#: ``--kfac-*`` flags (pinned by tests/test_service.py): a tenant's
+#: typo ('kfac_decomp_imp') must fail at submit time, not as a 3am
+#: scheduler argv crash. Non-kfac knobs (epochs, batch_size, ...) stay
+#: regex-validated only — the trainers' own surfaces differ too much
+#: to table them all.
+KFAC_KNOBS = frozenset({
+    'kfac_autotune', 'kfac_basis_update_freq', 'kfac_comm_precision',
+    'kfac_comm_prefetch', 'kfac_cov_update_freq', 'kfac_decomp_impl',
+    'kfac_decomp_shard', 'kfac_name', 'kfac_stagger', 'kfac_type',
+    'kfac_update_freq', 'kfac_update_freq_alpha',
+    'kfac_update_freq_decay', 'kfac_warm_start',
+})
+
 _TENANT = re.compile(r'^[a-z0-9][a-z0-9_-]{0,62}$')
 _KNOB = re.compile(r'^[a-z][a-z0-9_]{0,62}$')
 _ENVKEY = re.compile(r'^(KFAC|JAX)_[A-Z0-9_]{1,62}$')
@@ -155,6 +170,9 @@ def validate_spec(payload, trainers=None):
         if not isinstance(k, str) or not _KNOB.match(k):
             problems.append(f'knob name {k!r} must match '
                             '[a-z][a-z0-9_]*')
+        elif k.startswith('kfac_') and k not in KFAC_KNOBS:
+            problems.append(f'unknown K-FAC knob {k!r} '
+                            f'(known: {sorted(KFAC_KNOBS)})')
         if not isinstance(v, bool) and v is not None:
             _check_scalar(problems, f'knob {k!r}', v)
     env = payload.get('env', {})

@@ -139,9 +139,13 @@ def _warm_basis_gate(precond, seen, step, ui, ub):
     the run's ``seen`` record: warm only once a prior full exists (the
     stored basis must be orthogonal, not zeros), and every
     ``cold_restart_every``-th full goes cold to reset the orthogonality
-    error the chained basis ``Q <- Q @ V'`` accumulates."""
+    error the chained basis ``Q <- Q @ V'`` accumulates. An explicit
+    iterative ``decomp_impl`` (``precond.warm_impl``) warms through the
+    same gate — the tuner's ladder rung needs no separate
+    ``warm_start_basis`` opt-in."""
     streak = seen.get('warm_streak', 0)
-    warm = (getattr(precond, 'warm_start_basis', False)
+    warm = ((getattr(precond, 'warm_start_basis', False)
+             or getattr(precond, 'warm_impl', False))
             and 'last_full' in seen
             and streak < getattr(precond, 'cold_restart_every', 50))
     if ui and ub:

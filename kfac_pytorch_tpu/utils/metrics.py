@@ -94,6 +94,14 @@ class HealthMonitor:
             self.registry.watermark('health/max_rung').set(rung)
         self.skipped, self.fallbacks, self.rung = skipped, fallbacks, rung
 
+    def quality_signal(self):
+        """Monotone badness counter for the autotuner's numerical-
+        health gate (``KnobController(quality_gate=...)``): total
+        skipped batches + raw-SGD fallbacks. A knob probe window that
+        raised this number regressed accuracy and never commits,
+        whatever its step time said."""
+        return self.skipped + self.fallbacks
+
     def epoch_flush(self):
         """Per-epoch deltas ``{skipped, fallbacks, max_rung}``; resets the
         epoch accumulators (cumulative totals keep running)."""

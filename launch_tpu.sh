@@ -81,6 +81,37 @@ if [ -n "$KFAC_AUTOTUNE" ]; then
   esac
 fi
 
+# Decomposition wall (README "Attacking the decomposition wall"):
+# KFAC_DECOMP_IMPL selects the decomposition kernel for every trainer of
+# the run (the trainers read it as the --kfac-decomp-impl default; an
+# explicit flag still wins): xla = cold QDWH eigh / Cholesky;
+# subspace|jacobi (eigh variants) / newton_schulz (Cholesky variants)
+# are warm iterative GEMM kernels; auto picks the warm kernel per
+# variant. An explicit value is also a live autotuner ladder rung.
+if [ -n "$KFAC_DECOMP_IMPL" ]; then
+  case "$KFAC_DECOMP_IMPL" in
+    xla|auto|jacobi|subspace|newton_schulz) export KFAC_DECOMP_IMPL ;;
+    *) echo "launch_tpu.sh: KFAC_DECOMP_IMPL must be" \
+            "xla|auto|jacobi|subspace|newton_schulz," \
+            "got '$KFAC_DECOMP_IMPL'" >&2; exit 1 ;;
+  esac
+fi
+
+# KFAC_DECOMP_SHARD=1 turns on mesh-sharded decomposition (the
+# --kfac-decomp-shard default): each refresh cohort's eigh/inverse rows
+# are repartitioned cost-balanced across ALL devices instead of
+# owner-local — ~P x shorter decomposition critical path for two
+# bounded DecompComm gathers per step (scripts/comm_count.py pins the
+# wire bytes against FactorPlan.comm_volume). Implies the staggered
+# schedule.
+if [ -n "$KFAC_DECOMP_SHARD" ]; then
+  case "$KFAC_DECOMP_SHARD" in
+    0|1) export KFAC_DECOMP_SHARD ;;
+    *) echo "launch_tpu.sh: KFAC_DECOMP_SHARD must be 0 or 1," \
+            "got '$KFAC_DECOMP_SHARD'" >&2; exit 1 ;;
+  esac
+fi
+
 if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi

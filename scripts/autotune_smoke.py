@@ -1,6 +1,6 @@
 """Closed-loop autotune smoke: the CI gate for the online KnobController.
 
-Three legs, each writing its decision log as a JSONL artifact:
+Five legs, each writing its decision log as a JSONL artifact:
 
 1. **synthetic** (jax-free, fully deterministic — no wall clock): a
    planted cost profile whose refresh spike amortizes with frequency
@@ -15,7 +15,16 @@ Three legs, each writing its decision log as a JSONL artifact:
    (the acceptance criterion — the tuner never commits a change whose
    measured phase ratio leaves the band), every improving candidate
    vetoed.
-3. **measured** (``AUTOTUNE_SMOKE_MEASURED=1``, needs a jax CPU
+3. **decomp-ladder** (jax-free): the inverse-free rung
+   (``decomp_impl``) under a planted optimum — the newton_schulz rung
+   is genuinely cheaper, the controller must converge onto it with
+   ZERO vetoes of any kind.
+4. **quality-hold** (jax-free): the numerical-health gate — the
+   iterative rung is FASTER but raises the badness counter
+   (``quality_gate``) during its probe window. Gate: zero commits
+   (an accuracy-regressing rung never lands on speed alone), at least
+   one quality veto, steady at the cold kernel.
+5. **measured** (``AUTOTUNE_SMOKE_MEASURED=1``, needs a jax CPU
    backend): ``bench._micro_autotune()`` — the controller starts the
    real micro-MLP trainer at the pessimal cadence (kfac_update_freq=1)
    and must climb to the best hand-configured cadence of the same
@@ -147,6 +156,86 @@ def leg_drift_hold(art_dir):
             'failures': failures}
 
 
+class _FakeDecompPrecond(_FakePrecond):
+    def __init__(self, method='cholesky', decomp_impl='xla', **kw):
+        super().__init__(**kw)
+        self.method = method
+        self.decomp_impl = decomp_impl
+
+
+def leg_decomp_ladder(art_dir):
+    """Planted optimum on the inverse-free rung: newton_schulz's
+    decomposition marginal is 4x cheaper — the controller must land on
+    it with zero spurious vetoes."""
+    pre = _FakeDecompPrecond(kfac=4)
+    ctl = autotune.KnobController(
+        pre, window=8, settle=1, rel_improve=0.03, dwell_windows=1,
+        cooldown=2, steady_every=0, tune=('decomp_impl',),
+        decision_log=os.path.join(art_dir,
+                                  'autotune-decisions-decomp.jsonl'))
+
+    def model(F, i):
+        decomp = 0.4 if pre.decomp_impl == 'xla' else 0.1
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + decomp
+        return ('pred',), 0.01
+
+    steps = _feed(ctl, pre, model, 1000)
+    failures = []
+    if pre.decomp_impl != 'newton_schulz':
+        failures.append(f'final decomp_impl={pre.decomp_impl} != planted '
+                        'optimum newton_schulz')
+    if ctl.state != 'steady':
+        failures.append(f'no steady state after {steps} steps')
+    if ctl.vetoes:
+        failures.append(f'{ctl.vetoes} spurious vetoes')
+    return {'leg': 'decomp_ladder', 'planted_optimum': 'newton_schulz',
+            'final_decomp_impl': pre.decomp_impl, 'steps': steps,
+            'commits': ctl.commits, 'vetoes': ctl.vetoes,
+            'failures': failures}
+
+
+def leg_quality_hold(art_dir):
+    """The numerical-health acceptance criterion: a FASTER iterative
+    rung whose probe window raises the badness counter never commits."""
+    pre = _FakeDecompPrecond(kfac=4)
+    events = {'n': 0}
+    ctl = autotune.KnobController(
+        pre, window=8, settle=1, rel_improve=0.03, dwell_windows=1,
+        cooldown=50, steady_every=0, tune=('decomp_impl',),
+        quality_gate=lambda: events['n'],
+        decision_log=os.path.join(art_dir,
+                                  'autotune-decisions-quality.jsonl'))
+
+    def model(F, i):
+        if pre.decomp_impl == 'newton_schulz':
+            events['n'] += 1                  # accuracy regressing...
+            decomp = 0.05                     # ...but much faster
+        else:
+            decomp = 0.4
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + decomp
+        return ('pred',), 0.01
+
+    steps = _feed(ctl, pre, model, 1000)
+    failures = []
+    if ctl.commits:
+        failures.append(f'{ctl.commits} commits of an accuracy-'
+                        'regressing rung')
+    if not ctl.quality_vetoes:
+        failures.append('no quality veto fired')
+    if pre.decomp_impl != 'xla':
+        failures.append(f'knob moved to {pre.decomp_impl} despite the '
+                        'quality veto')
+    if ctl.state != 'steady':
+        failures.append(f'no steady state after {steps} steps '
+                        f'(state={ctl.state})')
+    return {'leg': 'quality_hold', 'commits': ctl.commits,
+            'quality_vetoes': ctl.quality_vetoes,
+            'final_decomp_impl': pre.decomp_impl, 'steps': steps,
+            'failures': failures}
+
+
 def leg_measured(art_dir, tol):
     """bench._micro_autotune on a real CPU backend: pessimal start,
     hand-configured sweep as the yardstick."""
@@ -178,7 +267,8 @@ def main():
     art_dir = os.environ.get('AUTOTUNE_SMOKE_DIR', '.')
     os.makedirs(art_dir, exist_ok=True)
     tol = float(os.environ.get('AUTOTUNE_SMOKE_TOL', '1.10'))
-    legs = [leg_synthetic(art_dir), leg_drift_hold(art_dir)]
+    legs = [leg_synthetic(art_dir), leg_drift_hold(art_dir),
+            leg_decomp_ladder(art_dir), leg_quality_hold(art_dir)]
     if os.environ.get('AUTOTUNE_SMOKE_MEASURED') == '1':
         legs.append(leg_measured(art_dir, tol))
     failures = [f for leg in legs for f in leg['failures']]

@@ -70,6 +70,10 @@ _WARNED_DTYPES = set()
 #: the 'grad_or_other' floor that MUST stay byte-identical under any
 #: comm_precision (compression never touches the SGD path).
 PHASE_OF_SCOPE = (
+    # DecompComm first: the shard exchange's gathers run INSIDE the
+    # stagger ComputeInverse/CommunicateInverse scopes, and first-match
+    # attribution must put them in their own ledger phase
+    ('kfac.DecompComm', 'DecompComm'),
     ('kfac.CommunicateFactor', 'FactorComm'),
     ('kfac.CommunicateInverse', 'InverseComm'),
     ('kfac.Precondition', 'PredComm'),
@@ -135,7 +139,10 @@ def _ce(outputs, batch):
 
 
 def parse_variant_spec(spec):
-    """'eigen' | 'eigen:bf16' -> (variant, comm_precision)."""
+    """'eigen' | 'eigen:bf16' | 'eigen+shard:bf16' -> (variant,
+    comm_precision). The '+shard' tag stays part of the variant name —
+    a compressed shard spec's fp32 counterpart is the shard spec, not
+    the unsharded one (different programs, different byte model)."""
     variant, _, precision = spec.partition(':')
     return variant, (precision or 'fp32')
 
@@ -163,14 +170,22 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
     if model is None:
         model = models.get_model(model_name, num_classes=10)
     tx = training.sgd(0.1, momentum=0.9)
+    # 'eigen+shard': the variant's staggered step with mesh-sharded
+    # decomposition (decomp_shard=True implies stagger) — the lowered
+    # program is ONE staggered step whose two DecompComm gathers the
+    # analytic model prices in closed form
+    base, _, tag = variant.partition('+')
+    decomp_shard = tag == 'shard'
     precond = None
     if variant != 'sgd':
-        precond = kfac.KFAC(variant=variant, lr=0.1, damping=0.003,
-                            fac_update_freq=1, kfac_update_freq=1,
+        precond = kfac.KFAC(variant=base, lr=0.1, damping=0.003,
+                            fac_update_freq=1,
+                            kfac_update_freq=2 if decomp_shard else 1,
                             num_devices=ndev, axis_name='batch',
                             assignment='balanced',
                             comm_precision=comm_precision,
-                            comm_prefetch=comm_prefetch)
+                            comm_prefetch=comm_prefetch,
+                            decomp_shard=decomp_shard)
     state = training.init_train_state(model, tx, precond,
                                       jax.random.PRNGKey(0),
                                       batch['input'])
@@ -183,8 +198,12 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
     # program twice) and read the compiled SPMD module's text
     from kfac_pytorch_tpu.preconditioner import KFACHyperParams
     hyper = KFACHyperParams(lr=jnp.float32(0.1), damping=jnp.float32(0.003))
-    jitted = step.make_variant(precond is not None, precond is not None,
-                               prefetch=comm_prefetch)
+    if decomp_shard:
+        jitted = step.make_variant(True, False, stagger_update=True)
+    else:
+        jitted = step.make_variant(precond is not None,
+                                   precond is not None,
+                                   prefetch=comm_prefetch)
     txt = jitted.lower(state, batch, hyper).compile().as_text()
     counts = collections.Counter()
     bytes_by_kind = collections.Counter()
@@ -206,7 +225,7 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
         rec['bytes'] += total
         for dt, b in per_dtype.items():
             rec['by_dtype'][dt] = rec['by_dtype'].get(dt, 0) + b
-    return {
+    led = {
         'variant': variant,
         'comm_precision': comm_precision,
         'comm_prefetch': bool(comm_prefetch),
@@ -215,6 +234,15 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
         'by_phase': by_phase,
         'total_bytes': int(sum(bytes_by_kind.values())),
     }
+    if decomp_shard:
+        # the closed-form DecompComm byte price of ONE staggered step
+        # under this layout — the COMM_COUNT_ASSERT pin compares the
+        # measured by_phase['DecompComm'] bytes against this exactly
+        led['decomp_comm_analytic'] = int(precond.plan.comm_volume(
+            stats_reduce=precond.stats_reduce, method=precond.method,
+            comm_precision=comm_precision,
+            decomp_shard=precond.decomp_shard_plan)['DecompComm'])
+    return led
 
 
 def collective_counts(variant, ndev=8, model_name='resnet20', model=None,
@@ -330,6 +358,13 @@ def main():
             if base > 0:
                 print(f'{spec:>17}: {100 * (1 - comp / base):.0f}% K-FAC '
                       f'collective-byte reduction vs {variant} (fp32)')
+        for spec, led in ledgers.items():
+            if 'decomp_comm_analytic' in led:
+                meas = led['by_phase'].get('DecompComm', {}).get('bytes', 0)
+                print(f'{spec:>17}: DecompComm measured '
+                      f'{meas / 2**20:.3f} MiB vs analytic '
+                      f'{led["decomp_comm_analytic"] / 2**20:.3f} MiB '
+                      '(per staggered step)')
         if 'eigen' in ledgers and 'eigen_dp' in ledgers:
             e = ledgers['eigen']['total_bytes'] - sgd_bytes
             edp = ledgers['eigen_dp']['total_bytes'] - sgd_bytes
@@ -355,7 +390,43 @@ def main():
             assert base > 0 and comp <= 0.6 * base, (
                 f'{spec}: expected >=40% K-FAC collective-byte reduction '
                 f'vs {variant}, got {base} -> {comp}')
-        print('COMM_COUNT_ASSERT: floor + compression gates passed')
+        # the DecompComm pin: a '+shard' spec's measured shard-exchange
+        # bytes must equal FactorPlan.comm_volume's closed-form price
+        # EXACTLY, and its gradient floor must be byte-identical to the
+        # SGD program's — the shard gathers shrink compute, never touch
+        # the gradient path
+        for spec, led in ledgers.items():
+            analytic = led.get('decomp_comm_analytic')
+            if analytic is None:
+                continue
+            measured = led['by_phase'].get('DecompComm', {}).get('bytes', 0)
+            assert measured == analytic, (
+                f'{spec}: measured DecompComm {measured} B != analytic '
+                f'FactorPlan.comm_volume {analytic} B — the shard '
+                'exchange and its byte model diverged')
+            # the floor pin compares against the UNSHARDED base
+            # variant's program (same preconditioner, same health-guard
+            # psum — the SGD program lacks the guard's 4-byte batch_ok
+            # reduce, so it is not the right baseline here; the SGD
+            # floor itself stays pinned gradient-only by check_floor)
+            variant, _ = parse_variant_spec(spec)
+            unsharded = variant.partition('+')[0]
+            # a shard spec with no unsharded counterpart would make the
+            # floor pin vacuously green — fail loudly instead (the same
+            # hardening the compressed-spec gates got in PR 8 review)
+            assert unsharded in ledgers, (
+                f'{spec}: no unsharded counterpart {unsharded!r} in the '
+                'ledger set — the gradient-floor pin needs it; add '
+                f'{unsharded!r} to COMM_COUNT_VARIANTS')
+            base_floor = ledgers[unsharded]['by_phase'].get(
+                FLOOR_PHASE, {}).get('bytes', 0)
+            got = led['by_phase'].get(FLOOR_PHASE, {}).get('bytes', 0)
+            assert got == base_floor, (
+                f'{spec}: grad/other floor {got} B != {unsharded} '
+                f'floor {base_floor} B — decomp_shard touched the '
+                'gradient path')
+        print('COMM_COUNT_ASSERT: floor + compression + decomp-shard '
+              'gates passed')
 
 
 if __name__ == '__main__':

@@ -928,3 +928,146 @@ def test_arbiter_adopted_base_survives_relaunch_composition():
     assert pre1.kfac_update_freq == 32
     arb1.propose('straggler', stretch=1)
     assert pre1.kfac_update_freq == 16
+
+
+# ---------------------------------------------------------------------------
+# the decomp_impl ladder (the inverse-free lane of ROADMAP item 5)
+# ---------------------------------------------------------------------------
+
+class _DecompPrecond(_FakePrecond):
+    """Fake preconditioner carrying the decomp_impl knob surface."""
+
+    def __init__(self, method='cholesky', decomp_impl='xla', **kw):
+        super().__init__(**kw)
+        self.method = method
+        self.decomp_impl = decomp_impl
+
+
+def test_decomp_impls_restated_tuple_matches_preconditioner():
+    # autotune must stay stdlib-importable, so it restates the canon
+    from kfac_pytorch_tpu import preconditioner
+    assert autotune.DECOMP_IMPLS == preconditioner.DECOMP_IMPLS
+
+
+def test_controller_decomp_impl_commits_planted_optimum():
+    """NS-ladder commit under a planted optimum: the newton_schulz rung
+    is genuinely faster, the controller probes it, commits, and goes
+    steady on it — the decomp_impl analog of the freq planted-optimum
+    tests."""
+    pre = _DecompPrecond(method='cholesky', decomp_impl='xla', kfac=4)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('decomp_impl',))
+
+    def model(F, i):
+        # cholesky refresh costs 0.4; the NS rung replaces it with 0.1
+        decomp = 0.4 if pre.decomp_impl == 'xla' else 0.1
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + decomp
+        return ('pred',), 0.01
+
+    _feed(ctl, pre, model, 200)
+    assert pre.decomp_impl == 'newton_schulz'
+    assert ctl.state == 'steady'
+    assert ctl.commits == 1
+    assert ctl.vetoes == 0                    # zero spurious vetoes
+    kinds = [d['kind'] for d in ctl.decisions]
+    assert 'commit' in kinds
+
+
+def test_controller_decomp_impl_reverts_when_slower():
+    """The revert side of the ladder: an iterative rung that does NOT
+    beat the cold kernel reverts and cools down — the knob never
+    flaps."""
+    pre = _DecompPrecond(method='eigh', decomp_impl='xla', kfac=4)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=3, steady_every=0,
+                                  tune=('decomp_impl',))
+
+    def model(F, i):
+        # subspace is SLOWER here (the CPU-like regime)
+        decomp = 0.2 if pre.decomp_impl == 'xla' else 0.35
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + decomp
+        return ('pred',), 0.01
+
+    _feed(ctl, pre, model, 200)
+    assert pre.decomp_impl == 'xla'           # reverted, stays cold
+    assert ctl.state == 'steady'
+    assert ctl.commits == 0
+    assert ctl.reverts >= 1
+
+
+def test_quality_gate_vetoes_accuracy_regressing_rung():
+    """The numerical-health gate: a rung that IS faster but raises the
+    badness counter during its probe window never commits (counted as
+    a veto, decision log says 'quality'), and the controller settles
+    steady on the original knob."""
+    pre = _DecompPrecond(method='cholesky', decomp_impl='xla', kfac=4)
+    events = {'n': 0}
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('decomp_impl',),
+                                  quality_gate=lambda: events['n'])
+
+    def model(F, i):
+        if pre.decomp_impl == 'newton_schulz':
+            events['n'] += 1                  # health events every step
+            decomp = 0.05                     # ...but much faster
+        else:
+            decomp = 0.4
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + decomp
+        return ('pred',), 0.01
+
+    _feed(ctl, pre, model, 300)
+    assert pre.decomp_impl == 'xla'           # the fast-but-wrong rung
+    assert ctl.commits == 0                   # never committed
+    assert ctl.quality_vetoes >= 1
+    assert ctl.state == 'steady'
+    vetoes = [d for d in ctl.decisions if d['kind'] == 'veto']
+    assert vetoes and vetoes[0].get('reason') == 'quality'
+    assert ctl.report()['quality_vetoes'] == ctl.quality_vetoes
+
+
+def test_arbiter_decomp_impl_is_trace_affecting():
+    """A decomp_impl change fires the variant-cache invalidators (the
+    kernel is baked into the traced programs) and direct external
+    writes are adopted as the new base, like comm_precision."""
+    pre = _DecompPrecond(method='eigh', decomp_impl='xla')
+    arb = autotune.arbiter_for(pre)
+    cleared = []
+    arb.add_invalidator(lambda: cleared.append(1))
+    arb.propose('tuner', decomp_impl='subspace')
+    assert pre.decomp_impl == 'subspace'
+    assert cleared == [1]
+    with pytest.raises(ValueError, match='decomp_impl'):
+        arb.propose('tuner', decomp_impl='bogus')
+    # external write adopted as base, tuner override dropped
+    pre.decomp_impl = 'xla'
+    arb.adopt_external()
+    assert arb.base['decomp_impl'] == 'xla'
+    assert 'decomp_impl' not in arb.tuner
+
+
+def test_decomp_impl_seeded_from_perfmodel_prior():
+    """On the modeled chip the fenced eigh constants say the iterative
+    rung wins by orders of magnitude: the controller seeds
+    decomp_impl from the perfmodel prior before any measurement."""
+    from kfac_pytorch_tpu import perfmodel
+    block = perfmodel.predict_block()
+    pre = _DecompPrecond(method='eigh', decomp_impl='xla', kfac=4)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  tune=('decomp_impl',),
+                                  predicted=block)
+    ctl.record(('pred',), 0.01)               # first record triggers seed
+    assert pre.decomp_impl == 'subspace'
+    seeds = [d for d in ctl.decisions if d['kind'] == 'seed']
+    assert seeds and seeds[0]['knob'] == 'decomp_impl'
+    # the priors themselves: iterative rungs orders under the fenced
+    # QDWH seconds on the modeled chip
+    priors = perfmodel.decomp_impl_priors(block, 'eigh')
+    assert priors['subspace'] < 0.1 * priors['xla']

@@ -63,7 +63,8 @@ def test_bench_json_contract_and_partial_checkpoint(tmp_path):
                 'eigen_dp_iter_s_freq10_warm_subspace',
                 'kfac_overhead_vs_sgd_freq1', 'kfac_overhead_vs_sgd_freq10',
                 'model_flops_per_iter', 'mfu_inverse_dp_freq1',
-                'peak_flops', 'phase_breakdown_s', 'eigh_impl'):
+                'peak_flops', 'phase_breakdown_s', 'eigh_impl',
+                'autotune', 'decomp'):
         assert key in extra, key
     # the analytic perf model's predictions ride along, clearly labeled
     # (VERDICT r4 #1: a tunnel-down round must still carry falsifiable

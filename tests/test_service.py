@@ -84,6 +84,32 @@ def test_spec_rejects_everything_at_once():
         assert frag in text, (frag, text)
 
 
+def test_spec_kfac_knob_table():
+    """kfac_*-prefixed knobs validate against the shared knob table
+    (spec.KFAC_KNOBS): the decomposition-wall knobs are requestable,
+    a typo fails at submit time, and the table stays in lockstep with
+    the trainers' --kfac-* surface."""
+    spec = validate_spec(_spec(knobs={'kfac_decomp_impl': 'newton_schulz',
+                                      'kfac_decomp_shard': True}))
+    argv = spec.trainer_argv()
+    assert '--kfac-decomp-impl' in argv and 'newton_schulz' in argv
+    assert '--kfac-decomp-shard' in argv
+    with pytest.raises(SpecError, match='kfac_decomp_imp'):
+        validate_spec(_spec(knobs={'kfac_decomp_imp': 'xla'}))  # typo
+    # the table covers every --kfac-* flag the trainers expose (the
+    # lockstep pin: adding a trainer flag without tabling it breaks
+    # here, not in a tenant's 3am submit)
+    import re as _re
+    from kfac_pytorch_tpu.service.spec import KFAC_KNOBS, TRAINERS
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    flags = set()
+    for rel in TRAINERS.values():
+        src = open(os.path.join(repo, rel)).read()
+        flags |= {m[2:].replace('-', '_') for m in _re.findall(
+            r"add_argument\('(--kfac-[a-z-]+)'", src)}
+    assert flags <= KFAC_KNOBS, flags - KFAC_KNOBS
+
+
 def test_spec_env_allows_only_kfac_jax():
     spec = validate_spec(_spec(env={'KFAC_COMM_PRECISION': 'bf16',
                                     'JAX_PLATFORMS': 'cpu'}))
