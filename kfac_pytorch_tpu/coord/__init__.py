@@ -1,0 +1,106 @@
+"""Pluggable coordination backends for the fleet protocols.
+
+Every fleet-level protocol in this repo — the shrink/grow membership
+barriers, quorum + lineage fencing (``resilience.elastic``), heartbeat
+leases (``resilience.heartbeat``), the durable job queue and the
+``kfac-serve`` capacity pool (``service/``) — used to bottom out on one
+shared POSIX lease directory of atomic-rename JSON files. This package
+names the primitives those protocols actually need
+(:class:`~.base.CoordBackend`: get / put / versioned CAS / delete /
+prefix list / TTL lease / watch) and ships two implementations:
+
+- :class:`~.posix.PosixDirBackend` — the default; byte-compatible with
+  the existing protocol files, so every drill, incident grammar and
+  ``kfac-obs`` timeline works unchanged.
+- :class:`~.tcpkv.TcpKvBackend` — a single-process etcd-style KV server
+  (``kfac-coord-serve``) with versioned CAS and server-enforced TTL
+  leases; no shared filesystem anywhere in the coordination plane.
+
+Plus the two wrappers that make the plane *testable* and *survivable*:
+:class:`~.chaos.ChaosBackend` (seeded ``KFAC_FAULT_COORD_*`` fault
+injection — the ``chaos_net`` idiom one layer down) and
+:class:`~.base.RetryingBackend` (bounded per-op backoff + jitter with a
+loud give-up). Selection is one env pair::
+
+    KFAC_COORD_BACKEND=posix          # default: the shared lease dir
+    KFAC_COORD_BACKEND=tcp KFAC_COORD_ADDR=host:8479
+
+:func:`backend_from_env` builds the full stack (base backend → chaos
+wrapper when armed → retry wrapper) for a given *root* (a lease-dir or
+service-dir path — on the KV server it becomes the key namespace, so
+disjoint directories stay disjoint stores).
+"""
+
+import os
+
+from kfac_pytorch_tpu.coord.base import (
+    ANY, CoordBackend, CoordError, CoordGiveUp, CoordTimeout, Lease,
+    RetryingBackend, Versioned, Watch, default_retry_policy)
+from kfac_pytorch_tpu.coord.chaos import (
+    COORD_ENVS, ChaosBackend, CoordFaultConfig)
+from kfac_pytorch_tpu.coord.chaos import from_env as chaos_from_env
+from kfac_pytorch_tpu.coord.chaos import maybe_wrap as maybe_wrap_chaos
+from kfac_pytorch_tpu.coord.posix import PosixDirBackend
+from kfac_pytorch_tpu.coord.tcpkv import (
+    DEFAULT_PORT, TcpKvBackend, TcpKvServer)
+
+#: backend selection env contract (exported by launchers / the service
+#: scheduler to every supervisor and trainer of a run)
+ENV_BACKEND = 'KFAC_COORD_BACKEND'
+ENV_ADDR = 'KFAC_COORD_ADDR'
+
+#: "the coordination plane is gone": exit code of a supervisor or
+#: scheduler whose backend ops exhausted their retry budget
+#: (:class:`CoordGiveUp`). Distinct from the trainer-protocol codes
+#: (113/114/115) and the membership verdicts (116/117): the operator's
+#: reaction is to check the coordination backend (is the KV server up?
+#: is the lease filesystem mounted?), not the pod.
+RC_COORD_LOST = 118
+
+
+def backend_from_env(root, *, retry=True, policy=None, chaos=True,
+                     env=None, clock=None, rng=None):
+    """Build the coordination stack for ``root``.
+
+    ``root`` is the protocol namespace — the lease-dir path for a pod,
+    the service-dir path for the scheduler. ``posix`` (default) maps it
+    onto that directory; ``tcp`` namespaces keys under it on the server
+    at ``KFAC_COORD_ADDR``. ``retry=False`` skips the retry wrapper
+    (heartbeat transports want raw misses, not backoff stalls inside
+    the liveness path); ``chaos=False`` skips fault injection (reserved
+    for backends that must stay truthful, e.g. forensics writers).
+    """
+    e = os.environ if env is None else env
+    kind = (e.get(ENV_BACKEND) or 'posix').strip().lower()
+    if kind in ('posix', 'file', ''):
+        backend = PosixDirBackend(root)
+    elif kind == 'tcp':
+        addr = (e.get(ENV_ADDR) or '').strip()
+        if not addr:
+            raise ValueError(
+                f'{ENV_BACKEND}=tcp needs {ENV_ADDR} ("host:port" of a '
+                'kfac-coord-serve KV server)')
+        backend = TcpKvBackend(addr, namespace=str(root))
+    else:
+        raise ValueError(f'{ENV_BACKEND} must be "posix" or "tcp", '
+                         f'got {kind!r}')
+    if chaos:
+        backend = maybe_wrap_chaos(backend)
+    if retry:
+        backend = RetryingBackend(backend, policy=policy, clock=clock,
+                                  rng=rng)
+    return backend
+
+
+#: short alias, mirroring ``chaos_net.from_env`` / ``faults.from_env``
+from_env = backend_from_env
+
+__all__ = [
+    'ANY', 'CoordBackend', 'CoordError', 'CoordGiveUp', 'CoordTimeout',
+    'Lease', 'Versioned', 'Watch', 'RetryingBackend',
+    'default_retry_policy', 'PosixDirBackend', 'TcpKvBackend',
+    'TcpKvServer', 'DEFAULT_PORT', 'ChaosBackend', 'CoordFaultConfig',
+    'COORD_ENVS', 'chaos_from_env', 'maybe_wrap_chaos',
+    'ENV_BACKEND', 'ENV_ADDR', 'RC_COORD_LOST', 'backend_from_env',
+    'from_env',
+]

@@ -1,0 +1,366 @@
+"""The coordination-backend contract: the six primitives every fleet
+protocol in this repo already implicitly uses.
+
+The membership barriers (``resilience.elastic``), heartbeat leases
+(``resilience.heartbeat``), lineage fencing, the durable job queue
+(``service.queue``) and the capacity pool (``service.scheduler``) all
+speak one implicit protocol: small JSON documents under hierarchical
+keys, written atomically, read torn-tolerantly, compare-and-swapped via
+an embedded epoch, scanned by prefix, and — for liveness — republished
+on a cadence. :class:`CoordBackend` names those primitives explicitly:
+
+- ``get(key) -> Versioned | None`` — torn/missing reads are ``None``
+  (the skip-and-retry discipline every protocol reader follows).
+- ``put(key, value)`` — atomic unconditional write.
+- ``put_cas(key, value, expect_version)`` — versioned compare-and-swap;
+  ``None`` expect means *create only if absent*, :data:`ANY` skips the
+  check. Returns the new version, or ``None`` on a conflict — a
+  conflict is an ANSWER (someone else moved the state), never an error.
+- ``delete(key)`` / ``delete_prefix(prefix)`` — idempotent removal.
+- ``list(prefix)`` / ``get_many(prefix)`` — prefix scans.
+- ``lease(key, ttl, payload)`` — a liveness key the backend may expire
+  when its owner stops refreshing (advisory on POSIX, enforced by the
+  TCP KV server).
+- ``watch(prefix)`` — poll-based change feed (puts/deletes since the
+  previous poll) for consumers that would otherwise re-read whole
+  trees.
+
+Error model: every transient backend failure raises
+:class:`CoordTimeout` (an :class:`OSError` subclass, so the existing
+``except OSError`` miss-one-beat / skip-one-poll semantics in the
+protocol layers degrade exactly as they do for a flaky shared
+filesystem). :class:`RetryingBackend` wraps any backend with a
+per-operation :class:`~kfac_pytorch_tpu.resilience.retry.RetryPolicy`
+and raises :class:`CoordGiveUp` — loudly, with the machine-greppable
+``[resilience: coord_gave_up=1]`` form — once the budget is spent, so
+callers exit with the dedicated give-up rc instead of wedging.
+
+Zero dependencies, jax-free (the heartbeat layer imports this).
+"""
+
+import contextlib
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def _res():
+    # lazy: coord is imported BY the resilience package's submodules
+    # (heartbeat, elastic) — a module-level import back into it would
+    # make the import order matter; a call-time one cannot
+    from kfac_pytorch_tpu import resilience
+    return resilience
+
+
+class CoordError(OSError):
+    """Base class for coordination-backend failures. An ``OSError`` on
+    purpose: the protocol layers' existing flaky-filesystem handling
+    (miss one beat, skip one poll, retry next cycle) applies verbatim.
+    """
+
+
+class CoordTimeout(CoordError):
+    """A transient backend failure (unreachable server, op timeout,
+    injected unavailability window). Retryable."""
+
+
+class CoordGiveUp(CoordError):
+    """The retry budget for one operation is spent. Raised by
+    :class:`RetryingBackend` after logging the loud give-up form;
+    supervisors/schedulers exit :data:`~kfac_pytorch_tpu.coord.RC_COORD_LOST`
+    on it instead of spinning against a dead coordination plane."""
+
+
+class _Any:
+    def __repr__(self):
+        return '<coord.ANY>'
+
+
+#: ``put_cas`` sentinel: skip the version check (unconditional write
+#: through the CAS path — distinct from ``expect_version=None``, which
+#: means "create only if the key does not exist yet").
+ANY = _Any()
+
+
+class Versioned:
+    """A read result: the decoded JSON value plus the backend's opaque
+    version token for it (feed it back to ``put_cas``)."""
+
+    __slots__ = ('value', 'version')
+
+    def __init__(self, value, version):
+        self.value = value
+        self.version = version
+
+    def __iter__(self):  # tuple-unpack convenience: value, version = r
+        yield self.value
+        yield self.version
+
+    def __repr__(self):
+        return f'Versioned({self.value!r}, version={self.version!r})'
+
+
+class Lease:
+    """A liveness key: ``refresh`` republishes (restarting the TTL on
+    backends that enforce one), ``release`` deletes. The POSIX backend
+    cannot expire leases server-side — readers there judge liveness by
+    sequence ADVANCE, which is the heartbeat monitor's contract anyway.
+    """
+
+    def __init__(self, backend, key, ttl):
+        self.backend = backend
+        self.key = key
+        self.ttl = float(ttl)
+
+    def refresh(self, payload):
+        return self.backend.put(self.key, payload, ttl=self.ttl)
+
+    def release(self):
+        with contextlib.suppress(OSError):
+            self.backend.delete(self.key)
+
+
+class Watch:
+    """Poll-based change feed over a key prefix.
+
+    ``poll()`` returns ``{key: 'put' | 'delete'}`` for everything that
+    changed since the previous poll (first poll: every existing key as
+    ``'put'``). Built on version snapshots, so it works on any backend
+    that implements ``list`` + ``get`` — no server-side subscription
+    needed, and a missed poll coalesces instead of queueing.
+    """
+
+    def __init__(self, backend, prefix):
+        self.backend = backend
+        self.prefix = str(prefix)
+        self._versions = None
+
+    def _snapshot(self):
+        out = {}
+        for key in self.backend.list(self.prefix):
+            got = self.backend.get(key)
+            if got is not None:
+                out[key] = got.version
+        return out
+
+    def poll(self):
+        now = self._snapshot()
+        prev = self._versions if self._versions is not None else {}
+        self._versions = now
+        changes = {}
+        for key, ver in now.items():
+            if prev.get(key) != ver:
+                changes[key] = 'put'
+        for key in prev:
+            if key not in now:
+                changes[key] = 'delete'
+        return changes
+
+
+def check_key(key):
+    """Keys are relative ``/``-joined paths; reject escapes so a POSIX
+    backend can never be walked out of its root."""
+    key = str(key)
+    if not key or key.startswith('/') or '\\' in key:
+        raise ValueError(f'bad coordination key {key!r}')
+    if any(part in ('', '.', '..') for part in key.split('/')):
+        raise ValueError(f'bad coordination key {key!r}')
+    return key
+
+
+def check_prefix(prefix):
+    """Prefixes share the key grammar ('' = everything, one trailing
+    ``/`` allowed) — and the same escape rejection: a ``..`` prefix
+    reaching ``delete_prefix`` must never walk a POSIX backend out of
+    its root."""
+    prefix = str(prefix)
+    if not prefix:
+        return prefix
+    if prefix.startswith('/') or '\\' in prefix:
+        raise ValueError(f'bad coordination prefix {prefix!r}')
+    parts = prefix.split('/')
+    if parts and parts[-1] == '':
+        parts = parts[:-1]
+    if any(part in ('', '.', '..') for part in parts):
+        raise ValueError(f'bad coordination prefix {prefix!r}')
+    return prefix
+
+
+class CoordBackend:
+    """Interface + shared conveniences. Subclasses implement ``get``,
+    ``put``, ``put_cas``, ``delete``, ``delete_prefix`` and ``list``."""
+
+    # -- required primitives ----------------------------------------------
+
+    def get(self, key):
+        raise NotImplementedError
+
+    def put(self, key, value, *, indent=None, ttl=None):
+        raise NotImplementedError
+
+    def put_cas(self, key, value, expect_version, *, indent=None,
+                ttl=None, token=None):
+        """``token``: optional idempotency token for replay-safe CAS
+        over a lossy wire — a backend that can remember the last
+        applied writer (the KV server) answers a REPLAY of the same
+        token with the original success instead of a self-conflict.
+        Local backends may ignore it (their CAS cannot time out
+        mid-apply)."""
+        raise NotImplementedError
+
+    def delete(self, key):
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix):
+        raise NotImplementedError
+
+    def list(self, prefix=''):
+        raise NotImplementedError
+
+    # -- derived ----------------------------------------------------------
+
+    def get_many(self, prefix=''):
+        """{key: value} for every readable key under ``prefix`` (torn
+        keys skipped this scan, the protocol-reader discipline)."""
+        out = {}
+        for key in self.list(prefix):
+            got = self.get(key)
+            if got is not None:
+                out[key] = got.value
+        return out
+
+    def lease(self, key, ttl, payload):
+        lease = Lease(self, key, ttl)
+        lease.refresh(payload)
+        return lease
+
+    def watch(self, prefix=''):
+        return Watch(self, prefix)
+
+    def ensure_prefix(self, prefix):
+        """Scaffold a key prefix where that means something (a POSIX
+        directory an operator will ``ls``); a no-op on KV backends."""
+
+    def close(self):
+        pass
+
+
+def default_retry_policy():
+    """Default per-op policy: small, bounded, jittered — a coordination
+    op sits inside supervisor poll loops, so the whole budget must stay
+    in the seconds range (give up loudly rather than stall a barrier).
+    """
+    from kfac_pytorch_tpu.resilience.retry import RetryPolicy
+    return RetryPolicy(attempts=5, base_delay=0.1, max_delay=2.0,
+                       multiplier=2.0, jitter=0.5,
+                       retry_on=(CoordTimeout,))
+
+
+class RetryingBackend(CoordBackend):
+    """Per-op bounded retry (backoff + jitter) around any backend.
+
+    Every retry bumps the process-global ``coord_retries`` counter and
+    accumulates the slept seconds (``stats()['wait_s']``); exhausting
+    the budget logs the machine-greppable give-up form and raises
+    :class:`CoordGiveUp` so the caller can exit
+    :data:`~kfac_pytorch_tpu.coord.RC_COORD_LOST` instead of wedging.
+    CAS conflicts are answers, not failures — they never retry.
+    """
+
+    def __init__(self, inner, *, policy=None, clock=None, rng=None,
+                 log=None):
+        import random
+
+        from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK
+        self.inner = inner
+        self.policy = policy or default_retry_policy()
+        self.clock = clock or REAL_CLOCK
+        self.rng = rng or random
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self._lock = threading.Lock()
+        self._retries = 0
+        self._gave_up = 0
+        self._wait_s = 0.0
+
+    def stats(self):
+        with self._lock:
+            return {'retries': self._retries, 'gave_up': self._gave_up,
+                    'wait_s': self._wait_s}
+
+    def _call(self, op, key, fn):
+        last = None
+        for attempt in range(self.policy.attempts):
+            try:
+                return fn()
+            except self.policy.retry_on as e:
+                last = e
+                if attempt == self.policy.attempts - 1:
+                    break
+                delay = self.policy.delay(attempt, self.rng)
+                with self._lock:
+                    self._retries += 1
+                    self._wait_s += delay
+                _res().counters.bump('coord_retries')
+                self.log.warning(
+                    'coord: retry %d/%d op=%s key=%s in %.2fs after: %s',
+                    attempt + 1, self.policy.attempts - 1, op, key,
+                    delay, e)
+                self.clock.sleep(delay)
+        with self._lock:
+            self._gave_up += 1
+        _res().counters.bump('coord_gave_ups')
+        self.log.error(
+            'coord: giving up op=%s key=%s after %d attempts (%s) '
+            '[resilience: coord_gave_up=1]', op, key,
+            self.policy.attempts, last)
+        raise CoordGiveUp(
+            f'coordination backend op {op} on {key!r} failed '
+            f'{self.policy.attempts} times: {last}') from last
+
+    # -- delegated ops ----------------------------------------------------
+
+    def get(self, key):
+        return self._call('get', key, lambda: self.inner.get(key))
+
+    def put(self, key, value, *, indent=None, ttl=None):
+        return self._call('put', key, lambda: self.inner.put(
+            key, value, indent=indent, ttl=ttl))
+
+    def put_cas(self, key, value, expect_version, *, indent=None,
+                ttl=None, token=None):
+        # ONE idempotency token per logical CAS, shared by every retry
+        # attempt: a timeout after the server applied the write must
+        # read as success on the replay, never as a self-conflict that
+        # makes the caller believe someone else moved the state
+        if token is None:
+            import os as _os
+            token = _os.urandom(8).hex()
+        return self._call('put_cas', key, lambda: self.inner.put_cas(
+            key, value, expect_version, indent=indent, ttl=ttl,
+            token=token))
+
+    def delete(self, key):
+        return self._call('delete', key, lambda: self.inner.delete(key))
+
+    def delete_prefix(self, prefix):
+        return self._call('delete_prefix', prefix,
+                          lambda: self.inner.delete_prefix(prefix))
+
+    def list(self, prefix=''):
+        return self._call('list', prefix, lambda: self.inner.list(prefix))
+
+    def get_many(self, prefix=''):
+        return self._call('get_many', prefix,
+                          lambda: self.inner.get_many(prefix))
+
+    def lease(self, key, ttl, payload):
+        lease = Lease(self, key, ttl)
+        lease.refresh(payload)
+        return lease
+
+    def ensure_prefix(self, prefix):
+        return self.inner.ensure_prefix(prefix)
+
+    def close(self):
+        self.inner.close()

@@ -1,0 +1,329 @@
+"""Deterministic fault injection at the coordination-backend level —
+the ``chaos_net`` idiom applied one layer down.
+
+``chaos_net`` makes the pod's *message* plane misbehave (heartbeat
+deliveries dropped, delayed, partitioned). What it cannot exercise is
+the *coordination* plane itself failing: the lease store timing out,
+returning stale or torn state, spuriously rejecting a CAS, or expiring
+a lease its owner was still refreshing. :class:`ChaosBackend` wraps any
+:class:`~.base.CoordBackend` and injects exactly those, with every
+decision a pure SHA-256 function of ``(seed, op, key, attempt)`` —
+identical env + identical op sequence ⇒ identical fault schedule, which
+is what the determinism tests pin.
+
+Env contract (``KFAC_FAULT_COORD_*``, registered in ``faults.py``'s
+STRICT ``from_env`` so a typo'd drill fails loudly at build time):
+
+  KFAC_FAULT_COORD_SEED      int; presence arms the chaos layer
+  KFAC_FAULT_COORD_FAIL      P(an op raises CoordTimeout)        [0, 1]
+  KFAC_FAULT_COORD_TORN      P(a get returns None — a torn read)
+  KFAC_FAULT_COORD_STALE     P(a get/get_many returns the PREVIOUS
+                             value this process saw for the key)
+  KFAC_FAULT_COORD_CAS       P(a put_cas reports a spurious conflict
+                             WITHOUT applying — the caller must re-read
+                             and re-derive, the CAS contract)
+  KFAC_FAULT_COORD_LEASE_EXPIRE
+                             P(a lease publish is silently dropped —
+                             the premature-expiry drill: the key stops
+                             advancing and readers declare its owner
+                             dead on schedule)
+  KFAC_FAULT_COORD_WINDOWS   unavailability windows "10:40;90:95"
+                             relative to T0 — every op inside a window
+                             raises CoordTimeout (the backend-outage
+                             drill the RetryPolicy must ride out or
+                             give up on loudly)
+  KFAC_FAULT_COORD_T0        wall-clock base of the windows (default:
+                             config load time)
+
+Faults apply at the WRAPPER, so both backends (and any future one) are
+drillable identically; the retry layer sits OUTSIDE the chaos wrapper,
+which is the point — retries are the system under test.
+"""
+
+import collections
+import dataclasses
+import hashlib
+import os
+import time
+from typing import Optional, Tuple
+
+from kfac_pytorch_tpu.coord.base import CoordBackend, CoordTimeout
+
+ENV_COORD_SEED = 'KFAC_FAULT_COORD_SEED'
+ENV_COORD_FAIL = 'KFAC_FAULT_COORD_FAIL'
+ENV_COORD_TORN = 'KFAC_FAULT_COORD_TORN'
+ENV_COORD_STALE = 'KFAC_FAULT_COORD_STALE'
+ENV_COORD_CAS = 'KFAC_FAULT_COORD_CAS'
+ENV_COORD_LEASE = 'KFAC_FAULT_COORD_LEASE_EXPIRE'
+ENV_COORD_WINDOWS = 'KFAC_FAULT_COORD_WINDOWS'
+ENV_COORD_T0 = 'KFAC_FAULT_COORD_T0'
+
+COORD_ENVS = frozenset({
+    ENV_COORD_SEED, ENV_COORD_FAIL, ENV_COORD_TORN, ENV_COORD_STALE,
+    ENV_COORD_CAS, ENV_COORD_LEASE, ENV_COORD_WINDOWS, ENV_COORD_T0,
+})
+
+
+def parse_windows(spec, env=ENV_COORD_WINDOWS):
+    """``"10:40;90:95"`` -> ((10.0, 40.0), (90.0, 95.0))."""
+    out = []
+    for part in str(spec).split(';'):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            lo, hi = part.split(':', 1)
+            start, end = float(lo), float(hi)
+        except ValueError:
+            raise ValueError(f'{env}: malformed window {part!r}; '
+                             'expected "start:end" seconds') from None
+        if end <= start:
+            raise ValueError(f'{env}: window {part!r} ends before it '
+                             'starts')
+        out.append((start, end))
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordFaultConfig:
+    seed: int = 0
+    fail: float = 0.0
+    torn: float = 0.0
+    stale: float = 0.0
+    cas: float = 0.0
+    lease_expire: float = 0.0
+    windows: Tuple[Tuple[float, float], ...] = ()
+    t0: float = 0.0
+
+    @property
+    def any_chaos(self):
+        return bool(self.fail or self.torn or self.stale or self.cas
+                    or self.lease_expire or self.windows)
+
+    def unavailable(self, wall):
+        rel = wall - self.t0
+        return any(lo <= rel < hi for lo, hi in self.windows)
+
+
+def _prob_env(env, e):
+    raw = e.get(env)
+    if not raw:
+        return 0.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f'{env} must be a probability in [0, 1], '
+                         f'got {raw!r}') from None
+    if not 0.0 <= v <= 1.0:
+        raise ValueError(f'{env} must be in [0, 1], got {v}')
+    return v
+
+
+def from_env(env=None):
+    """Snapshot the coordination-fault environment, or None when no
+    ``KFAC_FAULT_COORD_*`` variable is set. STRICT like
+    ``faults.from_env`` (which delegates validation here)."""
+    e = os.environ if env is None else env
+    if not any(k in e for k in COORD_ENVS):
+        return None
+    raw_seed = e.get(ENV_COORD_SEED, '0')
+    try:
+        seed = int(raw_seed)
+    except ValueError:
+        raise ValueError(f'{ENV_COORD_SEED} must be an integer, '
+                         f'got {raw_seed!r}') from None
+    raw_t0 = e.get(ENV_COORD_T0)
+    try:
+        t0 = float(raw_t0) if raw_t0 else time.time()
+    except ValueError:
+        raise ValueError(f'{ENV_COORD_T0} must be a wall timestamp, '
+                         f'got {raw_t0!r}') from None
+    spec = e.get(ENV_COORD_WINDOWS)
+    return CoordFaultConfig(
+        seed=seed,
+        fail=_prob_env(ENV_COORD_FAIL, e),
+        torn=_prob_env(ENV_COORD_TORN, e),
+        stale=_prob_env(ENV_COORD_STALE, e),
+        cas=_prob_env(ENV_COORD_CAS, e),
+        lease_expire=_prob_env(ENV_COORD_LEASE, e),
+        windows=parse_windows(spec) if spec else (),
+        t0=t0)
+
+
+def _u(cfg, op, key, attempt, lane):
+    """One uniform draw in [0, 1): a pure function of
+    ``(seed, op, key, attempt)`` per fault lane — the determinism
+    contract (SHA-256, stable across runs and interpreters)."""
+    digest = hashlib.sha256(
+        f'{cfg.seed}:{op}:{key}:{attempt}'.encode()).digest()
+    i = lane * 8
+    return int.from_bytes(digest[i:i + 8], 'big') / 2 ** 64
+
+
+class ChaosBackend(CoordBackend):
+    """Wrap a backend; inject the seeded fault schedule. ``trace``
+    records every injected fault as ``(kind, op, key, attempt)`` —
+    bounded, like the ChaosTransport delivery trace."""
+
+    def __init__(self, inner, cfg, *, wall=time.time):
+        self.inner = inner
+        self.cfg = cfg
+        self._wall = wall
+        self._attempts = {}          # (op, key) -> count
+        self._last_seen = {}         # key -> previous Versioned (stale)
+        self._last_vals = {}         # key -> previous value (get_many)
+        self.trace = collections.deque(maxlen=65536)
+        self.counts = collections.Counter()
+
+    def __repr__(self):
+        return f'ChaosBackend({self.inner!r})'
+
+    def _attempt(self, op, key):
+        if len(self._attempts) > 65536:
+            # bounded backstop (delete-op counters survive eviction):
+            # keep the most recent half, insertion-ordered
+            self._attempts = dict(
+                list(self._attempts.items())[-32768:])
+        k = (op, str(key))
+        self._attempts[k] = n = self._attempts.get(k, 0) + 1
+        return n
+
+    def _inject(self, kind, op, key, attempt):
+        self.counts[kind] += 1
+        self.trace.append((kind, op, str(key), attempt))
+
+    def _gate(self, op, key):
+        """The fail/window lane shared by every op; returns the attempt
+        index for the op-specific lanes."""
+        attempt = self._attempt(op, key)
+        if self.cfg.windows and self.cfg.unavailable(self._wall()):
+            self._inject('window', op, key, attempt)
+            raise CoordTimeout(
+                f'injected coord outage window (op={op} key={key})')
+        if self.cfg.fail and _u(self.cfg, op, key, attempt, 0) \
+                < self.cfg.fail:
+            self._inject('fail', op, key, attempt)
+            raise CoordTimeout(
+                f'injected coord op failure (op={op} key={key} '
+                f'attempt={attempt})')
+        return attempt
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, key):
+        attempt = self._gate('get', key)
+        if self.cfg.torn and _u(self.cfg, 'get', key, attempt, 1) \
+                < self.cfg.torn:
+            self._inject('torn', 'get', key, attempt)
+            return None
+        got = self.inner.get(key)
+        if got is not None:
+            prev = self._last_seen.get(key)
+            if (prev is not None and prev.version != got.version
+                    and self.cfg.stale
+                    and _u(self.cfg, 'get', key, attempt, 2)
+                    < self.cfg.stale):
+                self._inject('stale', 'get', key, attempt)
+                return prev
+            self._last_seen[key] = got
+        return got
+
+    def list(self, prefix=''):
+        self._gate('list', prefix)
+        return self.inner.list(prefix)
+
+    def get_many(self, prefix=''):
+        # ONE inner round trip (a per-key fan-out would multiply wire
+        # ops N+1-fold on the KV backend), torn/stale lanes applied per
+        # key on the result — same coverage, same determinism contract
+        self._gate('get_many', prefix)
+        raw = self.inner.get_many(prefix)
+        out = {}
+        for key in sorted(raw):
+            value = raw[key]
+            attempt = self._attempt('get', key)
+            if self.cfg.torn and _u(self.cfg, 'get', key, attempt, 1) \
+                    < self.cfg.torn:
+                self._inject('torn', 'get', key, attempt)
+                continue
+            prev = self._last_vals.get(key)
+            if (prev is not None and prev != value and self.cfg.stale
+                    and _u(self.cfg, 'get', key, attempt, 2)
+                    < self.cfg.stale):
+                self._inject('stale', 'get', key, attempt)
+                out[key] = prev
+                continue
+            self._last_vals[key] = value
+            out[key] = value
+        return out
+
+    # -- writes ------------------------------------------------------------
+
+    def put(self, key, value, *, indent=None, ttl=None):
+        attempt = self._gate('put', key)
+        if (ttl and self.cfg.lease_expire
+                and _u(self.cfg, 'lease', key, attempt, 3)
+                < self.cfg.lease_expire):
+            # premature lease expiry: the publish silently vanishes —
+            # the key stops advancing exactly as if the server dropped
+            # the lease early, and readers react on their deadline
+            self._inject('lease_expire', 'put', key, attempt)
+            return f'chaos-dropped-{attempt}'
+        return self.inner.put(key, value, indent=indent, ttl=ttl)
+
+    def put_cas(self, key, value, expect_version, *, indent=None,
+                ttl=None, token=None):
+        attempt = self._gate('put_cas', key)
+        if self.cfg.cas and _u(self.cfg, 'put_cas', key, attempt, 1) \
+                < self.cfg.cas:
+            self._inject('cas_conflict', 'put_cas', key, attempt)
+            return None  # reported conflict, nothing applied
+        return self.inner.put_cas(key, value, expect_version,
+                                  indent=indent, ttl=ttl, token=token)
+
+    def delete(self, key):
+        self._gate('delete', key)
+        self._evict(key)
+        return self.inner.delete(key)
+
+    def delete_prefix(self, prefix):
+        self._gate('delete_prefix', prefix)
+        for key in [k for k in self._last_vals
+                    if k.startswith(str(prefix))]:
+            self._evict(key)
+        for key in {k for _op, k in self._attempts
+                    if k.startswith(str(prefix))}:
+            self._evict(key)
+        return self.inner.delete_prefix(prefix)
+
+    def _evict(self, key):
+        """Deleted keys drop their fault-lane state: every spool entry
+        is a fresh unique key, and a long-running chaos-armed service
+        must not grow these maps monotonically (the trace deque is
+        bounded; these would not be). The delete ops' own counters are
+        KEPT — resetting them mid-retry would redraw attempt 1 forever
+        and turn one injected delete failure into a permanent one."""
+        key = str(key)
+        self._last_seen.pop(key, None)
+        self._last_vals.pop(key, None)
+        for pair in [p for p in self._attempts
+                     if p[1] == key
+                     and p[0] not in ('delete', 'delete_prefix')]:
+            del self._attempts[pair]
+
+    def ensure_prefix(self, prefix):
+        return self.inner.ensure_prefix(prefix)
+
+    def close(self):
+        self.inner.close()
+
+
+def maybe_wrap(backend, cfg=None):
+    """Wrap ``backend`` in a :class:`ChaosBackend` when the chaos env
+    is armed (or an explicit ``cfg`` is given); otherwise return it
+    untouched — the one-liner every backend construction site uses."""
+    if cfg is None:
+        cfg = from_env()
+    if cfg is None or not cfg.any_chaos:
+        return backend
+    return ChaosBackend(backend, cfg)

@@ -1,0 +1,195 @@
+"""The default backend: a shared POSIX directory of atomic-rename JSON
+files — exactly the protocol every drill, incident grammar and
+``kfac-obs`` timeline already reads.
+
+Byte compatibility is the contract: ``put(key, value)`` produces the
+same file, with the same bytes, at the same path, as the
+``resilience.atomic_write_json`` call it replaces (``json.dump`` +
+trailing newline, tmp + ``os.replace``), so a pod running half-new
+half-old code during a rolling upgrade still speaks one protocol, and
+every existing test that plants or inspects protocol files directly
+keeps passing unchanged.
+
+Versions are content hashes (sha256 of the file bytes, truncated):
+stat-based tokens alias on filesystems with coarse mtime granularity,
+and an ABA on *identical content* is harmless by construction (the CAS
+would rewrite the same bytes). ``put_cas`` serializes its
+check-then-replace through a per-root advisory ``flock`` (plus an
+in-process lock) — best-effort, the same degrade-gracefully discipline
+``write_world_stamp`` uses on lock-less filesystems.
+"""
+
+import contextlib
+import hashlib
+import json
+import os
+import shutil
+import threading
+
+from kfac_pytorch_tpu.coord.base import (
+    ANY, CoordBackend, CoordTimeout, Versioned, check_key, check_prefix)
+
+#: files the backend itself (or the atomic writer) creates that are
+#: never protocol state
+_SKIP_MARKERS = ('.tmp-', '.coord.lock')
+
+
+def _version(raw):
+    return hashlib.sha256(raw).hexdigest()[:16]
+
+
+class PosixDirBackend(CoordBackend):
+    """Keys map 1:1 onto files under ``root``; ``a/b.json`` is
+    ``<root>/a/b.json``. TTLs are advisory (no server to expire a
+    lease) — liveness readers judge sequence advance, as they always
+    have."""
+
+    def __init__(self, root):
+        # the root is NOT scaffolded here: read-only attaches (e.g.
+        # `kfac-serve status` on a mistyped path) must not create
+        # directories as a side effect — writes create parents lazily
+        self.root = str(root)
+        self._lock = threading.Lock()
+
+    def __repr__(self):
+        return f'PosixDirBackend({self.root!r})'
+
+    def _path(self, key):
+        return os.path.join(self.root, *check_key(key).split('/'))
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, key):
+        try:
+            with open(self._path(key), 'rb') as f:
+                raw = f.read()
+            return Versioned(json.loads(raw.decode()), _version(raw))
+        except (OSError, ValueError):
+            # missing, unreadable, or torn mid-replace: skip this poll
+            return None
+
+    def list(self, prefix=''):
+        prefix = check_prefix(prefix)
+        # walk only the deepest directory the prefix fully names — a
+        # claim scan over shrink-gen7/ must not stat the whole tree
+        base_rel = prefix.rsplit('/', 1)[0] if '/' in prefix else ''
+        start = (os.path.join(self.root, *base_rel.split('/'))
+                 if base_rel else self.root)
+
+        def _walk_error(e):
+            # a MISSING prefix is an empty answer (the barrier dir not
+            # created yet); any other failure (EIO/ESTALE on a network
+            # filesystem) must RAISE — callers like the queue's
+            # origin-dedup distinguish "empty" from "unavailable", and
+            # an error read as [] would let them decide blind
+            if not isinstance(e, FileNotFoundError):
+                raise CoordTimeout(str(e)) from e
+
+        out = []
+        for dirpath, dirnames, filenames in os.walk(
+                start, onerror=_walk_error):
+            rel = os.path.relpath(dirpath, self.root)
+            rel = '' if rel == '.' else rel.replace(os.sep, '/') + '/'
+            # prune subtrees the prefix can never match: a 'done-' scan
+            # must not descend into every shrink-gen*/trainer-gen*
+            # barrier dir on a network filesystem
+            dirnames[:] = [
+                d for d in dirnames
+                if (rel + d + '/').startswith(prefix)
+                or prefix.startswith(rel + d + '/')]
+            for name in filenames:
+                if any(m in name for m in _SKIP_MARKERS):
+                    continue
+                key = rel + name
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    # -- writes ------------------------------------------------------------
+
+    def _write(self, path, value, indent):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        raw = (json.dumps(value, indent=indent) + '\n').encode()
+        tmp = f'{path}.tmp-{os.getpid()}'
+        try:
+            with open(tmp, 'wb') as f:
+                f.write(raw)
+            os.replace(tmp, path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise
+        return _version(raw)
+
+    def put(self, key, value, *, indent=None, ttl=None):
+        del ttl  # advisory on POSIX
+        return self._write(self._path(key), value, indent)
+
+    def ensure_prefix(self, prefix):
+        os.makedirs(os.path.join(
+            self.root, *str(prefix).rstrip('/').split('/')),
+            exist_ok=True)
+
+    @contextlib.contextmanager
+    def _cas_lock(self):
+        """In-process lock + best-effort cross-process flock: the same
+        degrade-gracefully discipline write_world_stamp uses."""
+        with self._lock:
+            fd = None
+            try:
+                try:
+                    import fcntl
+                    fd = os.open(os.path.join(self.root, '.coord.lock'),
+                                 os.O_CREAT | os.O_RDWR)
+                    fcntl.flock(fd, fcntl.LOCK_EX)
+                except (ImportError, OSError):
+                    fd = None
+                yield
+            finally:
+                if fd is not None:
+                    with contextlib.suppress(OSError):
+                        os.close(fd)  # closing releases the flock
+
+    def put_cas(self, key, value, expect_version, *, indent=None,
+                ttl=None, token=None):
+        del ttl, token  # local CAS cannot time out mid-apply
+        path = self._path(key)
+        with self._cas_lock():
+            if expect_version is not ANY:
+                cur = self.get(key)
+                if expect_version is None:
+                    if cur is not None:
+                        return None
+                elif cur is None or cur.version != expect_version:
+                    return None
+            return self._write(path, value, indent)
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+            return True
+        except FileNotFoundError:
+            return False
+        except OSError as e:
+            raise CoordTimeout(str(e)) from e
+
+    def delete_prefix(self, prefix):
+        """Remove every key under ``prefix``; a prefix naming a whole
+        directory (``shrink-gen3/``) removes the directory too — the
+        ``rmtree`` idiom the barrier aborts rely on."""
+        prefix = check_prefix(prefix)
+        if not prefix:
+            raise ValueError('delete_prefix needs a non-empty prefix '
+                             '(refusing to wipe the whole namespace)')
+        n = 0
+        for key in self.list(prefix):
+            if self.delete(key):
+                n += 1
+        # scrub now-empty directories the prefix names (a leftover
+        # empty barrier dir reads as a live barrier to _max_grow_gen)
+        dir_path = os.path.join(self.root,
+                                *str(prefix).rstrip('/').split('/'))
+        if os.path.isdir(dir_path) and os.path.realpath(
+                dir_path) != os.path.realpath(self.root):
+            shutil.rmtree(dir_path, ignore_errors=True)
+        return n

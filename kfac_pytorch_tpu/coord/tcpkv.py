@@ -1,0 +1,325 @@
+"""A non-POSIX coordination backend: a single-process etcd-style KV
+server with versioned CAS and TTL leases, stdlib only.
+
+This is the existence proof that :class:`~.base.CoordBackend` is a real
+abstraction and not a file-system veneer: the pod protocols (shrink /
+grow barriers, lineage fencing, heartbeat leases, the job queue's epoch
+CAS) run unchanged against a store with none of POSIX's rename-atomic
+semantics — what they need is exactly the six primitives, provided here
+by one tiny server any pod host can reach over the same address plane
+``hosts.json`` already names.
+
+Wire protocol: one JSON request line per connection, one JSON response
+(the connection-per-op shape :class:`~..resilience.heartbeat.
+TcpHeartbeatTransport` already uses — a dead server presents as refused
+connections, which the retry layer converts into bounded backoff and a
+loud give-up, never a wedge). Versions are a per-store monotonic
+revision counter; a lease is a key with an ``expires`` wall deadline the
+server enforces lazily on every read and in a periodic sweep.
+
+Run it standalone (``kfac-coord-serve --port 8479``) or in-process
+(:class:`TcpKvServer` — the drills do). Select it per process with::
+
+    KFAC_COORD_BACKEND=tcp KFAC_COORD_ADDR=host:8479
+
+Every backend *root* (lease dir path, service dir path) becomes a key
+namespace on the server, so co-hosted pods and tenants stay disjoint
+exactly as their directories did.
+"""
+
+import argparse
+import contextlib
+import json
+import logging
+import socket
+import sys
+import threading
+import time
+
+from kfac_pytorch_tpu.coord.base import (
+    ANY, CoordBackend, CoordTimeout, Versioned, check_key, check_prefix)
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PORT = 8479
+
+#: sentinel the client sends for :data:`~.base.ANY` (JSON has no
+#: object identity)
+_ANY_WIRE = '__any__'
+
+
+class TcpKvServer:
+    """The store + listener. Thread-safe; ops are O(small-dict)."""
+
+    def __init__(self, host='0.0.0.0', port=DEFAULT_PORT, *,
+                 wall=time.time, sweep_interval=1.0):
+        self._wall = wall
+        self._lock = threading.Lock()
+        # key -> [value, version, expires|None, last_writer_token|None]
+        self._store = {}
+        self._rev = 0
+        self._stopped = False
+        self._sweep_interval = float(sweep_interval)
+        self._last_sweep = 0.0
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, int(port)))
+        self._srv.settimeout(0.25)
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]  # resolves port=0
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name='kfac-coord-kv')
+        self._thread.start()
+
+    # -- store ops (also usable in-process, the unit tests do) ------------
+
+    def _expired(self, entry, now):
+        return entry[2] is not None and now >= entry[2]
+
+    def _sweep(self, now):
+        if now - self._last_sweep < self._sweep_interval:
+            return
+        self._last_sweep = now
+        for key in [k for k, e in self._store.items()
+                    if self._expired(e, now)]:
+            del self._store[key]
+
+    def op(self, req):
+        """One request dict -> one response dict."""
+        kind = req.get('op')
+        key = req.get('key', '')
+        now = self._wall()
+        with self._lock:
+            self._sweep(now)
+            if kind == 'get':
+                e = self._store.get(key)
+                if e is None or self._expired(e, now):
+                    return {'ok': True, 'found': False}
+                return {'ok': True, 'found': True, 'value': e[0],
+                        'version': e[1]}
+            if kind in ('put', 'cas'):
+                expect = req.get('expect', _ANY_WIRE)
+                token = req.get('token')
+                e = self._store.get(key)
+                if e is not None and self._expired(e, now):
+                    e = None
+                if kind == 'cas' and expect != _ANY_WIRE:
+                    if token is not None and e is not None \
+                            and e[3] == token:
+                        # idempotent REPLAY: this caller's own CAS
+                        # already applied (the response was lost on the
+                        # wire) — answer the original success, never a
+                        # self-conflict
+                        return {'ok': True, 'version': e[1]}
+                    if expect is None:
+                        if e is not None:
+                            return {'ok': True, 'conflict': True}
+                    elif e is None or e[1] != expect:
+                        return {'ok': True, 'conflict': True}
+                self._rev += 1
+                ttl = req.get('ttl')
+                expires = now + float(ttl) if ttl else None
+                self._store[key] = [req.get('value'), self._rev,
+                                    expires, token]
+                return {'ok': True, 'version': self._rev}
+            if kind == 'delete':
+                e = self._store.pop(key, None)
+                return {'ok': True,
+                        'found': e is not None
+                        and not self._expired(e, now)}
+            if kind == 'delete_prefix':
+                hit = [k for k in self._store if k.startswith(key)]
+                for k in hit:
+                    del self._store[k]
+                return {'ok': True, 'count': len(hit)}
+            if kind == 'list':
+                keys = sorted(k for k, e in self._store.items()
+                              if k.startswith(key)
+                              and not self._expired(e, now))
+                return {'ok': True, 'keys': keys}
+            if kind == 'get_many':
+                out = {k: e[0] for k, e in self._store.items()
+                       if k.startswith(key)
+                       and not self._expired(e, now)}
+                return {'ok': True, 'values': out}
+            if kind == 'ping':
+                return {'ok': True, 'rev': self._rev,
+                        'keys': len(self._store)}
+        return {'ok': False, 'error': f'unknown op {kind!r}'}
+
+    # -- listener ----------------------------------------------------------
+
+    def _serve(self):
+        while not self._stopped:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # one thread per connection: a client that connects and
+            # then stalls (a SIGKILLed host mid-request — the standing
+            # drill) must not head-of-line-block every other host's
+            # heartbeat publishes and barrier claims behind its recv
+            # timeout
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        with contextlib.suppress(OSError, ValueError), conn:
+            conn.settimeout(2.0)
+            raw = b''
+            while not raw.endswith(b'\n'):
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                raw += chunk
+            if not raw.strip():
+                return
+            try:
+                resp = self.op(json.loads(raw.decode()))
+            except Exception as e:  # noqa: BLE001 — server must live
+                resp = {'ok': False, 'error': str(e)}
+            conn.sendall(json.dumps(resp).encode() + b'\n')
+
+    def close(self):
+        self._stopped = True
+        with contextlib.suppress(OSError):
+            self._srv.close()
+        self._thread.join(timeout=2)
+
+
+class TcpKvBackend(CoordBackend):
+    """Connection-per-op client. ``namespace`` (the backend root — a
+    lease-dir or service-dir path) prefixes every key on the server."""
+
+    def __init__(self, addr, namespace, *, timeout=2.0):
+        if isinstance(addr, str):
+            host, port = addr.rsplit(':', 1)
+            addr = (host, int(port))
+        self.addr = (str(addr[0]), int(addr[1]))
+        self.namespace = str(namespace).strip('/')
+        if not self.namespace:
+            # an empty namespace would make delete_prefix('') a
+            # server-GLOBAL wipe across every pod/tenant on the store
+            raise ValueError('TcpKvBackend needs a non-empty namespace '
+                             '(the backend root — a lease/service dir '
+                             'path)')
+        self.timeout = float(timeout)
+
+    def __repr__(self):
+        return (f'TcpKvBackend({self.addr[0]}:{self.addr[1]}, '
+                f'ns={self.namespace!r})')
+
+    def _full(self, key):
+        key = check_key(key)
+        return f'{self.namespace}/{key}' if self.namespace else key
+
+    def _request(self, req):
+        try:
+            with socket.create_connection(self.addr,
+                                          timeout=self.timeout) as s:
+                s.settimeout(self.timeout)
+                s.sendall(json.dumps(req).encode() + b'\n')
+                raw = b''
+                while not raw.endswith(b'\n'):
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    raw += chunk
+            resp = json.loads(raw.decode())
+        except (OSError, ValueError) as e:
+            raise CoordTimeout(
+                f'coord kv {self.addr[0]}:{self.addr[1]} unreachable '
+                f'({e})') from e
+        if not resp.get('ok'):
+            raise CoordTimeout(f'coord kv error: {resp.get("error")}')
+        return resp
+
+    # -- primitives --------------------------------------------------------
+
+    def get(self, key):
+        resp = self._request({'op': 'get', 'key': self._full(key)})
+        if not resp.get('found'):
+            return None
+        return Versioned(resp.get('value'), resp.get('version'))
+
+    def put(self, key, value, *, indent=None, ttl=None):
+        del indent  # a wire format, not a file format
+        req = {'op': 'put', 'key': self._full(key), 'value': value}
+        if ttl:
+            req['ttl'] = float(ttl)
+        return self._request(req)['version']
+
+    def put_cas(self, key, value, expect_version, *, indent=None,
+                ttl=None, token=None):
+        del indent
+        req = {'op': 'cas', 'key': self._full(key), 'value': value,
+               'expect': (_ANY_WIRE if expect_version is ANY
+                          else expect_version)}
+        if token is not None:
+            req['token'] = str(token)
+        if ttl:
+            req['ttl'] = float(ttl)
+        resp = self._request(req)
+        if resp.get('conflict'):
+            return None
+        return resp['version']
+
+    def delete(self, key):
+        return bool(self._request({'op': 'delete',
+                                   'key': self._full(key)}).get('found'))
+
+    def delete_prefix(self, prefix):
+        return int(self._request(
+            {'op': 'delete_prefix',
+             'key': self._full_prefix(prefix)}).get('count', 0))
+
+    def _full_prefix(self, prefix):
+        prefix = check_prefix(prefix)
+        return f'{self.namespace}/{prefix}'
+
+    def _strip(self, key):
+        ns = f'{self.namespace}/' if self.namespace else ''
+        return key[len(ns):] if ns and key.startswith(ns) else key
+
+    def list(self, prefix=''):
+        resp = self._request({'op': 'list',
+                              'key': self._full_prefix(prefix)})
+        return [self._strip(k) for k in resp.get('keys', ())]
+
+    def get_many(self, prefix=''):
+        resp = self._request({'op': 'get_many',
+                              'key': self._full_prefix(prefix)})
+        return {self._strip(k): v
+                for k, v in (resp.get('values') or {}).items()}
+
+    def ping(self):
+        return self._request({'op': 'ping'})
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='kfac-coord-serve',
+        description='Run the stdlib etcd-style coordination KV server '
+                    'pods/services point KFAC_COORD_ADDR at '
+                    '(KFAC_COORD_BACKEND=tcp).')
+    p.add_argument('--host', default='0.0.0.0')
+    p.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format='%(asctime)s %(message)s')
+    srv = TcpKvServer(args.host, args.port)
+    log.info('coord kv server listening on %s:%d', args.host, srv.port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -65,6 +65,14 @@ Host-side faults:
                              the pod supervisor's protocol-file readers
                              (the partition drill; see chaos_net.py for
                              the full sub-contract)
+  KFAC_FAULT_COORD_*         deterministic COORDINATION-BACKEND chaos:
+                             seeded op failures/outage windows, torn
+                             and stale reads, spurious CAS conflicts,
+                             premature lease expiry — injected by
+                             coord.chaos.ChaosBackend around whichever
+                             backend (POSIX dir / TCP KV) the pod
+                             protocols and the job queue run on (see
+                             coord/chaos.py for the full sub-contract)
   KFAC_FAULT_ONCE_DIR        directory of cross-RESTART one-shot
                              tokens: with it set, hang/crash faults
                              fire only in the first process that
@@ -108,12 +116,17 @@ from kfac_pytorch_tpu.resilience.heartbeat import ENV_HB_STOP  # noqa: E402
 # resilience.chaos_net layer, registered here so the strict from_env
 # validates the whole drill surface at build time
 from kfac_pytorch_tpu.resilience.chaos_net import NET_ENVS  # noqa: E402
+# coordination-backend chaos (op failures, torn/stale reads, CAS
+# conflicts, lease expiry, outage windows): defined and CONSUMED by the
+# jax-free coord.chaos layer, registered here so the strict from_env
+# validates the whole drill surface at build time
+from kfac_pytorch_tpu.coord.chaos import COORD_ENVS  # noqa: E402
 
 KNOWN_ENVS = frozenset({
     ENV_NAN_GRAD, ENV_INF_GRAD, ENV_STATS, ENV_FACTOR, ENV_EIGH,
     ENV_SIGTERM, ENV_CKPT, ENV_HANG, ENV_SLOW, ENV_SLOW_SECS, ENV_CRASH,
     ENV_CRASH_MODE, ENV_DATA, ENV_ONCE_DIR, ENV_HB_STOP,
-}) | NET_ENVS
+}) | NET_ENVS | COORD_ENVS
 
 # rc of the 'exit'-mode crash fault: distinct from Python's generic 1
 # and from the watchdog's RC_HANG (114) so supervisor logs attribute it
@@ -209,6 +222,12 @@ def from_env() -> FaultConfig:
     # filter), but a malformed spec must die here, at build time
     from kfac_pytorch_tpu.resilience import chaos_net as _chaos_net
     _chaos_net.from_env()
+    # validate-only likewise: the coordination-backend chaos schedule is
+    # consumed by coord.chaos (every backend construction site wraps
+    # through maybe_wrap), but a malformed spec must die here, at build
+    # time, like every other drill
+    from kfac_pytorch_tpu.coord import chaos as _coord_chaos
+    _coord_chaos.from_env()
     mode = os.environ.get(ENV_CKPT) or None
     if mode is not None and mode not in ('truncate', 'fail', 'eio_once'):
         raise ValueError(f'{ENV_CKPT} must be "truncate", "fail" or '

@@ -111,7 +111,8 @@ def atomic_write_json(path, obj, **dump_kw):
 
 
 from kfac_pytorch_tpu.resilience.retry import (  # noqa: E402
-    ManualClock, RetryError, RetryPolicy, call_with_retry, resumable_iter)
+    ManualClock, PollPacer, RetryError, RetryPolicy, call_with_retry,
+    resumable_iter)
 from kfac_pytorch_tpu.resilience.watchdog import (  # noqa: E402
     RC_HANG, StepWatchdog)
 from kfac_pytorch_tpu.resilience.supervisor import (  # noqa: E402
@@ -119,10 +120,12 @@ from kfac_pytorch_tpu.resilience.supervisor import (  # noqa: E402
 from kfac_pytorch_tpu.resilience.straggler import (  # noqa: E402
     StragglerGovernor)
 from kfac_pytorch_tpu.resilience.heartbeat import (  # noqa: E402
-    RC_PEER_DEAD, FileLeaseTransport, JoinAnnouncer, PeerHeartbeat,
-    TcpHeartbeatTransport, heartbeat_from_env, read_join_announcements)
+    RC_PEER_DEAD, BackendLeaseTransport, FileLeaseTransport,
+    JoinAnnouncer, PeerHeartbeat, TcpHeartbeatTransport,
+    heartbeat_from_env, read_join_announcements)
 from kfac_pytorch_tpu.resilience.elastic import (  # noqa: E402
-    RC_FENCED, RC_JOIN_FAILED, PodSupervisor, elastic_resume)
+    RC_COORD_LOST, RC_FENCED, RC_JOIN_FAILED, PodSupervisor,
+    elastic_resume)
 from kfac_pytorch_tpu.resilience.chaos_net import (  # noqa: E402
     ChaosTransport, NetFaultConfig)
 from kfac_pytorch_tpu.resilience.incident import (  # noqa: E402
@@ -130,10 +133,11 @@ from kfac_pytorch_tpu.resilience.incident import (  # noqa: E402
 
 __all__ = [
     'Counters', 'counters', 'atomic_write_json',
-    'ManualClock', 'RetryError', 'RetryPolicy',
+    'ManualClock', 'PollPacer', 'RetryError', 'RetryPolicy',
     'call_with_retry', 'resumable_iter', 'RC_HANG', 'StepWatchdog',
     'Supervisor', 'parse_stop_rc', 'StragglerGovernor',
-    'RC_PEER_DEAD', 'RC_JOIN_FAILED', 'RC_FENCED', 'FileLeaseTransport',
+    'RC_PEER_DEAD', 'RC_JOIN_FAILED', 'RC_FENCED', 'RC_COORD_LOST',
+    'BackendLeaseTransport', 'FileLeaseTransport',
     'JoinAnnouncer', 'PeerHeartbeat', 'TcpHeartbeatTransport',
     'ChaosTransport', 'NetFaultConfig',
     'heartbeat_from_env', 'read_join_announcements',

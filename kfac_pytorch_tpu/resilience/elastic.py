@@ -92,13 +92,16 @@ import sys
 import threading
 import time
 
+from kfac_pytorch_tpu import coord as coord_mod
+from kfac_pytorch_tpu.coord import CoordGiveUp, RC_COORD_LOST
 from kfac_pytorch_tpu.resilience import chaos_net
 from kfac_pytorch_tpu.resilience import heartbeat as hb_mod
 from kfac_pytorch_tpu.resilience.heartbeat import (
-    FileLeaseTransport, JoinAnnouncer, PeerHeartbeat, RC_PEER_DEAD,
+    BackendLeaseTransport, JoinAnnouncer, PeerHeartbeat, RC_PEER_DEAD,
     read_join_announcements)
 from kfac_pytorch_tpu.resilience.incident import IncidentReport
-from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK, RetryPolicy
+from kfac_pytorch_tpu.resilience.retry import (
+    PollPacer, REAL_CLOCK, RetryPolicy)
 from kfac_pytorch_tpu.resilience.supervisor import parse_stop_rc
 from kfac_pytorch_tpu.resilience.watchdog import RC_HANG
 
@@ -269,7 +272,8 @@ class PodSupervisor:
                  grow_timeout=None, join=False, join_timeout=120.0,
                  stop_rcs=(), incident_path=None, env=None, clock=None,
                  rng=None, popen=subprocess.Popen, poll_period=0.2,
-                 child_kill_grace=5.0, net_chaos=None, log=None):
+                 child_kill_grace=5.0, net_chaos=None, coord=None,
+                 log=None):
         self.argv_template = list(argv_template)
         self.host_id = int(host_id)
         self.members = list(range(int(num_hosts)))
@@ -329,35 +333,90 @@ class PodSupervisor:
                           else chaos_net.from_env())
         self.report = IncidentReport(host_id=self.host_id)
         os.makedirs(self.lease_dir, exist_ok=True)
+        # the coordination backend (kfac_pytorch_tpu.coord): every
+        # protocol read/write — claims, lineage, done/join markers, sup
+        # heartbeat leases — goes through it. Default: env-selected
+        # (POSIX lease dir byte-compatible; KV server when
+        # KFAC_COORD_BACKEND=tcp), chaos-wrapped when the
+        # KFAC_FAULT_COORD_* drill is armed, retried per-op with a loud
+        # CoordGiveUp -> RC_COORD_LOST once the budget is spent.
+        if coord is not None:
+            self.coord = coord
+            # even for an injected backend the liveness path strips the
+            # retry wrapper: a backoff stall inside the monitor's poll
+            # would delay the very detection the heartbeat exists for
+            self._coord_hb = (coord.inner
+                              if isinstance(coord,
+                                            coord_mod.RetryingBackend)
+                              else coord)
+        else:
+            self.coord = coord_mod.backend_from_env(
+                self.lease_dir, clock=self.clock, rng=self.rng)
+            # the heartbeat channel stays UN-retried: a missed publish
+            # or scan is a missed beat (the monitor's contract), and a
+            # backoff stall inside the liveness path would delay the
+            # very detection the heartbeat exists for
+            self._coord_hb = coord_mod.backend_from_env(
+                self.lease_dir, retry=False)
+        # cumulative protocol-poll wait (every scan loop is paced by a
+        # jitter-capped RetryPolicy schedule, not a bare sleep);
+        # surfaced as poll_wait_s in the [resilience: ...] counters
+        self._poll_wait = [0.0]
         # monotonic lineage epoch (see ENV_LINEAGE): persisted in the
         # lease dir so a whole-pod restart reusing its directories does
         # not start below the lineage its own checkpoints are stamped
-        # with (which would wrongly read as "we are the fenced fork")
-        self._lineage_mem = self._read_lineage()
+        # with (which would wrongly read as "we are the fenced fork").
+        # Read LAZILY (first _current_lineage call, inside run()'s
+        # CoordGiveUp handler): a backend that is down at construction
+        # must surface as RC_COORD_LOST, never as a silent lineage-0
+        # baseline that would defeat the fencing check.
+        self._lineage_mem = None
 
     def counts(self):
-        return {'restarts': self.restarts, 'crashes': self.crashes,
-                'hangs': self.hangs, 'shrinks': self.shrinks,
-                'grows': self.grows, 'joins': self.joins}
+        c = {'restarts': self.restarts, 'crashes': self.crashes,
+             'hangs': self.hangs, 'shrinks': self.shrinks,
+             'grows': self.grows, 'joins': self.joins,
+             'poll_wait_s': int(self._poll_wait[0])}
+        stats = getattr(self.coord, 'stats', None)
+        if callable(stats):
+            s = stats()
+            if s.get('retries'):
+                c['coord_retries'] = int(s['retries'])
+            if s.get('gave_up'):
+                c['coord_gave_ups'] = int(s['gave_up'])
+        return c
+
+    def _new_pace(self, period=None):
+        """A fresh jitter-capped pacer for one protocol wait loop."""
+        return PollPacer.for_period(
+            period if period is not None else self.poll_period,
+            clock=self.clock, rng=self.rng, total=self._poll_wait)
 
     # -- lineage epoch + graceful-departure markers -----------------------
 
-    def _lineage_path(self):
-        return os.path.join(self.lease_dir, 'lineage.json')
-
     def _read_lineage(self):
-        import json
+        """The persisted lineage epoch (0 when never bumped). A backend
+        GIVE-UP propagates: deciding 'lineage 0' on a dead coordination
+        plane would baseline a restarted pod below its own checkpoints
+        and defeat the fencing refusal — exit RC_COORD_LOST instead."""
         try:
-            with open(self._lineage_path()) as f:
-                return int(json.load(f)['lineage'])
+            got = self.coord.get('lineage.json')
+            return int(got.value['lineage']) if got is not None else 0
+        except CoordGiveUp:
+            raise
         except (OSError, ValueError, KeyError, TypeError):
             return 0
+
+    def _lineage_base(self):
+        if self._lineage_mem is None:
+            self._lineage_mem = self._read_lineage()
+        return self._lineage_mem
 
     def _current_lineage(self):
         """max(what we committed, what any member committed): the file
         re-read lets a member that raced a commit self-heal by the next
         relaunch instead of exporting a stale epoch forever."""
-        return max(self._lineage_mem, self._read_lineage())
+        return max(self._lineage_base(), self._read_lineage())
 
     def _bump_lineage(self):
         """On every COMMITTED membership change. All members compute
@@ -365,36 +424,40 @@ class PodSupervisor:
         writes are idempotent. NEVER called on a quorum-lost barrier —
         a fenced host's lineage freezes, which is exactly what lets
         elastic_resume refuse its fork later."""
-        from kfac_pytorch_tpu.resilience import atomic_write_json
         self._lineage_mem = self._current_lineage() + 1
         with contextlib.suppress(OSError):
-            atomic_write_json(self._lineage_path(),
-                              {'lineage': self._lineage_mem,
-                               'gen': self.gen, 'host': self.host_id,
-                               'wall': time.time()})
+            self.coord.put('lineage.json',
+                           {'lineage': self._lineage_mem,
+                            'gen': self.gen, 'host': self.host_id,
+                            'wall': time.time()})
         return self._lineage_mem
 
-    def _done_path(self, host):
-        return os.path.join(self.lease_dir, f'done-{host}.json')
+    def _done_key(self, host):
+        return f'done-{host}.json'
 
     def _mark_done(self):
         """Graceful-departure marker: a supervisor whose trainer
         FINISHED announces it, so peers that outlive us can tell
         'completed and left' from 'died/partitioned' — a departed host
         neither counts toward nor against the shrink quorum."""
-        from kfac_pytorch_tpu.resilience import atomic_write_json
         with contextlib.suppress(OSError):
-            atomic_write_json(self._done_path(self.host_id),
-                              {'host': self.host_id, 'gen': self.gen,
-                               'wall': time.time()})
+            self.coord.put(self._done_key(self.host_id),
+                           {'host': self.host_id, 'gen': self.gen,
+                            'wall': time.time()})
 
     def _departed(self):
-        """Members that announced graceful completion."""
-        out = set()
-        for m in self.members:
-            if m != self.host_id and os.path.exists(self._done_path(m)):
-                out.add(m)
-        return out
+        """Members that announced graceful completion. A backend
+        GIVE-UP propagates: the quorum gate consults this at decision
+        time, and a blind 'nobody departed' answer could fence the
+        last live host of a winding-down pod."""
+        try:
+            done = self.coord.get_many('done-')
+        except CoordGiveUp:
+            raise
+        except OSError:
+            return set()
+        return {m for m in self.members
+                if m != self.host_id and self._done_key(m) in done}
 
     # -- supervisor-to-supervisor heartbeat -------------------------------
 
@@ -418,17 +481,20 @@ class PodSupervisor:
         peer one beat (republished within an interval, well inside the
         startup grace). Incident reports are kept — they are the
         artifact, not protocol state."""
-        import shutil
         try:
-            names = os.listdir(self.lease_dir)
+            keys = self.coord.list('')
+        except CoordGiveUp:
+            raise   # startup on a dead backend: RC_COORD_LOST, not a
+            # half-scrubbed lease dir a later generation trips over
         except OSError:
             return
-        for name in names:
-            path = os.path.join(self.lease_dir, name)
-            if name.startswith(('shrink-gen', 'grow-gen', 'trainer-gen')):
-                shutil.rmtree(path, ignore_errors=True)
-            elif (name.startswith(('join-', 'done-'))
-                    and name.endswith('.json')):
+        barriers = set()
+        for key in keys:
+            top, _, rest = key.partition('/')
+            if top.startswith(('shrink-gen', 'grow-gen', 'trainer-gen')):
+                barriers.add(top)
+            elif (not rest and top.startswith(('join-', 'done-'))
+                    and top.endswith('.json')):
                 # a stale announcement from a previous incarnation would
                 # trigger a spurious grow barrier the moment the fresh
                 # pod comes up (the grow aborts when the ghost never
@@ -436,17 +502,18 @@ class PodSupervisor:
                 # markers would exempt live hosts from the new
                 # incarnation's shrink quorum
                 with contextlib.suppress(OSError):
-                    os.remove(path)
-            elif name == 'sup':
+                    self.coord.delete(key)
+            elif top == 'sup' and rest.startswith('hb-'):
                 with contextlib.suppress(OSError):
-                    for lease in os.listdir(path):
-                        if lease.startswith('hb-'):
-                            with contextlib.suppress(OSError):
-                                os.remove(os.path.join(path, lease))
+                    self.coord.delete(key)
+        for barrier in barriers:
+            with contextlib.suppress(OSError):
+                self.coord.delete_prefix(barrier + '/')
 
     def _monitor_transport(self):
-        transport = FileLeaseTransport(
-            os.path.join(self.lease_dir, 'sup'), self.host_id)
+        transport = BackendLeaseTransport(
+            self._coord_hb, self.host_id, prefix='sup',
+            ttl=4.0 * self.hb_deadline)
         if self.net_chaos is not None:
             transport = chaos_net.ChaosTransport(
                 transport, self.net_chaos, self.host_id)
@@ -484,11 +551,12 @@ class PodSupervisor:
         timeout = (timeout if timeout is not None
                    else self.hb_deadline + 2.0 * self.hb_interval)
         start = self.clock.monotonic()
+        pace = self._new_pace()
         while self.clock.monotonic() - start < timeout:
             dead = self._confirmed_dead()
             if dead:
                 return dead
-            self.clock.sleep(self.poll_period)
+            pace.sleep()
         self.log.warning('pod-supervisor: %s, but our own heartbeat '
                          'monitor confirmed no dead peer within %.1fs',
                          why, timeout)
@@ -607,10 +675,12 @@ class PodSupervisor:
     # -- shrink / grow claim lanes ----------------------------------------
 
     def _claim_dir(self, gen):
-        return os.path.join(self.lease_dir, f'shrink-gen{gen}')
+        """Key prefix of generation ``gen``'s shrink barrier (a
+        directory on the POSIX backend, a key namespace elsewhere)."""
+        return f'shrink-gen{gen}'
 
     def _grow_dir(self, gen):
-        return os.path.join(self.lease_dir, f'grow-gen{gen}')
+        return f'grow-gen{gen}'
 
     def _net_reachable(self, peers):
         """Drop entries from hosts the partition matrix currently cuts
@@ -624,40 +694,35 @@ class PodSupervisor:
                 if h == self.host_id
                 or not self.net_chaos.partitioned(h, self.host_id, now)}
 
-    def _read_claims(self, claim_dir, prefix='survivor-'):
-        import json
+    def _read_claims(self, barrier, prefix='survivor-'):
+        """Claims under barrier prefix ``barrier`` (``shrink-gen3`` /
+        ``grow-gen3``). Torn or malformed entries are skipped this
+        poll; a backend GIVE-UP propagates (the caller's loop exits
+        :data:`RC_COORD_LOST` rather than deciding membership on a
+        blind read)."""
         out = {}
-        try:
-            names = os.listdir(claim_dir)
-        except OSError:
-            return out
-        for name in names:
+        for key, payload in self.coord.get_many(f'{barrier}/').items():
+            name = key.rsplit('/', 1)[-1]
             if not (name.startswith(prefix) and name.endswith('.json')):
                 continue
             try:
-                with open(os.path.join(claim_dir, name)) as f:
-                    payload = json.load(f)
                 out[int(payload['host'])] = payload
-            except (OSError, ValueError, KeyError):
+            except (ValueError, KeyError, TypeError):
                 continue
         return self._net_reachable(out)
 
-    def _write_claim(self, claim_dir, prefix='survivor-', members=None):
+    def _write_claim(self, barrier, prefix='survivor-', members=None):
         """``members``: incumbent grow claims publish the CURRENT
         membership so the joiner can compute the same expected set the
         incumbents wait for (a joiner admitting on claim-set stability
         alone could adopt a smaller membership than the barrier closes
         with, if one incumbent is slow to stop its trainer and claim).
         """
-        from kfac_pytorch_tpu.resilience import atomic_write_json
-        os.makedirs(claim_dir, exist_ok=True)
         payload = {'host': self.host_id, 'addr': self.host_addr,
                    'wall': time.time()}
         if members is not None:
             payload['members'] = [int(m) for m in members]
-        atomic_write_json(
-            os.path.join(claim_dir, f'{prefix}{self.host_id}.json'),
-            payload)
+        self.coord.put(f'{barrier}/{prefix}{self.host_id}.json', payload)
 
     def _peer_shrink_started(self):
         """True when a peer has already claimed the NEXT generation."""
@@ -671,7 +736,7 @@ class PodSupervisor:
         the partition matrix cuts us off from is invisible."""
         return self._net_reachable(
             {h: p for h, p in
-             read_join_announcements(self.lease_dir).items()
+             read_join_announcements(self.coord).items()
              if h not in self.members})
 
     def _peer_grow_started(self):
@@ -720,6 +785,7 @@ class PodSupervisor:
         self._write_claim(claim_dir)
         expected = set(self.members) - set(dead)
         start = self.clock.monotonic()
+        pace = self._new_pace()
         while self.clock.monotonic() - start < self.shrink_timeout:
             # a host that finishes cleanly MID-barrier never claims:
             # drop fresh departures from the expected set instead of
@@ -727,7 +793,7 @@ class PodSupervisor:
             if expected - self._departed() <= set(
                     self._read_claims(claim_dir)):
                 break
-            self.clock.sleep(self.poll_period)
+            pace.sleep()
         # settle: a late claim from a host we wrote off means it is
         # alive after all — better to keep it than split-brain
         self.clock.sleep(self.settle)
@@ -765,8 +831,8 @@ class PodSupervisor:
             # withdraw our claim so the healed majority can never
             # mistake this dead barrier for late corroboration
             with contextlib.suppress(OSError):
-                os.remove(os.path.join(
-                    claim_dir, f'survivor-{self.host_id}.json'))
+                self.coord.delete(
+                    f'{claim_dir}/survivor-{self.host_id}.json')
             self.log.error(
                 'elastic: quorum lost at gen %d — claimants %s are a '
                 'minority of membership %s (tiebreak host %d) '
@@ -792,8 +858,7 @@ class PodSupervisor:
         # dead seconds after its admission
         for h in dead_set:
             with contextlib.suppress(OSError):
-                os.remove(os.path.join(self.lease_dir, 'sup',
-                                       f'hb-{h}.json'))
+                self.coord.delete(f'sup/hb-{h}.json')
         from kfac_pytorch_tpu.utils.runlog import resilience_suffix
         self.log.warning(
             'elastic: shrinking world %d -> %d survivors=%s gen=%d%s',
@@ -823,6 +888,7 @@ class PodSupervisor:
         self.log.info('elastic: grow claim written host=%d gen=%d',
                       self.host_id, next_gen)
         start = self.clock.monotonic()
+        pace = self._new_pace()
         while self.clock.monotonic() - start < self.grow_timeout:
             # SHRINK LANE WINS: a join announcement racing an
             # unconfirmed peer death can put peers in the shrink
@@ -835,8 +901,8 @@ class PodSupervisor:
             if (self._read_claims(self._claim_dir(next_gen))
                     or self._confirmed_dead()):
                 with contextlib.suppress(OSError):
-                    os.remove(os.path.join(
-                        claim_dir, f'member-{self.host_id}.json'))
+                    self.coord.delete(
+                        f'{claim_dir}/member-{self.host_id}.json')
                 self.log.warning(
                     'elastic: abandoning the grow at gen %d — a shrink '
                     'is underway at the same generation (the shrink '
@@ -851,7 +917,7 @@ class PodSupervisor:
                         | set(self._join_announced()) | set(claims))
             if expected <= set(claims):
                 break
-            self.clock.sleep(self.poll_period)
+            pace.sleep()
         # settle: a straggling claimant (joiner slow to scan the new
         # barrier dir, incumbent slow to stop its trainer) makes it in
         self.clock.sleep(self.settle)
@@ -874,12 +940,11 @@ class PodSupervisor:
             # at gen g+1 would make the very generation the incumbents
             # reopen permanently unjoinable.
             self._aborted_grow_gens.add(next_gen)
-            import shutil
-            shutil.rmtree(claim_dir, ignore_errors=True)
+            with contextlib.suppress(OSError):
+                self.coord.delete_prefix(claim_dir + '/')
             for h in joiners:
                 with contextlib.suppress(OSError):
-                    os.remove(os.path.join(self.lease_dir,
-                                           f'join-{h}.json'))
+                    self.coord.delete(f'join-{h}.json')
             self.log.warning(
                 'elastic: grow aborted at gen %d — announced joiner(s) '
                 '%s never claimed (stale announcement?); membership '
@@ -907,9 +972,9 @@ class PodSupervisor:
         # count toward quorum like anyone else.
         for h in admitted:
             with contextlib.suppress(OSError):
-                os.remove(os.path.join(self.lease_dir, f'join-{h}.json'))
+                self.coord.delete(f'join-{h}.json')
             with contextlib.suppress(OSError):
-                os.remove(self._done_path(h))
+                self.coord.delete(self._done_key(h))
         from kfac_pytorch_tpu.utils.runlog import resilience_suffix
         self.log.warning(
             'elastic: growing world %d -> %d members=%s gen=%d '
@@ -923,19 +988,22 @@ class PodSupervisor:
         return True
 
     def _max_grow_gen(self):
-        """Highest generation with a grow-claim barrier dir on disk, or
-        None — the joiner's baseline so completed barriers from earlier
-        churn cycles are inert to a later rejoin."""
+        """Highest generation with a live grow-claim barrier, or None —
+        the joiner's baseline so completed barriers from earlier churn
+        cycles are inert to a later rejoin."""
         best = None
         try:
-            names = os.listdir(self.lease_dir)
+            keys = self.coord.list('grow-gen')
+        except CoordGiveUp:
+            raise   # a baseline read on a dead backend would make
+            # completed barriers from earlier cycles look joinable
         except OSError:
             return None
-        for name in names:
-            if name.startswith('grow-gen'):
-                with contextlib.suppress(ValueError):
-                    g = int(name[len('grow-gen'):])
-                    best = g if best is None else max(best, g)
+        for key in keys:
+            top = key.split('/', 1)[0]
+            with contextlib.suppress(ValueError):
+                g = int(top[len('grow-gen'):])
+                best = g if best is None else max(best, g)
         return best
 
     def _join_pod(self):
@@ -959,11 +1027,12 @@ class PodSupervisor:
             deadline=self.hb_deadline, startup_grace=self.hb_grace,
             on_dead=self._record_peer_dead, gen=self.gen, log=self.log)
         self._hb.start()
-        announcer = JoinAnnouncer(self.lease_dir, self.host_id,
+        announcer = JoinAnnouncer(self.coord, self.host_id,
                                   addr=self.host_addr, log=self.log)
         self.report.add_event('join_announce', host=self.host_id)
         baseline = self._max_grow_gen() or 0
         start = self.clock.monotonic()
+        pace = self._new_pace()
         claimed_gen = None
         prev_claims = None
         stable_since = None
@@ -1032,7 +1101,7 @@ class PodSupervisor:
                         # it at the grow commit; re-reading (plus the
                         # per-relaunch re-read in _child_env) means a
                         # joiner that raced the write self-heals
-                        self._lineage_mem = max(self._lineage_mem,
+                        self._lineage_mem = max(self._lineage_base(),
                                                 self._read_lineage())
                         self.log.warning(
                             'join: admitted into pod as rank %d — '
@@ -1045,7 +1114,7 @@ class PodSupervisor:
                             members=self.members,
                             rank=self.members.index(self.host_id))
                         return True
-                self.clock.sleep(self.poll_period)
+                pace.sleep()
         finally:
             announcer.withdraw()
         if claimed_gen is not None:
@@ -1054,9 +1123,9 @@ class PodSupervisor:
             # count a host that has already exited and grow a
             # membership with a permanently missing rank
             with contextlib.suppress(OSError):
-                os.remove(os.path.join(
-                    self._grow_dir(claimed_gen),
-                    f'member-{self.host_id}.json'))
+                self.coord.delete(
+                    f'{self._grow_dir(claimed_gen)}'
+                    f'/member-{self.host_id}.json')
         self.log.error(
             'join: pod never admitted host %d within %.1fs — is the '
             'incumbent pod alive and sharing this lease dir (%s)? '
@@ -1089,6 +1158,27 @@ class PodSupervisor:
         self._terminate_child()
         return RC_FENCED
 
+    def _coord_lost(self, exc):
+        """The coordination backend exhausted a retry budget on an
+        operation this supervisor cannot proceed without (a barrier
+        read, a claim write): kill the trainer and exit the dedicated
+        :data:`RC_COORD_LOST` — a host that cannot reach the
+        coordination plane must not keep deciding membership, and the
+        operator's runbook reaction is 'check the backend', not
+        'restart the trainer'."""
+        from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        self.log.error(
+            'pod-supervisor: coordination backend lost — %s. Stopping '
+            'the trainer and exiting rc=%d; restart this supervisor '
+            'once the backend (lease filesystem / KV server) is back. '
+            '[resilience: coord_lost=1]%s', exc, RC_COORD_LOST,
+            resilience_suffix(self.counts()))
+        self.report.add_event('coord_lost', rc=RC_COORD_LOST,
+                              error=str(exc))
+        self.report.bump({'coord_lost': 1})
+        self._terminate_child()
+        return RC_COORD_LOST
+
     # -- main loop --------------------------------------------------------
 
     def run(self):
@@ -1098,20 +1188,22 @@ class PodSupervisor:
                 prev_handlers[s] = _signal.signal(s, self._forward_signal)
         except ValueError:  # pragma: no cover — non-main thread (tests)
             prev_handlers = {}
-        admitted = True
-        if self.join:
-            # joining an ACTIVE pod: its protocol files are live state,
-            # not stale debris — scrubbing them here would tear down the
-            # very barrier that admits us
-            admitted = self._join_pod()
-        else:
-            self._clear_stale_protocol_files()
         try:
+            admitted = True
+            if self.join:
+                # joining an ACTIVE pod: its protocol files are live
+                # state, not stale debris — scrubbing them here would
+                # tear down the very barrier that admits us
+                admitted = self._join_pod()
+            else:
+                self._clear_stale_protocol_files()
             if not admitted:
                 rc = RC_JOIN_FAILED
             else:
                 self._start_monitor()
                 rc = self._run_loop()
+        except CoordGiveUp as e:
+            rc = self._coord_lost(e)
         finally:
             for s, h in prev_handlers.items():
                 _signal.signal(s, h if h is not None else _signal.SIG_DFL)
@@ -1142,6 +1234,7 @@ class PodSupervisor:
         signal checks. Returns (rc, reason) with reason in
         {'exit', 'peer_dead', 'fenced', 'grow'}."""
         next_lane_check = 0.0
+        pace = self._new_pace()
         while True:
             rc = self.child.poll()
             if rc is not None:
@@ -1179,7 +1272,7 @@ class PodSupervisor:
                                      'barrier')
                     self._terminate_child()
                     return self.child.poll(), 'grow'
-            self.clock.sleep(self.poll_period)
+            pace.sleep()
 
     def _run_loop(self):
         from kfac_pytorch_tpu.utils.runlog import resilience_suffix

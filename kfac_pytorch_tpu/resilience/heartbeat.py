@@ -69,50 +69,70 @@ ENV_GEN = 'KFAC_HB_GEN'
 DEFAULT_TCP_PORT = 8478
 
 
-class FileLeaseTransport:
-    """Shared-filesystem leases: host ``i`` owns ``hb-i.json``.
+class BackendLeaseTransport:
+    """Heartbeat leases over any coordination backend
+    (:mod:`kfac_pytorch_tpu.coord`): host ``i`` owns the lease key
+    ``hb-i.json`` under ``prefix``.
 
-    Writes are atomic (tmp + rename, same discipline as the pickle
-    checkpoint path) so a reader never sees a torn payload; a reader
-    that catches a file mid-replace just keeps the previous sequence for
-    one poll. Works on anything rename-atomic (local disk, NFS, gcsfuse
-    with a single writer per object — each host only ever writes its own
-    lease).
+    Publishes carry ``ttl`` so a backend that can expire leases
+    server-side (the TCP KV server) drops a dead host's key on its own;
+    liveness still never DEPENDS on expiry — the monitor judges
+    sequence advance, so the POSIX backend's advisory TTLs are enough.
+    Backend errors surface as :class:`OSError` (``CoordError`` is one),
+    which the monitor already treats as a missed beat / skipped poll.
     """
 
-    def __init__(self, lease_dir, host_id):
-        self.lease_dir = str(lease_dir)
+    def __init__(self, backend, host_id, *, prefix='', ttl=None):
+        self.backend = backend
         self.host_id = int(host_id)
-        os.makedirs(self.lease_dir, exist_ok=True)
+        self.prefix = str(prefix)
+        if self.prefix and not self.prefix.endswith('/'):
+            self.prefix += '/'
+        self.ttl = ttl
 
-    def _path(self, host_id):
-        return os.path.join(self.lease_dir, f'hb-{host_id}.json')
+    def _key(self, host_id):
+        return f'{self.prefix}hb-{host_id}.json'
 
     def publish(self, payload):
-        _res.atomic_write_json(self._path(self.host_id), payload)
+        self.backend.put(self._key(self.host_id), payload, ttl=self.ttl)
 
     def read_peers(self):
         """{host_id: payload} for every readable lease but our own."""
         out = {}
-        try:
-            names = os.listdir(self.lease_dir)
-        except OSError:
-            return out
-        for name in names:
+        for key, payload in self.backend.get_many(self.prefix).items():
+            name = key[len(self.prefix):]
             if not (name.startswith('hb-') and name.endswith('.json')):
                 continue
             try:
                 hid = int(name[3:-5])
             except ValueError:
                 continue
-            if hid == self.host_id:
-                continue
-            try:
-                with open(os.path.join(self.lease_dir, name)) as f:
-                    out[hid] = json.load(f)
-            except (OSError, ValueError):
-                continue  # mid-replace or unreadable: next poll
+            if hid != self.host_id and isinstance(payload, dict):
+                out[hid] = payload
         return out
+
+    def close(self):
+        close = getattr(self.backend, 'close', None)
+        if callable(close):
+            close()
+
+
+class FileLeaseTransport(BackendLeaseTransport):
+    """Shared-filesystem leases: host ``i`` owns ``hb-i.json``.
+
+    Now a :class:`BackendLeaseTransport` bound to the byte-compatible
+    POSIX backend — writes are still atomic (tmp + rename, the same
+    discipline as the pickle checkpoint path) to the exact same files,
+    so a reader never sees a torn payload and mixed-version pods keep
+    interoperating. Works on anything rename-atomic (local disk, NFS,
+    gcsfuse with a single writer per object — each host only ever
+    writes its own lease).
+    """
+
+    def __init__(self, lease_dir, host_id):
+        from kfac_pytorch_tpu.coord.posix import PosixDirBackend
+        self.lease_dir = str(lease_dir)
+        super().__init__(PosixDirBackend(self.lease_dir), host_id)
 
 
 class TcpHeartbeatTransport:
@@ -474,17 +494,18 @@ class JoinAnnouncer:
     join is abandoned), so a LATER death of this host cannot replay the
     announcement into a spurious grow."""
 
-    def __init__(self, lease_dir, host_id, *, addr=None, log=None):
-        self.lease_dir = str(lease_dir)
+    def __init__(self, lease, host_id, *, addr=None, log=None):
+        self.backend = _as_backend(lease)
+        self.where = str(lease) if _is_pathish(lease) else repr(
+            self.backend)
         self.host_id = int(host_id)
         self.addr = addr
         self.log = log if log is not None else logging.getLogger(__name__)
         self._seq = 0
         self._announced = False
-        os.makedirs(self.lease_dir, exist_ok=True)
 
-    def _path(self):
-        return os.path.join(self.lease_dir, f'join-{self.host_id}.json')
+    def _key(self):
+        return f'join-{self.host_id}.json'
 
     def announce(self):
         """(Re)publish the announcement; atomic, idempotent. The first
@@ -496,38 +517,57 @@ class JoinAnnouncer:
             self.log.warning(
                 'join: host %d announcing to pod (lease %s) '
                 '[resilience: join_announce=1 host=%d]',
-                self.host_id, self.lease_dir, self.host_id)
-        _res.atomic_write_json(self._path(), {
+                self.host_id, self.where, self.host_id)
+        self.backend.put(self._key(), {
             'host': self.host_id, 'addr': self.addr, 'seq': self._seq,
             'pid': os.getpid(), 'wall': time.time()})
 
     def withdraw(self):
         self._announced = False
         with contextlib.suppress(OSError):
-            os.remove(self._path())
+            self.backend.delete(self._key())
 
 
-def read_join_announcements(lease_dir):
-    """{host_id: payload} for every readable ``join-*.json`` in the
-    lease dir (torn/unreadable files are skipped for one poll, same
-    discipline as the lease reader)."""
+def _is_pathish(obj):
+    return isinstance(obj, (str, bytes, os.PathLike))
+
+
+def _as_backend(lease):
+    """A lease-dir path becomes the env-selected coordination backend
+    rooted there (``kfac_pytorch_tpu.coord`` — POSIX byte-compatible
+    default, TCP KV when ``KFAC_COORD_BACKEND=tcp``); an object is
+    already a backend and passes through."""
+    if not _is_pathish(lease):
+        return lease
+    from kfac_pytorch_tpu import coord
+    return coord.backend_from_env(str(lease), retry=False)
+
+
+def read_join_announcements(lease):
+    """{host_id: payload} for every readable ``join-*.json`` under the
+    lease dir / backend (torn or unreadable entries are skipped for one
+    poll, same discipline as the lease reader)."""
+    from kfac_pytorch_tpu.coord import CoordGiveUp
+    backend = _as_backend(lease)
     out = {}
     try:
-        names = os.listdir(str(lease_dir))
-    except OSError:
+        payloads = backend.get_many('join-')
+    except CoordGiveUp:
+        # a spent retry budget must surface (RC_COORD_LOST), not read
+        # as "nobody is joining" forever
+        raise
+    except (OSError, ValueError):
         return out
-    for name in names:
+    for key, payload in payloads.items():
+        name = key.rsplit('/', 1)[-1]
         if not (name.startswith('join-') and name.endswith('.json')):
             continue
         try:
             hid = int(name[5:-5])
         except ValueError:
             continue
-        try:
-            with open(os.path.join(str(lease_dir), name)) as f:
-                out[hid] = json.load(f)
-        except (OSError, ValueError):
-            continue
+        if isinstance(payload, dict):
+            out[hid] = payload
     return out
 
 
@@ -593,7 +633,13 @@ def heartbeat_from_env(log=None, on_dead=None):
     elif not lease_dir:
         return None
     else:
-        transport = FileLeaseTransport(lease_dir, host_id)
+        # 'file' leases route through the env-selected coordination
+        # backend rooted at the lease dir: byte-identical POSIX files
+        # by default, the KV server when KFAC_COORD_BACKEND=tcp —
+        # the trainer-side liveness plane follows the pod's backend
+        transport = BackendLeaseTransport(
+            _as_backend(lease_dir), host_id,
+            ttl=4.0 * float(os.environ.get(ENV_DEADLINE, '10.0')))
     # network-chaos drill (KFAC_FAULT_NET_*): seeded drop/delay/dup/
     # reorder schedules + the time-windowed partition matrix wrap the
     # real transport; a no-op unless the env is armed

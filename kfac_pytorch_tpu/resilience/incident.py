@@ -32,7 +32,8 @@ import time
 _CUMULATIVE = frozenset({
     'restarts', 'crashes', 'hangs', 'gave_up', 'fenced', 'shrinks',
     'grows', 'joins', 'straggler_level', 'partition_suspected',
-    'quorum_lost',
+    'quorum_lost', 'coord_lost', 'coord_retries', 'coord_gave_ups',
+    'poll_wait_s',
 })
 
 # suffix keys that are event FIELDS riding along in a [resilience: ...]
@@ -72,6 +73,16 @@ _PATTERNS = (
         r'(?P<membership>\[[^\]]*\])')),
     ('fenced', re.compile(
         r'Fencing this host \(killing the trainer')),
+    # the coordination backend (kfac_pytorch_tpu/coord): per-op retries
+    # surface as coord_retries= counters in the [resilience: ...]
+    # suffixes; a spent budget is its own event — the give-up on ONE op
+    # (coord.base.RetryingBackend) and the supervisor/scheduler-level
+    # verdict that follows (rc=118, check the backend not the pod)
+    ('coord_gave_up', re.compile(
+        r'coord: giving up op=(?P<op>[\w_]+) key=(?P<key>\S*) after '
+        r'(?P<attempts>\d+) attempts')),
+    ('coord_lost', re.compile(
+        r'coordination backend lost — .*exiting rc=(?P<rc>\d+)')),
     # the grow cycle (elastic GROW / train-through-churn): a repaired
     # host's announcement, each supervisor's claim into the grow
     # barrier, the agreed enlargement, and the trainer-side upward

@@ -74,10 +74,75 @@ class RetryPolicy:
     retry_on: Tuple[type, ...] = (OSError, TimeoutError)
 
     def delay(self, k, rng):
-        d = min(self.max_delay, self.base_delay * self.multiplier ** k)
+        try:
+            raw = self.base_delay * self.multiplier ** k
+        except OverflowError:
+            # multiplier**k exceeds float range for large k (long-lived
+            # pacer loops): the cap is the answer either way
+            raw = self.max_delay
+        d = min(self.max_delay, raw)
         if self.jitter:
             d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
         return max(0.0, d)
+
+
+class PollPacer:
+    """Jitter-capped pacing for protocol scan loops.
+
+    The lease-dir scan loops (shrink/grow barriers, join admission,
+    child polling, the scheduler cycle) used to spin on a bare
+    ``sleep(poll_period)`` — fine on a local disk, a synchronized
+    thundering herd against a shared filesystem or a KV server. A pacer
+    turns each loop's waits into a :class:`RetryPolicy` schedule:
+    jittered, growing to a bounded cap (so an idle wait backs off
+    without ever stalling the protocol), decorrelated across hosts, and
+    ACCOUNTED — :attr:`waited` accumulates the slept seconds so the
+    supervisor can surface a cumulative ``poll_wait_s`` counter in its
+    ``[resilience: ...]`` line.
+
+    One pacer per wait loop (:meth:`reset` re-arms the schedule when a
+    loop observes progress); the shared ``total`` hook lets a parent
+    aggregate across loops.
+    """
+
+    def __init__(self, policy=None, *, clock=None, rng=None, total=None):
+        self.policy = policy or RetryPolicy(
+            attempts=1, base_delay=0.2, max_delay=1.0, multiplier=1.5,
+            jitter=0.25)
+        self.clock = clock or REAL_CLOCK
+        self.rng = rng or random
+        self.waited = 0.0
+        self._k = 0
+        self._total = total     # optional mutable [float] aggregate
+
+    @classmethod
+    def for_period(cls, period, *, cap=None, clock=None, rng=None,
+                   total=None):
+        """A pacer whose first wait is ``period`` and whose cap is
+        ``cap`` (default ``4 * period`` — bounded growth: an idle scan
+        relaxes a little, a protocol response never lags by more than a
+        few periods)."""
+        period = max(1e-4, float(period))
+        cap = float(cap) if cap is not None else 4.0 * period
+        return cls(RetryPolicy(attempts=1, base_delay=period,
+                               max_delay=max(period, cap),
+                               multiplier=1.5, jitter=0.25),
+                   clock=clock, rng=rng, total=total)
+
+    def reset(self):
+        self._k = 0
+
+    def sleep(self):
+        d = self.policy.delay(self._k, self.rng)
+        # k saturates well past where the cap takes over: a pacer lives
+        # for a whole supervise loop (hours), and an unbounded exponent
+        # would eventually overflow float range
+        self._k = min(self._k + 1, 64)
+        self.clock.sleep(d)
+        self.waited += d
+        if self._total is not None:
+            self._total[0] += d
+        return d
 
 
 def call_with_retry(fn, *, policy=None, clock=None, rng=None,

@@ -1,43 +1,50 @@
-"""Durable crash-safe job queue on plain files.
+"""Durable crash-safe job queue on the pluggable coordination backend.
 
 Design constraints (the tentpole's hard ones):
 
 - **A SIGKILLed scheduler restarts with no lost and no duplicated
   jobs.** Submission is a SPOOL write (``incoming/spec-<unique>.json``,
-  atomic tmp + rename); the scheduler INGESTS spool files into
-  numbered job state files (``jobs/job-<id>.json``) and only then
+  atomic on every backend); the scheduler INGESTS spool entries into
+  numbered job state keys (``jobs/job-<id>.json``) and only then
   removes the spool entry. A crash between the two leaves the spool
-  file behind — the restarted ingest sees its ``origin`` already
+  entry behind — the restarted ingest sees its ``origin`` already
   recorded on an existing job and just completes the cleanup, so the
   job exists exactly once. Jobs that were RUNNING when the scheduler
   died are its own children — they died with it — and
   :meth:`JobQueue.recover` requeues them (zero lost).
 
-- **Monotonic job epochs** (the PR-7 lineage pattern applied per job):
-  every state transition rewrites the job file atomically with
-  ``epoch + 1``, and :meth:`JobQueue.transition` refuses to apply a
-  transition computed against a stale epoch. That is what makes the
-  scheduler's requeue *fencing-aware*: when a fenced pod generation
-  collapses and several per-host supervisor exits are observed for the
-  same job, the first observation's requeue bumps the epoch and every
-  later one no-ops — the job re-enters the queue exactly once.
+- **Monotonic job epochs, enforced by backend CAS** (the PR-7 lineage
+  pattern applied per job): every state transition rewrites the job
+  record with ``epoch + 1`` through ``put_cas`` against the version the
+  decision was read at, and :meth:`JobQueue.transition` refuses to
+  apply a transition computed against a stale epoch OR a stale backend
+  version. That is what makes the scheduler's requeue *fencing-aware*:
+  when a fenced pod generation collapses and several per-host
+  supervisor exits are observed for the same job, the first
+  observation's requeue bumps the epoch and every later one no-ops —
+  the job re-enters the queue exactly once, even when the backend
+  itself is misbehaving (a spurious CAS conflict just re-reads and
+  re-derives; it can never double-apply).
 
-- **Torn-JSON tolerance**: the same discipline every protocol reader
-  in :mod:`..resilience` follows — an unreadable state file is skipped
-  this poll and retried next poll, never deleted. Writers are atomic
-  (``resilience.atomic_write_json``), so a torn read means a reader
-  raced a crash, and the artifact is still the source of truth.
+- **Torn-read tolerance**: the same discipline every protocol reader
+  in :mod:`..resilience` follows — an unreadable record is skipped
+  this poll and retried next poll, never deleted. The backend's
+  ``get`` returns ``None`` for torn state, so the discipline is now a
+  property of the coordination layer, not of each call site.
 
-One scheduler process owns the ``jobs/`` directory; the spool accepts
+One scheduler process owns the ``jobs/`` namespace; the spool accepts
 concurrent submitters (each spool name is unique by construction).
+The default backend is the byte-compatible POSIX directory (the
+``service_dir`` layout below, unchanged on disk); set
+``KFAC_COORD_BACKEND=tcp`` + ``KFAC_COORD_ADDR`` to run the whole
+queue against the KV server with zero shared filesystem.
 """
 
-import json
 import os
 import random
 import time
 
-from kfac_pytorch_tpu.resilience import atomic_write_json
+from kfac_pytorch_tpu import coord as coord_mod
 from kfac_pytorch_tpu.service.spec import SpecError, validate_spec
 
 #: job lifecycle states. ``lost`` is terminal-with-alarm: the retry
@@ -46,35 +53,20 @@ from kfac_pytorch_tpu.service.spec import SpecError, validate_spec
 STATES = ('queued', 'running', 'done', 'lost')
 
 
-def _read_json(path):
-    """Torn-tolerant read: one immediate retry (the writer may be
-    mid-rename), then None — the caller skips and re-polls."""
-    for _ in range(2):
-        try:
-            with open(path) as f:
-                return json.load(f)
-        except ValueError:
-            time.sleep(0.01)
-            continue
-        except OSError:
-            return None
-    return None
-
-
 class JobQueue:
     """The durable queue under ``service_dir``.
 
-    Layout::
+    Layout (keys on the coordination backend; literal files under
+    ``service_dir`` on the default POSIX backend)::
 
-        service_dir/
-          incoming/spec-*.json     submission spool (any process writes)
-          jobs/job-<id>.json       one state file per job (scheduler owns)
-          rejected/...             invalid submissions, kept for forensics
-          tenants/<tenant>/job-<id>/   per-job namespaces (scheduler)
+        incoming/spec-*.json     submission spool (any process writes)
+        jobs/job-<id>.json       one state record per job (scheduler owns)
+        rejected/...             invalid submissions, kept for forensics
+        tenants/<tenant>/job-<id>/   per-job namespaces (scheduler)
     """
 
     def __init__(self, service_dir, *, trainers=None, wall=time.time,
-                 create=True):
+                 create=True, backend=None):
         """``create=False``: read-only attach (``kfac-serve status``) —
         inspecting a mistyped path must not scaffold a service dir
         there."""
@@ -84,9 +76,16 @@ class JobQueue:
         self.rejected = os.path.join(self.service_dir, 'rejected')
         self.trainers = trainers
         self.wall = wall
+        if backend is not None:
+            self.backend = backend
+        else:
+            # read-only attaches (create=False) skip the chaos wrapper:
+            # no drill should sit between an operator and their status
+            self.backend = coord_mod.backend_from_env(
+                self.service_dir, chaos=create)
         if create:
-            for d in (self.incoming, self.jobs_dir, self.rejected):
-                os.makedirs(d, exist_ok=True)
+            for prefix in ('incoming/', 'jobs/', 'rejected/'):
+                self.backend.ensure_prefix(prefix)
 
     # -- submission (any process) -----------------------------------------
 
@@ -97,54 +96,72 @@ class JobQueue:
         spec = validate_spec(payload, trainers=self.trainers)
         name = (f'spec-{int(self.wall() * 1e6):016d}-{os.getpid()}'
                 f'-{random.randrange(16 ** 6):06x}.json')
-        atomic_write_json(os.path.join(self.incoming, name),
-                          spec.to_dict(), indent=2)
+        self.backend.put(f'incoming/{name}', spec.to_dict(), indent=2)
         return name
 
     # -- ingest (scheduler only) ------------------------------------------
 
-    def _job_path(self, job_id):
-        return os.path.join(self.jobs_dir, f'job-{int(job_id):06d}.json')
+    def _job_key(self, job_id):
+        return f'jobs/job-{int(job_id):06d}.json'
 
-    def _known_origins(self):
-        return {j.get('origin') for j in self.jobs() if j.get('origin')}
+    def _jobs_strict(self):
+        """One complete snapshot of the job records, or None when ANY
+        record is unreadable right now: a key that ``list`` names but
+        ``get_many`` could not return IS a torn record. Ingest derives
+        BOTH its origin dedup and the next id from this single
+        snapshot — deciding either on a blind or inconsistent read
+        would duplicate a job."""
+        keys = set(self.backend.list('jobs/'))
+        records = self.backend.get_many('jobs/')
+        if keys - set(records):
+            return None
+        return [rec for rec in records.values()
+                if isinstance(rec, dict)]
 
     def ingest(self, log=None):
-        """Move spool entries into numbered job files. Returns the list
-        of newly-created job records. Idempotent across crashes: a
-        spool file whose ``origin`` already has a job is cleanup-only,
-        an unreadable spool file waits for the next poll, an INVALID
+        """Move spool entries into numbered job records. Returns the
+        list of newly-created records. Idempotent across crashes: a
+        spool entry whose ``origin`` already has a job is cleanup-only,
+        an unreadable spool entry waits for the next poll, an INVALID
         one (validation is re-run here — the registry may differ from
         the submitter's) moves to ``rejected/`` with the reason."""
         try:
-            names = sorted(os.listdir(self.incoming))
+            keys = sorted(self.backend.list('incoming/'))
         except OSError:
             return []
-        if not names:
+        if not keys:
             return []
-        origins = self._known_origins()
-        next_id = 1 + max((j['id'] for j in self.jobs()), default=0)
+        snapshot = self._jobs_strict()
+        if snapshot is None:
+            return []   # a job record is torn: dedup would be blind
+        origins = {rec['origin'] for rec in snapshot
+                   if rec.get('origin')}
+        next_id = 1 + max((rec['id'] for rec in snapshot
+                           if isinstance(rec.get('id'), int)),
+                          default=0)
         created = []
-        for name in names:
-            spool = os.path.join(self.incoming, name)
+        for key in keys:
+            name = key.split('/', 1)[1]
             if name in origins:
                 # crashed after the job write, before the spool remove
                 try:
-                    os.remove(spool)
+                    self.backend.delete(key)
                 except OSError:
                     pass
                 continue
-            payload = _read_json(spool)
-            if payload is None:
+            got = self.backend.get(key)
+            if got is None:
                 continue  # torn mid-write: re-poll
+            payload = got.value
             try:
                 spec = validate_spec(payload, trainers=self.trainers)
             except SpecError as e:
                 try:
-                    os.replace(spool, os.path.join(self.rejected, name))
-                    atomic_write_json(
-                        os.path.join(self.rejected, name + '.reason'),
-                        {'problems': e.problems})
+                    self.backend.put(f'rejected/{name}', payload,
+                                     indent=2)
+                    self.backend.put(f'rejected/{name}.reason',
+                                     {'problems': e.problems})
+                    self.backend.delete(key)
                 except OSError:
                     pass
                 if log is not None:
@@ -156,9 +173,13 @@ class JobQueue:
                 'submitted': self.wall(), 'attempt': 0, 'requeues': 0,
                 'not_before': 0.0, 'history': [],
             }
-            atomic_write_json(self._job_path(next_id), record, indent=2)
+            # create-only CAS: a concurrent/ghost ingest of the same id
+            # loses cleanly instead of clobbering
+            if self.backend.put_cas(self._job_key(next_id), record,
+                                    None, indent=2) is None:
+                continue  # someone else owns this id; re-poll
             try:
-                os.remove(spool)
+                self.backend.delete(key)
             except OSError:
                 pass  # restart-time origin check completes the cleanup
             created.append(record)
@@ -168,55 +189,71 @@ class JobQueue:
     # -- reads -------------------------------------------------------------
 
     def jobs(self):
-        """All readable job records, id-ordered. Torn files are skipped
-        (retried next poll), never deleted."""
-        try:
-            names = sorted(os.listdir(self.jobs_dir))
-        except OSError:
-            return []
-        out = []
-        for name in names:
-            if not (name.startswith('job-') and name.endswith('.json')):
-                continue
-            rec = _read_json(os.path.join(self.jobs_dir, name))
-            if isinstance(rec, dict) and isinstance(rec.get('id'), int):
-                out.append(rec)
+        """All readable job records, id-ordered. Torn records are
+        skipped (retried next poll), never deleted. A backend FAILURE
+        propagates — an empty answer and an unavailable backend are
+        different things, and ``ingest``'s origin dedup (or ``recover``)
+        deciding on a blind read would duplicate or drop jobs."""
+        records = self.backend.get_many('jobs/')
+        out = [rec for rec in records.values()
+               if isinstance(rec, dict) and isinstance(rec.get('id'),
+                                                       int)]
         return sorted(out, key=lambda r: r['id'])
 
     def read(self, job_id):
-        return _read_json(self._job_path(job_id))
+        got = self.backend.get(self._job_key(job_id))
+        return None if got is None else got.value
 
     # -- transitions (scheduler only) --------------------------------------
 
     def transition(self, record, to_state, **fields):
         """Apply one state transition computed against ``record``.
 
-        The epoch CAS: the on-disk epoch must equal ``record['epoch']``
-        or the transition is REFUSED (returns None) — the record the
-        caller reasoned from is stale, someone already moved the job.
-        This is what bounds a fenced generation's requeue to exactly
-        once: every observer of the dead generation holds the same
-        epoch, the first transition bumps it, the rest no-op. On
-        success returns the new record (epoch + 1, history appended).
+        The epoch CAS: the stored epoch must equal ``record['epoch']``
+        — AND the write itself is a backend ``put_cas`` against the
+        version that epoch was read at — or the transition is REFUSED
+        (returns None): the record the caller reasoned from is stale,
+        someone already moved the job. This is what bounds a fenced
+        generation's requeue to exactly once: every observer of the
+        dead generation holds the same epoch, the first transition
+        bumps it, the rest no-op. On success returns the new record
+        (epoch + 1, history appended).
         """
         if to_state not in STATES:
             raise ValueError(f'unknown state {to_state!r} '
                              f'(states: {STATES})')
-        on_disk = self.read(record['id'])
-        if on_disk is None or on_disk.get('epoch') != record.get('epoch'):
-            return None
-        new = dict(on_disk)
-        new.update(fields)
-        new['epoch'] = on_disk['epoch'] + 1
-        new['state'] = to_state
-        new.setdefault('history', [])
-        new['history'] = list(new['history']) + [{
-            'wall': self.wall(), 'from': on_disk['state'],
-            'to': to_state, 'epoch': new['epoch'],
-            **{k: v for k, v in fields.items()
-               if isinstance(v, (str, int, float, bool))}}]
-        atomic_write_json(self._job_path(record['id']), new, indent=2)
-        return new
+        key = self._job_key(record['id'])
+        # bounded CAS loop: a conflict re-reads and re-checks the EPOCH.
+        # Epoch moved -> someone genuinely transitioned this observation
+        # first: refuse (the exactly-once contract). Epoch unchanged ->
+        # the conflict was spurious (a torn read raced, or the chaos
+        # drill injected one): retry — a misbehaving backend must not
+        # silently swallow a requeue. A TORN read retries for the same
+        # reason: job records are never deleted, so an unreadable one is
+        # mid-write (or injected), not gone — returning None on it would
+        # misreport "someone else moved the job" and orphan the requeue.
+        for _ in range(4):
+            got = self.backend.get(key)
+            if got is None:
+                continue
+            on_disk = got.value
+            if not isinstance(on_disk, dict) \
+                    or on_disk.get('epoch') != record.get('epoch'):
+                return None
+            new = dict(on_disk)
+            new.update(fields)
+            new['epoch'] = on_disk['epoch'] + 1
+            new['state'] = to_state
+            new.setdefault('history', [])
+            new['history'] = list(new['history']) + [{
+                'wall': self.wall(), 'from': on_disk['state'],
+                'to': to_state, 'epoch': new['epoch'],
+                **{k: v for k, v in fields.items()
+                   if isinstance(v, (str, int, float, bool))}}]
+            if self.backend.put_cas(key, new, got.version,
+                                    indent=2) is not None:
+                return new
+        return None
 
     def claim(self, record, **fields):
         """queued -> running (attempt bumped)."""

@@ -59,9 +59,10 @@ import subprocess
 import sys
 import time
 
-from kfac_pytorch_tpu.resilience import atomic_write_json
-from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK
-from kfac_pytorch_tpu.service.queue import JobQueue, _read_json
+from kfac_pytorch_tpu import coord as coord_mod
+from kfac_pytorch_tpu.coord import CoordGiveUp, RC_COORD_LOST
+from kfac_pytorch_tpu.resilience.retry import PollPacer, REAL_CLOCK
+from kfac_pytorch_tpu.service.queue import JobQueue
 from kfac_pytorch_tpu.service.spec import TRAINERS, validate_spec
 
 log = logging.getLogger(__name__)
@@ -69,7 +70,8 @@ log = logging.getLogger(__name__)
 #: the exit-code grammar the whole resilience stack speaks (supervisor
 #: STOP_RC_NAMES inverted, plus 0); anything else nonzero is a crash.
 RC_CLASSES = {0: 'done', 113: 'crash', 114: 'hang', 115: 'peer_dead',
-              116: 'join_failed', 117: 'fenced'}
+              116: 'join_failed', 117: 'fenced',
+              RC_COORD_LOST: 'coord_lost'}
 
 
 def classify_rc(rc):
@@ -131,6 +133,64 @@ class PortAllocator:
         self._claims.pop(job_id, None)
 
 
+class Launcher:
+    """The remote-launch seam: how one rank's supervisor command runs
+    on its capacity host.
+
+    The default (no ``prefix``) is today's behavior — a controller-node
+    ``Popen``. A ``hosts.json`` entry may instead carry a command
+    prefix (an ``ssh``-style argv template; ``{host}`` substitutes the
+    host name)::
+
+        {"hosts": {"h0": 2,
+                   "r1": {"slots": 2,
+                          "launch": ["ssh", "{host}", "--"]}}}
+
+    A prefixed launch cannot inherit the controller's process
+    environment across the ssh boundary, so :meth:`render` RE-EXPORTS
+    the job environment explicitly as ``env KEY=VALUE`` argv ahead of
+    the supervisor command: every ``KFAC_*`` / ``JAX_*`` variable (the
+    whole framework contract — including ones the controller merely
+    inherited, like ``KFAC_COORD_BACKEND``/``KFAC_COORD_ADDR``, which
+    the remote side must still see) plus anything else the service set
+    or changed relative to the controller's own environment.
+
+    What the prefix does NOT translate: the interpreter path and the
+    working directory. The rendered command runs the CONTROLLER's
+    ``sys.executable`` with module imports resolved on the remote host
+    — the remote machines must carry the same image/venv (the same
+    interpreter path with ``kfac_pytorch_tpu`` importable), or the
+    prefix should point at a wrapper that ``cd``-and-``exec``s into
+    the right environment. Per-tenant namespace paths in the argv are
+    controller paths and must be on storage both sides mount.
+    """
+
+    def __init__(self, host, prefix=None):
+        self.host = str(host)
+        self.prefix = [str(t) for t in prefix] if prefix else None
+
+    def render(self, argv, env, base_env=None):
+        """-> ``(final_argv, popen_env)``. Local: argv untouched, env
+        passed to Popen. Remote: prefixed argv with the re-export
+        inline, ``popen_env`` None (the local ssh process just
+        inherits the controller's)."""
+        if not self.prefix:
+            return list(argv), env
+        import shlex
+        base = os.environ if base_env is None else base_env
+        forward = {k: env[k] for k in sorted(env)
+                   if k.startswith(('KFAC_', 'JAX_'))
+                   or base.get(k) != env.get(k)}
+        prefix = [t.replace('{host}', self.host) for t in self.prefix]
+        # ssh flattens argv into one remote shell line: every value and
+        # command token must be quoted or a ';' in (say)
+        # KFAC_FAULT_COORD_WINDOWS splits the remote command in two
+        return (prefix + ['env']
+                + [f'{k}={shlex.quote(str(v))}'
+                   for k, v in forward.items()]
+                + [shlex.quote(str(t)) for t in argv], None)
+
+
 class _Run:
     """One admitted job's live half: processes, placement, namespace."""
 
@@ -161,8 +221,14 @@ class AdmissionController:
         self.trainers = dict(TRAINERS)
         if trainers:
             self.trainers.update(trainers)
+        # one coordination backend for the whole service: queue records,
+        # hosts.json capacity pool, spool — env-selected (POSIX default,
+        # KV server under KFAC_COORD_BACKEND=tcp), chaos-wrapped when
+        # the KFAC_FAULT_COORD_* drill is armed, per-op retried
+        self.coord = coord_mod.backend_from_env(self.service_dir,
+                                                clock=clock)
         self.queue = JobQueue(self.service_dir, trainers=self.trainers,
-                              wall=wall)
+                              wall=wall, backend=self.coord)
         self.repo_root = repo_root or os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         self.ports = PortAllocator(base=base_port, stride=port_stride)
@@ -183,6 +249,7 @@ class AdmissionController:
         self._stop = False
         self._warned_unplaceable = set()
         self.hosts_path = os.path.join(self.service_dir, 'hosts.json')
+        self.launchers = {}          # host name -> Launcher
         self.hosts = self._init_hosts(hosts)
 
     # -- capacity ----------------------------------------------------------
@@ -192,22 +259,46 @@ class AdmissionController:
         if on_disk is not None:
             return on_disk
         hosts = dict(hosts) if hosts else {'h0': 1}
-        atomic_write_json(self.hosts_path, {'hosts': hosts}, indent=2)
+        self.coord.put('hosts.json', {'hosts': hosts}, indent=2)
+        self.launchers = {name: Launcher(name) for name in hosts}
         return hosts
 
     def _read_hosts_file(self):
-        doc = _read_json(self.hosts_path)
+        """Slot map from the live ``hosts.json`` key (None when absent
+        or unusable). Entries are either a bare slot count (controller-
+        node exec, the default) or ``{"slots": n, "launch": [...]}`` —
+        the :class:`Launcher` seam; the launcher map refreshes as a
+        side effect so a live edit can re-home a host."""
+        got = self.coord.get('hosts.json')
+        doc = None if got is None else got.value
         if not isinstance(doc, dict):
             return None
         raw = doc.get('hosts')
         if not isinstance(raw, dict) or not raw:
             return None
-        out = {}
-        for name, slots in raw.items():
-            if isinstance(name, str) and isinstance(slots, int) \
-                    and slots > 0:
+        out, launchers = {}, {}
+        for name, entry in raw.items():
+            if not isinstance(name, str):
+                continue
+            slots, prefix = entry, None
+            if isinstance(entry, dict):
+                slots = entry.get('slots')
+                prefix = entry.get('launch') or None
+                if prefix is not None and not (
+                        isinstance(prefix, list)
+                        and all(isinstance(t, str) for t in prefix)):
+                    self.log.error(
+                        'service: hosts.json host %s has a malformed '
+                        '"launch" prefix (%r) — entry ignored', name,
+                        prefix)
+                    continue
+            if isinstance(slots, int) and slots > 0:
                 out[name] = slots
-        return out or None
+                launchers[name] = Launcher(name, prefix)
+        if not out:
+            return None
+        self.launchers = launchers
+        return out
 
     def _refresh_hosts(self):
         """Adopt a live capacity edit; a lost host kills + requeues its
@@ -362,11 +453,15 @@ class AdmissionController:
         pids = []
         try:
             for rank in sorted(ranks):
-                argv = self._rank_argv(claimed, ns, rank)
+                host = ranks[rank]
+                launcher = self.launchers.get(host) or Launcher(host)
+                argv, penv = launcher.render(
+                    self._rank_argv(claimed, ns, rank), env,
+                    base_env=self.env)
                 out = open(os.path.join(
                     ns['logs'], f'host{rank}.out'), 'ab')
                 run.files.append(out)
-                proc = self.popen(argv, env=env, cwd=self.repo_root,
+                proc = self.popen(argv, env=penv, cwd=self.repo_root,
                                   stdout=out, stderr=subprocess.STDOUT,
                                   start_new_session=True)
                 run.procs[rank] = proc
@@ -532,25 +627,42 @@ class AdmissionController:
         """Loop until stopped. ``drain``: exit once the queue is empty
         and nothing is running (the drill/CI mode). ``max_seconds``:
         hard bound. On exit every live child is killed and requeued so
-        the NEXT scheduler finds a consistent queue."""
-        self.queue.recover(log=self.log)
+        the NEXT scheduler finds a consistent queue. A coordination-
+        backend give-up (retry budget spent against a dead lease
+        filesystem / KV server) exits :data:`RC_COORD_LOST` — loudly,
+        with children killed, instead of spinning blind."""
         start = self.clock.monotonic()
+        # jitter-capped pacing instead of a bare fixed sleep: idle
+        # cycles relax toward the cap, a fleet of schedulers against
+        # one backend decorrelates, and the waited total is accounted
+        pace = PollPacer.for_period(self.poll_period, clock=self.clock)
         try:
+            self.queue.recover(log=self.log)
             while not self._stop:
                 busy = self.step()
-                if drain and not busy and not os.listdir(
-                        self.queue.incoming):
+                if drain and not busy and not self.queue.backend.list(
+                        'incoming/'):
                     return 0
                 if (max_seconds is not None
                         and self.clock.monotonic() - start
                         >= max_seconds):
                     return 0 if drain and not busy else 1
-                self.clock.sleep(self.poll_period)
+                if busy:
+                    pace.reset()
+                pace.sleep()
+        except CoordGiveUp as e:
+            self.log.error(
+                'service: coordination backend lost — %s. Killing '
+                'children and exiting rc=%d (poll_wait_s=%d); restart '
+                'kfac-serve once the backend is back. [resilience: '
+                'coord_lost=1]', e, RC_COORD_LOST, int(pace.waited))
+            return RC_COORD_LOST
         finally:
             for run in list(self.running.values()):
                 self._kill_run(run)
-                self._requeue(run, rc=-int(_signal.SIGKILL),
-                              klass='scheduler_stop', charge=False)
+                with contextlib.suppress(OSError):
+                    self._requeue(run, rc=-int(_signal.SIGKILL),
+                                  klass='scheduler_stop', charge=False)
         return 0
 
     def stop(self):

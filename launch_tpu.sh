@@ -116,6 +116,28 @@ if [ -n "$JAX_COORDINATOR_ADDRESS" ]; then
   export KFAC_TPU_MULTIHOST=1
 fi
 
+# Coordination backend (kfac_pytorch_tpu/coord/, README "Coordination
+# backends"): where the pod protocols — shrink/grow barrier claims,
+# lineage fencing, heartbeat file-leases, join/done markers, the
+# kfac-serve queue — keep their state.
+#   KFAC_COORD_BACKEND  posix (default: the shared lease DIRECTORY,
+#                       byte-compatible protocol files) | tcp (an
+#                       etcd-style KV server, no shared filesystem —
+#                       run one with `kfac-coord-serve --port 8479`)
+#   KFAC_COORD_ADDR     host:port of the KV server (required for tcp)
+# Backend fault drills: KFAC_FAULT_COORD_* (seed/fail/torn/stale/cas/
+# lease_expire/windows — faults.py STRICT from_env).
+if [ -n "$KFAC_COORD_BACKEND" ]; then
+  case "$KFAC_COORD_BACKEND" in
+    posix) export KFAC_COORD_BACKEND ;;
+    tcp)
+      : "${KFAC_COORD_ADDR:?KFAC_COORD_BACKEND=tcp needs KFAC_COORD_ADDR (host:port of a kfac-coord-serve KV server)}"
+      export KFAC_COORD_BACKEND KFAC_COORD_ADDR ;;
+    *) echo "launch_tpu.sh: KFAC_COORD_BACKEND must be posix|tcp," \
+            "got '$KFAC_COORD_BACKEND'" >&2; exit 1 ;;
+  esac
+fi
+
 # Training service (kfac-serve, kfac_pytorch_tpu/service/): when this
 # launch is one tenant job of the multi-tenant service, the scheduler
 # exports the per-job namespace env — pass it through so every child

@@ -1,0 +1,890 @@
+"""The pluggable coordination backend (kfac_pytorch_tpu/coord/).
+
+Pins the tentpole contracts with NO subprocesses (the real-process
+drills live in tests/test_pod_chaos.py / test_service_chaos.py behind
+-m slow):
+
+1. Both backends honor the primitive contract — atomic puts, versioned
+   CAS (create-only / expected-version / ANY), prefix list/scan,
+   delete(-prefix), poll-based watch — and the POSIX backend is
+   BYTE-compatible with the atomic-rename protocol files everything
+   already reads.
+2. The TCP KV backend is a real non-POSIX store: namespace isolation,
+   server-enforced TTL leases, CoordTimeout (never a hang) when the
+   server is gone.
+3. ChaosBackend's faults are seeded and deterministic — op failures,
+   outage windows, torn/stale reads, spurious CAS conflicts, premature
+   lease expiry — and the strict faults.from_env surface rejects
+   typo'd drills.
+4. RetryingBackend rides out transients with bounded jittered backoff
+   and gives up LOUDLY (CoordGiveUp + the machine-greppable form).
+5. The queue's epoch CAS stays exactly-once on both backends, even
+   under injected coordination faults; the shrink barrier still fences
+   the minority instead of split-braining on the KV backend.
+6. The static gate: no protocol code outside coord/ touches lease-dir
+   files directly anymore — the lint that keeps the abstraction from
+   rotting.
+"""
+
+import ast
+import json
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from kfac_pytorch_tpu import coord
+from kfac_pytorch_tpu.coord import (
+    ANY, ChaosBackend, CoordFaultConfig, CoordGiveUp, CoordTimeout,
+    PosixDirBackend, RetryingBackend, TcpKvBackend, TcpKvServer)
+from kfac_pytorch_tpu.resilience import atomic_write_json
+from kfac_pytorch_tpu.resilience.retry import ManualClock, RetryPolicy
+
+pytestmark = pytest.mark.core
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope='module')
+def kv_server():
+    srv = TcpKvServer('127.0.0.1', 0)
+    yield srv
+    srv.close()
+
+
+@pytest.fixture(params=['posix', 'tcp'])
+def backend(request, tmp_path, kv_server):
+    if request.param == 'posix':
+        return PosixDirBackend(str(tmp_path / 'root'))
+    return TcpKvBackend(('127.0.0.1', kv_server.port),
+                        namespace=str(tmp_path / 'root'))
+
+
+# ---------------------------------------------------------------------------
+# the primitive contract, on both backends
+# ---------------------------------------------------------------------------
+
+def test_put_get_roundtrip_and_versions(backend):
+    assert backend.get('a/x.json') is None
+    v1 = backend.put('a/x.json', {'host': 1, 'seq': 1})
+    got = backend.get('a/x.json')
+    assert got.value == {'host': 1, 'seq': 1}
+    assert got.version == v1
+    v2 = backend.put('a/x.json', {'host': 1, 'seq': 2})
+    assert v2 != v1
+    assert backend.get('a/x.json').value['seq'] == 2
+
+
+def test_put_cas_expected_version(backend):
+    backend.put('job.json', {'epoch': 0})
+    got = backend.get('job.json')
+    # stale token refused, nothing applied
+    assert backend.put_cas('job.json', {'epoch': 9}, 'bogus') is None
+    assert backend.get('job.json').value == {'epoch': 0}
+    # matching token applies and returns a NEW version
+    v2 = backend.put_cas('job.json', {'epoch': 1}, got.version)
+    assert v2 is not None and v2 != got.version
+    # the consumed token is now stale
+    assert backend.put_cas('job.json', {'epoch': 2},
+                           got.version) is None
+    assert backend.get('job.json').value == {'epoch': 1}
+
+
+def test_put_cas_create_only_and_any(backend):
+    assert backend.put_cas('new.json', {'n': 1}, None) is not None
+    assert backend.put_cas('new.json', {'n': 2}, None) is None
+    assert backend.get('new.json').value == {'n': 1}
+    assert backend.put_cas('new.json', {'n': 3}, ANY) is not None
+    assert backend.get('new.json').value == {'n': 3}
+
+
+def test_list_prefix_and_get_many(backend):
+    backend.put('shrink-gen3/survivor-0.json', {'host': 0})
+    backend.put('shrink-gen3/survivor-2.json', {'host': 2})
+    backend.put('grow-gen4/member-1.json', {'host': 1})
+    backend.put('lineage.json', {'lineage': 2})
+    assert backend.list('shrink-gen3/') == [
+        'shrink-gen3/survivor-0.json', 'shrink-gen3/survivor-2.json']
+    many = backend.get_many('shrink-gen3/')
+    assert {p['host'] for p in many.values()} == {0, 2}
+    # a bare prefix scans across "directories"
+    assert 'grow-gen4/member-1.json' in backend.list('grow-gen')
+
+
+def test_delete_and_delete_prefix(backend):
+    backend.put('grow-gen2/member-0.json', {'host': 0})
+    backend.put('grow-gen2/member-1.json', {'host': 1})
+    backend.put('keep.json', {})
+    assert backend.delete('grow-gen2/member-0.json') is True
+    assert backend.delete('grow-gen2/member-0.json') is False
+    assert backend.delete_prefix('grow-gen2/') == 1
+    assert backend.list('grow-gen2/') == []
+    assert backend.get('keep.json') is not None
+
+
+def test_watch_reports_puts_and_deletes(backend):
+    backend.put('w/a.json', {'v': 1})
+    w = backend.watch('w/')
+    assert w.poll() == {'w/a.json': 'put'}
+    assert w.poll() == {}
+    backend.put('w/a.json', {'v': 2})
+    backend.put('w/b.json', {'v': 1})
+    changes = w.poll()
+    assert changes == {'w/a.json': 'put', 'w/b.json': 'put'}
+    backend.delete('w/b.json')
+    assert w.poll() == {'w/b.json': 'delete'}
+
+
+def test_bad_keys_rejected(backend):
+    for bad in ('/abs', 'a/../b', '', 'a//b'):
+        with pytest.raises(ValueError):
+            backend.put(bad, {})
+
+
+# ---------------------------------------------------------------------------
+# POSIX specifics: byte-compat + torn reads
+# ---------------------------------------------------------------------------
+
+def test_posix_bytes_identical_to_atomic_write_json(tmp_path):
+    """The rolling-upgrade contract: the backend's files are the SAME
+    bytes the old direct writers produced, so mixed-version pods and
+    every existing drill grammar keep working."""
+    b = PosixDirBackend(str(tmp_path))
+    payload = {'host': 1, 'seq': 7, 'addr': None, 'wall': 123.5}
+    b.put('hb-1.json', payload)
+    atomic_write_json(str(tmp_path / 'ref.json'), payload)
+    assert (tmp_path / 'hb-1.json').read_bytes() \
+        == (tmp_path / 'ref.json').read_bytes()
+    # and the indent=2 form (queue records) matches too
+    b.put('job.json', payload, indent=2)
+    atomic_write_json(str(tmp_path / 'ref2.json'), payload, indent=2)
+    assert (tmp_path / 'job.json').read_bytes() \
+        == (tmp_path / 'ref2.json').read_bytes()
+
+
+def test_posix_torn_read_returns_none_then_recovers(tmp_path):
+    b = PosixDirBackend(str(tmp_path))
+    (tmp_path / 'claim.json').write_text('{"host": 1, "ad')  # torn
+    assert b.get('claim.json') is None
+    b.put('claim.json', {'host': 1})
+    assert b.get('claim.json').value == {'host': 1}
+
+
+def test_posix_does_not_scaffold_root_on_reads(tmp_path):
+    missing = tmp_path / 'nope'
+    b = PosixDirBackend(str(missing))
+    assert b.get('x.json') is None and b.list('') == []
+    assert not missing.exists()
+
+
+# ---------------------------------------------------------------------------
+# TCP KV specifics: namespaces, TTL leases, dead server
+# ---------------------------------------------------------------------------
+
+def test_tcpkv_namespace_isolation(kv_server):
+    a = TcpKvBackend(('127.0.0.1', kv_server.port), namespace='/pod/a')
+    b = TcpKvBackend(('127.0.0.1', kv_server.port), namespace='/pod/b')
+    a.put('hb-0.json', {'seq': 1})
+    assert b.get('hb-0.json') is None
+    assert b.list('') == []
+    assert a.get('hb-0.json').value == {'seq': 1}
+
+
+def test_tcpkv_ttl_lease_expires_server_side(kv_server):
+    b = TcpKvBackend(('127.0.0.1', kv_server.port),
+                     namespace='/ttl-test')
+    lease = b.lease('hb-0.json', 0.2, {'seq': 1})
+    assert b.get('hb-0.json') is not None
+    lease.refresh({'seq': 2})   # refresh restarts the TTL
+    time.sleep(0.12)
+    assert b.get('hb-0.json').value == {'seq': 2}
+    time.sleep(0.35)
+    assert b.get('hb-0.json') is None       # expired: owner went silent
+    assert b.list('') == []                 # and it is gone from scans
+
+
+def test_tcpkv_dead_server_raises_coord_timeout():
+    srv = TcpKvServer('127.0.0.1', 0)
+    port = srv.port
+    srv.close()
+    b = TcpKvBackend(('127.0.0.1', port), namespace='/x', timeout=0.3)
+    with pytest.raises(CoordTimeout):
+        b.get('anything.json')
+    with pytest.raises(CoordTimeout):
+        b.put('anything.json', {})
+
+
+def test_backend_from_env_selection(tmp_path, kv_server, monkeypatch):
+    monkeypatch.delenv(coord.ENV_BACKEND, raising=False)
+    b = coord.backend_from_env(str(tmp_path), retry=False)
+    assert isinstance(b, PosixDirBackend)
+    monkeypatch.setenv(coord.ENV_BACKEND, 'tcp')
+    with pytest.raises(ValueError, match='KFAC_COORD_ADDR'):
+        coord.backend_from_env(str(tmp_path), retry=False)
+    monkeypatch.setenv(coord.ENV_ADDR, f'127.0.0.1:{kv_server.port}')
+    b = coord.backend_from_env(str(tmp_path), retry=False)
+    assert isinstance(b, TcpKvBackend)
+    assert isinstance(coord.backend_from_env(str(tmp_path)),
+                      RetryingBackend)
+    monkeypatch.setenv(coord.ENV_BACKEND, 'zookeeper')
+    with pytest.raises(ValueError, match='posix.*tcp|tcp.*posix'):
+        coord.backend_from_env(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# ChaosBackend: seeded, deterministic, each fault lane real
+# ---------------------------------------------------------------------------
+
+def _chaos(tmp_path, name='c', **cfg):
+    return ChaosBackend(PosixDirBackend(str(tmp_path / name)),
+                        CoordFaultConfig(**cfg))
+
+
+def test_chaos_schedule_is_deterministic(tmp_path):
+    def run(name):
+        b = _chaos(tmp_path, name, seed=11, fail=0.4, torn=0.3)
+        outcomes = []
+        for i in range(30):
+            try:
+                b.put('k.json', {'i': i})
+                outcomes.append('put')
+            except CoordTimeout:
+                outcomes.append('fail')
+            got = None
+            try:
+                got = b.get('k.json')
+            except CoordTimeout:
+                outcomes.append('gfail')
+            outcomes.append('none' if got is None else 'val')
+        return outcomes, list(b.trace)
+    o1, t1 = run('a')
+    o2, t2 = run('b')
+    assert o1 == o2
+    assert [e[:2] for e in t1] == [e[:2] for e in t2]
+    assert 'fail' in o1 and 'none' in o1 and 'val' in o1
+
+
+def test_chaos_outage_window_fails_every_op(tmp_path):
+    now = time.time()
+    b = _chaos(tmp_path, seed=1, windows=((0.0, 3600.0),), t0=now)
+    for op in (lambda: b.get('x.json'), lambda: b.put('x.json', {}),
+               lambda: b.list(''), lambda: b.delete('x.json')):
+        with pytest.raises(CoordTimeout):
+            op()
+    assert b.counts['window'] >= 4
+    # outside the window everything works
+    b2 = _chaos(tmp_path, 'c2', seed=1, windows=((1000.0, 2000.0),),
+                t0=now)
+    b2.put('x.json', {'ok': 1})
+    assert b2.get('x.json').value == {'ok': 1}
+
+
+def test_chaos_torn_read_presents_as_skip(tmp_path):
+    b = _chaos(tmp_path, seed=5, torn=1.0)
+    b.put('x.json', {'v': 1})
+    assert b.get('x.json') is None
+    assert b.counts['torn'] >= 1
+
+
+def test_chaos_stale_read_returns_previous_value(tmp_path):
+    b = _chaos(tmp_path, seed=2, stale=1.0)
+    b.put('x.json', {'v': 1})
+    first = b.get('x.json')          # no previous value yet: fresh
+    assert first.value == {'v': 1}
+    b.put('x.json', {'v': 2})
+    assert b.get('x.json').value == {'v': 1}   # stale: the OLD value
+    assert b.counts['stale'] >= 1
+
+
+def test_chaos_spurious_cas_conflict_not_applied(tmp_path):
+    b = _chaos(tmp_path, seed=3, cas=1.0)
+    inner = b.inner
+    inner.put('job.json', {'epoch': 0})
+    got = inner.get('job.json')
+    assert b.put_cas('job.json', {'epoch': 1}, got.version) is None
+    # NOT applied: the caller re-reads and re-derives, nothing moved
+    assert inner.get('job.json').value == {'epoch': 0}
+    assert b.counts['cas_conflict'] == 1
+
+
+def test_chaos_premature_lease_expiry_drops_publish(tmp_path):
+    b = _chaos(tmp_path, seed=4, lease_expire=1.0)
+    b.put('hb-0.json', {'seq': 1}, ttl=5.0)    # a lease publish: dropped
+    assert b.inner.get('hb-0.json') is None
+    assert b.counts['lease_expire'] == 1
+    b.put('claim.json', {'host': 0})           # non-lease put: untouched
+    assert b.inner.get('claim.json') is not None
+
+
+def test_chaos_env_contract_is_strict(monkeypatch):
+    from kfac_pytorch_tpu.coord import chaos
+    monkeypatch.setenv('KFAC_FAULT_COORD_SEED', '7')
+    monkeypatch.setenv('KFAC_FAULT_COORD_FAIL', '0.25')
+    monkeypatch.setenv('KFAC_FAULT_COORD_WINDOWS', '5:10;20:30')
+    cfg = chaos.from_env()
+    assert cfg.seed == 7 and cfg.fail == 0.25
+    assert cfg.windows == ((5.0, 10.0), (20.0, 30.0))
+    monkeypatch.setenv('KFAC_FAULT_COORD_FAIL', '1.5')
+    with pytest.raises(ValueError):
+        chaos.from_env()
+    monkeypatch.setenv('KFAC_FAULT_COORD_FAIL', '0.1')
+    monkeypatch.setenv('KFAC_FAULT_COORD_WINDOWS', '10:5')
+    with pytest.raises(ValueError):
+        chaos.from_env()
+
+
+def test_faults_from_env_registers_coord_drills(monkeypatch):
+    faults = pytest.importorskip('kfac_pytorch_tpu.faults')
+    monkeypatch.setenv('KFAC_FAULT_COORD_SEED', '1')
+    monkeypatch.setenv('KFAC_FAULT_COORD_CAS', '0.5')
+    faults.from_env()  # known + well-formed: accepted
+    monkeypatch.setenv('KFAC_FAULT_COORD_CASS', '0.5')  # typo
+    with pytest.raises(ValueError, match='KFAC_FAULT_COORD_CASS'):
+        faults.from_env()
+
+
+# ---------------------------------------------------------------------------
+# RetryingBackend: ride out transients, give up loudly
+# ---------------------------------------------------------------------------
+
+def _retrying(inner, attempts=6):
+    clock = ManualClock()
+    rb = RetryingBackend(
+        inner, policy=RetryPolicy(attempts=attempts, base_delay=0.05,
+                                  max_delay=0.5,
+                                  retry_on=(CoordTimeout,)),
+        clock=clock, rng=random.Random(0))
+    return rb, clock
+
+
+def test_retrying_backend_rides_out_transients(tmp_path):
+    from kfac_pytorch_tpu import resilience
+    resilience.counters.reset()
+    b = _chaos(tmp_path, seed=11, fail=0.5)
+    rb, clock = _retrying(b)
+    for i in range(10):
+        rb.put('k.json', {'i': i})
+    assert rb.get('k.json').value == {'i': 9}
+    stats = rb.stats()
+    assert stats['retries'] >= 1 and stats['gave_up'] == 0
+    assert stats['wait_s'] > 0 and clock.sleeps
+    assert resilience.counters.get('coord_retries') == stats['retries']
+
+
+def test_retrying_backend_gives_up_loudly(tmp_path, caplog):
+    b = _chaos(tmp_path, seed=1, fail=1.0)
+    rb, _ = _retrying(b, attempts=3)
+    with caplog.at_level('ERROR', logger='kfac_pytorch_tpu.coord.base'):
+        with pytest.raises(CoordGiveUp):
+            rb.get('z.json')
+    assert rb.stats()['gave_up'] == 1
+    text = '\n'.join(r.getMessage() for r in caplog.records)
+    assert 'coord: giving up op=get' in text
+    assert '[resilience: coord_gave_up=1]' in text
+    # the incident grammar picks the give-up out of a scraped log
+    from kfac_pytorch_tpu.resilience.incident import IncidentReport
+    report = IncidentReport().scrape_lines(text.splitlines())
+    assert any(e['kind'] == 'coord_gave_up' for e in report.events)
+
+
+def test_cas_conflict_is_an_answer_not_a_retry(tmp_path):
+    b = _chaos(tmp_path, seed=3, cas=1.0)
+    rb, clock = _retrying(b)
+    b.inner.put('j.json', {'epoch': 0})
+    got = b.inner.get('j.json')
+    assert rb.put_cas('j.json', {'epoch': 1}, got.version) is None
+    assert not clock.sleeps  # no backoff burned on a semantic answer
+
+
+# ---------------------------------------------------------------------------
+# heartbeat leases over the backend
+# ---------------------------------------------------------------------------
+
+def test_backend_lease_transport_over_kv(kv_server, tmp_path):
+    from kfac_pytorch_tpu.resilience.heartbeat import (
+        BackendLeaseTransport, PeerHeartbeat)
+    ns = str(tmp_path / 'pod')
+    t0 = BackendLeaseTransport(
+        TcpKvBackend(('127.0.0.1', kv_server.port), namespace=ns),
+        0, prefix='sup')
+    t1 = BackendLeaseTransport(
+        TcpKvBackend(('127.0.0.1', kv_server.port), namespace=ns),
+        1, prefix='sup')
+    clock = ManualClock()
+    deaths = []
+    mon = PeerHeartbeat(t0, 0, 2, interval=1.0, deadline=5.0,
+                        startup_grace=2.0, clock=clock.monotonic,
+                        on_dead=lambda p, i: deaths.append(p))
+    t1.publish({'host': 1, 'seq': 1, 'gen': 0, 'pid': 99})
+    mon.poll_once()
+    assert not deaths
+    for seq in range(2, 5):                   # advancing: alive
+        t1.publish({'host': 1, 'seq': seq, 'gen': 0, 'pid': 99})
+        clock.sleep(2.0)
+        mon.poll_once()
+    assert not deaths
+    clock.sleep(6.0)                          # silence past the deadline
+    mon.poll_once()
+    assert deaths == [1]
+
+
+# ---------------------------------------------------------------------------
+# the queue's epoch CAS under injected coordination faults
+# ---------------------------------------------------------------------------
+
+def _queue(backend, wall=None):
+    from kfac_pytorch_tpu.service.queue import JobQueue
+    return JobQueue('/unused-root', backend=backend,
+                    **({'wall': wall} if wall else {}))
+
+
+def _spec(**over):
+    base = {'tenant': 'alice', 'trainer': 'cifar10_resnet',
+            'args': ['--epochs', '1'], 'hosts': 1, 'retry_budget': 2}
+    base.update(over)
+    return base
+
+
+def test_queue_lifecycle_on_kv_backend(kv_server, tmp_path):
+    q = _queue(TcpKvBackend(('127.0.0.1', kv_server.port),
+                            namespace=str(tmp_path / 'svc')))
+    q.submit(_spec())
+    created = q.ingest()
+    assert [r['id'] for r in created] == [1]
+    assert q.backend.list('incoming/') == []     # spool consumed
+    running = q.claim(created[0])
+    assert running['state'] == 'running' and running['epoch'] == 1
+    done = q.mark_done(running)
+    assert done['state'] == 'done'
+    assert q.counts()['done'] == 1
+
+
+@pytest.mark.parametrize('flavor', ['posix', 'tcp'])
+def test_queue_requeue_exactly_once_per_observation(
+        flavor, tmp_path, kv_server):
+    if flavor == 'posix':
+        backend = PosixDirBackend(str(tmp_path / 'svc'))
+    else:
+        backend = TcpKvBackend(('127.0.0.1', kv_server.port),
+                               namespace=str(tmp_path / 'svc'))
+    q = _queue(backend)
+    q.submit(_spec())
+    rec = q.ingest()[0]
+    running = q.claim(rec)
+    # two observers of the same dead generation hold the SAME record:
+    # the first requeue bumps the epoch, the second must no-op
+    first = q.requeue(dict(running), rc=117, reason='fenced')
+    second = q.requeue(dict(running), rc=117, reason='fenced')
+    assert first is not None and second is None
+    final = q.read(rec['id'])
+    assert final['state'] == 'queued' and final['requeues'] == 1
+
+
+def test_queue_epoch_cas_survives_spurious_conflicts(tmp_path):
+    """A chaos-injected CAS conflict must not swallow a transition:
+    the bounded re-read/retry loop applies it exactly once (the epoch
+    check still refuses genuinely stale observations)."""
+    chaos = ChaosBackend(PosixDirBackend(str(tmp_path / 'svc')),
+                         CoordFaultConfig(seed=9, cas=0.5))
+    q = _queue(chaos)
+    q.submit(_spec())
+    created = []
+    for _ in range(10):   # a conflicted create just re-polls next cycle
+        created = q.ingest()
+        if created:
+            break
+    rec = created[0]
+    # an exhausted CAS loop returns None WITHOUT applying; the caller's
+    # next cycle retries from a fresh read — loop like the scheduler's
+    # poll loop does, and pin that the net effect is exactly one apply
+    running = None
+    for _ in range(20):
+        running = q.claim(q.read(rec['id']) or rec)
+        if running is not None:
+            break
+    assert running is not None, 'claim lost to a spurious conflict'
+    requeued = None
+    for _ in range(20):
+        requeued = q.requeue(dict(running), rc=115, reason='peer_dead')
+        if requeued is not None:
+            break
+    assert requeued is not None
+    again = q.requeue(dict(running), rc=115, reason='peer_dead')
+    assert again is None                       # stale epoch: refused
+    final = q.read(rec['id'])
+    assert final['requeues'] == 1 and final['epoch'] == requeued['epoch']
+
+
+def test_queue_ingest_idempotent_under_chaos(tmp_path):
+    """Repeated ingests under seeded faults never duplicate a job (the
+    origin dedup + create-only CAS), and the spool is eventually
+    drained."""
+    chaos = ChaosBackend(PosixDirBackend(str(tmp_path / 'svc')),
+                         CoordFaultConfig(seed=21, fail=0.2, torn=0.2))
+    q = _queue(chaos)
+    clean = _queue(chaos.inner)
+    for i in range(3):
+        clean.submit(_spec(tenant=f'tenant{i}'))
+    for _ in range(200):  # keep ingesting through the fault schedule
+        try:
+            q.ingest()
+        except CoordTimeout:
+            continue
+        if not clean.backend.list('incoming/'):
+            break
+    jobs = clean.jobs()
+    assert [j['id'] for j in jobs] == [1, 2, 3]
+    assert sorted(j['spec']['tenant'] for j in jobs) \
+        == ['tenant0', 'tenant1', 'tenant2']
+    assert clean.backend.list('incoming/') == []
+
+
+# ---------------------------------------------------------------------------
+# the shrink barrier on the KV backend (fence-not-split-brain)
+# ---------------------------------------------------------------------------
+
+def _kv_sup(tmp_path, kv_server, host_id, num_hosts, **kw):
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    backend = TcpKvBackend(('127.0.0.1', kv_server.port),
+                           namespace=str(tmp_path / 'lease'))
+    kw.setdefault('settle', 0.0)
+    kw.setdefault('shrink_timeout', 0.15)
+    kw.setdefault('poll_period', 0.01)
+    return PodSupervisor(['trainer'], host_id=host_id,
+                         num_hosts=num_hosts,
+                         lease_dir=str(tmp_path / 'lease'),
+                         coord=backend, **kw), backend
+
+
+def test_shrink_majority_commits_on_kv_backend(tmp_path, kv_server):
+    sup, backend = _kv_sup(tmp_path, kv_server, 0, 3)
+    backend.put('shrink-gen1/survivor-2.json', {'host': 2, 'addr': None})
+    assert sup._shrink({1: {}}) is True
+    assert sup.members == [0, 2] and sup.gen == 1
+    # lineage lives on the KV server, not on any filesystem
+    assert backend.get('lineage.json').value['lineage'] == 1
+    assert sup._current_lineage() == 1
+    sup._hb.stop()
+
+
+def test_shrink_minority_fences_on_kv_backend(tmp_path, kv_server):
+    sup, backend = _kv_sup(tmp_path, kv_server, 0, 3)
+    assert sup._shrink({1: {}, 2: {}}) is False
+    assert sup.gen == 0 and sup.members == [0, 1, 2]
+    assert backend.get('lineage.json') is None   # lineage frozen
+    # the dead barrier holds no claim of ours
+    assert backend.list('shrink-gen1/') == []
+    kinds = [e['kind'] for e in sup.report.events]
+    assert 'quorum_lost' in kinds
+
+
+def test_shrink_commits_through_injected_backend_faults(tmp_path,
+                                                        kv_server):
+    """The acceptance pin: barrier + lineage survive a flaky
+    coordination backend — the retry wrapper rides out seeded op
+    failures and the retries are VISIBLE in the supervisor's counters
+    (-> the [resilience: ...] line -> the incident report)."""
+    backend = RetryingBackend(
+        ChaosBackend(
+            TcpKvBackend(('127.0.0.1', kv_server.port),
+                         namespace=str(tmp_path / 'lease')),
+            CoordFaultConfig(seed=13, fail=0.3)),
+        policy=RetryPolicy(attempts=8, base_delay=0.001,
+                           max_delay=0.01, retry_on=(CoordTimeout,)),
+        rng=random.Random(0))
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    sup = PodSupervisor(['trainer'], host_id=0, num_hosts=3,
+                        lease_dir=str(tmp_path / 'lease'),
+                        coord=backend, settle=0.0, shrink_timeout=0.3,
+                        poll_period=0.01)
+    backend.put('shrink-gen1/survivor-2.json', {'host': 2, 'addr': None})
+    assert sup._shrink({1: {}}) is True
+    assert sup.members == [0, 2]
+    counts = sup.counts()
+    assert counts.get('coord_retries', 0) >= 1
+    from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+    assert 'coord_retries=' in resilience_suffix(counts)
+    sup._hb.stop()
+
+
+def test_supervisor_coord_give_up_exits_118(tmp_path):
+    """A dead coordination plane is a LOUD, classified exit — never a
+    wedge: the supervisor kills its trainer and exits RC_COORD_LOST."""
+    import sys
+    from kfac_pytorch_tpu.resilience.elastic import (
+        PodSupervisor, RC_COORD_LOST)
+    backend = RetryingBackend(
+        ChaosBackend(PosixDirBackend(str(tmp_path / 'lease')),
+                     CoordFaultConfig(seed=1, fail=1.0)),
+        policy=RetryPolicy(attempts=2, base_delay=0.001,
+                           retry_on=(CoordTimeout,)),
+        rng=random.Random(0))
+    sup = PodSupervisor(
+        [sys.executable, '-c', 'import time; time.sleep(60)'],
+        host_id=0, num_hosts=2, lease_dir=str(tmp_path / 'lease'),
+        coord=backend, poll_period=0.01, backoff_base=0.01)
+    rc = sup.run()
+    assert rc == RC_COORD_LOST == 118
+    # trainer stopped — or never launched: a dead backend at startup
+    # (the lineage baseline read) must fail BEFORE a child exists
+    assert sup.child is None or sup.child.poll() is not None
+    report = json.loads(
+        (tmp_path / 'lease' / 'incident-host0.json').read_text())
+    assert any(e['kind'] == 'coord_lost' for e in report['events'])
+    assert report['counters'].get('coord_gave_ups', 0) >= 1
+    from kfac_pytorch_tpu.service.scheduler import classify_rc
+    assert classify_rc(rc) == 'coord_lost'
+
+
+# ---------------------------------------------------------------------------
+# polling audit: paced scan loops with an accounted cumulative wait
+# ---------------------------------------------------------------------------
+
+def test_poll_pacer_jittered_cap_and_accounting():
+    from kfac_pytorch_tpu.resilience.retry import PollPacer
+    clock = ManualClock()
+    total = [0.0]
+    pace = PollPacer.for_period(0.2, clock=clock, rng=random.Random(0),
+                                total=total)
+    delays = [pace.sleep() for _ in range(40)]
+    # jitter-bounded: never below base*(1-j), never above cap*(1+j)
+    assert all(0.2 * 0.75 - 1e-9 <= d <= 0.8 * 1.25 + 1e-9
+               for d in delays), (min(delays), max(delays))
+    # grows toward the cap, then stays bounded there
+    assert max(delays[10:]) <= 0.8 * 1.25 + 1e-9
+    assert delays[0] < max(delays)
+    assert pace.waited == pytest.approx(sum(delays))
+    assert total[0] == pytest.approx(sum(delays))
+    pace.reset()
+    assert pace.sleep() <= 0.2 * 1.25 + 1e-9
+
+
+def test_poll_pacer_survives_long_lived_loops():
+    """A pacer lives for a whole supervise loop (hours): the exponent
+    must saturate, never overflow float range (~1750 iterations used
+    to raise OverflowError and kill the supervisor)."""
+    from kfac_pytorch_tpu.resilience.retry import PollPacer, RetryPolicy
+    clock = ManualClock()
+    pace = PollPacer.for_period(0.2, clock=clock, rng=random.Random(1))
+    for _ in range(5000):
+        assert 0.0 < pace.sleep() <= 0.8 * 1.25 + 1e-9
+    # and RetryPolicy.delay itself is overflow-safe for any k
+    policy = RetryPolicy(base_delay=0.1, max_delay=2.0, multiplier=1.5,
+                         jitter=0.0)
+    assert policy.delay(10_000, random.Random(0)) == 2.0
+
+
+def test_supervisor_counts_surface_poll_wait(tmp_path):
+    import sys
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    sup = PodSupervisor([sys.executable, '-c', 'import time; '
+                         'time.sleep(0.3)'],
+                        host_id=0, num_hosts=1,
+                        lease_dir=str(tmp_path / 'lease'),
+                        poll_period=0.02)
+    assert sup.run() == 0
+    assert sup._poll_wait[0] > 0
+    assert 'poll_wait_s' in sup.counts()
+
+
+# ---------------------------------------------------------------------------
+# the remote-launcher seam
+# ---------------------------------------------------------------------------
+
+def test_launcher_local_is_identity():
+    from kfac_pytorch_tpu.service.scheduler import Launcher
+    argv, env = Launcher('h0').render(['python', 'x.py'], {'A': '1'})
+    assert argv == ['python', 'x.py'] and env == {'A': '1'}
+
+
+def test_launcher_remote_renders_prefix_and_env_reexport():
+    from kfac_pytorch_tpu.service.scheduler import Launcher
+    base = {'HOME': '/home/op', 'PATH': '/bin', 'KFAC_OLD': 'same',
+            'KFAC_COORD_BACKEND': 'tcp'}
+    env = dict(base, KFAC_TENANT='alice', KFAC_HB_PORT='8600',
+               CUSTOM_SET='by-service')
+    argv, penv = Launcher('r1', ['ssh', '{host}', '--']).render(
+        ['python', '-m', 'mod', '--flag'], env, base_env=base)
+    assert penv is None                      # local ssh inherits
+    assert argv[:3] == ['ssh', 'r1', '--']
+    assert argv[3] == 'env'
+    # every KFAC_*/JAX_* var is forwarded — INCLUDING ones the
+    # controller merely inherited (KFAC_COORD_BACKEND: ssh would drop
+    # it and the remote side would silently fall back to posix) — plus
+    # anything the service set or changed; unrelated inherited vars
+    # (HOME, PATH) stay out of the command line
+    reexport = argv[4:argv.index('python')]
+    assert reexport == ['CUSTOM_SET=by-service',
+                        'KFAC_COORD_BACKEND=tcp', 'KFAC_HB_PORT=8600',
+                        'KFAC_OLD=same', 'KFAC_TENANT=alice']
+    assert argv[-4:] == ['python', '-m', 'mod', '--flag']
+    # shell metacharacters are quoted for the remote shell: ssh
+    # flattens argv, and an unquoted ';' (the coord outage-window
+    # spec!) would split the remote command in two
+    argv2, _ = Launcher('r1', ['ssh', '{host}']).render(
+        ['python'], {'KFAC_FAULT_COORD_WINDOWS': '10:40;90:95'},
+        base_env={})
+    assert "KFAC_FAULT_COORD_WINDOWS='10:40;90:95'" in argv2
+
+
+def test_tcpkv_cas_replay_with_token_is_idempotent(kv_server, tmp_path):
+    """A CAS whose response was lost on the wire must not read as a
+    self-conflict on the replay: the retry layer sends one idempotency
+    token per logical op and the server answers the replay with the
+    original success."""
+    b = TcpKvBackend(('127.0.0.1', kv_server.port),
+                     namespace=str(tmp_path / 'cas'))
+    b.put('job.json', {'epoch': 0})
+    got = b.get('job.json')
+    v1 = b.put_cas('job.json', {'epoch': 1}, got.version, token='tok-1')
+    assert v1 is not None
+    # the REPLAY (same token, now-stale expect): original success, not
+    # a conflict — and nothing is applied twice
+    v2 = b.put_cas('job.json', {'epoch': 1}, got.version, token='tok-1')
+    assert v2 == v1
+    assert b.get('job.json').value == {'epoch': 1}
+    # a DIFFERENT writer with the same stale expect still conflicts
+    assert b.put_cas('job.json', {'epoch': 9}, got.version,
+                     token='tok-2') is None
+
+
+def test_retrying_backend_cas_token_survives_retry(tmp_path, kv_server):
+    """The retry wrapper generates ONE token per logical CAS, so an
+    attempt replayed after an injected timeout lands as the same
+    logical write (pinned against the KV server through chaos)."""
+    inner = TcpKvBackend(('127.0.0.1', kv_server.port),
+                         namespace=str(tmp_path / 'casr'))
+    inner.put('job.json', {'epoch': 0})
+    got = inner.get('job.json')
+
+    class FlakyOnce:
+        """Apply the CAS, then pretend the response was lost once."""
+
+        def __init__(self):
+            self.failed = False
+
+        def __getattr__(self, name):
+            return getattr(inner, name)
+
+        def put_cas(self, key, value, expect_version, **kw):
+            version = inner.put_cas(key, value, expect_version, **kw)
+            if not self.failed:
+                self.failed = True
+                raise CoordTimeout('response lost after apply')
+            return version
+
+    rb, _ = _retrying(FlakyOnce())
+    version = rb.put_cas('job.json', {'epoch': 1}, got.version)
+    assert version is not None                 # replay, not conflict
+    assert inner.get('job.json').value == {'epoch': 1}
+
+
+def test_scheduler_dry_run_pins_remote_rank_argv(tmp_path):
+    """hosts.json carries a launch prefix -> the admitted rank's argv
+    is the rendered remote command (prefix + env re-export + the
+    kfac-pod-supervise module invocation), popen env inherited."""
+    from kfac_pytorch_tpu.service.scheduler import AdmissionController
+    captured = []
+
+    class FakeProc:
+        pid = 4242
+
+        def poll(self):
+            return None
+
+        def wait(self, timeout=None):
+            return 0
+
+    def fake_popen(argv, **kw):
+        captured.append((argv, kw))
+        return FakeProc()
+
+    svc = tmp_path / 'svc'
+    ctl = AdmissionController(
+        str(svc), hosts={'h0': 1}, popen=fake_popen,
+        trainers={'mini': 'tests/chaos_trainer.py'})
+    # re-home the pool onto a remote host via the live hosts.json seam
+    ctl.coord.put('hosts.json', {'hosts': {
+        'r1': {'slots': 1, 'launch': ['ssh', '{host}', '--']}}},
+        indent=2)
+    ctl.queue.submit(_spec(trainer='mini'))
+    ctl.step()
+    assert captured, 'no launch captured'
+    argv, kw = captured[0]
+    assert argv[:3] == ['ssh', 'r1', '--'] and argv[3] == 'env'
+    assert kw.get('env') is None             # inherited, not passed
+    joined = ' '.join(argv)
+    assert 'kfac_pytorch_tpu.resilience.elastic' in joined
+    assert 'chaos_trainer.py' in joined
+    # the env re-export carries the tenant namespace + port block
+    assert any(a.startswith('KFAC_TENANT=alice') for a in argv)
+    assert any(a.startswith('KFAC_HB_PORT=') for a in argv)
+    assert any(a.startswith('KFAC_JOB_ID=job-') for a in argv)
+
+
+# ---------------------------------------------------------------------------
+# the static gate: no backend bypass outside coord/
+# ---------------------------------------------------------------------------
+
+#: direct-filesystem calls that USED to implement the protocols; any
+#: new occurrence outside the allowlist is the abstraction rotting
+_FORBIDDEN = {('os', 'listdir'), ('os', 'replace'), ('os', 'remove'),
+              ('os', 'rename'), ('shutil', 'rmtree'), (None, 'open'),
+              (None, 'atomic_write_json')}
+
+#: module -> {function names allowed to touch files directly} — each an
+#: ARTIFACT writer/reader (incident reports, per-rank log files, CLI
+#: spec input), never protocol state
+_ALLOWED = {
+    'kfac_pytorch_tpu/resilience/elastic.py': {'run'},
+    'kfac_pytorch_tpu/resilience/heartbeat.py': set(),
+    'kfac_pytorch_tpu/service/queue.py': set(),
+    'kfac_pytorch_tpu/service/scheduler.py': {'_admit', 'main'},
+}
+
+
+def _direct_io_sites(path):
+    tree = ast.parse(open(path).read())
+    sites = []
+
+    def visit(node, func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        if isinstance(node, ast.Call):
+            name = mod = None
+            f = node.func
+            if isinstance(f, ast.Name):
+                name = f.id
+            elif isinstance(f, ast.Attribute):
+                name = f.attr
+                if isinstance(f.value, ast.Name):
+                    mod = f.value.id
+            for fmod, fname in _FORBIDDEN:
+                if name == fname and (fmod is None or mod == fmod):
+                    sites.append((func, f'{mod or ""}.{name}'.lstrip('.'),
+                                  node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, func)
+
+    visit(tree, '<module>')
+    return sites
+
+
+def test_no_protocol_module_bypasses_the_backend():
+    """The lint that keeps the abstraction from rotting: the protocol
+    modules may not reach around the coordination backend with direct
+    lease-dir file IO. Allowed exceptions are named artifacts (incident
+    rotation, per-rank logs, CLI input) — extending the list requires
+    editing THIS test, which is the point."""
+    problems = []
+    for rel, allowed in _ALLOWED.items():
+        for func, call, lineno in _direct_io_sites(
+                os.path.join(REPO, rel)):
+            if func not in allowed:
+                problems.append(f'{rel}:{lineno} {func}() calls {call}')
+    assert not problems, (
+        'direct protocol-file IO outside coord/ (route it through the '
+        'CoordBackend, or allowlist a genuine artifact):\n  '
+        + '\n  '.join(problems))

@@ -43,14 +43,51 @@ TRAINER = os.path.join(REPO, 'tests', 'chaos_trainer.py')
 
 HB_DEADLINE = 4.0
 
+#: coordination-backend overlay (the TcpKv drill legs): every process
+#: of the drill — supervisors AND trainers — picks the backend and the
+#: seeded backend-fault schedule up from these envs
+_COORD_OVERLAY = {}
+
 
 def _env(**extra):
     base = {k: v for k, v in os.environ.items()
             if not (k.startswith('KFAC_FAULT_')
-                    or k.startswith('KFAC_HB_'))}
+                    or k.startswith('KFAC_HB_')
+                    or k.startswith('KFAC_COORD_'))}
     base['JAX_PLATFORMS'] = 'cpu'
+    base.update(_COORD_OVERLAY)
     base.update(extra)
     return base
+
+
+@pytest.fixture
+def tcpkv_coord():
+    """Run the whole drill on the TCP KV coordination backend with
+    seeded backend faults armed: a live kfac-coord-serve store in this
+    process, KFAC_COORD_BACKEND=tcp in every child, and mild
+    KFAC_FAULT_COORD_* probabilities (high enough that per-op retries
+    actually fire over a multi-minute drill, low enough that the
+    5-attempt budget keeps give-ups out of a healthy run)."""
+    from kfac_pytorch_tpu.coord import TcpKvServer
+    srv = TcpKvServer('127.0.0.1', 0)
+    # FAIL=0.05 sizes the drill's statistics: the supervisors make a
+    # few hundred retried coord ops over the run, so some retries fire
+    # with near-certainty (P[none] < 1e-4), while a give-up needs 5
+    # consecutive injected failures on one op (~3e-7) — never in a
+    # healthy drill
+    _COORD_OVERLAY.update({
+        'KFAC_COORD_BACKEND': 'tcp',
+        'KFAC_COORD_ADDR': f'127.0.0.1:{srv.port}',
+        'KFAC_FAULT_COORD_SEED': '5',
+        'KFAC_FAULT_COORD_FAIL': '0.05',
+        'KFAC_FAULT_COORD_TORN': '0.05',
+        'KFAC_FAULT_COORD_STALE': '0.05',
+    })
+    try:
+        yield srv
+    finally:
+        _COORD_OVERLAY.clear()
+        srv.close()
 
 
 def _done_line(out):
@@ -92,6 +129,23 @@ def _has_checkpoint(ckpt_dir, epoch=0):
 
 
 def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
+    _run_shrink_drill(tmp_path)
+
+
+def test_pod_shrinks_on_tcpkv_backend_with_coord_faults(tmp_path,
+                                                        tcpkv_coord):
+    """The same 2-host SIGKILL drill with ZERO shared-filesystem
+    coordination: every barrier claim, heartbeat lease, lineage bump
+    and join/done marker rides the TCP KV server — wrapped in the
+    seeded ChaosBackend, so the whole shrink survives a coordination
+    plane that times out, tears and staleness-serves reads — and the
+    backend's retries are visible in the incident report."""
+    _run_shrink_drill(tmp_path, art_subdir='coord',
+                      expect_coord_retries=True)
+
+
+def _run_shrink_drill(tmp_path, art_subdir=None,
+                      expect_coord_retries=False):
     control = _control_done(tmp_path)
     lease = tmp_path / 'lease'
     ckpt0, ckpt1 = str(tmp_path / 'ckpt_h0'), str(tmp_path / 'ckpt_h1')
@@ -177,6 +231,18 @@ def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
     assert report['shrinks'] and report['shrinks'][0]['from'] == 2
     assert report['shrinks'][0]['to'] == 1
     assert report['gave_up'] is False
+    if expect_coord_retries:
+        # the seeded backend faults really fired and the retry layer
+        # rode them out: evidence from either host's supervisor log or
+        # the incident counters (host 1 dies mid-run but its phase-1
+        # retries still count)
+        out1 = out1_path.read_text()
+        retried = (report['counters'].get('coord_retries', 0)
+                   + out0.count('coord: retry')
+                   + out1.count('coord: retry'))
+        assert retried >= 1, (report['counters'], out0[-1500:])
+        assert report['counters'].get('coord_lost', 0) == 0
+        assert 'coordination backend lost' not in out0
     exits = [e for e in report['events'] if e['kind'] == 'trainer_exit']
     from kfac_pytorch_tpu.resilience.heartbeat import RC_PEER_DEAD
     # the trainer's own monitor and the supervisor's race to the same
@@ -242,10 +308,13 @@ def test_pod_shrinks_to_survivor_after_host_sigkill(tmp_path):
                for e in merged['traceEvents'])
 
     # CI artifact export: keep the drill's debris + the aggregated
-    # timeline when the workflow asks for it
+    # timeline when the workflow asks for it (the TcpKv leg's land
+    # under coord/ alongside the posix-backend drills')
     art = os.environ.get('KFAC_DRILL_ARTIFACTS')
     if art:
         import shutil
+        if art_subdir:
+            art = os.path.join(art, art_subdir)
         os.makedirs(art, exist_ok=True)
         for p in paths + traces:
             shutil.copy(p, art)
@@ -805,3 +874,21 @@ def test_pod_partition_quorum_fences_minority_then_rejoins(tmp_path):
                       default=str)
         with open(os.path.join(part_art, 'pod_trace.json'), 'w') as f:
             json.dump(aggregate.merged_chrome_trace(timeline), f)
+
+
+# ---------------------------------------------------------------------------
+# TcpKv backend legs of the standing churn + partition drills: the same
+# acceptance runs with the coordination plane on the KV server and the
+# seeded backend faults armed. Nightly tier (the 2-host TcpKv leg above
+# rides the regular chaos job; these add ~25 min each).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.nightly
+def test_pod_churn_on_tcpkv_backend(tmp_path, tcpkv_coord):
+    test_pod_survives_churn_kill_and_rejoin(tmp_path)
+
+
+@pytest.mark.nightly
+def test_pod_partition_on_tcpkv_backend(tmp_path, tcpkv_coord):
+    test_pod_partition_quorum_fences_minority_then_rejoins(tmp_path)

@@ -1558,7 +1558,7 @@ def test_read_claims_skips_torn_json_and_filters_partition(tmp_path):
     resilience.atomic_write_json(str(d / 'survivor-1.json'),
                                  {'host': 1, 'addr': None})
     (d / 'survivor-9.json').write_text('{"host": 9, "ad')  # torn
-    claims = sup._read_claims(str(d))
+    claims = sup._read_claims('shrink-gen1')
     assert 2 in claims          # reachable, intact
     assert 1 not in claims      # partitioned away
     assert 9 not in claims      # torn: skipped, not crashed

@@ -44,15 +44,49 @@ EXAMPLES = 32          # 4 steps/epoch
 TENANTS = ('alice', 'bob', 'carol')
 
 
+#: coordination-backend overlay (the TcpKv drill leg): scheduler and
+#: supervisor subprocesses pick the backend + backend-fault schedule up
+#: from these envs
+_COORD_OVERLAY = {}
+
+
 def _env(**extra):
     base = {k: v for k, v in os.environ.items()
             if not (k.startswith('KFAC_FAULT_')
                     or k.startswith('KFAC_HB_')
+                    or k.startswith('KFAC_COORD_')
                     or k in ('KFAC_TENANT', 'KFAC_JOB_ID',
                              'KFAC_PROM_FILE', 'KFAC_TRACE_DIR'))}
     base['JAX_PLATFORMS'] = 'cpu'
+    base.update(_COORD_OVERLAY)
     base.update(extra)
     return base
+
+
+@pytest.fixture
+def tcpkv_coord(monkeypatch):
+    """Service drill on the TCP KV coordination backend: the queue,
+    hosts.json pool and every pod protocol ride the KV server; the
+    scheduler subprocess additionally runs with mild seeded
+    KFAC_FAULT_COORD_* probabilities. The test process itself submits
+    through the same backend (env-selected), faults unarmed — chaos
+    belongs between the SERVICE and its backend, not in the harness."""
+    from kfac_pytorch_tpu.coord import TcpKvServer
+    srv = TcpKvServer('127.0.0.1', 0)
+    monkeypatch.setenv('KFAC_COORD_BACKEND', 'tcp')
+    monkeypatch.setenv('KFAC_COORD_ADDR', f'127.0.0.1:{srv.port}')
+    _COORD_OVERLAY.update({
+        'KFAC_COORD_BACKEND': 'tcp',
+        'KFAC_COORD_ADDR': f'127.0.0.1:{srv.port}',
+        'KFAC_FAULT_COORD_SEED': '5',
+        'KFAC_FAULT_COORD_FAIL': '0.02',
+        'KFAC_FAULT_COORD_TORN': '0.02',
+    })
+    try:
+        yield srv
+    finally:
+        _COORD_OVERLAY.clear()
+        srv.close()
 
 
 def _done_line(text):
@@ -74,8 +108,8 @@ def _spec(tenant):
 
 
 def test_service_survives_host_loss_zero_jobs_lost(tmp_path):
+    from kfac_pytorch_tpu import coord
     from kfac_pytorch_tpu.obs import aggregate
-    from kfac_pytorch_tpu.resilience import atomic_write_json
     from kfac_pytorch_tpu.service import JobQueue
 
     # the undisturbed control fixes the schedule contract every tenant
@@ -147,7 +181,10 @@ def test_service_survives_host_loss_zero_jobs_lost(tmp_path):
         victim_tenant = victim['spec']['tenant']
         victim_host = victim['placement']['0']
         hosts = {h: 2 for h in ('h0', 'h1', 'h2') if h != victim_host}
-        atomic_write_json(str(svc / 'hosts.json'), {'hosts': hosts})
+        # through the env-selected coordination backend: the identical
+        # atomic hosts.json file on posix, the KV key on the tcp leg
+        coord.backend_from_env(str(svc), retry=False, chaos=False).put(
+            'hosts.json', {'hosts': hosts}, indent=2)
 
         rc = sched.wait(timeout=900)
         assert rc == 0, _fail(f'scheduler rc={rc}')
@@ -243,9 +280,14 @@ def test_service_survives_host_loss_zero_jobs_lost(tmp_path):
         os.makedirs(root, exist_ok=True)
         shutil.copy(svc / 'service.log', root)
         shutil.copy(svc_out, root)
-        shutil.copytree(queue.jobs_dir,
-                        os.path.join(root, 'queue-state'),
-                        dirs_exist_ok=True)
+        if os.path.isdir(queue.jobs_dir):   # posix backend: literal files
+            shutil.copytree(queue.jobs_dir,
+                            os.path.join(root, 'queue-state'),
+                            dirs_exist_ok=True)
+        else:                               # KV backend: dump the records
+
+            with open(os.path.join(root, 'queue-state.json'), 'w') as f:
+                json.dump(queue.jobs(), f, indent=2, default=str)
         for tenant, rec in by_tenant.items():
             tdir = os.path.join(root, tenant)
             os.makedirs(tdir, exist_ok=True)
@@ -258,3 +300,15 @@ def test_service_survives_host_loss_zero_jobs_lost(tmp_path):
                 json.dump({k: v for k, v in t.items()
                            if not k.startswith('_')}, f, indent=2,
                           default=str)
+
+
+# ---------------------------------------------------------------------------
+# TcpKv backend leg: the same 3-tenant acceptance drill with the queue,
+# capacity pool and every pod protocol on the KV server, backend faults
+# armed. Nightly tier (adds a full drill run).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.nightly
+def test_service_drill_on_tcpkv_backend(tmp_path, tcpkv_coord):
+    test_service_survives_host_loss_zero_jobs_lost(tmp_path)
