@@ -79,6 +79,18 @@ def parse_args():
                         'residual; the gradient allreduce is never '
                         'compressed (see README "Communication '
                         'compression")')
+    p.add_argument('--kfac-comm-mode',
+                   default=os.environ.get('KFAC_COMM_MODE') or None,
+                   choices=['inverse', 'pred'],
+                   help='override the variant\'s comm mode (default from '
+                        '$KFAC_COMM_MODE; unset = the variant default): '
+                        "'inverse' gathers decompositions once per "
+                        "refresh, 'pred' gathers preconditioned "
+                        'gradients every step. A runtime knob since the '
+                        'live replanning path — with --kfac-autotune the '
+                        'controller probes the other mode and applies a '
+                        'winning switch mid-run via KFAC.replan (see '
+                        'README "Live replanning")')
     p.add_argument('--kfac-comm-prefetch', action='store_true',
                    help='comm_inverse variants only: publish each '
                         "inverse update's gathered decomposition for "
@@ -227,6 +239,7 @@ def main():
             warm_start_basis=args.kfac_warm_start,
             factor_decay=args.stat_decay, kl_clip=args.kl_clip,
             comm_precision=args.kfac_comm_precision,
+            comm_mode=args.kfac_comm_mode,
             comm_prefetch=args.kfac_comm_prefetch,
             decomp_impl=args.kfac_decomp_impl,
             decomp_shard=args.kfac_decomp_shard,

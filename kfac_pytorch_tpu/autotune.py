@@ -65,13 +65,23 @@ from collections import deque
 
 #: the preconditioner attributes the arbiter owns. Nothing else in the
 #: repo may assign these on a KFAC instance (pinned by
-#: tests/test_autotune.py's setattr-guard test).
+#: tests/test_autotune.py's setattr-guard test). ``comm_mode`` (ISSUE
+#: 14) is special: committing it does not just retrace — the arbiter
+#: queues a ``KFAC.request_replan`` so the trainer rebuilds the
+#: FactorPlan and swaps the (verbatim-carried) state between steps.
 KNOB_ATTRS = ('fac_update_freq', 'kfac_update_freq', 'damping',
-              'comm_precision', 'decomp_impl')
+              'comm_precision', 'decomp_impl', 'comm_mode')
 
 #: the wire-dtype ladder the tuner climbs (successive halving of the
 #: collective payload; collectives.WIRE_DTYPES order).
 COMM_PRECISIONS = ('fp32', 'bf16', 'int8')
+
+#: the two comm-mode roads of one factor layout (plan.FactorPlan):
+#: gather decompositions once per refresh vs gather preconditioned
+#: gradients every step. A real probe/commit/revert knob since ISSUE
+#: 14 (the live replanning path); the analytic ``decide_comm_mode``
+#: verdict seeds which road is probed first.
+COMM_MODES = ('inverse', 'pred')
 
 #: the decomposition-implementation ladder (the inverse-free lane of
 #: ROADMAP item 5): per method, the cold kernel vs its warm iterative
@@ -80,6 +90,23 @@ COMM_PRECISIONS = ('fp32', 'bf16', 'int8')
 DECOMP_IMPLS = ('xla', 'auto', 'jacobi', 'subspace', 'newton_schulz')
 DECOMP_LADDERS = {'eigh': ('xla', 'subspace'),
                   'cholesky': ('xla', 'newton_schulz')}
+
+#: arbiter knob -> the spec/trainer-flag name a relaunch carries it
+#: back through (service.spec.KFAC_KNOBS grammar; lockstep with the
+#: trainers' ``--kfac-*`` flags). ``damping`` is deliberately absent:
+#: the trainers' ``--damping`` is not a kfac_* spec knob and the
+#: schedule owns its decay.
+ADOPTED_KNOB_FLAGS = {
+    'fac_update_freq': 'kfac_cov_update_freq',
+    'kfac_update_freq': 'kfac_update_freq',
+    'comm_precision': 'kfac_comm_precision',
+    'decomp_impl': 'kfac_decomp_impl',
+    'comm_mode': 'kfac_comm_mode',
+}
+
+#: the adopted-knob snapshot filename (written next to the decision
+#: log; read by kfac-serve at requeue time)
+ADOPTED_KNOBS_FILENAME = 'adopted-knobs.json'
 
 _APPLYING = threading.local()
 
@@ -109,6 +136,7 @@ def _capture(precond):
         'damping': getattr(precond, 'damping', None),
         'comm_precision': getattr(precond, 'comm_precision', None),
         'decomp_impl': getattr(precond, 'decomp_impl', None),
+        'comm_mode': getattr(precond, 'comm_mode', None),
     }
 
 
@@ -200,8 +228,41 @@ class KnobArbiter:
             if 'decomp_impl' in changed:
                 self.tuner.pop('decomp_impl', None)
                 self.base['decomp_impl'] = cur['decomp_impl']
+            if 'comm_mode' in changed:
+                self.tuner.pop('comm_mode', None)
+                self.base['comm_mode'] = cur['comm_mode']
             self._applied = cur
             return True
+
+    def sync_knobs(self, **values):
+        """Re-base knobs an AUTHORITATIVE external path just wrote —
+        ``KFAC.replan`` calls this after swapping ``comm_mode``, so the
+        rebuilt plan's mode becomes the arbiter's base instead of being
+        detected (and re-adopted) as a foreign write on the next
+        proposal. Tuner overrides for the synced knobs are kept only if
+        they match the new value (a direct replan supersedes a stale
+        override the same way an external freq write supersedes the
+        stretch)."""
+        with self._lock:
+            for k, v in values.items():
+                if k not in KNOB_ATTRS:
+                    raise KeyError(f'unknown knob {k!r}')
+                self.base[k] = v
+                if self.tuner.get(k, v) != v:
+                    self.tuner.pop(k, None)
+            if self._applied is not None:
+                self._applied.update(values)
+
+    def invalidate(self):
+        """Run the registered variant-cache invalidators once (the
+        replan path fires them through here; knob commits fire them in
+        :meth:`_commit`). One stale cache must never block the change.
+        """
+        for fn in list(self._invalidators):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                pass
 
     def propose(self, source, **kw):
         """Fold one proposer's intent in and apply the composed knobs.
@@ -265,6 +326,8 @@ class KnobArbiter:
             'comm_precision', self.base['comm_precision'])
         eff['decomp_impl'] = self.tuner.get(
             'decomp_impl', self.base['decomp_impl'])
+        eff['comm_mode'] = self.tuner.get(
+            'comm_mode', self.base['comm_mode'])
         return eff
 
     def _commit(self, source):
@@ -288,6 +351,20 @@ class KnobArbiter:
             raise ValueError(
                 f'decomp_impl must be one of {DECOMP_IMPLS}, '
                 f'got {eff["decomp_impl"]!r}')
+        if 'comm_mode' in changed:
+            if eff['comm_mode'] not in COMM_MODES:
+                raise ValueError(f'comm_mode must be one of {COMM_MODES}, '
+                                 f'got {eff["comm_mode"]!r}')
+            if (eff['comm_mode'] == 'pred'
+                    and getattr(self.precond, 'comm_prefetch', False)):
+                # mirror replan's combination rule SYNCHRONOUSLY — a
+                # deferred failure would land inside the next train
+                # step with the knob already written against the old
+                # plan
+                raise ValueError(
+                    "cannot propose comm_mode='pred' with comm_prefetch "
+                    'in force: the pred gather IS the step consumer and '
+                    'cannot be deferred')
         with _applying():
             for k in changed:
                 setattr(self.precond, k, eff[k])
@@ -297,17 +374,26 @@ class KnobArbiter:
             rebase = getattr(self.precond, 'rebase_cohorts', None)
             if rebase is not None:
                 rebase()
-        if 'comm_precision' in changed or 'decomp_impl' in changed:
+        if 'comm_mode' in changed:
+            # the applied switch (ISSUE 14): the new mode needs a NEW
+            # FactorPlan and a state swap the arbiter cannot perform
+            # (the state lives in the trainer) — queue a replan the
+            # trainer applies between steps. The invalidators fire HERE,
+            # once (the queued replan carries _invalidate=False), so
+            # the acceptance criterion "variant cache invalidates
+            # exactly once per switch" holds by construction.
+            request = getattr(self.precond, 'request_replan', None)
+            if request is not None:
+                request(comm_mode=eff['comm_mode'], _invalidate=False)
+        if ('comm_precision' in changed or 'decomp_impl' in changed
+                or 'comm_mode' in changed):
             # the wire dtype AND the decomposition kernel are baked
             # into the traced programs (comm_precision also into the
-            # EF-residual state structure): every attached trainer's
-            # variant cache must retrace; training.step_fn re-seeds /
-            # drops KFACState.comm_err host-side on the next dispatch
-            for fn in list(self._invalidators):
-                try:
-                    fn()
-                except Exception:  # noqa: BLE001 — one stale cache must
-                    pass           # not block the knob change
+            # EF-residual state structure; comm_mode into the whole
+            # collective schedule): every attached trainer's variant
+            # cache must retrace; training.step_fn re-seeds / drops
+            # KFACState.comm_err host-side on the next dispatch
+            self.invalidate()
         self.changes += 1
         self._applied = _capture(self.precond)
         try:
@@ -373,6 +459,26 @@ def _marginals(means):
         if label and label not in out:
             out[label] = val
     return out
+
+
+def _mode_switch_keeps_layout(precond, mode):
+    """Would a replan to ``mode`` keep the row layout (the verbatim
+    in-place carry)? Mirrors replan's distribute resolution: pred
+    always collapses the factor-wise split; a non-pred target
+    re-resolves the eigen/ekfac auto rule for the current world."""
+    if mode == 'pred':
+        target = False
+    else:
+        dl = getattr(precond, 'distribute_layer_factors', None)
+        if dl is None and getattr(precond, 'variant', '') in ('eigen',
+                                                              'ekfac'):
+            plan = getattr(precond, 'plan', None)
+            target = (plan is not None
+                      and getattr(precond, 'num_devices', 1)
+                      > len(plan.metas))
+        else:
+            target = bool(dl)
+    return target == bool(getattr(precond, '_distributed', False))
 
 
 def comm_mode_bytes(plan, method, comm_precision='fp32'):
@@ -477,7 +583,7 @@ class KnobController:
     def __init__(self, precond, *, window=16, settle=2, rel_improve=0.03,
                  dwell_windows=2, cooldown=6, steady_every=50,
                  tune=('kfac_update_freq', 'fac_update_freq',
-                       'comm_precision', 'decomp_impl'),
+                       'comm_precision', 'decomp_impl', 'comm_mode'),
                  freq_bounds=None, comm_precisions=COMM_PRECISIONS,
                  predicted=None, platform=None, variant=None,
                  anchor='central', decision_log=None, log=None,
@@ -727,6 +833,48 @@ class KnobController:
                 # 'auto' sits on the method's warm rung
                 eff = ladder[1] if cur == 'auto' else cur
                 out.extend((knob, cur, v) for v in ladder if v != eff)
+            elif knob == 'comm_mode':
+                # the applied comm-mode switch (ISSUE 14): probeable
+                # only where the replan path exists — a meshed, set-up
+                # preconditioner that can rebuild its plan. ekfac is
+                # excluded (its scale moments are comm-mode shaped and
+                # would re-accumulate across every probe), and the pred
+                # road is unreachable under comm_prefetch (the pred
+                # gather IS the step consumer).
+                cur = getattr(self.precond, 'comm_mode', None)
+                if (cur not in COMM_MODES
+                        or getattr(self.precond, 'axis_name', None) is None
+                        or getattr(self.precond, 'plan', None) is None
+                        or getattr(self.precond, 'ekfac', False)
+                        or not callable(getattr(self.precond,
+                                                'request_replan', None))):
+                    continue
+                for v in COMM_MODES:
+                    if v == cur:
+                        continue
+                    if v == 'pred' and getattr(self.precond,
+                                               'comm_prefetch', False):
+                        continue
+                    if not _mode_switch_keeps_layout(self.precond, v):
+                        # a switch that re-resolves the factor
+                        # distribution (distributed eigen -> pred
+                        # collapses ownership; pred-start eigen ->
+                        # inverse can re-distribute) is a row-layout
+                        # rebuild with a host-side state transport,
+                        # not the verbatim in-place switch a probe can
+                        # afford — the tuner only probes
+                        # layout-preserving switches
+                        continue
+                    out.append((knob, cur, v))
+        # the analytic comm-mode verdict is a SEEDED PRIOR, not an
+        # applied decision: when it disagrees with the current mode,
+        # its candidate probes first — the measured window still
+        # decides the commit
+        if self.comm_mode_choice is not None:
+            pri = [c for c in out if c[0] == 'comm_mode'
+                   and c[2] == self.comm_mode_choice]
+            if pri:
+                out = pri + [c for c in out if c not in pri]
         return out
 
     def _next_probe(self):
@@ -794,8 +942,14 @@ class KnobController:
             self.commits += 1
             self._bump('autotune_commits')
             gain = 100.0 * (1 - t / self.baseline_t)
+            extra = {}
+            if knob == 'comm_mode':
+                # an APPLIED (not advisory) switch: the plan was rebuilt
+                # and the state carried through KFAC.replan — the
+                # decision-log grammar the acceptance criterion greps for
+                extra['applied'] = True
             self._decision('commit', knob=knob, frm=old, to=new,
-                           before_s=self.baseline_t, after_s=t)
+                           before_s=self.baseline_t, after_s=t, **extra)
             self.log.info(
                 'autotune: committed %s %s -> %s (step time %.6fs -> '
                 '%.6fs, -%.1f%%) at step %d', knob, old, new,
@@ -867,13 +1021,15 @@ class KnobController:
         return False
 
     def _maybe_comm_mode(self, measured):
-        """One-shot advisory comm-mode decision from the layout's
-        analytic per-step collective bytes at the current cadence
-        (comm_inverse amortizes its gather over kfac_update_freq steps;
-        comm_pred ships preconditioned grads every step). ADVISORY:
-        switching modes rebuilds the factor plan and the state layout —
-        the decision is recorded/logged for the operator, never applied
-        live."""
+        """One-shot analytic comm-mode verdict from the layout's
+        per-step collective bytes at the current cadence (comm_inverse
+        amortizes its gather over kfac_update_freq steps; comm_pred
+        ships preconditioned grads every step). Since ISSUE 14 this is
+        the SEEDED PRIOR of a real knob, not an advisory log line: when
+        the verdict disagrees with the running mode, ``_candidates``
+        probes that mode first and the measured probe window decides —
+        a commit rebuilds the plan live through ``KFAC.replan`` (the
+        decision log then shows an *applied* comm_mode commit)."""
         if self.comm_mode_choice is not None:
             return
         plan = getattr(self.precond, 'plan', None)
@@ -911,7 +1067,33 @@ class KnobController:
                     f.write(json.dumps(d) + '\n')
             except OSError:
                 pass
+        if kind in ('seed', 'commit', 'revert'):
+            # every knob movement refreshes the adopted snapshot, so a
+            # kfac-serve requeue always relaunches at the latest tuned
+            # cadence (PR 10 follow-on)
+            self._export_adopted()
         return d
+
+    def _export_adopted(self):
+        """Snapshot the currently-adopted knobs as spec-grammar names
+        (``adopted-knobs.json`` next to the decision log). kfac-serve
+        reads this at requeue time and carries the values into the
+        relaunch argv, so a requeued job resumes at its tuned cadence
+        instead of re-climbing the ladder from the submitted config."""
+        if not self.decision_log:
+            return
+        knobs = _capture(self.precond)
+        doc = {flag: knobs[k] for k, flag in ADOPTED_KNOB_FLAGS.items()
+               if knobs[k] is not None}
+        path = os.path.join(os.path.dirname(self.decision_log) or '.',
+                            ADOPTED_KNOBS_FILENAME)
+        try:
+            tmp = path + '.tmp'
+            with open(tmp, 'w') as f:
+                json.dump(doc, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass
 
     def _instant(self, name, **args):
         try:

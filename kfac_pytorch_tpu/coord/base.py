@@ -137,12 +137,9 @@ class Watch:
         self._versions = None
 
     def _snapshot(self):
-        out = {}
-        for key in self.backend.list(self.prefix):
-            got = self.backend.get(key)
-            if got is not None:
-                out[key] = got.version
-        return out
+        return {key: got.version
+                for key, got in
+                self.backend.get_many_versioned(self.prefix).items()}
 
     def poll(self):
         now = self._snapshot()
@@ -228,6 +225,19 @@ class CoordBackend:
             got = self.get(key)
             if got is not None:
                 out[key] = got.value
+        return out
+
+    def get_many_versioned(self, prefix=''):
+        """{key: Versioned} for every readable key under ``prefix`` —
+        the change-feed scan (:class:`Watch`). Derived default is
+        list + get per key; backends with a server-side scan override
+        it with ONE round trip (the KV backend does — a watch poll
+        must never cost more wire ops than the plain read it gates)."""
+        out = {}
+        for key in self.list(prefix):
+            got = self.get(key)
+            if got is not None:
+                out[key] = got
         return out
 
     def lease(self, key, ttl, payload):
@@ -353,6 +363,10 @@ class RetryingBackend(CoordBackend):
     def get_many(self, prefix=''):
         return self._call('get_many', prefix,
                           lambda: self.inner.get_many(prefix))
+
+    def get_many_versioned(self, prefix=''):
+        return self._call('get_many_versioned', prefix,
+                          lambda: self.inner.get_many_versioned(prefix))
 
     def lease(self, key, ttl, payload):
         lease = Lease(self, key, ttl)

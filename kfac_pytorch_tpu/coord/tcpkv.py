@@ -138,10 +138,14 @@ class TcpKvServer:
                               and not self._expired(e, now))
                 return {'ok': True, 'keys': keys}
             if kind == 'get_many':
-                out = {k: e[0] for k, e in self._store.items()
-                       if k.startswith(key)
-                       and not self._expired(e, now)}
-                return {'ok': True, 'values': out}
+                live = {k: e for k, e in self._store.items()
+                        if k.startswith(key)
+                        and not self._expired(e, now)}
+                # versions ride along so a Watch poll is ONE round trip
+                # (clients on an older server fall back to per-key gets)
+                return {'ok': True,
+                        'values': {k: e[0] for k, e in live.items()},
+                        'versions': {k: e[1] for k, e in live.items()}}
             if kind == 'ping':
                 return {'ok': True, 'rev': self._rev,
                         'keys': len(self._store)}
@@ -293,6 +297,20 @@ class TcpKvBackend(CoordBackend):
                               'key': self._full_prefix(prefix)})
         return {self._strip(k): v
                 for k, v in (resp.get('values') or {}).items()}
+
+    def get_many_versioned(self, prefix=''):
+        """One round trip: the server's get_many carries versions, so a
+        Watch poll never multiplies wire ops N+1-fold over the plain
+        scan it gates. An older server without the versions field
+        degrades to the derived per-key path."""
+        resp = self._request({'op': 'get_many',
+                              'key': self._full_prefix(prefix)})
+        versions = resp.get('versions')
+        if versions is None:
+            return super().get_many_versioned(prefix)
+        values = resp.get('values') or {}
+        return {self._strip(k): Versioned(values.get(k), v)
+                for k, v in versions.items() if k in values}
 
     def ping(self):
         return self._request({'op': 'ping'})

@@ -101,6 +101,10 @@ class FactorPlan:
     bucket_dims: List[int]              # sorted bucket keys (stable order)
     local_flat_offsets: Dict[int, int]  # bucket dim -> offset into the
                                         # per-device concatenated slot vector
+    # the ownership rule this plan was built with — carried so
+    # comm_volume can honestly price the OTHER comm mode's layout
+    # (a pred plan re-derives whole-layer ownership from the same rule)
+    assignment: str = 'round_robin'
 
     @property
     def num_layers(self):
@@ -167,8 +171,36 @@ class FactorPlan:
                 if method == 'eigh':
                     inverse += b.n_rows * b.dim * wire + b.n_rows * scale_b
         else:
+            pred_owners = None
             for pg in self.pred_groups:
-                rows = self.num_devices * pg.k_per_dev
+                k = pg.k_per_dev
+                if k == 0:
+                    # this plan was built for comm_inverse, so the pred
+                    # local tables were never laid out — but the OTHER
+                    # road's price must still be honest (the autotuner's
+                    # comm-mode prior asks for it via the comm_mode
+                    # override): K is what the pred layout WOULD pad to.
+                    # Re-derive the WHOLE-LAYER ownership a pred plan
+                    # builds (pred never distributes factor-wise — a
+                    # distributed plan's nominal A-owners clump on even
+                    # ranks and would inflate K up to 2x)
+                    if pred_owners is None:
+                        if self.assignment == 'balanced':
+                            costs = [_slot_cost(m.in_dim)
+                                     + _slot_cost(m.out_dim)
+                                     for m in self.metas]
+                            pred_owners = [int(o) for o in
+                                           balanced_assign(
+                                               costs, self.num_devices)]
+                        else:
+                            pred_owners = [int(o) for o in
+                                           round_robin_assign(
+                                               len(self.metas),
+                                               self.num_devices)]
+                    owners = [pred_owners[int(i)] for i in pg.layer_idx]
+                    k = max(1, max(owners.count(d)
+                                   for d in range(self.num_devices)))
+                rows = self.num_devices * k
                 pred += rows * (pg.dg * pg.da * wire + scale_b)
         if decomp_shard is not None:
             # the shard exchange REPLACES the staggered InverseComm
@@ -230,6 +262,15 @@ class CohortPlan:
     mate_flat: Dict[int, np.ndarray]
     cohort_cost: np.ndarray             # [P, F] Σ bucket_dim³ per cohort
     cohort_count: np.ndarray            # [P, F] valid rows per cohort
+    # per-bucket cadence overrides (ISSUE 14): the base refresh window
+    # this layout was built for and the {bucket dim: stretch} overrides
+    # applied on top of it — ``num_cohorts`` is the expanded table
+    # window (base * lcm(stretches)); a bucket with stretch m refreshes
+    # each of its rows every base*m steps instead of every base steps.
+    # Carried so ``KFAC.rebase_cohorts`` can tell "same layout" apart
+    # from "same cohort count by coincidence".
+    base_freq: int = 0
+    bucket_freq: Dict[int, int] = dataclasses.field(default_factory=dict)
 
     def max_rows_per_step(self):
         """Max over (device, cohort) of genuinely refreshed rows — the
@@ -247,7 +288,8 @@ class CohortPlan:
             if self.cohort_count.size else 0
 
 
-def build_cohorts(plan: 'FactorPlan', num_cohorts: int) -> CohortPlan:
+def build_cohorts(plan: 'FactorPlan', num_cohorts: int,
+                  bucket_freq: Optional[Dict[int, int]] = None) -> CohortPlan:
     """Partition each device's valid factor rows into ``num_cohorts``
     refresh cohorts, balanced by eigh cost ∝ D³.
 
@@ -258,47 +300,95 @@ def build_cohorts(plan: 'FactorPlan', num_cohorts: int) -> CohortPlan:
     cost tiebreak round-robins each bucket's equal-cost rows over the
     cheapest cohorts (large buckets don't clump onto the step that also
     drew the small-bucket overflow).
+
+    ``bucket_freq`` (ISSUE 14): per-bucket cadence overrides — a
+    ``{bucket dim: stretch}`` map where a bucket with stretch ``m``
+    refreshes each of its rows every ``num_cohorts * m`` steps instead
+    of every ``num_cohorts``. The table window expands to
+    ``W = lcm over buckets of num_cohorts * m`` and a row with stretch
+    ``m`` appears in ``W / (num_cohorts * m)`` cohorts at stride
+    ``num_cohorts * m`` — the greedy balances the SUM of (count, load)
+    over a row's appearance set, so the per-step decomposition budget
+    stays even while stretched (typically large-D) buckets buy their
+    rows out of most steps. With no overrides (the default) this
+    reduces bit-identically to the original single-appearance layout.
     """
+    import math
     F = max(1, int(num_cohorts))
     P = plan.num_devices
+    bucket_freq = {int(k): max(1, int(v))
+                   for k, v in (bucket_freq or {}).items()}
+    unknown = sorted(set(bucket_freq) - set(plan.bucket_dims))
+    if unknown:
+        raise ValueError(f'bucket_freq names unknown bucket dims '
+                         f'{unknown} (plan has {plan.bucket_dims})')
+    stretch = {b: bucket_freq.get(b, 1) for b in plan.bucket_dims}
+    W = F
+    for m in stretch.values():
+        W = math.lcm(W, F * m)
+    if W > 128 * F:
+        # the tables are static traced constants replicated per cohort:
+        # coprime stretches would lcm-explode them (231x for {3,7,11}).
+        # KFAC.replan restricts stretches to powers of two <= 64; this
+        # backstop keeps direct callers inside the same budget.
+        raise ValueError(
+            f'bucket_freq window {W} exceeds {128 * F} '
+            f'(= 128 * base {F}): use power-of-two stretches '
+            f'(got {bucket_freq})')
+
+    def _appearances(bdim, c0):
+        return range(c0, W, F * stretch[bdim])
+
     assign: Dict[int, np.ndarray] = {}
-    cohort_cost = np.zeros((P, F), dtype=np.float64)
-    cohort_count = np.zeros((P, F), dtype=np.int64)
+    cohort_cost = np.zeros((P, W), dtype=np.float64)
+    cohort_count = np.zeros((P, W), dtype=np.int64)
     for bdim in plan.bucket_dims:
         b = plan.buckets[bdim]
         assign[bdim] = np.full((P, b.per_dev), -1, dtype=np.int64)
     for d in range(P):
-        loads = np.zeros(F, dtype=np.float64)
-        counts = np.zeros(F, dtype=np.int64)
+        loads = np.zeros(W, dtype=np.float64)
+        counts = np.zeros(W, dtype=np.int64)
         for bdim in sorted(plan.bucket_dims, reverse=True):
             b = plan.buckets[bdim]
+            period = F * stretch[bdim]
             ks = [k for k in range(b.per_dev) if b.valid[d * b.per_dev + k]]
             for k in ks:
-                c = min(range(F), key=lambda i: (counts[i], loads[i], i))
+                # a stretched row appears at stride `period`: balance
+                # the TOTAL count/load over its whole appearance set
+                # (stretch 1 / W == F is exactly the original
+                # (counts[c], loads[c], c) key)
+                c = min(range(period), key=lambda c0: (
+                    sum(counts[a] for a in _appearances(bdim, c0)),
+                    sum(loads[a] for a in _appearances(bdim, c0)), c0))
                 assign[bdim][d, k] = c
                 # cost at the PADDED dim: that is what the batched
                 # decomposition actually runs at
-                loads[c] += _slot_cost(bdim)
-                counts[c] += 1
+                for a in _appearances(bdim, c):
+                    loads[a] += _slot_cost(bdim)
+                    counts[a] += 1
         cohort_cost[d] = loads
         cohort_count[d] = counts
+
+    def _in_cohort(bdim, c0, f):
+        return c0 >= 0 and (f - c0) % (F * stretch[bdim]) == 0
 
     rows, valid, grows, gvalid, own_flat, mate_flat = {}, {}, {}, {}, {}, {}
     for bdim in plan.bucket_dims:
         b = plan.buckets[bdim]
-        counts = np.zeros((F, P), dtype=np.int64)
+        counts = np.zeros((W, P), dtype=np.int64)
         for d in range(P):
             for k in range(b.per_dev):
                 c = assign[bdim][d, k]
                 if c >= 0:
-                    counts[c, d] += 1
+                    for a in _appearances(bdim, c):
+                        counts[a, d] += 1
         R = max(1, int(counts.max()))
-        r_tbl = np.zeros((F, P, R), dtype=np.int32)
-        v_tbl = np.zeros((F, P, R), dtype=bool)
-        for f in range(F):
+        r_tbl = np.zeros((W, P, R), dtype=np.int32)
+        v_tbl = np.zeros((W, P, R), dtype=bool)
+        for f in range(W):
             for d in range(P):
                 members = [k for k in range(b.per_dev)
-                           if assign[bdim][d, k] == f]
+                           if _in_cohort(bdim, assign[bdim][d, k], f)]
                 # padding points at a row OUTSIDE this cohort (always
                 # exists whenever padding is needed: count < R ≤ per_dev)
                 # so real updates and padding writes never collide
@@ -313,22 +403,23 @@ def build_cohorts(plan: 'FactorPlan', num_cohorts: int) -> CohortPlan:
         rows[bdim] = r_tbl
         valid[bdim] = v_tbl
         dev_off = (np.arange(P, dtype=np.int32) * b.per_dev)[None, :, None]
-        grows[bdim] = (r_tbl + dev_off).reshape(F, P * R)
-        gvalid[bdim] = v_tbl.reshape(F, P * R)
+        grows[bdim] = (r_tbl + dev_off).reshape(W, P * R)
+        gvalid[bdim] = v_tbl.reshape(W, P * R)
         own_flat[bdim] = (r_tbl + plan.local_flat_offsets[bdim]).astype(
             np.int32)
         if b.mate_flat is not None:
             mate_flat[bdim] = np.take_along_axis(
-                np.broadcast_to(b.mate_flat[None], (F,) + b.mate_flat.shape),
+                np.broadcast_to(b.mate_flat[None], (W,) + b.mate_flat.shape),
                 r_tbl, axis=2).astype(np.int32)
         else:
             # factor-wise distributed layouts carry no mate maps (eigh
             # only there — the cholesky path never reads this table)
             mate_flat[bdim] = own_flat[bdim]
-    return CohortPlan(num_cohorts=F, rows=rows, valid=valid,
+    return CohortPlan(num_cohorts=W, rows=rows, valid=valid,
                       global_rows=grows, global_valid=gvalid,
                       own_flat=own_flat, mate_flat=mate_flat,
-                      cohort_cost=cohort_cost, cohort_count=cohort_count)
+                      cohort_cost=cohort_cost, cohort_count=cohort_count,
+                      base_freq=F, bucket_freq=bucket_freq)
 
 
 @dataclasses.dataclass
@@ -471,6 +562,30 @@ def build_decomp_shard(plan: 'FactorPlan',
         shard_cost=shard_cost, shard_count=shard_count,
         cohort_rows={b: cohorts.rows[b].shape[2]
                      for b in plan.bucket_dims})
+
+
+def same_row_layout(plan_a: 'FactorPlan', plan_b: 'FactorPlan') -> bool:
+    """True when the two plans place every factor row identically —
+    same world size, same buckets (dims, per-device rows, validity) and
+    the same per-layer row map. When this holds, a rebuilt plan's state
+    arrays are layout-compatible with the old plan's and a replan can
+    carry them VERBATIM (the applied comm-mode switch: only the traced
+    programs change, not one byte of state). comm_mode itself is NOT
+    part of the row layout — only ownership (which both plans derive
+    from the same assignment inputs) is."""
+    if plan_a.num_devices != plan_b.num_devices:
+        return False
+    if plan_a.bucket_dims != plan_b.bucket_dims:
+        return False
+    for bdim in plan_a.bucket_dims:
+        a, b = plan_a.buckets[bdim], plan_b.buckets[bdim]
+        if (a.per_dev, a.n_rows) != (b.per_dev, b.n_rows):
+            return False
+        if not np.array_equal(a.valid, b.valid):
+            return False
+        if not np.array_equal(a.true_dims, b.true_dims):
+            return False
+    return plan_a.layer_rows == plan_b.layer_rows
 
 
 def build_plan(metas: Dict[str, LayerMeta], num_devices: int, comm_mode: str,
@@ -636,4 +751,5 @@ def build_plan(metas: Dict[str, LayerMeta], num_devices: int, comm_mode: str,
     return FactorPlan(metas=meta_list, num_devices=P, comm_mode=comm_mode,
                       buckets=buckets, layer_rows=layer_rows,
                       pred_groups=pred_groups, bucket_dims=bucket_dims,
-                      local_flat_offsets=local_flat_offsets)
+                      local_flat_offsets=local_flat_offsets,
+                      assignment=assignment)

@@ -292,13 +292,23 @@ class KFAC:
                  basis_update_freq=None, warm_start_basis=False,
                  warm_sweeps=None, cold_restart_every=50, stagger=False,
                  health=True, comm_precision='fp32', comm_prefetch=False,
-                 decomp_impl=None, decomp_shard=False):
+                 decomp_impl=None, decomp_shard=False, comm_mode=None):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
         if cfg['comm_mode'] is None:  # 'inverse' variant honors the flag
             cfg['comm_mode'] = ('inverse' if communicate_inverse_or_not
                                 else 'pred')
+        if comm_mode is not None:
+            # ISSUE 14: comm_mode is a RUNTIME knob now — the variant
+            # only picks the starting mode, and an explicit override
+            # (the trainers' --kfac-comm-mode, a kfac-serve relaunch
+            # carrying an autotune-adopted switch) starts on the other
+            # road of the same layout. The live switch is KFAC.replan.
+            if comm_mode not in ('inverse', 'pred'):
+                raise ValueError("comm_mode must be 'inverse' or 'pred', "
+                                 f'got {comm_mode!r}')
+            cfg['comm_mode'] = comm_mode
         self.variant = variant
         self.stats_reduce = cfg['stats_reduce']
         self.method = cfg['method']
@@ -404,6 +414,17 @@ class KFAC:
                     '"Staggered refresh")')
         self._cohorts = None
         self._shard_plan = None
+        # per-bucket stagger cadence overrides ({bucket dim: stretch},
+        # plan.build_cohorts bucket_freq) — set via replan(); empty =
+        # the uniform cadence
+        self.bucket_stagger_freq = {}
+        # resolved factor distribution (setup records it; replan keeps
+        # it except where comm_pred forbids the factor-wise split)
+        self._distributed = None
+        # a queued replan spec (request_replan): the trainer applies it
+        # host-side between steps (apply_pending_replan) — the
+        # double-buffered swap point where no traced program is running
+        self._pending_replan = None
         from kfac_pytorch_tpu.parallel import collectives as _coll
         self.comm_precision = _coll.check_wire_dtype(comm_precision)
         self.comm_prefetch = bool(comm_prefetch)
@@ -461,13 +482,20 @@ class KFAC:
         distribute = self.distribute_layer_factors
         if self.variant in ('eigen', 'ekfac') and distribute is None:
             # reference auto rule: factor-wise split iff world > #layers
-            # (eigen.py:66-71)
-            distribute = self.num_devices > len(metas)
+            # (eigen.py:66-71) — but comm_pred forbids the factor-wise
+            # split (rank_a == rank_g), so a comm_mode='pred' override
+            # (ctor or replan) collapses the auto rule to whole-layer
+            # ownership, mirroring replan()'s resolution: any config
+            # the live switch can land on must be constructible cold
+            # (the adopted-knobs relaunch restarts trainers there)
+            distribute = (self.comm_mode != 'pred'
+                          and self.num_devices > len(metas))
         self.plan = build_plan(
             metas, num_devices=self.num_devices, comm_mode=self.comm_mode,
             assignment=self.assignment,
             distribute_layer_factors=bool(distribute),
             bucket_fn=self.bucket_fn)
+        self._distributed = bool(distribute)
         self._cohorts = None
         if self.stagger:
             self.rebase_cohorts()
@@ -484,8 +512,12 @@ class KFAC:
         if not self.stagger or self.plan is None:
             return None
         f = max(1, int(self.kfac_update_freq))
-        if self._cohorts is None or self._cohorts.num_cohorts != f:
-            self._cohorts = build_cohorts(self.plan, f)
+        overrides = {int(k): max(1, int(v))
+                     for k, v in (self.bucket_stagger_freq or {}).items()}
+        if (self._cohorts is None or self._cohorts.base_freq != f
+                or self._cohorts.bucket_freq != overrides):
+            self._cohorts = build_cohorts(self.plan, f,
+                                          bucket_freq=overrides)
             self._shard_plan = None
         if self.decomp_shard and self._shard_plan is None:
             self._shard_plan = build_decomp_shard(self.plan, self._cohorts)
@@ -501,6 +533,285 @@ class KFAC:
         """The mesh-sharded decomposition layout
         (plan.DecompShardPlan), or None when ``decomp_shard`` is off."""
         return self._shard_plan
+
+    # -- live replanning (ISSUE 14) ---------------------------------------
+
+    @property
+    def pending_replan(self):
+        """The queued :meth:`request_replan` spec (or None). The trainer
+        checks this at the top of every host step and applies it via
+        :meth:`apply_pending_replan` — the atomic between-steps swap."""
+        return self._pending_replan
+
+    def request_replan(self, _invalidate=True, **spec):
+        """Queue a replan to be applied at the next step boundary.
+
+        The knob arbiter calls this when the tuner commits a
+        ``comm_mode`` switch (it cannot apply the switch itself — the
+        factor state lives in the trainer's TrainState, and the swap
+        must happen between steps, never under a traced program).
+        Later requests merge per key; ``_invalidate=False`` records
+        that the caller already fired the variant-cache invalidators
+        (the arbiter fires them exactly once at commit time). The flag
+        ORs across merged requests: one caller that still needs the
+        invalidation keeps it armed even when an arbiter request (which
+        already fired) merges in after it."""
+        pend = dict(self._pending_replan or {'_invalidate': False})
+        invalidate = bool(pend.get('_invalidate', False)) or _invalidate
+        pend.update(spec)
+        pend['_invalidate'] = invalidate
+        self._pending_replan = pend
+        return pend
+
+    def apply_pending_replan(self, kfac_state):
+        """Apply (and clear) the queued replan against ``kfac_state``;
+        returns the (possibly verbatim) transported state. No-op when
+        nothing is pending."""
+        spec = self._pending_replan
+        self._pending_replan = None
+        if not spec:
+            return kfac_state
+        return self.replan(kfac_state, **spec)
+
+    def replan(self, kfac_state=None, *, comm_mode=None, num_devices=None,
+               bucket_overrides=None, variant=None,
+               axis_name='__unchanged__', _invalidate=True):
+        """Rebuild the :class:`~kfac_pytorch_tpu.plan.FactorPlan` (and
+        the staggered cohort/shard tables) MID-RUN and transport the
+        factor state into the new layout — the primitive behind applied
+        comm-mode switching, per-bucket cadence tuning and
+        zero-relaunch elasticity (ROADMAP item 2).
+
+        Args (every one optional — unset keeps the current value):
+          kfac_state: the live :class:`KFACState` to transport; None
+            rebuilds the plan only (no state exists yet). Host-side:
+            call OUTSIDE jit with the state addressable. When the row
+            layout is unchanged (a pure comm-mode switch) the state is
+            carried VERBATIM — not a byte moves, only the traced
+            programs change.
+          comm_mode: 'inverse' | 'pred' — the applied switch between
+            communicating decompositions and communicating
+            preconditioned gradients. Factor EMAs, decompositions and
+            the EF residual all carry exactly (same rows, same
+            owners); E-KFAC scale moments are comm-mode shaped and
+            re-accumulate (their existing transport contract).
+          num_devices: the new world size — the elastic lane.
+            Factors AND (same-method) decompositions transport through
+            ``reshard_kfac_state``'s per-layer row remap, so the
+            resumed world preconditions immediately instead of passing
+            gradients through until the next refresh.
+          bucket_overrides: per-bucket stagger cadence
+            ``{bucket dim: stretch}`` (``plan.build_cohorts``
+            bucket_freq; ``{}`` clears). Stagger configs only.
+          variant: switch the variant family (e.g. 'eigen' <->
+            'inverse_dp'): stats_reduce/method/comm_mode re-derive from
+            the variant table (an explicit ``comm_mode=`` still wins).
+            Cross-METHOD switches rebuild the decomposition from the
+            carried factors at the next inverse update (the trainer's
+            seen-inverse gate re-arms through the invalidator).
+          axis_name: the mesh axis of the new plan (elastic 1<->N
+            moves); default keeps the current one.
+
+        The swap is atomic at the host level: the new plan, tables and
+        transported state are fully built BEFORE any attribute of this
+        preconditioner changes, so a failed replan leaves the run
+        untouched. The KnobArbiter invalidators fire exactly once per
+        replan (``_invalidate=False`` when the arbiter already fired
+        them at commit time), so every attached trainer retraces
+        against the new plan and nothing else recompiles.
+        """
+        import copy
+        import logging
+        assert self.plan is not None, 'call setup() first'
+        from kfac_pytorch_tpu.plan import same_row_layout
+        old_plan = self.plan
+        log = logging.getLogger(__name__)
+
+        # -- resolve the target configuration -----------------------------
+        new_variant = self.variant if variant is None else variant
+        if new_variant not in _VARIANTS:
+            raise KeyError(f'unknown variant {new_variant!r}')
+        cfg = dict(_VARIANTS[new_variant])
+        if variant is None:
+            new_mode = self.comm_mode
+        else:
+            new_mode = cfg['comm_mode'] or 'pred'
+        if comm_mode is not None:
+            if comm_mode not in ('inverse', 'pred'):
+                raise ValueError("comm_mode must be 'inverse' or 'pred', "
+                                 f'got {comm_mode!r}')
+            new_mode = comm_mode
+        new_method = cfg['method'] if variant is not None else self.method
+        new_reduce = (cfg['stats_reduce'] if variant is not None
+                      else self.stats_reduce)
+        new_ekfac = (cfg.get('ekfac', False) if variant is not None
+                     else self.ekfac)
+        new_P = self.num_devices if num_devices is None else int(num_devices)
+        if new_P < 1:
+            raise ValueError(f'num_devices must be >= 1, got {new_P}')
+        new_axis = (self.axis_name if axis_name == '__unchanged__'
+                    else axis_name)
+        if bucket_overrides is None:
+            new_overrides = dict(self.bucket_stagger_freq or {})
+        else:
+            if not self.stagger:
+                raise ValueError(
+                    'bucket_overrides tune the STAGGERED cohort cadence '
+                    '(KFAC(stagger=True)); this preconditioner refreshes '
+                    'whole tables')
+            new_overrides = {int(k): int(v)
+                             for k, v in dict(bucket_overrides).items()}
+            if any(v < 1 for v in new_overrides.values()):
+                raise ValueError('bucket_overrides stretches must be '
+                                 f'>= 1, got {new_overrides}')
+            if any(v & (v - 1) or v > 64 for v in new_overrides.values()):
+                # power-of-two stretches keep the cohort-table window at
+                # F * max(stretch); coprime stretches would lcm-explode
+                # the static tables (231x for {3,7,11}) that get baked
+                # into every traced program
+                raise ValueError('bucket_overrides stretches must be '
+                                 'powers of two <= 64, got '
+                                 f'{new_overrides}')
+            unknown = sorted(set(new_overrides)
+                             - set(old_plan.bucket_dims))
+            if unknown:
+                # validated HERE, before the atomic commit — a bad dim
+                # failing later inside rebase_cohorts would leave the
+                # preconditioner half-swapped and wedge every
+                # subsequent staggered dispatch
+                raise ValueError(
+                    f'bucket_overrides names unknown bucket dims '
+                    f'{unknown} (plan has {old_plan.bucket_dims})')
+
+        # -- validate the combination (the ctor rules, re-checked) --------
+        if new_mode == 'pred' and self.comm_prefetch:
+            raise ValueError(
+                "cannot replan to comm_mode='pred' with comm_prefetch: "
+                'the pred gather IS the step consumer and cannot be '
+                'deferred (drop comm_prefetch first)')
+        if new_ekfac and self.stagger:
+            raise ValueError('cannot replan a staggered preconditioner '
+                             'onto an ekfac variant (stagger exclusion)')
+        if self.decomp_impl is not None:
+            if (self.decomp_impl in ('subspace', 'jacobi')
+                    and new_method != 'eigh'):
+                raise ValueError(
+                    f'decomp_impl={self.decomp_impl!r} is an eigh kernel '
+                    f'but the replan target decomposes by {new_method} — '
+                    'switch decomp_impl first')
+            if (self.decomp_impl == 'newton_schulz'
+                    and new_method != 'cholesky'):
+                raise ValueError(
+                    "decomp_impl='newton_schulz' replaces the Cholesky "
+                    f'inverse but the replan target uses {new_method} — '
+                    'switch decomp_impl first')
+        # comm_pred forbids the factor-wise split (reference asserts
+        # rank_a == rank_g there): a distributed eigen layout replans to
+        # pred by collapsing back to whole-layer ownership. The
+        # resolution MIRRORS setup() exactly for the target config —
+        # the ctor's explicit flag, else the eigen/ekfac auto rule
+        # re-resolved for the new world/variant (a non-eigen target
+        # never auto-distributes) — because a replanned plan must be
+        # the plan a fresh setup of that config would build, or the
+        # adopted-knobs relaunch would land state on a different row
+        # layout than the live-switched incarnation ran.
+        distribute = self.distribute_layer_factors
+        if distribute is None and new_variant in ('eigen', 'ekfac'):
+            distribute = (new_mode != 'pred'
+                          and new_P > len(old_plan.metas))
+        distribute = bool(distribute)
+        if new_mode == 'pred':
+            distribute = False
+
+        # -- build the new layout + transported state FIRST ---------------
+        new_plan = build_plan(
+            {m.path: m for m in old_plan.metas}, num_devices=new_P,
+            comm_mode=new_mode, assignment=self.assignment,
+            distribute_layer_factors=distribute, bucket_fn=self.bucket_fn)
+        clone = copy.copy(self)
+        clone.variant = new_variant
+        clone.stats_reduce = new_reduce
+        clone.method = new_method
+        clone.comm_mode = new_mode
+        clone.ekfac = new_ekfac
+        clone.num_devices = new_P
+        clone.axis_name = new_axis
+        clone.plan = new_plan
+        clone._distributed = distribute
+        clone.bucket_stagger_freq = new_overrides
+        clone._cohorts = None
+        clone._shard_plan = None
+
+        same_layout = same_row_layout(old_plan, new_plan)
+        new_state = kfac_state
+        verbatim = False
+        if kfac_state is not None:
+            verbatim = (
+                same_layout and self.method == clone.method
+                # scales are comm-mode shaped; the EF residual only
+                # exists on lossy MPD reduces — both must agree for a
+                # byte-for-byte carry
+                and (not (self.ekfac or clone.ekfac)
+                     or (self.ekfac == clone.ekfac
+                         and self.comm_mode == clone.comm_mode))
+                and self._tracks_comm_err == clone._tracks_comm_err
+                and ((kfac_state.comm_err is None)
+                     == (not clone._tracks_comm_err)))
+            if not verbatim:
+                from kfac_pytorch_tpu.utils.checkpoint import \
+                    reshard_kfac_state
+                new_state = reshard_kfac_state(self, clone, kfac_state,
+                                               carry_decomp=True)
+
+        # -- commit: swap every table/attr atomically between steps -------
+        trace_changed = (
+            not same_layout or new_mode != self.comm_mode
+            or new_method != self.method or new_reduce != self.stats_reduce
+            or new_ekfac != self.ekfac or new_axis != self.axis_name
+            or new_overrides != (self.bucket_stagger_freq or {}))
+        try:
+            from kfac_pytorch_tpu.autotune import _applying
+        except ImportError:  # pragma: no cover — autotune is stdlib
+            import contextlib
+            _applying = contextlib.nullcontext
+        with _applying():
+            # comm_mode is a KNOB_ATTRS member: the write happens under
+            # the arbiter's applying guard (single-writer discipline),
+            # and the arbiter re-bases below so it never reads this as
+            # a foreign write to adopt
+            self.comm_mode = new_mode
+        self.variant = new_variant
+        self.stats_reduce = new_reduce
+        self.method = new_method
+        self.ekfac = new_ekfac
+        self.num_devices = new_P
+        self.axis_name = new_axis
+        self.plan = new_plan
+        self._distributed = distribute
+        self.bucket_stagger_freq = new_overrides
+        self._cohorts = None
+        self._shard_plan = None
+        if self.stagger:
+            self.rebase_cohorts()
+        arb = self._knob_arbiter
+        if arb is not None:
+            arb.sync_knobs(comm_mode=new_mode)
+        log.info(
+            'kfac: replan applied comm_mode=%s world=%d%s%s '
+            '(layout %s, state %s)', new_mode, new_P,
+            f' variant={new_variant}' if variant is not None else '',
+            f' bucket_overrides={new_overrides}' if new_overrides else '',
+            'unchanged' if same_layout else 'rebuilt',
+            'carried verbatim' if verbatim else
+            ('transported' if kfac_state is not None else 'none'))
+        if _invalidate and trace_changed and arb is not None:
+            arb.invalidate()
+        elif _invalidate and trace_changed:
+            # no arbiter yet -> no trainer registered an invalidator;
+            # create it lazily so later trainers still attach to one
+            from kfac_pytorch_tpu.autotune import arbiter_for
+            arbiter_for(self).invalidate()
+        return new_state
 
     @property
     def resolved_decomp_impl(self):

@@ -8,10 +8,12 @@ permanent: the surviving hosts' supervisors agree on the surviving set,
 relaunch their trainers with the reduced world size, and the trainers
 resume through :func:`elastic_resume`, which transports the accumulated
 K-FAC factor statistics (thousands of steps of A/G EMAs) from the old
-world's checkpoint layout into the new one via
-``utils.checkpoint.reshard_kfac_state``. Decompositions re-initialize
-and are rebuilt at the first inverse update — the fresh-start degrade
-path the trainer already handles.
+world's checkpoint layout into the new one — routed through
+``KFAC.replan`` (ISSUE 14), which rides
+``utils.checkpoint.reshard_kfac_state`` and carries the stored
+decompositions too (same method), so the relaunched world resumes
+preconditioning immediately instead of paying a cold full
+decomposition on the relaunch critical path.
 
 One :class:`PodSupervisor` per host (``kfac-pod-supervise``, or
 ``KFAC_POD_SUPERVISE=1`` through ``launch_tpu.sh``)::
@@ -211,8 +213,20 @@ def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
                                        retry=retry)
     if epoch is None:
         return None, None, old_world
-    carried = ckpt.reshard_kfac_state(pre_old, precond,
-                                      restored.kfac_state)
+    # route the cross-world transport through the live replanning path
+    # (ISSUE 14): pre_old — the restore structure — replans itself into
+    # the new world and transports factors AND (same-method)
+    # decompositions through reshard_kfac_state's row remap, so the
+    # relaunch resumes *preconditioning* immediately instead of paying
+    # a cold full decomposition on the relaunch critical path. The
+    # trainer's preconditioner keeps its own (identical) plan; replan
+    # here is the transport engine, and the layout it lands on must be
+    # the one the trainer runs (same world, same comm mode).
+    carried = pre_old.replan(
+        jax.device_get(restored.kfac_state),
+        num_devices=getattr(precond, 'num_devices', old_world),
+        comm_mode=getattr(precond, 'comm_mode', None),
+        axis_name=getattr(precond, 'axis_name', None))
     # adopt through the host: restored leaves may be committed to the
     # old world's sharding and cannot feed the new mesh directly
     host = jax.device_get
@@ -223,9 +237,10 @@ def elastic_resume(base_dir, max_epoch, precond, state, *, make_precond,
         health=host(restored.health),  # committed like every other leaf
         kfac_state=host(carried))
     step = int(jax.device_get(restored.step))
-    lg.info('elastic resume: transported K-FAC factors from world %d -> '
-            '%d at checkpoint-%d (step %d); decompositions rebuild at '
-            'the first inverse update', old_world, new_world, epoch, step)
+    lg.info('elastic resume: transported K-FAC factors AND '
+            'decompositions from world %d -> %d at checkpoint-%d '
+            '(step %d) via replan — preconditioning resumes immediately',
+            old_world, new_world, epoch, step)
     if new_world > old_world:
         # machine-greppable grow form (incident/timeline grammar):
         # distinct from the shrink direction so a churn timeline can
@@ -889,6 +904,22 @@ class PodSupervisor:
                       self.host_id, next_gen)
         start = self.clock.monotonic()
         pace = self._new_pace()
+        # watch-driven settle (ISSUE 14 / coord follow-on): gate the
+        # expensive claim re-read on the backend's change feed over the
+        # barrier prefix instead of re-scanning every poll — a new
+        # claimant (including a joiner we never heard announce) shows
+        # up as a watch event before it can matter to the expected-set
+        # condition. PollPacer stays as the pacing fallback: backends
+        # without watch (a custom CoordBackend predating it) and
+        # chaos-net runs (the partition matrix changes REACHABILITY
+        # with no key change, which a pure change feed cannot see)
+        # keep the plain poll-paced scan.
+        watch = None
+        if self.net_chaos is None:
+            watch_fn = getattr(self.coord, 'watch', None)
+            if callable(watch_fn):
+                with contextlib.suppress(OSError):
+                    watch = watch_fn(claim_dir + '/')
         while self.clock.monotonic() - start < self.grow_timeout:
             # SHRINK LANE WINS: a join announcement racing an
             # unconfirmed peer death can put peers in the shrink
@@ -909,14 +940,25 @@ class PodSupervisor:
                     'lane wins)', next_gen)
                 self.report.add_event('grow_yielded', gen=next_gen)
                 return False
-            claims = self._read_claims(claim_dir, prefix='member-')
-            # expected = incumbents + every announcer + everyone who
-            # already claimed (a host that saw an announcement we
-            # missed, or a joiner we only learn about from its claim)
-            expected = (set(self.members) | set(joiners)
-                        | set(self._join_announced()) | set(claims))
-            if expected <= set(claims):
-                break
+            changed = True
+            if watch is not None:
+                try:
+                    changed = bool(watch.poll())
+                except CoordGiveUp:
+                    raise
+                except OSError:
+                    # a failed poll degrades to the plain scan — the
+                    # watch is an optimization, never a correctness gate
+                    changed = True
+            if changed:
+                claims = self._read_claims(claim_dir, prefix='member-')
+                # expected = incumbents + every announcer + everyone who
+                # already claimed (a host that saw an announcement we
+                # missed, or a joiner we only learn about from its claim)
+                expected = (set(self.members) | set(joiners)
+                            | set(self._join_announced()) | set(claims))
+                if expected <= set(claims):
+                    break
             pace.sleep()
         # settle: a straggling claimant (joiner slow to scan the new
         # barrier dir, incumbent slow to stop its trainer) makes it in

@@ -401,6 +401,16 @@ class AdmissionController:
 
     def _rank_argv(self, record, ns, rank):
         spec = validate_spec(record['spec'], trainers=self.trainers)
+        adopted = record.get('adopted_knobs') or {}
+        if adopted:
+            # autotune-adopted knobs from the PREVIOUS incarnation
+            # (ISSUE 14 / PR 10 follow-on): overlay them on the
+            # submitted spec so the relaunch resumes at its tuned
+            # cadence instead of re-climbing the ladder. The keys were
+            # validated against KFAC_KNOBS at requeue time; the
+            # tenant's spec stays the stored intent — the overlay is
+            # runtime provenance on the record.
+            spec.knobs.update(adopted)
         script = self.trainers[spec.trainer]
         if not os.path.isabs(script):
             script = os.path.join(self.repo_root, script)
@@ -536,16 +546,57 @@ class AdmissionController:
         if charge:
             backoff = min(self.backoff_max,
                           self.backoff_base * (2 ** charged))
+        extra = {}
+        adopted = self._adopted_knobs(run)
+        if adopted:
+            extra['adopted_knobs'] = adopted
         new = self.queue.requeue(
             record, rc=rc, reason=klass, backoff_s=backoff,
-            charged_requeues=charged + (1 if charge else 0))
+            charged_requeues=charged + (1 if charge else 0), **extra)
         if new is not None:
             self.log.warning(
                 'service: job_requeue job=%d tenant=%s rc=%d class=%s '
                 'attempt=%d backoff_s=%.1f', record['id'],
                 spec['tenant'], rc if rc is not None else -1, klass,
                 record.get('attempt', 0), backoff)
+            if adopted:
+                self.log.warning(
+                    'service: job_knobs_adopted job=%d tenant=%s '
+                    'knobs=%s', record['id'], spec['tenant'],
+                    json.dumps(adopted, sort_keys=True))
         self._finish(run)
+
+    def _adopted_knobs(self, run):
+        """The dead incarnation's autotune-adopted knob snapshot
+        (``adopted-knobs.json``, written by the KnobController next to
+        its decision log in the job's trace namespace), filtered to the
+        spec knob grammar. A requeued job relaunches with these overlaid
+        on its spec, so the tuner's climb survives the restart (the
+        arbiter adopts them as its new base — the cross-generation
+        composition tests pin that). Missing/torn file -> {} (the job
+        simply re-climbs)."""
+        from kfac_pytorch_tpu.autotune import ADOPTED_KNOBS_FILENAME
+        from kfac_pytorch_tpu.service.spec import KFAC_KNOBS
+        path = os.path.join(run.ns['trace'], ADOPTED_KNOBS_FILENAME)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        if not isinstance(doc, dict):
+            return {}
+        out = {}
+        for k, v in doc.items():
+            if (k in KFAC_KNOBS and not isinstance(v, bool)
+                    and isinstance(v, (str, int, float))
+                    # the spec path's _check_scalar rule: a tampered /
+                    # torn snapshot in the (tenant-writable) trace dir
+                    # must not smuggle a newline/NUL into the relaunch
+                    # argv or the single-line job_knobs_adopted grammar
+                    and not (isinstance(v, str)
+                             and ('\n' in v or '\x00' in v))):
+                out[k] = v
+        return out
 
     def _reap(self):
         for run in list(self.running.values()):

@@ -509,6 +509,17 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
         faults.maybe_slow(fault_cfg, step,
                           sleep=(straggler.sleep if straggler is not None
                                  else None))
+        if (precond is not None
+                and getattr(precond, 'pending_replan', None)):
+            # a queued live replan (the arbiter's applied comm_mode
+            # switch, or a direct request_replan): apply it HERE — the
+            # between-steps boundary where no traced program is running
+            # — before anything below reads the preconditioner's config
+            # or retraces against the (already-invalidated) variant
+            # cache. A pure comm-mode switch carries the state verbatim;
+            # a layout change transports it host-side.
+            state = state.replace(
+                kfac_state=precond.apply_pending_replan(state.kfac_state))
         if health_cfg is not None and state.health is None:
             # one-time upgrade of a pre-health TrainState (old checkpoint
             # or a hand-built state): done host-side BEFORE the jitted
@@ -690,13 +701,27 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     step_fn.variants = variants
     step_fn.make_variant = make_variant
     if precond is not None:
-        # trace-affecting knob changes (comm_precision through the knob
-        # arbiter — scheduler/straggler/tuner frequency changes are
-        # host-side gating and deliberately NOT invalidating) clear the
+        # trace-affecting knob changes (comm_precision / decomp_impl /
+        # an applied comm_mode replan through the knob arbiter —
+        # scheduler/straggler/tuner frequency changes are host-side
+        # gating and deliberately NOT invalidating) clear the
         # compiled-variant cache so no stale program keeps the old wire
-        # dtype; the next dispatch retraces against the new config
+        # dtype or plan; the next dispatch retraces against the new
+        # config
+        def _invalidate_variants():
+            variants.clear()
+            # a replan may have dropped the stored decomposition (a
+            # cross-method variant switch zeroes it): re-derive the
+            # "seen a decomposition" record from the STATE on the next
+            # dispatch, and restart the warm-streak bookkeeping — the
+            # next full decomposition after any trace-affecting change
+            # goes cold (never warm-seed across a swapped plan; only
+            # the cold-restart cadence shifts, never correctness)
+            for k in ('yes', 'last_full', 'warm_streak'):
+                seen_inverse.pop(k, None)
+
         from kfac_pytorch_tpu.autotune import arbiter_for
-        arbiter_for(precond).add_invalidator(variants.clear)
+        arbiter_for(precond).add_invalidator(_invalidate_variants)
     return step_fn
 
 

@@ -148,7 +148,7 @@ def _save_checkpoint_once(base_dir, epoch, state, include_kfac, block):
         os.replace(tmp, final)
 
 
-def reshard_kfac_state(pre_old, pre_new, kfac_state):
+def reshard_kfac_state(pre_old, pre_new, kfac_state, carry_decomp=False):
     """Elastic world-size resume (beyond the reference): re-lay the
     K-FAC FACTOR state from ``pre_old``'s plan (its ``num_devices``)
     into ``pre_new``'s — restore a checkpoint taken at one world size
@@ -161,12 +161,28 @@ def reshard_kfac_state(pre_old, pre_new, kfac_state):
     into fewer shards, growing spreads them over more (any pad rows the
     new, less-even layout needs start from the fresh zero init and are
     never read — pad-row-exact, pinned by the N->M->N roundtrip tests). Only the FACTORS (the accumulated statistics —
-    the state that takes thousands of steps to rebuild) are carried;
-    decompositions re-initialize to zero and are recomputed at the
-    first inverse update, exactly the fresh-start degrade path the
+    the state that takes thousands of steps to rebuild) are carried by
+    default; decompositions re-initialize to zero and are recomputed at
+    the first inverse update, exactly the fresh-start degrade path the
     trainer already handles (training.py seen-inverse gating; E-KFAC
     scales likewise re-accumulate — they are basis-bound). The step
     counter is preserved.
+
+    ``carry_decomp`` (ISSUE 14, the live-replanning transport): when
+    both preconditioners decompose by the SAME method, also transport
+    the stored decompositions through the identical per-layer row
+    remap — each row's decomposition is a property of that row's
+    (identity-padded) factor alone, so a FULL-row move is exact at any
+    world size (true-block slicing would be wrong here: eigh orders
+    eigenvalues globally, interleaving the pad block's unit eigenpairs
+    with the true spectrum). The relaunched/replanned run then resumes
+    *preconditioning* immediately instead of passing gradients through
+    until the next inverse refresh — the shrink/grow relaunch critical
+    path the replan routing cuts. New pad rows stay at the zero init
+    (never read); E-KFAC scales stay transport-transient either way
+    (their group layout is comm-mode bound, not row bound). Ignored
+    when the methods differ (an eigen<->cholesky replan rebuilds the
+    decomposition from the carried factors).
 
     Host-side numpy: call OUTSIDE jit, with the old state fully
     addressable (single-host restore, or after a replicated restore).
@@ -182,16 +198,39 @@ def reshard_kfac_state(pre_old, pre_new, kfac_state):
     fresh = pre_new.init()
     factors = {k: np.array(v) for k, v in fresh.factors.items()}
     old = {k: np.asarray(v) for k, v in kfac_state.factors.items()}
+    carry_decomp = (carry_decomp and pre_old.method == pre_new.method)
+    decomp = None
+    old_decomp = None
+    if carry_decomp:
+        # leaf groups that are per-row bucket stacks (scales are group-
+        # keyed and comm-mode shaped — never row-transported)
+        decomp = {grp: {k: np.array(v) for k, v in leaves.items()}
+                  for grp, leaves in fresh.decomp.items()
+                  if grp in ('evals', 'evecs', 'invs')}
+        old_decomp = {grp: {k: np.asarray(v) for k, v in leaves.items()}
+                      for grp, leaves in kfac_state.decomp.items()
+                      if grp in decomp}
     for i, meta in enumerate(plan_o.metas):
         ba_o, ra_o, bg_o, rg_o, _ = plan_o.layer_rows[i]
         ba_n, ra_n, bg_n, rg_n, _ = plan_n.layer_rows[i]
         da, dg = meta.in_dim, meta.out_dim
         factors[str(ba_n)][ra_n, :da, :da] = old[str(ba_o)][ra_o, :da, :da]
         factors[str(bg_n)][rg_n, :dg, :dg] = old[str(bg_o)][rg_o, :dg, :dg]
+        if carry_decomp:
+            for grp in decomp:
+                dst, src = decomp[grp], old_decomp[grp]
+                dst[str(ba_n)][ra_n] = src[str(ba_o)][ra_o]
+                dst[str(bg_n)][rg_n] = src[str(bg_o)][rg_o]
     import jax.numpy as jnp
-    return fresh.replace(
+    out = fresh.replace(
         step=jnp.asarray(np.asarray(kfac_state.step)),
         factors={k: jnp.asarray(v) for k, v in factors.items()})
+    if carry_decomp:
+        new_decomp = dict(out.decomp)
+        for grp, leaves in decomp.items():
+            new_decomp[grp] = {k: jnp.asarray(v) for k, v in leaves.items()}
+        out = out.replace(decomp=new_decomp)
+    return out
 
 
 class StaleLineageError(RuntimeError):

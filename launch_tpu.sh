@@ -65,6 +65,21 @@ if [ -n "$KFAC_COMM_PRECISION" ]; then
   esac
 fi
 
+# Live replanning (README "Live replanning"): KFAC_COMM_MODE=inverse|pred
+# overrides the variant's comm mode for every trainer of the run (the
+# trainers read it as the --kfac-comm-mode default; an explicit flag
+# still wins). 'inverse' gathers decompositions once per refresh,
+# 'pred' gathers preconditioned gradients every step; with the
+# autotuner on, the other mode is a real probe/commit rung applied
+# mid-run via KFAC.replan — this env sets only the STARTING mode.
+if [ -n "$KFAC_COMM_MODE" ]; then
+  case "$KFAC_COMM_MODE" in
+    inverse|pred) export KFAC_COMM_MODE ;;
+    *) echo "launch_tpu.sh: KFAC_COMM_MODE must be inverse|pred," \
+            "got '$KFAC_COMM_MODE'" >&2; exit 1 ;;
+  esac
+fi
+
 # Closed-loop autotuning: KFAC_AUTOTUNE=1 enables the online knob
 # controller in every trainer of the run (the trainers read it as the
 # --kfac-autotune default; an explicit flag still wins). The controller

@@ -236,6 +236,92 @@ def leg_quality_hold(art_dir):
             'failures': failures}
 
 
+class _FakeCommModePrecond(_FakePrecond):
+    """comm-mode-switchable fake (ISSUE 14): a planted analytic byte
+    model (pred ships 64 MiB every step, inverse 8 MiB per refresh) and
+    a replan stub that records the applied switch — everything the
+    controller's comm_mode rung needs, no jax anywhere."""
+
+    def __init__(self, mode='pred', **kw):
+        super().__init__(**kw)
+        self.comm_mode = mode
+        self.axis_name = 'batch'
+        self.method = 'eigh'
+        self.ekfac = False
+        self.comm_prefetch = False
+        self.replans = []
+        outer = self
+
+        class _Plan:
+            def comm_volume(self, *, stats_reduce, method,
+                            comm_precision='fp32', comm_mode=None,
+                            decomp_shard=None):
+                mode = comm_mode or outer.comm_mode
+                return {'FactorComm': 0,
+                        'InverseComm': (8 << 20) if mode == 'inverse'
+                        else 0,
+                        'PredComm': (64 << 20) if mode == 'pred' else 0,
+                        'DecompComm': 0}
+
+        self.plan = _Plan()
+
+    def request_replan(self, _invalidate=True, **spec):
+        self.replans.append(dict(spec))
+
+
+def leg_comm_mode(art_dir):
+    """The applied comm-mode switch (ISSUE 14 acceptance): a planted
+    comm-bound profile where comm_pred costs 0.05 s every step and
+    comm_inverse amortizes to ~0.015 s — the analytic verdict seeds the
+    inverse candidate first, the measured probe wins, the controller
+    COMMITS (decision log shows an *applied*, not advisory, commit via
+    KFAC.replan) and steady state beats the starting mode."""
+    pre = _FakeCommModePrecond(mode='pred', kfac=4)
+    ctl = autotune.KnobController(
+        pre, window=8, settle=1, rel_improve=0.03, dwell_windows=1,
+        cooldown=2, steady_every=0, tune=('comm_mode',),
+        decision_log=os.path.join(art_dir,
+                                  'autotune-decisions-comm-mode.jsonl'))
+
+    def model(F, i):
+        if pre.comm_mode == 'pred':
+            # the pred gather ships every step: comm-bound flat profile
+            return ('pred',), 0.05
+        if i == 0:
+            return ('pred', 'stats', 'decomp', 'gather'), 0.03
+        return ('pred',), 0.01
+
+    steps = _feed(ctl, pre, model, 1000)
+    failures = []
+    if pre.comm_mode != 'inverse':
+        failures.append(f'final comm_mode={pre.comm_mode} — the planted '
+                        'comm-bound profile was not applied')
+    commits = [d for d in ctl.decisions
+               if d['kind'] == 'commit' and d.get('knob') == 'comm_mode']
+    if not commits:
+        failures.append('no comm_mode commit in the decision log')
+    elif not commits[0].get('applied'):
+        failures.append('comm_mode commit is not marked applied '
+                        '(advisory-only regression)')
+    if ctl.comm_mode_choice != 'inverse':
+        failures.append(f'analytic prior chose {ctl.comm_mode_choice}, '
+                        "expected 'inverse' (seeded-prior regression)")
+    if not pre.replans:
+        failures.append('no KFAC.request_replan recorded — the commit '
+                        'did not route through the live replanning path')
+    steady_t = (ctl.last_window or {}).get('time_s')
+    if steady_t is None or steady_t >= 0.05:
+        failures.append(f'steady-state window {steady_t}s does not beat '
+                        'the starting mode (0.05 s/step)')
+    if ctl.state != 'steady':
+        failures.append(f'no steady state after {steps} steps '
+                        f'(state={ctl.state})')
+    return {'leg': 'comm_mode', 'final_comm_mode': pre.comm_mode,
+            'prior_choice': ctl.comm_mode_choice,
+            'replans': list(pre.replans), 'steady_window_s': steady_t,
+            'commits': ctl.commits, 'steps': steps, 'failures': failures}
+
+
 def leg_measured(art_dir, tol):
     """bench._micro_autotune on a real CPU backend: pessimal start,
     hand-configured sweep as the yardstick."""
@@ -268,7 +354,8 @@ def main():
     os.makedirs(art_dir, exist_ok=True)
     tol = float(os.environ.get('AUTOTUNE_SMOKE_TOL', '1.10'))
     legs = [leg_synthetic(art_dir), leg_drift_hold(art_dir),
-            leg_decomp_ladder(art_dir), leg_quality_hold(art_dir)]
+            leg_decomp_ladder(art_dir), leg_quality_hold(art_dir),
+            leg_comm_mode(art_dir)]
     if os.environ.get('AUTOTUNE_SMOKE_MEASURED') == '1':
         legs.append(leg_measured(art_dir, tol))
     failures = [f for leg in legs for f in leg['failures']]

@@ -139,12 +139,24 @@ def _ce(outputs, batch):
 
 
 def parse_variant_spec(spec):
-    """'eigen' | 'eigen:bf16' | 'eigen+shard:bf16' -> (variant,
-    comm_precision). The '+shard' tag stays part of the variant name —
-    a compressed shard spec's fp32 counterpart is the shard spec, not
-    the unsharded one (different programs, different byte model)."""
+    """'eigen' | 'eigen:bf16' | 'eigen+shard:bf16' | 'eigen_dp>inverse'
+    -> (variant, comm_precision). The '+shard' tag stays part of the
+    variant name — a compressed shard spec's fp32 counterpart is the
+    shard spec, not the unsharded one (different programs, different
+    byte model). A '>mode' tag (ISSUE 14) likewise stays part of the
+    variant name: the spec lowers the variant AFTER a live
+    ``KFAC.replan(comm_mode=mode)`` — the program the autotuner's
+    applied comm-mode switch actually runs — and the assert gate pins
+    its K-FAC phase bytes against ``FactorPlan.comm_volume`` for the
+    switched mode."""
     variant, _, precision = spec.partition(':')
     return variant, (precision or 'fp32')
+
+
+def parse_replan_tag(variant):
+    """'eigen_dp>inverse' -> ('eigen_dp', 'inverse'); no tag -> (v, None)."""
+    base, _, mode = variant.partition('>')
+    return base, (mode or None)
 
 
 def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
@@ -173,8 +185,11 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
     # 'eigen+shard': the variant's staggered step with mesh-sharded
     # decomposition (decomp_shard=True implies stagger) — the lowered
     # program is ONE staggered step whose two DecompComm gathers the
-    # analytic model prices in closed form
-    base, _, tag = variant.partition('+')
+    # analytic model prices in closed form. 'variant>mode' (ISSUE 14):
+    # lower the program AFTER a live KFAC.replan to the other comm
+    # mode — the exact program the autotuner's applied switch runs.
+    variant_tagged, replan_to = parse_replan_tag(variant)
+    base, _, tag = variant_tagged.partition('+')
     decomp_shard = tag == 'shard'
     precond = None
     if variant != 'sgd':
@@ -193,6 +208,12 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
                                      axis_name='batch', mesh=mesh,
                                      extra_mutable=('batch_stats',),
                                      donate=False)
+    if replan_to is not None:
+        # the live switch: rebuild the plan, carry the state (verbatim
+        # here — same layout), retrace. What gets lowered below is the
+        # SWITCHED program, byte-pinned against comm_volume(comm_mode=)
+        state = state.replace(kfac_state=precond.replan(
+            jax.device_get(state.kfac_state), comm_mode=replan_to))
     # build the full factor+inverse variant WITHOUT executing a step
     # (AOT lower/compile only — executing first would compile the same
     # program twice) and read the compiled SPMD module's text
@@ -242,6 +263,18 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
             stats_reduce=precond.stats_reduce, method=precond.method,
             comm_precision=comm_precision,
             decomp_shard=precond.decomp_shard_plan)['DecompComm'])
+    if replan_to is not None:
+        # the closed-form per-phase byte price of the SWITCHED program
+        # (FactorPlan.comm_volume for the replanned mode) — the
+        # COMM_COUNT_ASSERT pin compares the measured K-FAC phases
+        # against this byte-for-byte (the ISSUE 14 acceptance
+        # criterion: the HLO ledger matches the analytic model for the
+        # program the applied switch runs)
+        led['comm_mode'] = replan_to
+        led['comm_mode_analytic'] = {
+            k: int(v) for k, v in precond.plan.comm_volume(
+                stats_reduce=precond.stats_reduce, method=precond.method,
+                comm_precision=comm_precision).items()}
     return led
 
 
@@ -365,6 +398,13 @@ def main():
                       f'{meas / 2**20:.3f} MiB vs analytic '
                       f'{led["decomp_comm_analytic"] / 2**20:.3f} MiB '
                       '(per staggered step)')
+            if 'comm_mode_analytic' in led:
+                for phase in ('FactorComm', 'InverseComm', 'PredComm'):
+                    meas = led['by_phase'].get(phase, {}).get('bytes', 0)
+                    print(f'{spec:>17}: switched-program {phase} measured '
+                          f'{meas / 2**20:.3f} MiB vs analytic '
+                          f'{led["comm_mode_analytic"][phase] / 2**20:.3f}'
+                          ' MiB')
         if 'eigen' in ledgers and 'eigen_dp' in ledgers:
             e = ledgers['eigen']['total_bytes'] - sgd_bytes
             edp = ledgers['eigen_dp']['total_bytes'] - sgd_bytes
@@ -425,8 +465,36 @@ def main():
                 f'{spec}: grad/other floor {got} B != {unsharded} '
                 f'floor {base_floor} B — decomp_shard touched the '
                 'gradient path')
+        # the comm-mode pin (ISSUE 14): a '>mode' spec's SWITCHED
+        # program must price every K-FAC comm phase byte-for-byte at
+        # FactorPlan.comm_volume's closed form for the new mode, and
+        # its gradient floor must be byte-identical to the UNswitched
+        # base variant's program — a replan reroutes factor traffic,
+        # never the gradient path
+        for spec, led in ledgers.items():
+            analytic = led.get('comm_mode_analytic')
+            if analytic is None:
+                continue
+            for phase in ('FactorComm', 'InverseComm', 'PredComm'):
+                measured = led['by_phase'].get(phase, {}).get('bytes', 0)
+                assert measured == analytic[phase], (
+                    f'{spec}: measured {phase} {measured} B != analytic '
+                    f'FactorPlan.comm_volume {analytic[phase]} B — the '
+                    'replanned program and its byte model diverged')
+            base = parse_replan_tag(parse_variant_spec(spec)[0])[0]
+            assert base in ledgers, (
+                f'{spec}: no unswitched counterpart {base!r} in the '
+                'ledger set — the gradient-floor pin needs it; add '
+                f'{base!r} to COMM_COUNT_VARIANTS')
+            base_floor = ledgers[base]['by_phase'].get(
+                FLOOR_PHASE, {}).get('bytes', 0)
+            got = led['by_phase'].get(FLOOR_PHASE, {}).get('bytes', 0)
+            assert got == base_floor, (
+                f'{spec}: grad/other floor {got} B != {base} floor '
+                f'{base_floor} B — the comm-mode replan touched the '
+                'gradient path')
         print('COMM_COUNT_ASSERT: floor + compression + decomp-shard '
-              'gates passed')
+              '+ comm-mode gates passed')
 
 
 if __name__ == '__main__':

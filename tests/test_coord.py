@@ -836,12 +836,14 @@ _FORBIDDEN = {('os', 'listdir'), ('os', 'replace'), ('os', 'remove'),
 
 #: module -> {function names allowed to touch files directly} — each an
 #: ARTIFACT writer/reader (incident reports, per-rank log files, CLI
-#: spec input), never protocol state
+#: spec input, the tuner's adopted-knobs.json snapshot in the job's
+#: trace namespace), never protocol state
 _ALLOWED = {
     'kfac_pytorch_tpu/resilience/elastic.py': {'run'},
     'kfac_pytorch_tpu/resilience/heartbeat.py': set(),
     'kfac_pytorch_tpu/service/queue.py': set(),
-    'kfac_pytorch_tpu/service/scheduler.py': {'_admit', 'main'},
+    'kfac_pytorch_tpu/service/scheduler.py': {'_admit', 'main',
+                                              '_adopted_knobs'},
 }
 
 
