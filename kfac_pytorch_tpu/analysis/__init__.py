@@ -1,0 +1,40 @@
+"""``kfac-lint``: project-invariant static analysis for this repo.
+
+Fourteen PRs of distributed K-FAC work accreted hard invariants that
+were enforced only by runtime drills (or one ad-hoc AST scan inside
+``tests/test_coord.py``): the single-writer knob arbitration of PR 9,
+the coordination-backend no-bypass discipline of PR 12, the incident
+event grammar every timeline consumer parses, the ``KFAC_*`` env
+contract, the atomic-rename discipline on every protocol file, and the
+purity rules a jit/shard_map-traced body must obey. Each of those cost
+at least one review round when it was broken; all of them are
+*machine-checkable from the source text*. This package checks them.
+
+Design constraints (they shaped everything here):
+
+- **Pure stdlib.** The linter parses the tree with ``ast`` and never
+  imports the code under analysis — so the CI ``lint`` job runs in
+  seconds on a bare Python with no jax/flax installed, and a module
+  with a jax-breaking bug still lints. Registries the rules need
+  (``envspec.ENV``, ``incident._PATTERNS``, ``autotune.KNOB_ATTRS``)
+  are read *statically* out of their defining modules, so there is one
+  source of truth and zero imports.
+- **Ratchet, not amnesty.** ``lint-baseline.json`` pins the accepted
+  pre-existing findings (each with a written justification). New
+  findings fail; fixed findings make their baseline entry *stale*,
+  which also fails until the entry is deleted — the baseline only
+  burns down.
+- **Local escape hatch.** ``# kfac-lint: disable=<rule-id> -- reason``
+  on (or immediately above) a line suppresses it, greppably, at the
+  site — the reviewable form of "yes, this one is deliberate".
+
+Entry points: the ``kfac-lint`` console script (pyproject), ``python
+-m kfac_pytorch_tpu.analysis``, or — on a box with no jax — ``python
+kfac_pytorch_tpu/analysis/cli.py`` (the cli bootstraps the package
+namespace itself so the jax-importing package root never loads).
+"""
+
+from kfac_pytorch_tpu.analysis.core import (  # noqa: F401
+    Finding, LintResult, Rule, RepoContext, run_lint, finding_key,
+)
+from kfac_pytorch_tpu.analysis.cli import main  # noqa: F401

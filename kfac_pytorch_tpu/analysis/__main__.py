@@ -1,0 +1,7 @@
+"""``python -m kfac_pytorch_tpu.analysis`` == ``kfac-lint``."""
+
+import sys
+
+from kfac_pytorch_tpu.analysis.cli import main
+
+sys.exit(main())

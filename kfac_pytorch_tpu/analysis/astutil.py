@@ -1,0 +1,72 @@
+"""Small shared AST helpers for the rules (stdlib ``ast`` only)."""
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_with_func(tree: ast.AST) -> Iterator[Tuple[ast.AST, str]]:
+    """Yield ``(node, enclosing_function_name)`` pairs; '<module>' at
+    module level. The *nearest* enclosing def wins (nested defs give
+    the inner name), matching how the coord allowlist names sites."""
+
+    def visit(node, func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        yield node, func
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, func)
+
+    yield from visit(tree, '<module>')
+
+
+def func_defs(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Every (qualname, def) in the module: ``f``, ``Class.m``,
+    ``outer.<locals>.inner``."""
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + child.name
+                yield qual, child
+                yield from visit(child, qual + '.<locals>.')
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, prefix + child.name + '.')
+            else:
+                yield from visit(child, prefix)
+
+    yield from visit(tree, '')
+
+
+def docstring_linenos(tree: ast.AST) -> set:
+    """Line ranges occupied by docstrings (module/class/function first
+    statements) — string-scanning rules skip them."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, 'body', [])
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                c = body[0].value
+                for ln in range(c.lineno, (c.end_lineno or c.lineno) + 1):
+                    out.add(ln)
+    return out

@@ -1,0 +1,135 @@
+"""``kfac-lint`` command line.
+
+Three equivalent entries:
+
+- ``kfac-lint`` (console script, installed envs);
+- ``python -m kfac_pytorch_tpu.analysis.cli`` (repo checkout with jax);
+- ``python kfac_pytorch_tpu/analysis/cli.py`` (**no jax required** —
+  the bootstrap below registers a lightweight namespace for the parent
+  package so its jax-importing ``__init__`` never loads; this is what
+  the CI ``lint`` job runs on a bare Python).
+
+Exit code 0 = clean (baselined findings allowed), 1 = new findings or
+a stale baseline entry (the ratchet), 2 = usage error.
+"""
+
+import sys
+
+if __package__ in (None, ''):  # pragma: no cover - script-mode bootstrap
+    import os as _os
+    import types as _types
+    _here = _os.path.dirname(_os.path.abspath(__file__))
+    _pkg_root = _os.path.dirname(_here)          # kfac_pytorch_tpu/
+    _repo = _os.path.dirname(_pkg_root)
+    if _repo not in sys.path:
+        sys.path.insert(0, _repo)
+    if 'kfac_pytorch_tpu' not in sys.modules:
+        _parent = _types.ModuleType('kfac_pytorch_tpu')
+        _parent.__path__ = [_pkg_root]
+        sys.modules['kfac_pytorch_tpu'] = _parent
+    if 'kfac_pytorch_tpu.analysis' not in sys.modules:
+        _pkg = _types.ModuleType('kfac_pytorch_tpu.analysis')
+        _pkg.__path__ = [_here]
+        sys.modules['kfac_pytorch_tpu.analysis'] = _pkg
+
+import argparse
+import json
+import os
+
+from kfac_pytorch_tpu.analysis import core as _core
+from kfac_pytorch_tpu.analysis.rules import ALL_RULES, RULE_IDS
+
+BASELINE_NAME = 'lint-baseline.json'
+
+
+def find_repo_root(start: str) -> str:
+    """Walk up from ``start`` to the directory holding pyproject.toml
+    (the linter's path keys are all repo-relative)."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, 'pyproject.toml')):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            # fall back to the checkout this file lives in
+            return os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+        cur = parent
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog='kfac-lint',
+        description='project-invariant static analysis for this repo')
+    p.add_argument('--root', default=None,
+                   help='repo root (default: walk up to pyproject.toml)')
+    p.add_argument('--rule', action='append', dest='rules', metavar='ID',
+                   help=f'run only this rule (repeatable); '
+                        f'known: {", ".join(RULE_IDS)}')
+    p.add_argument('--json', action='store_true',
+                   help='machine-readable findings on stdout')
+    p.add_argument('--baseline', default=None,
+                   help=f'baseline file (default: <root>/{BASELINE_NAME})')
+    p.add_argument('--no-baseline', action='store_true',
+                   help='report every finding, ignoring the baseline')
+    p.add_argument('--write-baseline', action='store_true',
+                   help='rewrite the baseline to accept every current '
+                        'finding (each entry gets a TODO justification '
+                        'that still fails the gate until written)')
+    p.add_argument('--list-rules', action='store_true',
+                   help='print the rule table and exit')
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f'{r.id:14s} {r.summary}')
+        return 0
+    root = args.root or find_repo_root(os.getcwd())
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    baseline = {} if args.no_baseline \
+        else _core.load_baseline(baseline_path)
+    try:
+        result = _core.run_lint(root, ALL_RULES, rule_ids=args.rules,
+                                baseline=baseline)
+    except KeyError as e:
+        print(f'kfac-lint: {e.args[0]}', file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        # merge, never clobber: entries owned by rules that did NOT run
+        # this invocation (--rule filter) survive verbatim with their
+        # justifications; for the rules that did run, keep the matched
+        # keys' written justifications and stamp new findings with TODO
+        full = _core.load_baseline(baseline_path)
+        active = set(result.rules_run)
+        entries = {k: v for k, v in full.items()
+                   if k.split(':', 1)[0] not in active}
+        for k, v in _core.baseline_entries_for(result, root).items():
+            entries[k] = full.get(k, v)
+        _core.write_baseline(baseline_path, entries)
+        print(f'kfac-lint: wrote {len(entries)} entr'
+              f'{"y" if len(entries) == 1 else "ies"} to {baseline_path}')
+        return 0
+
+    if args.json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for key in result.stale_baseline:
+            print(f'STALE baseline entry (fixed? delete it from '
+                  f'{os.path.basename(baseline_path)}): {key}')
+        n, b = len(result.findings), len(result.baselined)
+        print(f'kfac-lint: {result.files_scanned} files, '
+              f'{len(result.rules_run)} rules: {n} new finding(s), '
+              f'{b} baselined, {result.suppressed} suppressed, '
+              f'{len(result.stale_baseline)} stale baseline entr'
+              f'{"y" if len(result.stale_baseline) == 1 else "ies"}')
+    return 1 if result.failed else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""Rule registry: the six project invariants ``kfac-lint`` enforces.
+
+Each rule module defines one :class:`~kfac_pytorch_tpu.analysis.core.
+Rule` subclass; ``ALL_RULES`` is the ordered registry the CLI and the
+tests iterate. Adding a rule = adding a module here + a fixture pair in
+``tests/test_lint.py`` (one snippet it catches, one it passes) + a row
+in the README table.
+"""
+
+from kfac_pytorch_tpu.analysis.rules.knob_writer import KnobWriterRule
+from kfac_pytorch_tpu.analysis.rules.coord_bypass import CoordBypassRule
+from kfac_pytorch_tpu.analysis.rules.env_contract import EnvContractRule
+from kfac_pytorch_tpu.analysis.rules.event_grammar import EventGrammarRule
+from kfac_pytorch_tpu.analysis.rules.atomic_write import AtomicWriteRule
+from kfac_pytorch_tpu.analysis.rules.trace_purity import TracePurityRule
+
+ALL_RULES = (
+    KnobWriterRule(),
+    CoordBypassRule(),
+    EnvContractRule(),
+    EventGrammarRule(),
+    AtomicWriteRule(),
+    TracePurityRule(),
+)
+
+RULE_IDS = tuple(r.id for r in ALL_RULES)

@@ -1,0 +1,119 @@
+"""Rule ``atomic-write``: no bare ``open(..., 'w')`` + ``json.dump``.
+
+PR 7's and PR 12's torn-JSON bugs all had the same shape: a writer did
+``open(path, 'w')`` + ``json.dump`` while a concurrent reader (another
+host's supervisor, the scheduler, kfac-obs ``--follow``) read the
+half-written file. The repo's discipline since is
+``resilience.atomic_write_json`` (full write to a tmp name, then
+``os.replace``) — or, for protocol *state*, the CoordBackend's CAS.
+This rule makes the discipline law: a ``json.dump(obj, f)`` (or
+``f.write(json.dumps(...))``) where ``f`` is bound from a write-mode
+``open`` in the same statement scope is flagged everywhere in the
+package, except inside ``atomic_write_json`` itself and the coord
+backends (which implement the atomicity the rest of the tree leans
+on).
+
+Even a hand-rolled tmp+``os.replace`` around a bare dump is flagged:
+four copies of the discipline is how one of them loses its fsync or
+its crash-cleanup. Route it through the shared helper.
+"""
+
+import ast
+from typing import List
+
+from kfac_pytorch_tpu.analysis import astutil
+from kfac_pytorch_tpu.analysis.core import Finding, ModuleInfo, \
+    RepoContext, Rule
+
+#: modules that IMPLEMENT the atomicity discipline (the shared helper
+#: and the coordination backends) — everything else routes through them
+IMPLEMENTATIONS = (
+    'kfac_pytorch_tpu/resilience/__init__.py',
+    'kfac_pytorch_tpu/coord/',
+)
+
+_WRITE_MODES = ('w', 'wt', 'w+', 'wb', 'x', 'xt')
+
+
+def _open_write_names(tree: ast.AST):
+    """Set of (enclosing function, name) file-object bindings from a
+    write-mode ``open``: ``with open(p, 'w') as f`` and
+    ``f = open(p, 'w')``. Scoped per function so a handle *parameter*
+    named like some other function's write handle is never implicated."""
+    names = set()
+
+    def mode_of(call: ast.Call):
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id == 'open'):
+            return None
+        if len(call.args) >= 2:
+            return astutil.str_const(call.args[1])
+        for kw in call.keywords:
+            if kw.arg == 'mode':
+                return astutil.str_const(kw.value)
+        return 'r'
+
+    for node, func in astutil.walk_with_func(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                mode = mode_of(item.context_expr)
+                if mode in _WRITE_MODES and isinstance(
+                        item.optional_vars, ast.Name):
+                    names.add((func, item.optional_vars.id))
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            mode = mode_of(node.value)
+            if mode in _WRITE_MODES:
+                names.add((func, node.targets[0].id))
+    return names
+
+
+class AtomicWriteRule(Rule):
+    id = 'atomic-write'
+    summary = 'JSON written via atomic_write_json / backend CAS, never bare open+dump'
+    invariant = ('atomic protocol writes: any JSON another process may '
+                 'read concurrently is written full-to-tmp then '
+                 'os.replace (resilience.atomic_write_json) or through '
+                 'CoordBackend CAS')
+    caught = ('PR 7/12: torn-JSON readers on protocol files written '
+              'with bare open+json.dump')
+
+    def scope(self, relpath: str) -> bool:
+        return relpath.startswith('kfac_pytorch_tpu/') \
+            and not relpath.startswith('kfac_pytorch_tpu/analysis/') \
+            and not any(relpath == p or relpath.startswith(p)
+                        for p in IMPLEMENTATIONS)
+
+    def check(self, mod: ModuleInfo, ctx: RepoContext) -> List[Finding]:
+        write_names = _open_write_names(mod.tree)
+        if not write_names:
+            return []
+        out = []
+        for node, func in astutil.walk_with_func(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = None
+            d = astutil.dotted(node.func)
+            if d in ('json.dump',) and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Name) \
+                    and (func, node.args[1].id) in write_names:
+                hit = f'json.dump into open(..., \'w\') file ' \
+                      f'{node.args[1].id!r}'
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == 'write' \
+                    and isinstance(node.func.value, ast.Name) \
+                    and (func, node.func.value.id) in write_names \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Call) \
+                    and astutil.dotted(node.args[0].func) == 'json.dumps':
+                hit = f'{node.func.value.id}.write(json.dumps(...)) ' \
+                      f'into open(..., \'w\') file'
+            if hit:
+                out.append(Finding(
+                    self.id, mod.relpath, node.lineno,
+                    f'{hit} — a concurrent reader can see a torn file; '
+                    f'route it through resilience.atomic_write_json '
+                    f'(or CoordBackend CAS for protocol state)',
+                    node.col_offset))
+        return out

@@ -1,0 +1,95 @@
+"""Rule ``coord-bypass``: protocol modules don't reach around the
+coordination backend.
+
+PR 12 routed every fleet protocol — shrink/grow claims, lineage,
+heartbeat leases, join/done markers, queue epoch-CAS, the hosts.json
+pool — through ``kfac_pytorch_tpu/coord``'s ``CoordBackend`` so the
+whole fleet can move from the POSIX lease dir to a KV service by
+flipping ``KFAC_COORD_BACKEND``. The abstraction rots the day one
+protocol module quietly goes back to ``os.listdir``/``open`` on the
+lease dir (exactly how the torn-JSON reader bugs of PR 7 happened).
+
+This rule is the framework home of the ad-hoc AST scan that shipped
+inside tests/test_coord.py: the protocol modules listed in
+``PROTOCOL_MODULES`` may not call direct-filesystem primitives
+(``os.listdir``/``os.replace``/``os.remove``/``os.rename``/
+``shutil.rmtree``/``open``/``atomic_write_json``) outside the
+per-module ``ALLOWED_FUNCS`` allowlist — each allowlisted function is
+a named *artifact* writer/reader (incident reports, per-rank log
+files, CLI spec input, the tuner's adopted-knobs snapshot), never
+protocol state. Extending the allowlist means editing THIS file, in
+review — which is the point. tests/test_coord.py now invokes this rule
+(one source of truth; the test is a thin ``kfac-lint --rule
+coord-bypass`` run).
+"""
+
+from typing import List
+
+import ast
+
+from kfac_pytorch_tpu.analysis import astutil
+from kfac_pytorch_tpu.analysis.core import Finding, ModuleInfo, \
+    RepoContext, Rule
+
+#: direct-filesystem calls that USED to implement the protocols; any
+#: new occurrence outside the allowlist is the abstraction rotting
+FORBIDDEN = frozenset({
+    ('os', 'listdir'), ('os', 'replace'), ('os', 'remove'),
+    ('os', 'rename'), ('shutil', 'rmtree'), (None, 'open'),
+    (None, 'atomic_write_json'),
+})
+
+#: protocol module -> {function names allowed to touch files directly}.
+#: Every entry is a genuine ARTIFACT path (reviewed when added here):
+#:   elastic.run            — per-host run log + incident report files
+#:   scheduler._admit/main  — CLI spec input + per-job log plumbing
+#:   scheduler._adopted_knobs — reads the tuner's adopted-knobs.json
+#:                            snapshot out of the job's trace namespace
+#: A module under coord/ itself is the backend, not a bypass, and is
+#: deliberately NOT in scope.
+PROTOCOL_MODULES = {
+    'kfac_pytorch_tpu/resilience/elastic.py': frozenset({'run'}),
+    'kfac_pytorch_tpu/resilience/heartbeat.py': frozenset(),
+    'kfac_pytorch_tpu/service/queue.py': frozenset(),
+    'kfac_pytorch_tpu/service/scheduler.py': frozenset({
+        '_admit', 'main', '_adopted_knobs'}),
+}
+
+
+class CoordBypassRule(Rule):
+    id = 'coord-bypass'
+    summary = 'protocol modules route all shared state through CoordBackend'
+    invariant = ('coord no-bypass: shrink/grow claims, leases, queue '
+                 'epochs and the host pool live behind CoordBackend '
+                 'primitives, never behind direct lease-dir file IO')
+    caught = ('PR 7/12: torn-JSON protocol readers and non-atomic '
+              'claim writes that only surfaced mid-drill')
+
+    def scope(self, relpath: str) -> bool:
+        return relpath in PROTOCOL_MODULES
+
+    def check(self, mod: ModuleInfo, ctx: RepoContext) -> List[Finding]:
+        allowed = PROTOCOL_MODULES[mod.relpath]
+        out = []
+        for node, func in astutil.walk_with_func(mod.tree):
+            if not isinstance(node, ast.Call) or func in allowed:
+                continue
+            name = modname = None
+            f = node.func
+            if isinstance(f, ast.Name):
+                name = f.id
+            elif isinstance(f, ast.Attribute):
+                name = f.attr
+                if isinstance(f.value, ast.Name):
+                    modname = f.value.id
+            for fmod, fname in FORBIDDEN:
+                if name == fname and (fmod is None or modname == fmod):
+                    call = f'{modname}.{name}' if modname else name
+                    out.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        f'{func}() calls {call} — protocol state goes '
+                        f'through the CoordBackend; if this is a genuine '
+                        f'artifact, allowlist it in '
+                        f'analysis/rules/coord_bypass.py (in review)',
+                        node.col_offset))
+        return out

@@ -1,0 +1,137 @@
+"""Rule ``env-contract``: every ``KFAC_*``/``JAX_*`` knob is declared.
+
+The env surface grew organically across fourteen PRs (~190 read sites)
+with three partial validators — ``faults.from_env`` STRICT mode,
+``launch_tpu.sh``'s case blocks, the README table — each hand-kept and
+each incomplete: a typo'd ``KFAC_COMM_PRECISON=bf16`` exported next to
+a trainer silently did nothing. ``kfac_pytorch_tpu/envspec.py`` is now
+the single registry (pure literal data, so this rule reads it without
+importing anything), and those validators derive from it.
+
+This rule closes the loop at review time:
+
+- any **full-string literal** matching ``^(KFAC|JAX)_[A-Z0-9_]*[A-Z0-9]$``
+  anywhere in the shipped tree (an ``os.environ`` read, an ``ENV_FOO =``
+  constant, a child-env re-export list, a spec allowlist) must name a
+  declared variable — an undeclared name is either a typo or an
+  undocumented knob, both lint errors;
+- an ``os.environ``/``os.getenv`` read whose *name argument is built
+  dynamically* (f-string, concatenation, call) is flagged: dynamic
+  names defeat the registry, so they need an explicit per-site
+  suppression with a reason.
+
+Prefix scans (``k.startswith('KFAC_FAULT_')``) use trailing-underscore
+literals, which the pattern deliberately does not match.
+"""
+
+import ast
+import re
+from typing import List
+
+from kfac_pytorch_tpu.analysis import astutil
+from kfac_pytorch_tpu.analysis.core import Finding, ModuleInfo, \
+    RepoContext, Rule
+
+ENVSPEC = 'kfac_pytorch_tpu/envspec.py'
+
+ENV_NAME_RE = re.compile(r'^(KFAC|JAX)_[A-Z0-9_]*[A-Z0-9]$')
+
+#: receivers that make a ``.get``/``.pop``/``.setdefault``/``[]``/
+#: ``in`` an environment read
+_ENVIRON_HEADS = ('os.environ', 'environ')
+_READ_METHODS = ('get', 'pop', 'setdefault')
+
+
+def _is_environ(node: ast.AST) -> bool:
+    d = astutil.dotted(node)
+    return d is not None and (d in _ENVIRON_HEADS
+                              or d.endswith('.environ'))
+
+
+class EnvContractRule(Rule):
+    id = 'env-contract'
+    summary = 'every KFAC_*/JAX_* env name is declared in envspec.py'
+    invariant = ('central env contract: envspec.ENV declares every '
+                 'knob; faults.from_env STRICT validation, '
+                 'launch_tpu.sh and the README table derive from it')
+    caught = ('undeclared/typo\'d KFAC_* knobs that silently never '
+              'armed (multiple PRs\' review rounds)')
+
+    def scope(self, relpath: str) -> bool:
+        return relpath != ENVSPEC \
+            and not relpath.startswith('kfac_pytorch_tpu/analysis/')
+
+    def declared(self, ctx: RepoContext) -> frozenset:
+        """Statically lift the declared names out of envspec.py: every
+        ``E('NAME', ...)`` call with a literal first argument."""
+        mod = ctx.module(ENVSPEC)
+        names = set()
+        if mod.tree is None:              # pragma: no cover - repo parses
+            return frozenset()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in ('E', 'EnvVar') and node.args:
+                name = astutil.str_const(node.args[0])
+                if name:
+                    names.add(name)
+        return frozenset(names)
+
+    def check(self, mod: ModuleInfo, ctx: RepoContext) -> List[Finding]:
+        declared = self.declared(ctx)
+        doc_lines = astutil.docstring_linenos(mod.tree)
+        # strings inside __all__ are exported Python symbols, not env
+        # names, even when the symbol happens to look like one
+        all_lines = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == '__all__'
+                    for t in node.targets):
+                for ln in range(node.lineno, (node.end_lineno
+                                              or node.lineno) + 1):
+                    all_lines.add(ln)
+        doc_lines |= all_lines
+        out = []
+        for node in ast.walk(mod.tree):
+            # (a) any env-shaped full-string literal must be declared
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.lineno not in doc_lines \
+                    and ENV_NAME_RE.match(node.value) \
+                    and node.value not in declared:
+                out.append(Finding(
+                    self.id, mod.relpath, node.lineno,
+                    f'{node.value!r} is not declared in envspec.ENV — '
+                    f'declare it (name, kind, consumer, doc) or fix '
+                    f'the typo', node.col_offset))
+            # (b) dynamic env names defeat the registry
+            elif isinstance(node, ast.Call):
+                name_arg = None
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in _READ_METHODS \
+                        and _is_environ(f.value) and node.args:
+                    name_arg = node.args[0]
+                elif astutil.dotted(f) in ('os.getenv', 'getenv') \
+                        and node.args:
+                    name_arg = node.args[0]
+                if name_arg is not None and not (
+                        astutil.str_const(name_arg) is not None
+                        or isinstance(name_arg, ast.Name)
+                        or (isinstance(name_arg, ast.Attribute))):
+                    out.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        'environment read with a dynamically-built '
+                        'name — the envspec registry cannot see it; '
+                        'use a declared literal/constant or suppress '
+                        'with a reason', node.col_offset))
+            elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+                sl = node.slice
+                if astutil.str_const(sl) is None \
+                        and not isinstance(sl, (ast.Name, ast.Attribute)):
+                    out.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        'os.environ[...] with a dynamically-built name '
+                        '— use a declared literal/constant or suppress '
+                        'with a reason', node.col_offset))
+        return out

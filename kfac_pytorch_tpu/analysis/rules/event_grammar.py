@@ -1,0 +1,249 @@
+"""Rule ``event-grammar``: emitted event log forms parse under the
+shared incident grammar.
+
+``resilience/incident.py``'s ``EVENT_PATTERNS`` is the contract three
+consumers share: incident scraping, the ``kfac-obs`` pod timeline, and
+every CI drill that greps a run log for an event. The producers are
+plain ``log.info(...)`` calls scattered across elastic/heartbeat/
+supervisor/coord/autotune/service — nothing ties an emit site to its
+regex, so grammar drift (reworded literal text, a renamed ``k=v``
+field, a new field the regex can't see) historically surfaced
+mid-drill as an empty timeline.
+
+This rule ties them statically. For every static string template in
+the tree (a %-style logging template, an f-string, a returned message
+form), it synthesizes a sample line by substituting placeholders, then:
+
+- the sample *claims* every pattern whose literal head it starts with
+  (heads are computed from the regex sources, also statically);
+- a claiming site must ``search``-match at least one claimed pattern
+  *relaxed* — every named capture group loosened to ``.+?`` so only
+  the literal skeleton is compared (the capture classes stay a runtime
+  concern; the literal text IS the grammar).
+
+A site that claims a head but matches no skeleton is drift. A
+prefixed narration line that is deliberately *not* an event gets a
+``# kfac-lint: disable=event-grammar -- <reason>`` at the site, which
+is exactly the review conversation the grammar needs.
+"""
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from kfac_pytorch_tpu.analysis import astutil
+from kfac_pytorch_tpu.analysis.core import Finding, ModuleInfo, \
+    RepoContext, Rule
+
+INCIDENT = 'kfac_pytorch_tpu/resilience/incident.py'
+
+#: grammar definition + its two regex consumers: their files quote the
+#: pattern sources themselves, which are not emit sites
+EXCLUDED = (INCIDENT, 'kfac_pytorch_tpu/obs/aggregate.py')
+
+#: a head must be at least this long to claim a site — short module
+#: prefixes like ``elastic: `` alone prove nothing
+MIN_HEAD = 12
+
+_PCT = re.compile(r'%[-+ #0]*\d*(?:\.\d+)?([srdifFeEgGxXc%])')
+
+_SAMPLES = {'s': 'x7', 'r': "'x7'", 'd': '7', 'i': '7', 'f': '3.5',
+            'F': '3.5', 'e': '3.5', 'E': '3.5', 'g': '3.5', 'G': '3.5',
+            'x': '7', 'X': '7', 'c': 'x', '%': '%'}
+
+_META = set('([{.*+?|^$')
+
+
+def _literal_head(src: str) -> str:
+    """Leading literal text of a regex source (regex escapes resolved,
+    stop at the first group/class/quantifier)."""
+    out: List[str] = []
+    i = 0
+    while i < len(src):
+        c = src[i]
+        if c == '\\':
+            nxt = src[i + 1] if i + 1 < len(src) else ''
+            if nxt and nxt in '()[]{}.*+?|^$\\':
+                out.append(nxt)
+                i += 2
+                continue
+            break                       # \d, \S, \w... — a class
+        if c in _META:
+            if c in '*+?{' and out:     # quantifier on the last literal
+                out.pop()
+            break
+        out.append(c)
+        i += 1
+    return ''.join(out)
+
+
+def _skip_class(src: str, i: int) -> int:
+    """``i`` points at '['; return index past the closing ']'."""
+    j = i + 1
+    if j < len(src) and src[j] == '^':
+        j += 1
+    if j < len(src) and src[j] == ']':
+        j += 1
+    while j < len(src) and src[j] != ']':
+        j += 2 if src[j] == '\\' else 1
+    return j + 1
+
+
+def _relax(src: str) -> str:
+    """Replace every named capture group's content with ``.+?`` so the
+    literal skeleton is what gets matched."""
+    out: List[str] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c == '\\' and i + 1 < n:
+            out.append(src[i:i + 2])
+            i += 2
+            continue
+        if c == '[':
+            j = _skip_class(src, i)
+            out.append(src[i:j])
+            i = j
+            continue
+        if src.startswith('(?P<', i):
+            depth, j = 0, i
+            while j < n:
+                cj = src[j]
+                if cj == '\\':
+                    j += 2
+                    continue
+                if cj == '[':
+                    j = _skip_class(src, j)
+                    continue
+                if cj == '(':
+                    depth += 1
+                elif cj == ')':
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            name_end = src.index('>', i)
+            out.append(src[i:name_end + 1] + '.+?)')
+            i = j + 1
+            continue
+        out.append(c)
+        i += 1
+    return ''.join(out)
+
+
+def template_sample(node: ast.AST) -> Optional[Tuple[List[str], str]]:
+    """(sample_texts, literal_prefix) for a static string template, or
+    None. %-placeholders and f-string fields become sample values; the
+    string-valued ones (``%s``, f-fields) are *also* tried as empty,
+    because emit sites pass optional suffixes (`` at step N``, a
+    resilience suffix) through a trailing ``%s`` that is legitimately
+    absent from the grammar form."""
+    s = astutil.str_const(node)
+    if s is not None:
+        full = _PCT.sub(lambda m: _SAMPLES[m.group(1)], s)
+        bare = _PCT.sub(
+            lambda m: '' if m.group(1) in 'sr' else _SAMPLES[m.group(1)], s)
+        first = _PCT.search(s)
+        prefix = s[:first.start()] if first else s
+        return [full, bare], prefix
+    if isinstance(node, ast.JoinedStr):
+        full: List[str] = []
+        bare: List[str] = []
+        prefix: List[str] = []
+        literal_so_far = True
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                full.append(v.value)
+                bare.append(v.value)
+                if literal_so_far:
+                    prefix.append(v.value)
+            else:
+                full.append('7')
+                literal_so_far = False
+        return [''.join(full), ''.join(bare)], ''.join(prefix)
+    return None
+
+
+class EventGrammarRule(Rule):
+    id = 'event-grammar'
+    summary = 'emitted event log forms parse under incident.EVENT_PATTERNS'
+    invariant = ('shared event grammar: every event-form emit site '
+                 'search-matches some EVENT_PATTERNS regex, so '
+                 'incident scraping / kfac-obs timelines never drift '
+                 'from the producers')
+    caught = ('grammar drift that emptied kfac-obs timelines and only '
+              'surfaced mid-drill (PR 7/10 review rounds)')
+
+    def scope(self, relpath: str) -> bool:
+        return relpath.startswith('kfac_pytorch_tpu/') \
+            and relpath not in EXCLUDED \
+            and not relpath.startswith('kfac_pytorch_tpu/analysis/')
+
+    def patterns(self, ctx: RepoContext):
+        """Statically lift ``(kind, source, head, relaxed)`` out of
+        incident.py's ``_PATTERNS`` tuple."""
+        cached = getattr(ctx, '_event_patterns', None)
+        if cached is not None:
+            return cached
+        tree = ctx.module(INCIDENT).tree
+        pats = []
+        for node in tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == '_PATTERNS'
+                            for t in node.targets)):
+                continue
+            for el in node.value.elts:
+                if not (isinstance(el, ast.Tuple) and len(el.elts) == 2):
+                    continue
+                kind = astutil.str_const(el.elts[0])
+                call = el.elts[1]
+                if not (isinstance(call, ast.Call) and call.args):
+                    continue
+                src = astutil.str_const(call.args[0])
+                if kind and src:
+                    head = _literal_head(src)
+                    pats.append((kind, src, head,
+                                 re.compile(_relax(src))))
+        ctx._event_patterns = tuple(pats)
+        return ctx._event_patterns
+
+    def check(self, mod: ModuleInfo, ctx: RepoContext) -> List[Finding]:
+        pats = self.patterns(ctx)
+        doc_lines = astutil.docstring_linenos(mod.tree)
+        # an f-string's literal chunks are Constants too — only the
+        # whole JoinedStr is the template, never its pieces
+        nested = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.JoinedStr):
+                for sub in ast.walk(node):
+                    if sub is not node:
+                        nested.add(id(sub))
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.Constant, ast.JoinedStr)):
+                continue
+            if id(node) in nested or node.lineno in doc_lines:
+                continue
+            got = template_sample(node)
+            if got is None:
+                continue
+            samples, prefix = got
+            claimed = [(kind, relaxed) for kind, _src, head, relaxed
+                       in pats
+                       if len(head) >= MIN_HEAD
+                       and (prefix.startswith(head)
+                            or (len(prefix) >= MIN_HEAD
+                                and head.startswith(prefix)))]
+            if not claimed:
+                continue
+            if any(r.search(s) for _k, r in claimed for s in samples):
+                continue
+            kinds = ', '.join(sorted({k for k, _r in claimed}))
+            out.append(Finding(
+                self.id, mod.relpath, node.lineno,
+                f'event-form string drifts from the incident grammar: '
+                f'it starts like event(s) [{kinds}] but matches no '
+                f'EVENT_PATTERNS regex — fix the form, extend the '
+                f'grammar, or suppress with a reason if this is '
+                f'narration, not an event', node.col_offset))
+        return out

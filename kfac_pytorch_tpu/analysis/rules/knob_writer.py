@@ -1,0 +1,105 @@
+"""Rule ``knob-writer``: only the arbiter assigns runtime knobs.
+
+PR 9's costliest review-round bug: three controllers (the param
+scheduler, the straggler governor, the elastic rescale hook) raced
+last-writer-wins over the same ``KFAC`` attributes. The fix made
+``autotune.KnobArbiter`` the single writer of ``KNOB_ATTRS`` and
+demoted everyone else to proposers — enforced at runtime by a
+``__setattr__``-guard test (tests/test_autotune.py). This rule is the
+static half: an *assignment* to a knob attribute (or a ``setattr``
+with a literal knob name) anywhere outside the arbiter module is a
+violation the reviewer sees before the drill runs.
+
+Allowed, by construction of the discipline itself:
+
+- ``kfac_pytorch_tpu/autotune.py`` — the arbiter (whole module);
+- any ``__init__``/``__post_init__`` — construction-time base values
+  are the arbiter's *input*, not a runtime write;
+- ``KFAC.replan`` in preconditioner.py — the live-replanning commit
+  writes ``comm_mode`` under the arbiter's ``_applying()`` guard (the
+  runtime test proves the guard is actually held there).
+
+``KNOB_ATTRS`` is read statically out of autotune.py, so a knob added
+there is instantly law here too.
+"""
+
+from typing import List
+
+import ast
+
+from kfac_pytorch_tpu.analysis import astutil
+from kfac_pytorch_tpu.analysis.core import Finding, ModuleInfo, \
+    RepoContext, Rule
+
+AUTOTUNE = 'kfac_pytorch_tpu/autotune.py'
+
+#: (module, enclosing function) sites allowed to write a knob outside
+#: __init__ — each must hold the arbiter's ``_applying()`` guard, which
+#: the runtime setattr-guard test (tests/test_autotune.py) verifies
+ALLOWED_SITES = frozenset({
+    ('kfac_pytorch_tpu/preconditioner.py', 'replan'),
+})
+
+_CONSTRUCTORS = ('__init__', '__post_init__', '__new__')
+
+
+def _assigned_attrs(target):
+    """The Attribute nodes a target actually REBINDS — not attribute
+    reads inside subscript slices (``table[cfg.damping] = 1`` reads the
+    knob, it doesn't write it) and not subscripted containers
+    (``x.buckets[0] = v`` mutates contents, not a knob binding)."""
+    if isinstance(target, ast.Attribute):
+        yield target
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            yield from _assigned_attrs(el)
+    elif isinstance(target, ast.Starred):
+        yield from _assigned_attrs(target.value)
+
+
+class KnobWriterRule(Rule):
+    id = 'knob-writer'
+    summary = 'only autotune.KnobArbiter assigns KNOB_ATTRS at runtime'
+    invariant = ('single-writer knob arbitration: every runtime change '
+                 'to fac/kfac_update_freq, damping, comm_precision, '
+                 'decomp_impl, comm_mode flows through the arbiter')
+    caught = ('PR 9: scheduler/governor/elastic racing last-writer-wins '
+              'over the same KFAC attributes')
+
+    def scope(self, relpath: str) -> bool:
+        return relpath != AUTOTUNE and relpath.endswith('.py') \
+            and not relpath.startswith('kfac_pytorch_tpu/analysis/')
+
+    def _knobs(self, ctx: RepoContext):
+        return tuple(ctx.static_literal(AUTOTUNE, 'KNOB_ATTRS'))
+
+    def check(self, mod: ModuleInfo, ctx: RepoContext) -> List[Finding]:
+        knobs = set(self._knobs(ctx))
+        out = []
+
+        def flag(node, attr):
+            out.append(Finding(
+                self.id, mod.relpath, node.lineno,
+                f'direct write to knob attribute {attr!r} — runtime '
+                f'knob changes must go through autotune.KnobArbiter '
+                f'(propose/commit), not assignment', node.col_offset))
+
+        for node, func in astutil.walk_with_func(mod.tree):
+            if func in _CONSTRUCTORS:
+                continue
+            if (mod.relpath, func) in ALLOWED_SITES:
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for el in _assigned_attrs(t):
+                        if el.attr in knobs:
+                            flag(node, el.attr)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == 'setattr' and len(node.args) >= 2:
+                name = astutil.str_const(node.args[1])
+                if name in knobs:
+                    flag(node, name)
+        return out

@@ -1088,10 +1088,11 @@ class KnobController:
         path = os.path.join(os.path.dirname(self.decision_log) or '.',
                             ADOPTED_KNOBS_FILENAME)
         try:
-            tmp = path + '.tmp'
-            with open(tmp, 'w') as f:
-                json.dump(doc, f, indent=2, sort_keys=True)
-            os.replace(tmp, path)
+            # kfac-serve reads this cross-process at requeue time: one
+            # atomicity discipline for every such file (lazy import —
+            # this module stays stdlib-importable)
+            from kfac_pytorch_tpu.resilience import atomic_write_json
+            atomic_write_json(path, doc, indent=2, sort_keys=True)
         except OSError:
             pass
 

@@ -121,12 +121,30 @@ from kfac_pytorch_tpu.resilience.chaos_net import NET_ENVS  # noqa: E402
 # jax-free coord.chaos layer, registered here so the strict from_env
 # validates the whole drill surface at build time
 from kfac_pytorch_tpu.coord.chaos import COORD_ENVS  # noqa: E402
+# the central env registry: the strict check derives its known-set
+# from the declarations, so "documented" and "accepted" can never
+# drift apart (kfac-lint's env-contract rule checks the read sites
+# against the same file, statically)
+from kfac_pytorch_tpu import envspec  # noqa: E402
 
-KNOWN_ENVS = frozenset({
+KNOWN_ENVS = envspec.declared('KFAC_FAULT_')
+
+# the registry and the consumers are mutually pinned at import time: a
+# drill env consumed here (or by chaos_net / coord.chaos / heartbeat)
+# but not declared in envspec.py — or declared there but consumed by
+# nothing — is a contract hole that must fail the build, not pass
+# vacuously with the fault never armed
+_CONSUMED = frozenset({
     ENV_NAN_GRAD, ENV_INF_GRAD, ENV_STATS, ENV_FACTOR, ENV_EIGH,
     ENV_SIGTERM, ENV_CKPT, ENV_HANG, ENV_SLOW, ENV_SLOW_SECS, ENV_CRASH,
     ENV_CRASH_MODE, ENV_DATA, ENV_ONCE_DIR, ENV_HB_STOP,
 }) | NET_ENVS | COORD_ENVS
+if _CONSUMED != KNOWN_ENVS:  # pragma: no cover — import-time contract
+    raise RuntimeError(
+        'faults/envspec drift: undeclared drill env(s) '
+        f'{sorted(_CONSUMED - KNOWN_ENVS)}, declared-but-unconsumed '
+        f'{sorted(KNOWN_ENVS - _CONSUMED)}; fix '
+        'kfac_pytorch_tpu/envspec.py')
 
 # rc of the 'exit'-mode crash fault: distinct from Python's generic 1
 # and from the watchdog's RC_HANG (114) so supervisor logs attribute it

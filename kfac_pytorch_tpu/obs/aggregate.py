@@ -533,12 +533,15 @@ def main(argv=None):
     if args.out:
         doc = {k: v for k, v in timeline.items()
                if not k.startswith('_')}
-        with open(args.out, 'w') as f:
-            json.dump(doc, f, indent=2, default=str)
+        # --follow re-runs land on the same path while a CI step (or a
+        # human) reads the previous render: atomic like every other
+        # concurrently-readable JSON in the tree
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        atomic_write_json(args.out, doc, indent=2, default=str)
         print(f'wrote {args.out}')
     if args.trace_out:
-        with open(args.trace_out, 'w') as f:
-            json.dump(merged_chrome_trace(timeline), f)
+        from kfac_pytorch_tpu.resilience import atomic_write_json
+        atomic_write_json(args.trace_out, merged_chrome_trace(timeline))
         print(f'wrote {args.trace_out}')
     return 0
 

@@ -55,6 +55,17 @@ _PATTERNS = (
         r'— restart (?P<n>\d+)/(?P<max>\d+) in (?P<delay_s>[\d.]+)s')),
     ('gave_up', re.compile(
         r'supervisor: trainer exited rc=(?P<rc>-?\d+) .*giving up')),
+    # the supervisor's OTHER two terminal verdicts (found by the
+    # kfac-lint event-grammar rule: these emit sites carried k=v event
+    # payloads the grammar could not see, so a preemption or
+    # configured-stop shutdown was invisible on the kfac-obs timeline
+    # while the give-up verdict was not)
+    ('preempt_stop', re.compile(
+        r'supervisor: trainer exited rc=(?P<rc>-?\d+) after forwarded '
+        r'signal — preemption shutdown, not restarting')),
+    ('stop_rc', re.compile(
+        r'supervisor: trainer exited rc=(?P<rc>-?\d+) \(configured '
+        r'stop code\) — not restarting')),
     ('shrink', re.compile(
         r'elastic: shrinking world (?P<from>\d+) -> (?P<to>\d+) '
         r'survivors=(?P<survivors>\[[^\]]*\]) gen=(?P<gen>\d+)')),

@@ -216,6 +216,20 @@ if [ "$KFAC_HB_TRANSPORT" = tcp ]; then
   export KFAC_HB_HOSTS="${KFAC_HB_HOSTS:-$nworkers}"
 fi
 
+# Central env contract (kfac_pytorch_tpu/envspec.py; README "Static
+# analysis"): every exported KFAC_* name must be declared in the
+# registry and carry a well-formed value. A typo'd knob
+# (KFAC_COMM_PRECISON=bf16) kills the launch here, in milliseconds,
+# instead of silently never arming on an allocated pod. envspec.py is
+# stdlib-pure and run as a bare file, so this works on hosts where jax
+# itself is broken — the value checks above stay as the launcher's own
+# fast path; the registry is the completeness net (undeclared names,
+# malformed values of everything else).
+if ! "${PY:-python}" kfac_pytorch_tpu/envspec.py --validate; then
+  echo "launch_tpu.sh: environment failed the envspec contract (above)" >&2
+  exit 1
+fi
+
 # Pod-resilience wrapper: KFAC_POD_SUPERVISE=1 runs the trainer under
 # the per-host kfac-pod-supervise loop (resilience/elastic.py) — on top
 # of the crash/hang restarts below, the supervisors heartbeat each other

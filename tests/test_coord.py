@@ -26,7 +26,6 @@ drills live in tests/test_pod_chaos.py / test_service_chaos.py behind
    rotting.
 """
 
-import ast
 import json
 import os
 import random
@@ -828,65 +827,21 @@ def test_scheduler_dry_run_pins_remote_rank_argv(tmp_path):
 # the static gate: no backend bypass outside coord/
 # ---------------------------------------------------------------------------
 
-#: direct-filesystem calls that USED to implement the protocols; any
-#: new occurrence outside the allowlist is the abstraction rotting
-_FORBIDDEN = {('os', 'listdir'), ('os', 'replace'), ('os', 'remove'),
-              ('os', 'rename'), ('shutil', 'rmtree'), (None, 'open'),
-              (None, 'atomic_write_json')}
-
-#: module -> {function names allowed to touch files directly} — each an
-#: ARTIFACT writer/reader (incident reports, per-rank log files, CLI
-#: spec input, the tuner's adopted-knobs.json snapshot in the job's
-#: trace namespace), never protocol state
-_ALLOWED = {
-    'kfac_pytorch_tpu/resilience/elastic.py': {'run'},
-    'kfac_pytorch_tpu/resilience/heartbeat.py': set(),
-    'kfac_pytorch_tpu/service/queue.py': set(),
-    'kfac_pytorch_tpu/service/scheduler.py': {'_admit', 'main',
-                                              '_adopted_knobs'},
-}
-
-
-def _direct_io_sites(path):
-    tree = ast.parse(open(path).read())
-    sites = []
-
-    def visit(node, func):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            func = node.name
-        if isinstance(node, ast.Call):
-            name = mod = None
-            f = node.func
-            if isinstance(f, ast.Name):
-                name = f.id
-            elif isinstance(f, ast.Attribute):
-                name = f.attr
-                if isinstance(f.value, ast.Name):
-                    mod = f.value.id
-            for fmod, fname in _FORBIDDEN:
-                if name == fname and (fmod is None or mod == fmod):
-                    sites.append((func, f'{mod or ""}.{name}'.lstrip('.'),
-                                  node.lineno))
-        for child in ast.iter_child_nodes(node):
-            visit(child, func)
-
-    visit(tree, '<module>')
-    return sites
-
-
 def test_no_protocol_module_bypasses_the_backend():
     """The lint that keeps the abstraction from rotting: the protocol
     modules may not reach around the coordination backend with direct
-    lease-dir file IO. Allowed exceptions are named artifacts (incident
-    rotation, per-rank logs, CLI input) — extending the list requires
-    editing THIS test, which is the point."""
-    problems = []
-    for rel, allowed in _ALLOWED.items():
-        for func, call, lineno in _direct_io_sites(
-                os.path.join(REPO, rel)):
-            if func not in allowed:
-                problems.append(f'{rel}:{lineno} {func}() calls {call}')
-    assert not problems, (
+    lease-dir file IO. Since ISSUE 15 the ad-hoc AST scan that lived
+    here IS a framework rule — the forbidden-call set and the artifact
+    allowlist have exactly one home
+    (kfac_pytorch_tpu/analysis/rules/coord_bypass.py), shared by the CI
+    ``lint`` job, the ``kfac-lint --rule coord-bypass`` CLI, and this
+    thin invocation; extending the allowlist still means editing a
+    reviewed file, which is the point."""
+    from kfac_pytorch_tpu.analysis import run_lint
+    from kfac_pytorch_tpu.analysis.rules import ALL_RULES
+    res = run_lint(REPO, ALL_RULES, rule_ids=['coord-bypass'])
+    assert not res.findings, (
         'direct protocol-file IO outside coord/ (route it through the '
-        'CoordBackend, or allowlist a genuine artifact):\n  '
-        + '\n  '.join(problems))
+        'CoordBackend, or allowlist a genuine artifact in '
+        'analysis/rules/coord_bypass.py):\n  '
+        + '\n  '.join(f.render() for f in res.findings))

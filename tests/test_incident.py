@@ -217,3 +217,22 @@ def test_clean_run_summary():
 def test_suffix_parse_contract(line, key, value):
     from kfac_pytorch_tpu.utils.runlog import parse_resilience_suffix
     assert parse_resilience_suffix(line)[key] == value
+
+
+def test_supervisor_terminal_verdicts_are_events():
+    """Regression for the ISSUE 15 event-grammar lint finding: the
+    supervisor's preemption-shutdown and configured-stop verdicts were
+    emitted with k=v payloads that no EVENT_PATTERNS regex matched —
+    invisible on incident reports and kfac-obs timelines while the
+    third terminal verdict (gave_up) was a first-class event. Pin the
+    two new patterns against the exact emit forms in supervisor.py."""
+    rep = IncidentReport(host_id=0).scrape_lines([
+        'supervisor: trainer exited rc=-15 after forwarded signal '
+        '— preemption shutdown, not restarting '
+        '[resilience: restarts=0]',
+        'supervisor: trainer exited rc=117 (configured stop code) '
+        '— not restarting [resilience: restarts=1]',
+    ])
+    by = {e['kind']: e for e in rep.events}
+    assert by['preempt_stop']['rc'] == -15
+    assert by['stop_rc']['rc'] == 117
