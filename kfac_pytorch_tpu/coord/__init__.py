@@ -15,6 +15,10 @@ prefix list / TTL lease / watch) and ships two implementations:
 - :class:`~.tcpkv.TcpKvBackend` — a single-process etcd-style KV server
   (``kfac-coord-serve``) with versioned CAS and server-enforced TTL
   leases; no shared filesystem anywhere in the coordination plane.
+- :class:`~.replicated.ReplicatedKvBackend` — quorum reads/writes over
+  3 KV replicas with a monotonic per-key replication revision as the
+  fence: one replica down or partitioned is invisible to callers, and
+  only true quorum loss degrades to the loud ``RC_COORD_LOST``.
 
 Plus the two wrappers that make the plane *testable* and *survivable*:
 :class:`~.chaos.ChaosBackend` (seeded ``KFAC_FAULT_COORD_*`` fault
@@ -24,6 +28,7 @@ loud give-up). Selection is one env pair::
 
     KFAC_COORD_BACKEND=posix          # default: the shared lease dir
     KFAC_COORD_BACKEND=tcp KFAC_COORD_ADDR=host:8479
+    KFAC_COORD_BACKEND=replicated KFAC_COORD_ADDRS=h0:8479,h1:8479,h2:8479
 
 :func:`backend_from_env` builds the full stack (base backend → chaos
 wrapper when armed → retry wrapper) for a given *root* (a lease-dir or
@@ -31,6 +36,7 @@ service-dir path — on the KV server it becomes the key namespace, so
 disjoint directories stay disjoint stores).
 """
 
+import dataclasses
 import os
 
 from kfac_pytorch_tpu.coord.base import (
@@ -41,6 +47,7 @@ from kfac_pytorch_tpu.coord.chaos import (
 from kfac_pytorch_tpu.coord.chaos import from_env as chaos_from_env
 from kfac_pytorch_tpu.coord.chaos import maybe_wrap as maybe_wrap_chaos
 from kfac_pytorch_tpu.coord.posix import PosixDirBackend
+from kfac_pytorch_tpu.coord.replicated import ReplicatedKvBackend
 from kfac_pytorch_tpu.coord.tcpkv import (
     DEFAULT_PORT, TcpKvBackend, TcpKvServer)
 
@@ -48,6 +55,7 @@ from kfac_pytorch_tpu.coord.tcpkv import (
 #: scheduler to every supervisor and trainer of a run)
 ENV_BACKEND = 'KFAC_COORD_BACKEND'
 ENV_ADDR = 'KFAC_COORD_ADDR'
+ENV_ADDRS = 'KFAC_COORD_ADDRS'
 
 #: "the coordination plane is gone": exit code of a supervisor or
 #: scheduler whose backend ops exhausted their retry budget
@@ -81,9 +89,38 @@ def backend_from_env(root, *, retry=True, policy=None, chaos=True,
                 f'{ENV_BACKEND}=tcp needs {ENV_ADDR} ("host:port" of a '
                 'kfac-coord-serve KV server)')
         backend = TcpKvBackend(addr, namespace=str(root))
+    elif kind == 'replicated':
+        addrs = [a.strip()
+                 for a in (e.get(ENV_ADDRS) or '').replace(';', ',')
+                 .split(',') if a.strip()]
+        if len(addrs) < 2:
+            raise ValueError(
+                f'{ENV_BACKEND}=replicated needs {ENV_ADDRS} '
+                '(comma-separated "host:port" of at least 2 — normally '
+                '3 — kfac-coord-serve replicas)')
+        cfg = chaos_from_env(env=e) if chaos else None
+        replicas = []
+        for i, addr in enumerate(addrs):
+            rep = TcpKvBackend(addr, namespace=str(root))
+            if cfg is not None and cfg.any_chaos:
+                # per-replica seed offset: the same seed on every
+                # replica would fault all of them in lockstep, which is
+                # exactly the correlated failure a quorum cannot absorb
+                # — the drill must make replicas DISAGREE
+                rep = ChaosBackend(
+                    rep, dataclasses.replace(cfg, seed=cfg.seed + i))
+            replicas.append(rep)
+        # thread the injected clock (an object with .monotonic, the
+        # RetryingBackend convention) down to the quorum layer's
+        # down-replica cooldown — under a simulated clock a cooldown
+        # measured in real seconds would outlive a whole outage window
+        backend = ReplicatedKvBackend(
+            replicas,
+            clock=clock.monotonic if clock is not None else None)
+        chaos = False  # injected per-replica above, not on the merge
     else:
-        raise ValueError(f'{ENV_BACKEND} must be "posix" or "tcp", '
-                         f'got {kind!r}')
+        raise ValueError(f'{ENV_BACKEND} must be "posix", "tcp" or '
+                         f'"replicated", got {kind!r}')
     if chaos:
         backend = maybe_wrap_chaos(backend)
     if retry:
@@ -99,8 +136,8 @@ __all__ = [
     'ANY', 'CoordBackend', 'CoordError', 'CoordGiveUp', 'CoordTimeout',
     'Lease', 'Versioned', 'Watch', 'RetryingBackend',
     'default_retry_policy', 'PosixDirBackend', 'TcpKvBackend',
-    'TcpKvServer', 'DEFAULT_PORT', 'ChaosBackend', 'CoordFaultConfig',
-    'COORD_ENVS', 'chaos_from_env', 'maybe_wrap_chaos',
-    'ENV_BACKEND', 'ENV_ADDR', 'RC_COORD_LOST', 'backend_from_env',
-    'from_env',
+    'TcpKvServer', 'ReplicatedKvBackend', 'DEFAULT_PORT',
+    'ChaosBackend', 'CoordFaultConfig', 'COORD_ENVS', 'chaos_from_env',
+    'maybe_wrap_chaos', 'ENV_BACKEND', 'ENV_ADDR', 'ENV_ADDRS',
+    'RC_COORD_LOST', 'backend_from_env', 'from_env',
 ]

@@ -129,20 +129,24 @@ class Watch:
     ``'put'``). Built on version snapshots, so it works on any backend
     that implements ``list`` + ``get`` — no server-side subscription
     needed, and a missed poll coalesces instead of queueing.
+
+    ``values`` holds the decoded values of the LAST poll's snapshot —
+    the versioned scan returns them anyway, so a consumer that polls
+    through a watch gets the current state for free and only has to
+    re-decode the keys the poll named (O(changes) idle cost, which is
+    the whole point of watching instead of re-reading the tree).
     """
 
     def __init__(self, backend, prefix):
         self.backend = backend
         self.prefix = str(prefix)
         self._versions = None
-
-    def _snapshot(self):
-        return {key: got.version
-                for key, got in
-                self.backend.get_many_versioned(self.prefix).items()}
+        self.values = {}
 
     def poll(self):
-        now = self._snapshot()
+        snap = self.backend.get_many_versioned(self.prefix)
+        now = {key: got.version for key, got in snap.items()}
+        self.values = {key: got.value for key, got in snap.items()}
         prev = self._versions if self._versions is not None else {}
         self._versions = now
         changes = {}

@@ -1,0 +1,465 @@
+"""Quorum-replicated coordination: N (normally 3) independent
+:class:`~.tcpkv.TcpKvServer` replicas behind one logical
+:class:`~.base.CoordBackend`, so the coordination plane itself stops
+being the single point of failure ROADMAP item 4 names.
+
+Replication fence — the PR-7 lineage-epoch trick applied per key:
+every write carries a monotonic *replication revision* ``r`` (plus a
+writer nonce ``n`` for same-revision tiebreaks) inside the stored
+envelope, and that ``r`` is the version the contract exposes::
+
+    {'r': 7, 'n': '<writer-nonce>', 'v': <the JSON value>}       # value
+    {'r': 8, 'n': '<writer-nonce>', 'tomb': True}                # delete
+
+- **Writes** read a quorum to learn the highest ``r`` seen anywhere,
+  write ``r + 1`` to every answering replica, and succeed only on a
+  quorum of acks — so any two committed writes are ordered by ``r``
+  and any read quorum overlaps every committed write.
+- **Reads** take the majority answer: the envelope with the highest
+  ``(r, n)`` wins, unless a *majority* of answering replicas say the
+  key is absent (which is how a lagging replica's resurrected value
+  loses after the tombstone TTL). Lagging replicas are repaired
+  read-through — pushed the winning envelope with a CAS against the
+  stale native version, so a repair can never clobber a newer write.
+- **CAS** checks ``expect_version`` against the winning ``r`` and then
+  writes per-replica with a CAS against each replica's *native* version
+  from the read phase, so two racing CAS callers cannot both reach a
+  quorum; the retry layer's idempotency token rides in the envelope
+  (``tok``) and a replayed attempt that finds its own token winning
+  just completes the write instead of self-conflicting.
+- **Deletes** are quorum-written tombstones with a server-enforced TTL
+  (:data:`TOMBSTONE_TTL`): a replica partitioned for the whole
+  tombstone lifetime can in principle resurrect a deleted key until
+  the next read repairs it — the protocols above (create-only claims,
+  epoch CAS) are insensitive to this, and the window is one scan wide.
+
+One replica down or partitioned is *invisible* to callers (reads and
+writes still reach a quorum; the replica is repaired read-through when
+it returns). Losing the quorum raises :class:`~.base.CoordTimeout`
+per-op, which the retry wrapper converts into the existing loud
+``CoordGiveUp`` → ``RC_COORD_LOST=118`` — exactly the single-server
+failure story, just requiring two simultaneous failures to trigger.
+
+Selection (``backend_from_env``)::
+
+    KFAC_COORD_BACKEND=replicated \
+    KFAC_COORD_ADDRS=host0:8479,host1:8479,host2:8479
+
+``KFAC_FAULT_COORD_*`` chaos injects *per replica* (seed offset by the
+replica index, so the drills exercise disagreeing replicas instead of
+faulting all three in lockstep).
+
+Replica failures are first-class incident events (the
+``kfac-obs``/incident grammar): ``replica_down`` when a replica stops
+answering, ``replica_repair`` for every read-through repair,
+``quorum_degraded`` when the pool first drops below full strength.
+"""
+
+import contextlib
+import collections
+import logging
+import os
+import threading
+import time
+
+from kfac_pytorch_tpu.coord.base import (
+    ANY, CoordBackend, CoordTimeout, Versioned, check_key, check_prefix)
+
+
+def _res():
+    # lazy: mirrors base.py — coord is imported by resilience submodules
+    from kfac_pytorch_tpu import resilience
+    return resilience
+
+
+#: server-enforced lifetime of a delete tombstone. Long enough that a
+#: briefly-lagging replica is repaired well before the majority forgets
+#: the delete; short enough that tombstones never accumulate.
+TOMBSTONE_TTL = 60.0
+
+#: after a replica fails an op, skip it for this long before probing
+#: again — a dead TCP replica must cost one connect timeout per
+#: cooldown, not one per op (heartbeat scans run several ops a second).
+DOWN_COOLDOWN = 2.0
+
+
+class ReplicatedKvBackend(CoordBackend):
+    """Quorum reads/writes over ``replicas`` (CoordBackend instances,
+    normally :class:`~.tcpkv.TcpKvBackend` — anything with the same
+    contract works, which is what the fleet simulator exploits)."""
+
+    def __init__(self, replicas, *, quorum=None, names=None,
+                 down_cooldown=DOWN_COOLDOWN, clock=time.monotonic,
+                 log=None):
+        self.replicas = list(replicas)
+        n = len(self.replicas)
+        if n < 2:
+            raise ValueError('ReplicatedKvBackend needs at least 2 '
+                             f'replicas (got {n}); one replica is just '
+                             'the tcp backend with extra steps')
+        self.quorum = int(quorum) if quorum else n // 2 + 1
+        if not 0 < self.quorum <= n:
+            raise ValueError(f'quorum {self.quorum} out of range for '
+                             f'{n} replicas')
+        if names is not None:
+            self._names = [str(x) for x in names]
+        else:
+            self._names = []
+            for i, rep in enumerate(self.replicas):
+                addr = getattr(rep, 'addr', None) \
+                    or getattr(getattr(rep, 'inner', None), 'addr', None)
+                self._names.append(f'{addr[0]}:{addr[1]}'
+                                   if addr else f'replica{i}')
+        self.down_cooldown = float(down_cooldown)
+        self._clock = clock if clock is not None else time.monotonic
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self._lock = threading.Lock()
+        self._down_until = [0.0] * n
+        self._up = [True] * n
+        self._degraded = False
+        self._nonce_ctr = 0
+        # nonces only break (r, n) ties between concurrent writers —
+        # they never appear in any trace, so randomness here does not
+        # touch the simulator's determinism contract
+        self._instance = os.urandom(4).hex()
+        self.counts = collections.Counter()
+
+    def __repr__(self):
+        return (f'ReplicatedKvBackend({", ".join(self._names)}, '
+                f'quorum={self.quorum})')
+
+    # -- replica pool state ------------------------------------------------
+
+    def _next_nonce(self):
+        with self._lock:
+            self._nonce_ctr += 1
+            return f'{self._instance}-{self._nonce_ctr:08d}'
+
+    def _mark_down(self, i, exc):
+        with self._lock:
+            self._down_until[i] = self._clock() + self.down_cooldown
+            was_up = self._up[i]
+            self._up[i] = False
+            reachable = sum(self._up)
+        if was_up:
+            self.counts['replica_down'] += 1
+            _res().counters.bump('replica_down')
+            self.log.error(
+                'coord-replicated: replica %s down — %s (%d/%d replicas '
+                'reachable) [resilience: replica_down=1]',
+                self._names[i], exc, reachable, len(self.replicas))
+
+    def _mark_up(self, i):
+        with self._lock:
+            was_up = self._up[i]
+            self._up[i] = True
+            self._down_until[i] = 0.0
+        if not was_up:
+            # narration, not an incident event: the greppable story is
+            # replica_down -> replica_repair; this line just marks when
+            # the probe started answering again
+            self.log.info('coord-replicated: contact restored with %s '
+                          '(read-through repair will catch it up)',
+                          self._names[i])
+
+    def _note_degraded(self, responders):
+        total = len(self.replicas)
+        with self._lock:
+            if responders >= total:
+                self._degraded = False
+                return
+            if self._degraded:
+                return
+            self._degraded = True
+        self.counts['quorum_degraded'] += 1
+        _res().counters.bump('quorum_degraded')
+        self.log.warning(
+            'coord-replicated: quorum degraded — %d of %d replicas '
+            'answering (quorum %d) [resilience: quorum_degraded=1]',
+            responders, total, self.quorum)
+
+    def _fan(self, op, key, fn):
+        """``fn(replica)`` on every replica not in down-cooldown;
+        returns ``{index: result}`` for the ones that answered. Raises
+        :class:`CoordTimeout` (the retryable verdict) below quorum."""
+        now = self._clock()
+        results = {}
+        for i, rep in enumerate(self.replicas):
+            if now < self._down_until[i]:
+                continue
+            try:
+                results[i] = fn(rep)
+            except (OSError, ValueError) as e:
+                self._mark_down(i, e)
+            else:
+                self._mark_up(i)
+        if len(results) < self.quorum:
+            raise CoordTimeout(
+                f'coord-replicated: quorum lost — {len(results)} of '
+                f'{len(self.replicas)} replicas answered op={op} '
+                f'key={key!r} (need {self.quorum})')
+        self._note_degraded(len(results))
+        return results
+
+    # -- envelopes ---------------------------------------------------------
+
+    @staticmethod
+    def _env(got):
+        """The replication envelope out of one replica's answer, or
+        None for absent / not-an-envelope (a foreign value in the
+        namespace is treated as absent — replicated namespaces must be
+        replicated-only)."""
+        if got is None:
+            return None
+        value = got.value
+        if isinstance(value, dict) and isinstance(value.get('r'), int):
+            return value
+        return None
+
+    @staticmethod
+    def _rank(env):
+        return (env['r'], str(env.get('n', '')))
+
+    def _merge(self, answers):
+        """``(winner_env | None, absent_majority, max_r)`` over
+        ``{index: Versioned | None}``. ``absent_majority`` is judged
+        against the ABSOLUTE quorum, not the responder count: a
+        committed write lives on >= quorum replicas, so it can never be
+        out-voted by absence — only an uncommitted or resurrected
+        value can."""
+        winner = None
+        absent = 0
+        max_r = 0
+        for got in answers.values():
+            env = self._env(got)
+            if env is None:
+                absent += 1
+                continue
+            max_r = max(max_r, env['r'])
+            if winner is None or self._rank(env) > self._rank(winner):
+                winner = env
+        return winner, absent >= self.quorum, max_r
+
+    def _repair(self, key, winner, answers, *, ttl=None):
+        """Push ``winner`` to every answering replica that disagrees,
+        CAS'd against the stale native version read — a repair can lose
+        to a concurrent newer write but never clobber one. Returns how
+        many replicas now carry ``winner`` (carriers + repaired)."""
+        if ttl is None:
+            ttl = TOMBSTONE_TTL if winner.get('tomb') else winner.get('t')
+        carriers = 0
+        for i, got in answers.items():
+            env = self._env(got)
+            if env is not None and self._rank(env) == self._rank(winner):
+                carriers += 1
+                continue
+            expect = None if got is None else got.version
+            with contextlib.suppress(OSError, ValueError):
+                if self.replicas[i].put_cas(key, winner, expect,
+                                            ttl=ttl) is not None:
+                    carriers += 1
+                    self.counts['replica_repair'] += 1
+                    _res().counters.bump('replica_repair')
+                    self.log.info(
+                        'coord-replicated: replica %s repaired key=%s '
+                        'rrev=%d [resilience: replica_repair=1]',
+                        self._names[i], key, winner['r'])
+        return carriers
+
+    def _tombstone(self, max_r):
+        return {'r': max_r + 1, 'n': self._next_nonce(), 'tomb': True}
+
+    # -- primitives --------------------------------------------------------
+
+    def get(self, key):
+        check_key(key)
+        answers = self._fan('get', key, lambda r: r.get(key))
+        winner, absent_maj, max_r = self._merge(answers)
+        if winner is None:
+            return None
+        if absent_maj:
+            # resurrection: a majority forgot this key (tombstone TTL
+            # elapsed) while a lagging replica still holds a value —
+            # re-tombstone the straggler instead of believing it
+            self._repair(key, self._tombstone(max_r), answers)
+            return None
+        self._repair(key, winner, answers)
+        if winner.get('tomb'):
+            return None
+        return Versioned(winner.get('v'), winner['r'])
+
+    def put(self, key, value, *, indent=None, ttl=None):
+        del indent  # a wire format, not a file format
+        check_key(key)
+        answers = self._fan('put', key, lambda r: r.get(key))
+        _w, _a, max_r = self._merge(answers)
+        env = {'r': max_r + 1, 'n': self._next_nonce(), 'v': value}
+        if ttl:
+            env['t'] = float(ttl)
+        acks = 0
+        for i in answers:
+            try:
+                self.replicas[i].put(key, env, ttl=ttl)
+            except (OSError, ValueError) as e:
+                self._mark_down(i, e)
+            else:
+                acks += 1
+        if acks < self.quorum:
+            # retry-safe: the retry re-reads, sees this partial write's
+            # r as max, and rewrites everything at r + 1
+            raise CoordTimeout(
+                f'coord-replicated: put on {key!r} reached {acks} of '
+                f'{len(self.replicas)} replicas (need {self.quorum})')
+        return env['r']
+
+    def put_cas(self, key, value, expect_version, *, indent=None,
+                ttl=None, token=None):
+        del indent
+        check_key(key)
+        answers = self._fan('put_cas', key, lambda r: r.get(key))
+        winner, absent_maj, max_r = self._merge(answers)
+        cur = None
+        if winner is not None and not absent_maj \
+                and not winner.get('tomb'):
+            cur = winner
+        if token is not None and cur is not None \
+                and cur.get('tok') == str(token):
+            # REPLAY of our own CAS (the previous attempt's ack was
+            # lost): complete the write instead of self-conflicting
+            carriers = self._repair(key, cur, answers, ttl=ttl)
+            if carriers >= self.quorum:
+                return cur['r']
+            raise CoordTimeout(
+                f'coord-replicated: cas replay on {key!r} completed on '
+                f'{carriers} replicas (need {self.quorum})')
+        cur_r = None if cur is None else cur['r']
+        if expect_version is None:
+            if cur is not None:
+                return None  # create-only, and the key exists
+        elif expect_version is not ANY and cur_r != expect_version:
+            return None
+        env = {'r': max_r + 1, 'n': self._next_nonce(), 'v': value}
+        if ttl:
+            env['t'] = float(ttl)
+        if token is not None:
+            env['tok'] = str(token)
+        acks = []
+        conflicts = 0
+        for i, got in answers.items():
+            # CAS against each replica's NATIVE version from the read
+            # phase: two racing logical CASes interleave per replica,
+            # and whoever lands second on any replica conflicts there —
+            # so at most one of them can reach a quorum of acks
+            expect = None if got is None else got.version
+            try:
+                v = self.replicas[i].put_cas(
+                    key, env, expect, ttl=ttl,
+                    token=str(token) if token is not None else None)
+            except (OSError, ValueError) as e:
+                self._mark_down(i, e)
+                continue
+            if v is None:
+                conflicts += 1
+            else:
+                acks.append((i, v, got))
+        if len(acks) >= self.quorum:
+            return env['r']
+        if conflicts:
+            # lost the race (or a per-replica chaos lane injected a
+            # conflict): best-effort rollback of the partial writes so
+            # the winner's quorum stays clean, then answer CONFLICT —
+            # the caller re-reads and re-derives, the CAS contract
+            for i, v, got in acks:
+                with contextlib.suppress(OSError, ValueError):
+                    if got is None:
+                        self.replicas[i].delete(key)
+                    else:
+                        self.replicas[i].put_cas(key, got.value, v)
+            return None
+        raise CoordTimeout(
+            f'coord-replicated: cas on {key!r} acked by {len(acks)} of '
+            f'{len(self.replicas)} replicas (need {self.quorum})')
+
+    def delete(self, key):
+        check_key(key)
+        answers = self._fan('delete', key, lambda r: r.get(key))
+        winner, absent_maj, max_r = self._merge(answers)
+        present = (winner is not None and not absent_maj
+                   and not winner.get('tomb'))
+        env = self._tombstone(max_r)
+        acks = 0
+        for i in answers:
+            try:
+                self.replicas[i].put(key, env, ttl=TOMBSTONE_TTL)
+            except (OSError, ValueError) as e:
+                self._mark_down(i, e)
+            else:
+                acks += 1
+        if acks < self.quorum:
+            raise CoordTimeout(
+                f'coord-replicated: delete on {key!r} reached {acks} of '
+                f'{len(self.replicas)} replicas (need {self.quorum})')
+        return present
+
+    def delete_prefix(self, prefix):
+        check_prefix(prefix)
+        count = 0
+        for key in sorted(self._scan(prefix)):
+            if self.delete(key):
+                count += 1
+        return count
+
+    # -- scans -------------------------------------------------------------
+
+    def _scan(self, prefix):
+        """{key: winning envelope} for every LIVE key under ``prefix``
+        from a quorum of replica scans; lagging replicas repaired
+        in passing (this is how a returned replica catches up without
+        any dedicated anti-entropy machinery — the heartbeat and queue
+        scans already sweep every hot key on a cadence)."""
+        answers = self._fan('get_many', prefix,
+                            lambda r: r.get_many_versioned(prefix))
+        keys = set()
+        for d in answers.values():
+            keys.update(d)
+        out = {}
+        for key in sorted(keys):
+            per = {i: d.get(key) for i, d in answers.items()}
+            winner, absent_maj, max_r = self._merge(per)
+            if winner is None:
+                continue
+            if absent_maj:
+                self._repair(key, self._tombstone(max_r), per)
+                continue
+            self._repair(key, winner, per)
+            if not winner.get('tomb'):
+                out[key] = winner
+        return out
+
+    def list(self, prefix=''):
+        return sorted(self._scan(prefix))
+
+    def get_many(self, prefix=''):
+        return {k: env.get('v')
+                for k, env in self._scan(prefix).items()}
+
+    def get_many_versioned(self, prefix=''):
+        return {k: Versioned(env.get('v'), env['r'])
+                for k, env in self._scan(prefix).items()}
+
+    # -- plumbing ----------------------------------------------------------
+
+    def ping(self):
+        """Per-replica liveness probe (``launch_tpu.sh`` preflight)."""
+        answers = self._fan('ping', '', lambda r: r.ping())
+        return {'ok': True, 'quorum': self.quorum,
+                'replicas': {self._names[i]: resp
+                             for i, resp in answers.items()}}
+
+    def ensure_prefix(self, prefix):
+        pass  # KV namespaces need no scaffolding
+
+    def close(self):
+        for rep in self.replicas:
+            with contextlib.suppress(OSError):
+                rep.close()

@@ -9,13 +9,19 @@ semantics — what they need is exactly the six primitives, provided here
 by one tiny server any pod host can reach over the same address plane
 ``hosts.json`` already names.
 
-Wire protocol: one JSON request line per connection, one JSON response
-(the connection-per-op shape :class:`~..resilience.heartbeat.
-TcpHeartbeatTransport` already uses — a dead server presents as refused
-connections, which the retry layer converts into bounded backoff and a
-loud give-up, never a wedge). Versions are a per-store monotonic
-revision counter; a lease is a key with an ``expires`` wall deadline the
-server enforces lazily on every read and in a periodic sweep.
+Wire protocol: newline-delimited JSON request/response pairs over a
+PERSISTENT connection — the client keeps one socket per backend and
+reconnects on error, so a simulated-fleet op rate costs one TCP
+handshake per backend lifetime, not one per op. One-shot
+connection-per-op clients (older versions, shell probes) still work:
+the server answers requests until the peer closes. A dead server
+presents as a broken/refused socket, which the retry layer converts
+into bounded backoff and a loud give-up, never a wedge — a mid-stream
+server restart costs the in-flight op one :class:`CoordTimeout` and the
+CAS idempotency token makes the replay safe. Versions are a per-store
+monotonic revision counter; a lease is a key with an ``expires`` wall
+deadline the server enforces lazily on every read and in a periodic
+sweep.
 
 Run it standalone (``kfac-coord-serve --port 8479``) or in-process
 (:class:`TcpKvServer` — the drills do). Select it per process with::
@@ -170,21 +176,32 @@ class TcpKvServer:
                              daemon=True).start()
 
     def _handle(self, conn):
+        # request LOOP: serve newline-delimited ops until the peer
+        # closes (persistent clients) or goes idle past the timeout —
+        # a one-shot connection-per-op client just closes after its
+        # first response and falls out on the empty recv
         with contextlib.suppress(OSError, ValueError), conn:
-            conn.settimeout(2.0)
-            raw = b''
-            while not raw.endswith(b'\n'):
-                chunk = conn.recv(65536)
-                if not chunk:
-                    break
-                raw += chunk
-            if not raw.strip():
-                return
-            try:
-                resp = self.op(json.loads(raw.decode()))
-            except Exception as e:  # noqa: BLE001 — server must live
-                resp = {'ok': False, 'error': str(e)}
-            conn.sendall(json.dumps(resp).encode() + b'\n')
+            conn.settimeout(30.0)
+            buf = b''
+            while True:
+                while b'\n' not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                if self._stopped:
+                    # checked AFTER the blocking recv: a closed server
+                    # must never answer from its lingering store, even
+                    # on connections that were already open
+                    return
+                line, buf = buf.split(b'\n', 1)
+                if not line.strip():
+                    continue
+                try:
+                    resp = self.op(json.loads(line.decode()))
+                except Exception as e:  # noqa: BLE001 — server must live
+                    resp = {'ok': False, 'error': str(e)}
+                conn.sendall(json.dumps(resp).encode() + b'\n')
 
     def close(self):
         self._stopped = True
@@ -193,9 +210,18 @@ class TcpKvServer:
         self._thread.join(timeout=2)
 
 
+#: ops whose replay is harmless — a broken REUSED socket resends these
+#: on a fresh connection transparently; everything else surfaces as one
+#: CoordTimeout and lets the retry layer (CAS idempotency token in
+#: hand) decide
+_IDEMPOTENT_OPS = frozenset({'get', 'list', 'get_many', 'ping'})
+
+
 class TcpKvBackend(CoordBackend):
-    """Connection-per-op client. ``namespace`` (the backend root — a
-    lease-dir or service-dir path) prefixes every key on the server."""
+    """Persistent-connection client: ONE socket per backend, reused
+    across ops and re-established on error. ``namespace`` (the backend
+    root — a lease-dir or service-dir path) prefixes every key on the
+    server."""
 
     def __init__(self, addr, namespace, *, timeout=2.0):
         if isinstance(addr, str):
@@ -210,6 +236,8 @@ class TcpKvBackend(CoordBackend):
                              '(the backend root — a lease/service dir '
                              'path)')
         self.timeout = float(timeout)
+        self._sock = None
+        self._lock = threading.Lock()
 
     def __repr__(self):
         return (f'TcpKvBackend({self.addr[0]}:{self.addr[1]}, '
@@ -219,26 +247,68 @@ class TcpKvBackend(CoordBackend):
         key = check_key(key)
         return f'{self.namespace}/{key}' if self.namespace else key
 
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        s.settimeout(self.timeout)
+        return s
+
+    def _drop_sock(self):
+        s, self._sock = self._sock, None
+        if s is not None:
+            with contextlib.suppress(OSError):
+                s.close()
+
+    @staticmethod
+    def _send_recv(s, payload):
+        s.sendall(payload)
+        raw = b''
+        while not raw.endswith(b'\n'):
+            chunk = s.recv(65536)
+            if not chunk:
+                raise OSError('connection closed mid-response')
+            raw += chunk
+        return raw
+
     def _request(self, req):
+        payload = json.dumps(req).encode() + b'\n'
+        with self._lock:
+            try:
+                fresh = self._sock is None
+                if fresh:
+                    self._sock = self._connect()
+                raw = self._send_recv(self._sock, payload)
+            except (OSError, ValueError) as e:
+                self._drop_sock()
+                # a REUSED socket can be stale (server restart, idle
+                # disconnect): resend idempotent reads on a fresh
+                # connection transparently; writes surface the error —
+                # the op may or may not have applied, and the retry
+                # layer's CAS token is the replay-safety mechanism
+                if fresh or req.get('op') not in _IDEMPOTENT_OPS:
+                    raise CoordTimeout(
+                        f'coord kv {self.addr[0]}:{self.addr[1]} '
+                        f'unreachable ({e})') from e
+                try:
+                    self._sock = self._connect()
+                    raw = self._send_recv(self._sock, payload)
+                except (OSError, ValueError) as e2:
+                    self._drop_sock()
+                    raise CoordTimeout(
+                        f'coord kv {self.addr[0]}:{self.addr[1]} '
+                        f'unreachable ({e2})') from e2
         try:
-            with socket.create_connection(self.addr,
-                                          timeout=self.timeout) as s:
-                s.settimeout(self.timeout)
-                s.sendall(json.dumps(req).encode() + b'\n')
-                raw = b''
-                while not raw.endswith(b'\n'):
-                    chunk = s.recv(65536)
-                    if not chunk:
-                        break
-                    raw += chunk
             resp = json.loads(raw.decode())
-        except (OSError, ValueError) as e:
+        except ValueError as e:
             raise CoordTimeout(
-                f'coord kv {self.addr[0]}:{self.addr[1]} unreachable '
-                f'({e})') from e
+                f'coord kv {self.addr[0]}:{self.addr[1]} sent a '
+                f'malformed response ({e})') from e
         if not resp.get('ok'):
             raise CoordTimeout(f'coord kv error: {resp.get("error")}')
         return resp
+
+    def close(self):
+        with self._lock:
+            self._drop_sock()
 
     # -- primitives --------------------------------------------------------
 

@@ -89,6 +89,8 @@ class BackendLeaseTransport:
         if self.prefix and not self.prefix.endswith('/'):
             self.prefix += '/'
         self.ttl = ttl
+        self._watch = None    # None = build lazily; False = unsupported
+        self._cached = None
 
     def _key(self, host_id):
         return f'{self.prefix}hb-{host_id}.json'
@@ -97,9 +99,39 @@ class BackendLeaseTransport:
         self.backend.put(self._key(self.host_id), payload, ttl=self.ttl)
 
     def read_peers(self):
-        """{host_id: payload} for every readable lease but our own."""
+        """{host_id: payload} for every readable lease but our own.
+
+        Watch-driven (ROADMAP 4(b)): one versioned scan per poll — the
+        same single round trip as the plain scan on the KV backends —
+        with the decoded per-host view rebuilt only when the watch
+        reports changed keys, so an idle pod's scan costs O(changes).
+        Liveness stays correct through the cache by construction: an
+        unchanged version IS an unchanged (pid, gen, seq) identity, and
+        the monitor judges advance. A backend without watch support, or
+        a watch poll that errors, degrades to the plain full scan
+        (rebuilt watch next poll)."""
+        if self._watch is None:
+            try:
+                self._watch = self.backend.watch(self.prefix)
+            except Exception:  # noqa: BLE001 — a backend predating watch
+                self._watch = False
+        if self._watch is False:
+            return self._decode_peers(self.backend.get_many(self.prefix))
+        try:
+            changes = self._watch.poll()
+        except (OSError, ValueError):
+            # degraded fallback: plain scan this poll (its own errors
+            # surface as the monitor's usual missed beat), fresh watch
+            # — which re-reads the full tree — on the next one
+            self._watch = None
+            return self._decode_peers(self.backend.get_many(self.prefix))
+        if changes or self._cached is None:
+            self._cached = self._decode_peers(self._watch.values)
+        return dict(self._cached)
+
+    def _decode_peers(self, payloads):
         out = {}
-        for key, payload in self.backend.get_many(self.prefix).items():
+        for key, payload in payloads.items():
             name = key[len(self.prefix):]
             if not (name.startswith('hb-') and name.endswith('.json')):
                 continue

@@ -35,6 +35,9 @@ _CUMULATIVE = frozenset({
     'quorum_lost', 'coord_lost', 'coord_retries', 'coord_gave_ups',
     'poll_wait_s',
 })
+# (the replicated backend's replica_down/replica_repair/quorum_degraded
+# suffixes are per-event deltas — =1 each emission — so they take the
+# default SUM aggregation, not the cumulative MAX above)
 
 # suffix keys that are event FIELDS riding along in a [resilience: ...]
 # line (heartbeat's peer=/detect_s=, the join announcement's host=), not
@@ -94,6 +97,22 @@ _PATTERNS = (
         r'(?P<attempts>\d+) attempts')),
     ('coord_lost', re.compile(
         r'coordination backend lost — .*exiting rc=(?P<rc>\d+)')),
+    # the replicated quorum (coord.replicated): one replica's loss,
+    # its read-through catch-up after a restart, and the degraded-
+    # but-answering state between them — so an operator's timeline
+    # reads replica_down -> quorum_degraded -> replica_repair without
+    # any trainer-visible coord_lost in between (that one only appears
+    # on TRUE quorum loss)
+    ('replica_down', re.compile(
+        r'coord-replicated: replica (?P<replica>\S+) down — '
+        r'.*\((?P<up>\d+)/(?P<total>\d+) replicas reachable\)')),
+    ('replica_repair', re.compile(
+        r'coord-replicated: replica (?P<replica>\S+) repaired '
+        r'key=(?P<key>\S+) rrev=(?P<rrev>\d+)')),
+    ('quorum_degraded', re.compile(
+        r'coord-replicated: quorum degraded — (?P<up>\d+) of '
+        r'(?P<total>\d+) replicas answering \(quorum '
+        r'(?P<quorum>\d+)\)')),
     # the grow cycle (elastic GROW / train-through-churn): a repaired
     # host's announcement, each supervisor's claim into the grow
     # barrier, the agreed enlargement, and the trainer-side upward

@@ -644,10 +644,17 @@ class AdmissionController:
 
     # -- the loop ----------------------------------------------------------
 
-    def step(self):
+    def step(self, ingest=True):
         """One scheduling cycle; returns True while there is (or may
-        be) work left."""
-        self.queue.ingest(log=self.log)
+        be) work left. ``ingest=False`` skips the spool scan — the
+        watch-driven loop passes it when the ``incoming/`` watch saw no
+        changes AND the spool is empty (a non-empty spool always
+        re-ingests: a torn or deferred entry produces no new key
+        event). Everything else stays unconditional: reaps, capacity
+        refresh and admissions are wall-clock-driven (``not_before``
+        backoffs, child exits), not key-change-driven."""
+        if ingest:
+            self.queue.ingest(log=self.log)
         # reap BEFORE refreshing capacity: a job that already finished
         # on a just-removed host must be marked done, not requeued
         self._reap()
@@ -687,12 +694,37 @@ class AdmissionController:
         # cycles relax toward the cap, a fleet of schedulers against
         # one backend decorrelates, and the waited total is accounted
         pace = PollPacer.for_period(self.poll_period, clock=self.clock)
+        # settle scan: a version-diff watch over the spool replaces the
+        # per-cycle ingest list when the backend supports it (ROADMAP
+        # 4b). The PollPacer above stays as the degraded fallback — a
+        # watch error this cycle just scans the old way.
+        watch = None
+        watch_fn = getattr(self.queue.backend, 'watch', None)
+        if callable(watch_fn):
+            try:
+                watch = watch_fn('incoming/')
+            except (OSError, ValueError, NotImplementedError):
+                watch = None
         try:
             self.queue.recover(log=self.log)
             while not self._stop:
-                busy = self.step()
-                if drain and not busy and not self.queue.backend.list(
-                        'incoming/'):
+                ingest, spool = True, None
+                if watch is not None:
+                    try:
+                        changed = bool(watch.poll())
+                        spool = watch.values
+                        # a non-empty spool must keep re-ingesting even
+                        # without key events: torn or deferred entries
+                        # sit in place until a later scan accepts them
+                        ingest = changed or bool(spool)
+                    except CoordGiveUp:
+                        raise
+                    except (OSError, ValueError):
+                        ingest, spool = True, None
+                busy = self.step(ingest=ingest)
+                if drain and not busy and not (
+                        spool if spool is not None
+                        else self.queue.backend.list('incoming/')):
                     return 0
                 if (max_seconds is not None
                         and self.clock.monotonic() - start

@@ -140,16 +140,31 @@ fi
 #                       etcd-style KV server, no shared filesystem —
 #                       run one with `kfac-coord-serve --port 8479`)
 #   KFAC_COORD_ADDR     host:port of the KV server (required for tcp)
+#   KFAC_COORD_ADDRS    comma-separated host:port of the KV replicas —
+#                       normally 3 (required for replicated; one
+#                       replica down is invisible, quorum loss exits
+#                       RC_COORD_LOST=118)
 # Backend fault drills: KFAC_FAULT_COORD_* (seed/fail/torn/stale/cas/
-# lease_expire/windows — faults.py STRICT from_env).
+# lease_expire/windows — faults.py STRICT from_env; on replicated they
+# arm PER REPLICA with decorrelated seeds).
 if [ -n "$KFAC_COORD_BACKEND" ]; then
   case "$KFAC_COORD_BACKEND" in
     posix) export KFAC_COORD_BACKEND ;;
     tcp)
       : "${KFAC_COORD_ADDR:?KFAC_COORD_BACKEND=tcp needs KFAC_COORD_ADDR (host:port of a kfac-coord-serve KV server)}"
       export KFAC_COORD_BACKEND KFAC_COORD_ADDR ;;
-    *) echo "launch_tpu.sh: KFAC_COORD_BACKEND must be posix|tcp," \
-            "got '$KFAC_COORD_BACKEND'" >&2; exit 1 ;;
+    replicated)
+      : "${KFAC_COORD_ADDRS:?KFAC_COORD_BACKEND=replicated needs KFAC_COORD_ADDRS (comma-separated host:port of the kfac-coord-serve replicas, normally 3)}"
+      case "$KFAC_COORD_ADDRS" in
+        *[,\;]*) ;;
+        *) echo "launch_tpu.sh: KFAC_COORD_ADDRS needs at least 2" \
+                "comma-separated replicas, got '$KFAC_COORD_ADDRS'" \
+                >&2; exit 1 ;;
+      esac
+      export KFAC_COORD_BACKEND KFAC_COORD_ADDRS ;;
+    *) echo "launch_tpu.sh: KFAC_COORD_BACKEND must be" \
+            "posix|tcp|replicated, got '$KFAC_COORD_BACKEND'" >&2
+       exit 1 ;;
   esac
 fi
 

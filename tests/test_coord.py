@@ -37,7 +37,8 @@ import pytest
 from kfac_pytorch_tpu import coord
 from kfac_pytorch_tpu.coord import (
     ANY, ChaosBackend, CoordFaultConfig, CoordGiveUp, CoordTimeout,
-    PosixDirBackend, RetryingBackend, TcpKvBackend, TcpKvServer)
+    PosixDirBackend, ReplicatedKvBackend, RetryingBackend, TcpKvBackend,
+    TcpKvServer)
 from kfac_pytorch_tpu.resilience import atomic_write_json
 from kfac_pytorch_tpu.resilience.retry import ManualClock, RetryPolicy
 
@@ -53,12 +54,30 @@ def kv_server():
     srv.close()
 
 
-@pytest.fixture(params=['posix', 'tcp'])
-def backend(request, tmp_path, kv_server):
+@pytest.fixture(scope='module')
+def kv_trio():
+    servers = [TcpKvServer('127.0.0.1', 0) for _ in range(3)]
+    yield servers
+    for srv in servers:
+        srv.close()
+
+
+def _replicated(kv_trio, namespace, **kw):
+    return ReplicatedKvBackend(
+        [TcpKvBackend(('127.0.0.1', srv.port), namespace=namespace)
+         for srv in kv_trio], **kw)
+
+
+@pytest.fixture(params=['posix', 'tcp', 'replicated'])
+def backend(request, tmp_path, kv_server, kv_trio):
     if request.param == 'posix':
         return PosixDirBackend(str(tmp_path / 'root'))
-    return TcpKvBackend(('127.0.0.1', kv_server.port),
-                        namespace=str(tmp_path / 'root'))
+    if request.param == 'tcp':
+        return TcpKvBackend(('127.0.0.1', kv_server.port),
+                            namespace=str(tmp_path / 'root'))
+    # the full primitive contract must hold through the quorum merge
+    # too — same tests, zero special-casing
+    return _replicated(kv_trio, str(tmp_path / 'root'))
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +232,190 @@ def test_tcpkv_dead_server_raises_coord_timeout():
         b.get('anything.json')
     with pytest.raises(CoordTimeout):
         b.put('anything.json', {})
+
+
+def test_tcpkv_reuses_one_socket_across_ops(kv_server, tmp_path):
+    """Connection reuse is the point of the persistent client: many
+    ops, ONE socket — no per-op connect()/close() churn against the
+    store every heartbeat tick."""
+    b = TcpKvBackend(('127.0.0.1', kv_server.port),
+                     namespace=str(tmp_path / 'ns'))
+    b.put('a.json', {'v': 0})
+    sock = b._sock
+    assert sock is not None
+    for i in range(5):
+        b.put('a.json', {'v': i})
+        assert b.get('a.json').value == {'v': i}
+        b.list('')
+    assert b._sock is sock      # still the first connection
+    b.close()
+    assert b._sock is None
+
+
+def test_tcpkv_reused_socket_absorbs_server_restart(tmp_path):
+    """The mid-stream restart pin: a stale reused socket must be
+    transparent for idempotent READS (resent once on a fresh
+    connection), LOUD for writes (the op may or may not have applied —
+    replay safety belongs to the CAS-token layer, not the socket)."""
+    srv = TcpKvServer('127.0.0.1', 0)
+    port = srv.port
+    ns = str(tmp_path / 'ns')
+    b = TcpKvBackend(('127.0.0.1', port), namespace=ns, timeout=0.5)
+    try:
+        b.put('k.json', {'v': 1})
+        stale = b._sock
+        assert stale is not None
+        srv.close()
+        srv = TcpKvServer('127.0.0.1', port)   # restart, same port
+        # read on the stale socket: absorbed (fresh store -> None),
+        # and the client is now on a NEW connection
+        assert b.get('k.json') is None
+        assert b._sock is not None and b._sock is not stale
+        b.put('k.json', {'v': 2})
+        srv.close()
+        srv = TcpKvServer('127.0.0.1', port)
+        # write on the stale socket: surfaced, never silently replayed
+        with pytest.raises(CoordTimeout):
+            b.put('k.json', {'v': 3})
+        # and the very next op reconnects cleanly
+        assert b.get('k.json') is None
+        b.put('k.json', {'v': 4})
+        assert b.get('k.json').value == {'v': 4}
+    finally:
+        srv.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# ReplicatedKvBackend: absorb one replica, repair it, degrade loudly
+# ---------------------------------------------------------------------------
+
+def _trio(tmp_path, **kw):
+    servers = [TcpKvServer('127.0.0.1', 0) for _ in range(3)]
+    kw.setdefault('down_cooldown', 0.05)
+    b = ReplicatedKvBackend(
+        [TcpKvBackend(('127.0.0.1', s.port),
+                      namespace=str(tmp_path / 'ns'), timeout=0.4)
+         for s in servers], **kw)
+    return servers, b
+
+
+def test_replicated_one_replica_down_is_invisible(tmp_path):
+    servers, b = _trio(tmp_path)
+    try:
+        b.put('a.json', {'v': 1})
+        servers[1].close()
+        # every primitive keeps answering on the 2/3 quorum — zero
+        # caller-visible errors
+        assert b.get('a.json').value == {'v': 1}
+        b.put('a.json', {'v': 2})
+        got = b.get('a.json')
+        assert got.value == {'v': 2}
+        assert b.put_cas('a.json', {'v': 3}, got.version) is not None
+        assert b.get('a.json').value == {'v': 3}
+        assert b.list('') == ['a.json']
+        assert b.counts.get('replica_down', 0) >= 1
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_replicated_restarted_empty_replica_is_repaired(tmp_path):
+    servers, b = _trio(tmp_path)
+    try:
+        b.put('a.json', {'v': 1})
+        port = servers[1].port
+        servers[1].close()
+        b.put('a.json', {'v': 2})          # applied on replicas 0, 2
+        servers[1] = TcpKvServer('127.0.0.1', port)  # EMPTY store
+        time.sleep(0.06)                   # past the down cooldown
+        # the majority answer wins; the lagging replica is repaired
+        # read-through in the same pass
+        assert b.get('a.json').value == {'v': 2}
+        assert b.counts.get('replica_repair', 0) >= 1
+        direct = TcpKvBackend(('127.0.0.1', port),
+                              namespace=str(tmp_path / 'ns'))
+        envelope = direct.get('a.json').value
+        assert envelope['v'] == {'v': 2}   # caught back up
+        direct.close()
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_replicated_quorum_loss_is_loud(tmp_path):
+    servers, b = _trio(tmp_path)
+    try:
+        b.put('a.json', {'v': 1})
+        servers[0].close()
+        servers[2].close()
+        with pytest.raises(CoordTimeout, match='quorum'):
+            b.get('a.json')
+        with pytest.raises(CoordTimeout, match='quorum'):
+            b.put('a.json', {'v': 2})
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_backend_from_env_replicated(tmp_path, kv_trio, monkeypatch):
+    monkeypatch.setenv(coord.ENV_BACKEND, 'replicated')
+    monkeypatch.delenv(coord.ENV_ADDRS, raising=False)
+    with pytest.raises(ValueError, match='KFAC_COORD_ADDRS'):
+        coord.backend_from_env(str(tmp_path), retry=False)
+    monkeypatch.setenv(coord.ENV_ADDRS, f'127.0.0.1:{kv_trio[0].port}')
+    with pytest.raises(ValueError, match='at least 2'):
+        coord.backend_from_env(str(tmp_path), retry=False)
+    monkeypatch.setenv(
+        coord.ENV_ADDRS,
+        ','.join(f'127.0.0.1:{s.port}' for s in kv_trio))
+    b = coord.backend_from_env(str(tmp_path), retry=False)
+    assert isinstance(b, ReplicatedKvBackend)
+    wrapped = coord.backend_from_env(str(tmp_path))
+    assert isinstance(wrapped, RetryingBackend)
+    assert isinstance(wrapped.inner, ReplicatedKvBackend)
+    wrapped.put('x.json', {'v': 1})
+    assert wrapped.get('x.json').value == {'v': 1}
+    # armed chaos lands PER REPLICA (decorrelated seeds), never on the
+    # merge — a lockstep fault on all three is the one correlated
+    # failure a quorum cannot absorb, so the drill must not inject it
+    monkeypatch.setenv('KFAC_FAULT_COORD_FAIL', '0.25')
+    monkeypatch.setenv('KFAC_FAULT_COORD_SEED', '7')
+    b = coord.backend_from_env(str(tmp_path / 'chaos'), retry=False)
+    assert isinstance(b, ReplicatedKvBackend)
+    seeds = set()
+    for rep in b.replicas:
+        assert isinstance(rep, ChaosBackend)
+        seeds.add(rep.cfg.seed)
+    assert len(seeds) == len(b.replicas)
+
+
+def test_shrink_majority_commits_on_replicated_backend(tmp_path,
+                                                       kv_trio):
+    """The barrier + lineage bump land through the quorum merge — with
+    one replica ALREADY DEAD the whole time."""
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    servers = [TcpKvServer('127.0.0.1', 0) for _ in range(3)]
+    backend = ReplicatedKvBackend(
+        [TcpKvBackend(('127.0.0.1', s.port),
+                      namespace=str(tmp_path / 'lease'), timeout=0.4)
+         for s in servers], down_cooldown=0.05)
+    servers[2].close()                    # one replica down mid-drill
+    try:
+        sup = PodSupervisor(['trainer'], host_id=0, num_hosts=3,
+                            lease_dir=str(tmp_path / 'lease'),
+                            coord=backend, settle=0.0,
+                            shrink_timeout=0.15, poll_period=0.01)
+        backend.put('shrink-gen1/survivor-2.json',
+                    {'host': 2, 'addr': None})
+        assert sup._shrink({1: {}}) is True
+        assert sup.members == [0, 2] and sup.gen == 1
+        assert backend.get('lineage.json').value['lineage'] == 1
+        assert sup._current_lineage() == 1
+        sup._hb.stop()
+    finally:
+        for s in servers:
+            s.close()
 
 
 def test_backend_from_env_selection(tmp_path, kv_server, monkeypatch):

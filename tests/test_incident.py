@@ -147,6 +147,81 @@ def test_counter_aggregation_sums_deltas_maxes_cumulatives():
     assert rep.counters['peer_dead'] == 1
 
 
+REPLICATED_LOG = """\
+coord-replicated: replica 10.0.0.2:8479 down — coord kv 10.0.0.2:8479 \
+unreachable ([Errno 111] Connection refused) (2/3 replicas reachable) \
+[resilience: replica_down=1]
+coord-replicated: quorum degraded — 2 of 3 replicas answering \
+(quorum 2) [resilience: quorum_degraded=1]
+coord-replicated: replica 10.0.0.2:8479 repaired key=lineage.json \
+rrev=4 [resilience: replica_repair=1]
+"""
+
+
+def test_scrape_extracts_replicated_quorum_story():
+    """The replicated backend's log forms land in the shared grammar:
+    an operator timeline reads replica_down -> quorum_degraded ->
+    replica_repair with NO coord_lost in between — one replica down is
+    the absorbed case, not an incident verdict."""
+    rep = IncidentReport(host_id=0).scrape_lines(
+        REPLICATED_LOG.splitlines())
+    by_kind = {}
+    for e in rep.events:
+        by_kind.setdefault(e['kind'], []).append(e)
+    down = by_kind['replica_down'][0]
+    assert down['replica'] == '10.0.0.2:8479'
+    assert (down['up'], down['total']) == (2, 3)
+    deg = by_kind['quorum_degraded'][0]
+    assert (deg['up'], deg['total'], deg['quorum']) == (2, 3, 2)
+    repair = by_kind['replica_repair'][0]
+    assert repair['replica'] == '10.0.0.2:8479'
+    assert repair['key'] == 'lineage.json' and repair['rrev'] == 4
+    assert 'coord_lost' not in by_kind and 'coord_gave_up' not in by_kind
+    # the [resilience: ...] suffixes aggregate as per-event deltas
+    assert rep.counters['replica_down'] == 1
+    assert rep.counters['quorum_degraded'] == 1
+    assert rep.counters['replica_repair'] == 1
+
+
+def test_replicated_events_come_from_the_real_emitters(tmp_path):
+    """Grammar-vs-emitter drift gate: scrape lines PRODUCED by the real
+    ReplicatedKvBackend (a replica killed under it), not hand-copied
+    fixtures."""
+    import logging
+    import time
+    from kfac_pytorch_tpu.coord import ReplicatedKvBackend, TcpKvBackend
+    from kfac_pytorch_tpu.coord import TcpKvServer
+    servers = [TcpKvServer('127.0.0.1', 0) for _ in range(3)]
+    logger = logging.getLogger('test-replicated-emitters')
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger.addHandler(_Capture())
+    logger.setLevel(logging.DEBUG)
+    b = ReplicatedKvBackend(
+        [TcpKvBackend(('127.0.0.1', s.port),
+                      namespace=str(tmp_path), timeout=0.3)
+         for s in servers], log=logger, down_cooldown=0.01)
+    try:
+        b.put('lineage.json', {'lineage': 1})
+        port = servers[1].port
+        servers[1].close()
+        b.put('lineage.json', {'lineage': 2})
+        servers[1] = TcpKvServer('127.0.0.1', port)  # empty store
+        time.sleep(0.02)
+        assert b.get('lineage.json').value == {'lineage': 2}
+    finally:
+        for s in servers:
+            s.close()
+    rep = IncidentReport(host_id=0).scrape_lines(records)
+    kinds = {e['kind'] for e in rep.events}
+    assert {'replica_down', 'quorum_degraded',
+            'replica_repair'} <= kinds, (kinds, records)
+
+
 def test_gave_up_is_machine_detectable():
     rep = IncidentReport().scrape_lines([GAVE_UP])
     d = rep.to_dict()

@@ -90,6 +90,30 @@ def tcpkv_coord():
         srv.close()
 
 
+@pytest.fixture
+def replicated_coord():
+    """Run the whole drill on the REPLICATED (quorum) coordination
+    backend: three live KV replicas in this process and
+    KFAC_COORD_BACKEND=replicated in every child. No seeded backend
+    faults — the disturbance under test is a whole replica dying (the
+    tests close servers from this list mid-drill), and the quorum layer
+    must absorb exactly one such loss without a single visible
+    coordination failure."""
+    from kfac_pytorch_tpu.coord import TcpKvServer
+    servers = [TcpKvServer('127.0.0.1', 0) for _ in range(3)]
+    _COORD_OVERLAY.update({
+        'KFAC_COORD_BACKEND': 'replicated',
+        'KFAC_COORD_ADDRS': ','.join(
+            f'127.0.0.1:{s.port}' for s in servers),
+    })
+    try:
+        yield servers
+    finally:
+        _COORD_OVERLAY.clear()
+        for s in servers:
+            s.close()
+
+
 def _done_line(out):
     lines = [l for l in out.splitlines() if l.startswith('DONE ')]
     assert lines, f'no DONE line; output tail: {out[-3000:]}'
@@ -144,8 +168,99 @@ def test_pod_shrinks_on_tcpkv_backend_with_coord_faults(tmp_path,
                       expect_coord_retries=True)
 
 
+def test_pod_shrinks_on_replicated_backend_with_replica_kill(
+        tmp_path, replicated_coord):
+    """The 2-host SIGKILL drill on the QUORUM backend, with a second
+    simultaneous failure: the instant host 1's process group dies, one
+    of the three KV replicas dies with it. Every barrier claim, lineage
+    bump, heartbeat lease and join/done marker of the shrink rides the
+    remaining 2/3 majority — the drill must finish exactly like the
+    healthy-backend leg, with the replica loss visible only as the
+    backend's own replica_down emission, never as a coord retry storm
+    or a coord_lost."""
+    _run_shrink_drill(
+        tmp_path, art_subdir='replicated',
+        on_host_kill=lambda: replicated_coord[2].close(),
+        expect_replica_down=True)
+
+
+def test_pod_exits_118_when_replicated_quorum_lost(tmp_path,
+                                                   replicated_coord):
+    """TRUE quorum loss is loud, never a wedge: with two of three
+    replicas dead the majority is gone, every coordination op degrades
+    below quorum, the retry budget spends itself, and both supervisors
+    exit RC_COORD_LOST (118) with the coord_lost event in the incident
+    report — a host that cannot reach a majority must stop deciding
+    membership instead of treating the one reachable replica as truth."""
+    from kfac_pytorch_tpu.coord import RC_COORD_LOST
+    from kfac_pytorch_tpu.resilience.incident import IncidentReport
+
+    lease = tmp_path / 'lease'
+    ckpt0, ckpt1 = str(tmp_path / 'ckpt_h0'), str(tmp_path / 'ckpt_h1')
+    out0_path = tmp_path / 'host0.out'
+    out1_path = tmp_path / 'host1.out'
+    # pace the steps (same reasoning as the shrink drill): the schedule
+    # must still be mid-flight when the quorum goes away
+    pod_env = _env(KFAC_FAULT_SLOW_STEP='0:999',
+                   KFAC_FAULT_SLOW_SECS='1.5')
+    procs = []
+    try:
+        with open(out0_path, 'wb') as f0, open(out1_path, 'wb') as f1:
+            for host_id, ckpt, f in ((0, ckpt0, f0), (1, ckpt1, f1)):
+                procs.append(subprocess.Popen(
+                    _pod_cmd(host_id, lease, ckpt), env=pod_env, cwd=REPO,
+                    stdout=f, stderr=subprocess.STDOUT,
+                    start_new_session=True))
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                if any(p.poll() is not None for p in procs):
+                    pytest.fail('a pod member exited before the quorum '
+                                'kill; host0 tail: '
+                                + out0_path.read_text()[-3000:])
+                if _has_checkpoint(ckpt0) and _has_checkpoint(ckpt1):
+                    break
+                time.sleep(0.5)
+            else:
+                pytest.fail('epoch-0 checkpoints never appeared; host0 '
+                            'tail: ' + out0_path.read_text()[-3000:])
+            # kill the MAJORITY — staged, so the runlog tells the whole
+            # escalation story: one replica down first (ops succeed on
+            # the 2/3 majority and the backend logs quorum DEGRADED),
+            # then the second (below quorum: every op fails, quorum
+            # LOST). Heartbeat leases publish every 0.3s, so 2s of
+            # degraded operation is dozens of successful quorum ops.
+            replicated_coord[0].close()
+            time.sleep(2.0)
+            replicated_coord[1].close()
+            rcs = [p.wait(timeout=180) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    out0 = out0_path.read_text()
+    assert rcs == [RC_COORD_LOST, RC_COORD_LOST], (rcs, out0[-4000:])
+    assert 'coordination backend lost' in out0, out0[-4000:]
+    # the runlog tells the escalation story the incident grammar
+    # scrapes: replica down -> quorum degraded (2/3 window) -> quorum
+    # lost -> give-up
+    assert 'coord-replicated: quorum lost' in out0, out0[-4000:]
+    rep = IncidentReport(host_id=0).scrape_lines(out0.splitlines())
+    assert rep.counters.get('replica_down', 0) >= 2, rep.counters
+    assert rep.counters.get('quorum_degraded', 0) >= 1, rep.counters
+    assert rep.counters.get('coord_lost', 0) >= 1, rep.counters
+    # and the incident report names the exit for the operator
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    lost = [e for e in report['events'] if e['kind'] == 'coord_lost']
+    assert lost and lost[0]['rc'] == RC_COORD_LOST, report['events']
+
+
 def _run_shrink_drill(tmp_path, art_subdir=None,
-                      expect_coord_retries=False):
+                      expect_coord_retries=False,
+                      on_host_kill=None, expect_replica_down=False):
     control = _control_done(tmp_path)
     lease = tmp_path / 'lease'
     ckpt0, ckpt1 = str(tmp_path / 'ckpt_h0'), str(tmp_path / 'ckpt_h1')
@@ -186,6 +301,10 @@ def _run_shrink_drill(tmp_path, art_subdir=None,
                 pytest.fail('epoch-0 checkpoints never appeared; host0 '
                             'tail: ' + out0_path.read_text()[-3000:])
             kill_t = time.time()
+            if on_host_kill is not None:
+                # the replicated leg's second simultaneous failure: a
+                # KV replica dies along with the host
+                on_host_kill()
             os.killpg(os.getpgid(procs[1].pid), signal.SIGKILL)
             procs[1].wait(timeout=30)
 
@@ -241,6 +360,16 @@ def _run_shrink_drill(tmp_path, art_subdir=None,
                    + out0.count('coord: retry')
                    + out1.count('coord: retry'))
         assert retried >= 1, (report['counters'], out0[-1500:])
+        assert report['counters'].get('coord_lost', 0) == 0
+        assert 'coordination backend lost' not in out0
+    if expect_replica_down:
+        # the quorum layer NAMED the dead replica in the survivor's
+        # runlog — and absorbed it: no give-up, no coord_lost, and the
+        # incident grammar picks the emission up as a counter
+        from kfac_pytorch_tpu.resilience.incident import IncidentReport
+        assert 'coord-replicated: replica' in out0, out0[-4000:]
+        rep = IncidentReport(host_id=0).scrape_lines(out0.splitlines())
+        assert rep.counters.get('replica_down', 0) >= 1, rep.counters
         assert report['counters'].get('coord_lost', 0) == 0
         assert 'coordination backend lost' not in out0
     exits = [e for e in report['events'] if e['kind'] == 'trainer_exit']
