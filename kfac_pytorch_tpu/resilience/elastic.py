@@ -127,6 +127,23 @@ RC_JOIN_FAILED = 116
 # finalized under its lineage).
 RC_FENCED = 117
 
+# "this host was checkpoint-suspended": the supervisor-level verdict of
+# a pod the scheduler asked to stop — a priority preemption or a host
+# drain delivered a suspend request (the SUSPEND_KEY marker in the
+# pod's lease namespace), the trainer was stopped at a step boundary
+# (SIGTERM -> PreemptionGuard grace-window checkpoint, lineage-stamped
+# like every commit), and the supervisor exited without any further
+# commits. NEVER charged to a retry budget: the scheduler parks the
+# job SUSPENDED and resumes it — possibly on different hosts, through
+# the elastic reshard lane — when capacity returns.
+RC_SUSPENDED = 119
+
+#: coordination key of the scheduler's checkpoint-suspend request,
+#: relative to the pod's lease namespace (the scheduler writes it
+#: through the same backend under the job's lease prefix; the
+#: supervisor's suspend lane polls it at heartbeat cadence)
+SUSPEND_KEY = 'suspend.json'
+
 # supervisor -> trainer lineage contract: the monotonic lineage epoch
 # of the membership this trainer belongs to (bumped on every COMMITTED
 # shrink/grow; persisted across pod incarnations in the lease dir's
@@ -518,6 +535,13 @@ class PodSupervisor:
                 # incarnation's shrink quorum
                 with contextlib.suppress(OSError):
                     self.coord.delete(key)
+            elif not rest and top == SUSPEND_KEY:
+                # a stale suspend request from the PREVIOUS life of this
+                # job (the scheduler's delete was lost, or the pod died
+                # before acting on it) would re-suspend the resumed job
+                # the moment its suspend lane first polls
+                with contextlib.suppress(OSError):
+                    self.coord.delete(key)
             elif top == 'sup' and rest.startswith('hb-'):
                 with contextlib.suppress(OSError):
                     self.coord.delete(key)
@@ -743,6 +767,20 @@ class PodSupervisor:
         """True when a peer has already claimed the NEXT generation."""
         claims = self._read_claims(self._claim_dir(self.gen + 1))
         return bool(set(claims) - {self.host_id})
+
+    def _suspend_requested(self):
+        """The scheduler's checkpoint-suspend request (a preemption or
+        a host drain): the :data:`SUSPEND_KEY` marker in this pod's
+        lease namespace, or None. A backend give-up propagates (a dead
+        backend is rc=118, never a silently-ignored suspension); a
+        torn read is no request yet — the scheduler re-delivers."""
+        try:
+            got = self.coord.get(SUSPEND_KEY)
+        except CoordGiveUp:
+            raise
+        except OSError:
+            return None
+        return None if got is None else got.value
 
     def _join_announced(self):
         """{host: payload} of NON-member join announcements — the grow
@@ -1200,6 +1238,26 @@ class PodSupervisor:
         self._terminate_child()
         return RC_FENCED
 
+    def _suspend(self, rc):
+        """Checkpoint-suspend on the scheduler's request: the trainer
+        was stopped at a boundary (SIGTERM — its PreemptionGuard banked
+        the grace-window, lineage-stamped checkpoint), and this
+        supervisor exits :data:`RC_SUSPENDED` with no further commits —
+        the fence's no-commit-past-the-stop discipline, but a verdict
+        the scheduler ASKED for: it parks the job SUSPENDED (uncharged)
+        and resumes it, possibly on different hosts, through the
+        elastic reshard lane."""
+        from kfac_pytorch_tpu.utils.runlog import resilience_suffix
+        self.log.warning(
+            'pod-supervisor: suspending on request — trainer stopped '
+            '(grace checkpoint banked, trainer rc was %s), exiting '
+            'rc=%d with no further commits [resilience: suspended=1]%s',
+            rc, RC_SUSPENDED, resilience_suffix(self.counts()))
+        self.report.add_event('suspended', gen=self.gen,
+                              rc=RC_SUSPENDED, trainer_rc=rc)
+        self.report.bump({'suspended': 1})
+        return RC_SUSPENDED
+
     def _coord_lost(self, exc):
         """The coordination backend exhausted a retry budget on an
         operation this supervisor cannot proceed without (a barrier
@@ -1273,8 +1331,8 @@ class PodSupervisor:
 
     def _wait_child(self):
         """Wait for the trainer; interleave peer-death / shrink / join /
-        signal checks. Returns (rc, reason) with reason in
-        {'exit', 'peer_dead', 'fenced', 'grow'}."""
+        suspend / signal checks. Returns (rc, reason) with reason in
+        {'exit', 'peer_dead', 'fenced', 'grow', 'suspend'}."""
         next_lane_check = 0.0
         pace = self._new_pace()
         while True:
@@ -1308,6 +1366,18 @@ class PodSupervisor:
             now = self.clock.monotonic()
             if now >= next_lane_check:
                 next_lane_check = now + self.hb_interval
+                # the suspend lane: the scheduler asked this pod to
+                # checkpoint-suspend (preemption / drain). Stop the
+                # trainer at this boundary (SIGTERM — its
+                # PreemptionGuard banks the grace-window checkpoint)
+                # and exit RC_SUSPENDED; paced with the join lane for
+                # the same reason (a lease-namespace read per check).
+                if self._suspend_requested() is not None:
+                    self.log.warning('pod-supervisor: suspend '
+                                     'requested — stopping the trainer '
+                                     'at this checkpoint boundary')
+                    self._terminate_child()
+                    return self.child.poll(), 'suspend'
                 if self._join_announced() or self._peer_grow_started():
                     self.log.warning('pod-supervisor: join announced — '
                                      'stopping the trainer for the grow '
@@ -1338,6 +1408,8 @@ class PodSupervisor:
                 self._grow(self._join_announced())
                 self.restarts += 1
                 continue
+            if reason == 'suspend':
+                return self._suspend(rc)
             if self._terminating:
                 self.log.info('pod-supervisor: trainer exited rc=%s '
                               'after forwarded signal — not restarting%s',

@@ -30,10 +30,10 @@ import time
 # aggregate by MAX. Everything else in a [resilience: ...] suffix is a
 # per-epoch delta: aggregate by SUM.
 _CUMULATIVE = frozenset({
-    'restarts', 'crashes', 'hangs', 'gave_up', 'fenced', 'shrinks',
-    'grows', 'joins', 'straggler_level', 'partition_suspected',
-    'quorum_lost', 'coord_lost', 'coord_retries', 'coord_gave_ups',
-    'poll_wait_s',
+    'restarts', 'crashes', 'hangs', 'gave_up', 'fenced', 'suspended',
+    'shrinks', 'grows', 'joins', 'straggler_level',
+    'partition_suspected', 'quorum_lost', 'coord_lost',
+    'coord_retries', 'coord_gave_ups', 'poll_wait_s',
 })
 # (the replicated backend's replica_down/replica_repair/quorum_degraded
 # suffixes are per-event deltas — =1 each emission — so they take the
@@ -87,6 +87,16 @@ _PATTERNS = (
         r'(?P<membership>\[[^\]]*\])')),
     ('fenced', re.compile(
         r'Fencing this host \(killing the trainer')),
+    # the checkpoint-suspend verdict (ISSUE 17 preemption): the
+    # scheduler asked, the supervisor stopped the trainer at a
+    # checkpoint boundary and exits RC_SUSPENDED with no further
+    # commits — the pod half of the job_preempt -> job_suspend story
+    # (head starts mid-line, like 'fenced' above: the many
+    # 'pod-supervisor: %s ...' narration sites must not claim it)
+    ('suspended', re.compile(
+        r'suspending on request — trainer stopped '
+        r'\(grace checkpoint banked, trainer rc was '
+        r'(?P<trainer_rc>\S+)\), exiting rc=(?P<rc>\d+)')),
     # the coordination backend (kfac_pytorch_tpu/coord): per-op retries
     # surface as coord_retries= counters in the [resilience: ...]
     # suffixes; a spent budget is its own event — the give-up on ONE op
@@ -199,6 +209,36 @@ _PATTERNS = (
     ('pool_grow', re.compile(
         r'service: pool_grow slots=(?P<from>\d+) -> (?P<to>\d+) '
         r'added=(?P<added>\[[^\]]*\])')),
+    # the multi-tenant policy lanes (ISSUE 17): a preemption names its
+    # victim and the job it made room for, the landed checkpoint-
+    # suspend parks the victim, a resume on different hosts is the
+    # migration edge, and the fair-share accounting + autoscale
+    # requests narrate WHY — so kfac-obs renders a per-tenant
+    # preemption timeline (preempt -> suspend -> migrate -> done)
+    # with zero new aggregation code
+    ('job_preempt', re.compile(
+        r'service: job_preempt job=(?P<job>\d+) '
+        r'tenant=(?P<tenant>[\w-]+) victim_of=(?P<victim_of>\d+) '
+        r'priority=(?P<priority>-?\d+) '
+        r'by_priority=(?P<by_priority>-?\d+) '
+        r'grace_s=(?P<grace_s>[\d.]+)')),
+    ('job_suspend', re.compile(
+        r'service: job_suspend job=(?P<job>\d+) '
+        r'tenant=(?P<tenant>[\w-]+) rc=(?P<rc>-?\d+) '
+        r'reason=(?P<why>[\w-]+) hosts=(?P<on>[\w,-]+) '
+        r'attempt=(?P<attempt>\d+)')),
+    ('job_migrate', re.compile(
+        r'service: job_migrate job=(?P<job>\d+) '
+        r'tenant=(?P<tenant>[\w-]+) from=(?P<from>[\w,-]+) '
+        r'to=(?P<to>[\w,-]+) attempt=(?P<attempt>\d+)')),
+    ('tenant_share', re.compile(
+        r'service: tenant_share tenant=(?P<tenant>[\w-]+) '
+        r'used=(?P<used>\d+) of=(?P<of>\d+) '
+        r'weight=(?P<weight>[\d.]+) share=(?P<share>[\d.]+)')),
+    ('scale_request', re.compile(
+        r'service: scale_request desired=(?P<desired>\d+) '
+        r'capacity=(?P<capacity>\d+) queued=(?P<queued>\d+) '
+        r'suspended=(?P<suspended>\d+)')),
     ('straggler_degrade', re.compile(
         r'straggler: step-time EMA (?P<ema_s>[\d.]+)s over budget '
         r'(?P<budget_s>[\d.]+)s(?: at step (?P<step>\d+))? — stretching '

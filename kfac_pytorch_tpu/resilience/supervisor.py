@@ -51,7 +51,8 @@ log = logging.getLogger(__name__)
 # this module stays importable without jax.
 STOP_RC_NAMES = {'hang': RC_HANG, 'peer_dead': 115, 'peer-dead': 115,
                  'crash': 113, 'join_failed': 116, 'join-failed': 116,
-                 'fenced': 117, 'coord_lost': 118, 'coord-lost': 118}
+                 'fenced': 117, 'coord_lost': 118, 'coord-lost': 118,
+                 'suspended': 119}
 
 
 def parse_stop_rc(value):

@@ -25,20 +25,28 @@ millions of users":
   delete.
 - :mod:`scheduler` — the admission controller (``kfac-serve``): packs
   queued jobs onto the available pod capacity (a live, re-read
-  ``hosts.json`` — capacity can shrink or grow mid-run), launches each
-  job under ``kfac-pod-supervise``, classifies exits through the
-  existing rc grammar (0 done / 114 hang / 115 peer-dead / 116
-  join-failed / 117 fenced), requeues with backoff on pod failure, and
-  gives every job a per-tenant namespace (run logs, trace dir,
-  Prometheus textfile, checkpoints, lease dir) plus a collision-free
-  ``KFAC_HB_PORT`` block so jobs sharing a host never fight over
-  heartbeat ports or lease files.
+  ``hosts.json`` — capacity can shrink, grow or DRAIN mid-run),
+  launches each job under ``kfac-pod-supervise``, classifies exits
+  through the existing rc grammar (0 done / 114 hang / 115 peer-dead
+  / 116 join-failed / 117 fenced / 119 suspended), requeues with
+  backoff on pod failure, and gives every job a per-tenant namespace
+  (run logs, trace dir, Prometheus textfile, checkpoints, lease dir)
+  plus a collision-free ``KFAC_HB_PORT`` block so jobs sharing a host
+  never fight over heartbeat ports or lease files. It is also the
+  multi-tenant POLICY loop (ISSUE 17): weighted fair-share admission
+  ordering, priority preemption as checkpoint-suspend (victims park
+  SUSPENDED, uncharged, and resume — possibly on different hosts,
+  the migration lane — when capacity returns), zero-loss host drain,
+  and queue-driven autoscale requests for an external capacity
+  responder.
 
 Service events land in the run log in the shared incident grammar
 (``job_admit`` / ``job_requeue`` / ``job_done`` / ``job_lost`` /
-``pool_shrink``), so ``kfac-obs`` — including the new ``--follow``
-live mode — renders a tenant's whole story (admit -> failure ->
-requeue -> done) with zero service-specific aggregation code.
+``pool_shrink`` / ``job_preempt`` / ``job_suspend`` /
+``job_migrate`` / ``tenant_share`` / ``scale_request``), so
+``kfac-obs`` — including the ``--follow`` live mode — renders a
+tenant's whole story (admit -> preempt -> suspend -> migrate ->
+done) with zero service-specific aggregation code.
 
 Everything here is dependency-free stdlib: the scheduler must run on a
 controller node with no accelerator stack at all.

@@ -50,7 +50,12 @@ from kfac_pytorch_tpu.service.spec import SpecError, validate_spec
 #: job lifecycle states. ``lost`` is terminal-with-alarm: the retry
 #: budget is spent and an operator must look (the ``job_lost`` incident
 #: line is the alarm); ``done`` is the only happy terminal state.
-STATES = ('queued', 'running', 'done', 'lost')
+#: ``suspended`` is the preemption parking state: the job was
+#: checkpoint-suspended (victim of a priority preemption or a host
+#: drain), holds a lineage-stamped checkpoint, and re-enters ``queued``
+#: through :meth:`JobQueue.resume` when capacity returns — never
+#: charged to the retry budget.
+STATES = ('queued', 'running', 'suspended', 'done', 'lost')
 
 
 class JobQueue:
@@ -269,6 +274,22 @@ class JobQueue:
             record, 'queued', last_rc=rc, last_reason=reason,
             requeues=record.get('requeues', 0) + 1,
             not_before=self.wall() + float(backoff_s), **fields)
+
+    def suspend(self, record, *, rc, reason, **fields):
+        """running -> suspended (checkpoint-suspend landed). Uncharged:
+        ``requeues`` does not move — a preemption is the scheduler's
+        decision, not the tenant's failure. None when the epoch moved
+        (every rank's RC_SUSPENDED exit observes the same epoch; the
+        first observation parks the job, the rest no-op)."""
+        return self.transition(record, 'suspended', last_rc=rc,
+                               last_reason=reason, **fields)
+
+    def resume(self, record, **fields):
+        """suspended -> queued (capacity returned; the job competes
+        for placement again, with its adopted-knobs carry and
+        checkpoint intact). Not a requeue: no backoff, no charge."""
+        return self.transition(record, 'queued', last_reason='resume',
+                               not_before=0.0, **fields)
 
     def mark_done(self, record, **fields):
         return self.transition(record, 'done', **fields)

@@ -35,8 +35,30 @@ rc    class         service reaction
 117   fenced        requeue with backoff (budgeted) — the
                     epoch CAS bounds a collapsed generation's
                     many fenced exits to ONE requeue
+119   suspended     ``job_suspend`` — the checkpoint-suspend
+                    landed (preemption or drain): parked
+                    SUSPENDED, never charged, resumes when
+                    capacity returns (``job_migrate`` when it
+                    resumes on different hosts)
 <0    signal        requeue with backoff (budgeted)
 ====  ============  =========================================
+
+Multi-tenant policy (ISSUE 17): admission order is (priority desc,
+weighted dominant share asc, id) — ``spec.weight`` scales each
+tenant's entitlement, and the ``tenant_share`` events narrate the
+accounting. A higher-priority job that cannot be placed PREEMPTS:
+victims (preemptible, strictly lower priority; most over-share
+tenant first, youngest job first) receive a checkpoint-suspend
+request through the coordination backend — their PodSupervisors run
+the fence + lineage-stamped checkpoint path and exit
+``RC_SUSPENDED``; past ``KFAC_SUSPEND_GRACE`` seconds the scheduler
+escalates to SIGKILL (the last banked checkpoint still carries the
+resume). A ``hosts.json`` entry marked ``"draining": true`` stops
+taking placements and suspend-migrates its preemptible jobs off —
+a zero-loss drain. Under ``KFAC_AUTOSCALE`` the scheduler also
+emits ``scale-request.json`` (desired slots from live demand) for
+an external capacity responder — the fleet simulator answers it in
+CI.
 
 Per-tenant namespaces: every job gets
 ``tenants/<tenant>/job-<id>/{lease,trace,ckpt,logs}`` plus
@@ -71,7 +93,14 @@ log = logging.getLogger(__name__)
 #: STOP_RC_NAMES inverted, plus 0); anything else nonzero is a crash.
 RC_CLASSES = {0: 'done', 113: 'crash', 114: 'hang', 115: 'peer_dead',
               116: 'join_failed', 117: 'fenced',
-              RC_COORD_LOST: 'coord_lost'}
+              RC_COORD_LOST: 'coord_lost', 119: 'suspended'}
+
+#: resilience.elastic's RC_SUSPENDED / SUSPEND_KEY spelled as literals
+#: (the supervisor.py precedent for 113) so the scheduler stays
+#: importable without the pod-supervisor stack; the values are pinned
+#: equal by tests/test_service.py.
+RC_SUSPENDED = 119
+SUSPEND_KEY = 'suspend.json'
 
 
 def classify_rc(rc):
@@ -81,6 +110,14 @@ def classify_rc(rc):
     if rc in RC_CLASSES:
         return RC_CLASSES[rc]
     return 'signal' if rc < 0 else 'crash'
+
+
+def _env_flag(env, name, default=False):
+    """'1'/'true'/'yes' -> True, '0'/''/'false'/'no' -> False."""
+    v = env.get(name)
+    if v is None:
+        return default
+    return str(v).strip().lower() not in ('', '0', 'false', 'no')
 
 
 class PortConflictError(RuntimeError):
@@ -202,6 +239,8 @@ class _Run:
         self.procs = {}               # rank -> Popen
         self.files = []               # open log file handles
         self.exits = {}               # rank -> rc (observed)
+        self.suspend = None           # pending checkpoint-suspend:
+        #                               {'reason', 'by', 'deadline'}
 
     def hosts(self):
         return sorted(set(self.ranks.values()))
@@ -216,7 +255,8 @@ class AdmissionController:
                  backoff_base=2.0, backoff_max=60.0, poll_period=0.5,
                  supervisor_args=(), popen=subprocess.Popen,
                  killer=None, clock=None, wall=time.time, env=None,
-                 log=None):
+                 log=None, preempt=None, suspend_grace=None,
+                 autoscale=None):
         self.service_dir = str(service_dir)
         self.trainers = dict(TRAINERS)
         if trainers:
@@ -245,11 +285,29 @@ class AdmissionController:
         self.wall = wall
         self.env = env
         self.log = log if log is not None else logging.getLogger(__name__)
+        # preemption / autoscale policy knobs: constructor args win,
+        # then the KFAC_* environment, then the defaults (preemption
+        # on, autoscale opt-in — emitting capacity requests only makes
+        # sense when a responder is listening)
+        env_src = env if env is not None else os.environ
+        self.preempt = (_env_flag(env_src, 'KFAC_PREEMPT', True)
+                        if preempt is None else bool(preempt))
+        self.suspend_grace = float(
+            env_src.get('KFAC_SUSPEND_GRACE', 30.0)
+            if suspend_grace is None else suspend_grace)
+        self.autoscale = (_env_flag(env_src, 'KFAC_AUTOSCALE', False)
+                          if autoscale is None else bool(autoscale))
         self.running = {}            # job_id -> _Run
         self._stop = False
         self._warned_unplaceable = set()
+        self._last_shares = {}       # tenant -> (used, share) emitted
+        self._last_scale = None      # last scale_request desired_slots
+        self._dirty = True           # force the next job-table scan
+        self._next_wake = None       # earliest queued not_before
+        self._busy = True            # last scan's verdict (cached)
         self.hosts_path = os.path.join(self.service_dir, 'hosts.json')
         self.launchers = {}          # host name -> Launcher
+        self.draining = set()        # hosts placements must avoid
         self.hosts = self._init_hosts(hosts)
 
     # -- capacity ----------------------------------------------------------
@@ -257,18 +315,21 @@ class AdmissionController:
     def _init_hosts(self, hosts):
         on_disk = self._read_hosts_file()
         if on_disk is not None:
-            return on_disk
+            out, self.draining = on_disk
+            return out
         hosts = dict(hosts) if hosts else {'h0': 1}
         self.coord.put('hosts.json', {'hosts': hosts}, indent=2)
         self.launchers = {name: Launcher(name) for name in hosts}
         return hosts
 
     def _read_hosts_file(self):
-        """Slot map from the live ``hosts.json`` key (None when absent
-        or unusable). Entries are either a bare slot count (controller-
-        node exec, the default) or ``{"slots": n, "launch": [...]}`` —
-        the :class:`Launcher` seam; the launcher map refreshes as a
-        side effect so a live edit can re-home a host."""
+        """``(slot map, draining set)`` from the live ``hosts.json``
+        key (None when absent or unusable). Entries are either a bare
+        slot count (controller-node exec, the default) or ``{"slots":
+        n, "launch": [...], "draining": true}`` — the
+        :class:`Launcher` seam plus the drain flag; the launcher map
+        refreshes as a side effect so a live edit can re-home a
+        host."""
         got = self.coord.get('hosts.json')
         doc = None if got is None else got.value
         if not isinstance(doc, dict):
@@ -276,7 +337,7 @@ class AdmissionController:
         raw = doc.get('hosts')
         if not isinstance(raw, dict) or not raw:
             return None
-        out, launchers = {}, {}
+        out, launchers, draining = {}, {}, set()
         for name, entry in raw.items():
             if not isinstance(name, str):
                 continue
@@ -284,6 +345,8 @@ class AdmissionController:
             if isinstance(entry, dict):
                 slots = entry.get('slots')
                 prefix = entry.get('launch') or None
+                if entry.get('draining'):
+                    draining.add(name)
                 if prefix is not None and not (
                         isinstance(prefix, list)
                         and all(isinstance(t, str) for t in prefix)):
@@ -298,24 +361,37 @@ class AdmissionController:
         if not out:
             return None
         self.launchers = launchers
-        return out
+        return out, draining & set(out)
+
+    def _effective_slots(self):
+        """Placeable slot total: draining hosts contribute zero."""
+        return sum(n for h, n in self.hosts.items()
+                   if h not in self.draining)
 
     def _refresh_hosts(self):
-        """Adopt a live capacity edit; a lost host kills + requeues its
-        jobs (uncharged — capacity loss is the operator's event, not
-        the tenant's)."""
-        now = self._read_hosts_file()
-        if now is None or now == self.hosts:
+        """Adopt a live capacity edit. A lost host kills + requeues
+        its jobs (uncharged — capacity loss is the operator's event,
+        not the tenant's); a host newly marked ``draining`` stops
+        taking placements and its preemptible jobs are checkpoint-
+        suspended off it (the zero-loss migration lane) while non-
+        preemptible ones finish in place."""
+        got = self._read_hosts_file()
+        if got is None:
             return
-        old_slots = sum(self.hosts.values())
-        new_slots = sum(now.values())
+        now, draining = got
+        if now == self.hosts and draining == self.draining:
+            return
+        self._dirty = True
+        old_slots = self._effective_slots()
         lost = sorted(set(self.hosts) - set(now))
         added = sorted(set(now) - set(self.hosts))
-        self.hosts = now
-        # slot-count-only edits (h0: 2 -> 1, a drain) must land on the
-        # timeline too, not just whole-host removals; a drained host's
-        # jobs finish in place (over-commitment bleeds off naturally),
-        # a REMOVED host's jobs are killed and requeued
+        newly_draining = sorted(draining - self.draining - set(lost))
+        self.hosts, self.draining = now, draining
+        new_slots = self._effective_slots()
+        # slot-count-only edits (h0: 2 -> 1) and drain flips must land
+        # on the timeline too, not just whole-host removals; a REMOVED
+        # host's jobs are killed and requeued, a DRAINING host's are
+        # suspend-migrated below
         if lost or new_slots < old_slots:
             self.log.warning('service: pool_shrink slots=%d -> %d '
                              'lost=%s', old_slots, new_slots, lost)
@@ -332,6 +408,13 @@ class AdmissionController:
                 self._kill_run(run)
                 self._requeue(run, rc=-int(_signal.SIGKILL),
                               klass='host_lost', charge=False)
+        if newly_draining:
+            for run in list(self.running.values()):
+                if (run.suspend is None
+                        and set(run.hosts()) & set(newly_draining)
+                        and run.record['spec'].get('preemptible',
+                                                   True)):
+                    self._request_suspend(run, reason='drain')
         if added or new_slots > old_slots:
             self.log.warning('service: pool_grow slots=%d -> %d '
                              'added=%s', old_slots, new_slots, added)
@@ -344,13 +427,14 @@ class AdmissionController:
                 used[h] = used.get(h, 0) + 1
         return used
 
-    def _place(self, n_ranks):
+    def _place(self, n_ranks, used=None):
         """rank -> host placement for ``n_ranks`` slots, spreading
-        across the freest hosts first; None when the pool cannot hold
-        the job right now."""
-        used = self._used_slots()
-        free = [[self.hosts[h] - used.get(h, 0), h] for h in
-                sorted(self.hosts)]
+        across the freest hosts first (draining hosts excluded); None
+        when the pool cannot hold the job right now. ``used`` lets the
+        preemption planner ask hypotheticals without admitting."""
+        used = self._used_slots() if used is None else used
+        free = [[(0 if h in self.draining else self.hosts[h])
+                 - used.get(h, 0), h] for h in sorted(self.hosts)]
         if sum(max(0, f) for f, _ in free) < n_ranks:
             return None
         ranks = {}
@@ -361,6 +445,169 @@ class AdmissionController:
             ranks[rank] = free[0][1]
             free[0][0] -= 1
         return ranks
+
+    # -- fair share / preemption / autoscale --------------------------------
+
+    def _share_table(self, jobs):
+        """tenant -> ``(used_slots, weight, share)`` over the live
+        job set, where ``share`` is the weighted dominant share
+        ``used / placeable_slots / weight``. A tenant's weight is the
+        max across its live specs; admission sorts ascending on
+        ``share`` (the under-served tenant goes first) and the victim
+        ordering sorts descending (the most over-share tenant pays
+        first) — that is the whole weighted-fair-share policy."""
+        total = max(1, self._effective_slots())
+        weights = {}
+        for rec in jobs:
+            if rec.get('state') in ('queued', 'running', 'suspended'):
+                spec = rec['spec']
+                w = spec.get('weight', 1.0)
+                w = float(w) if isinstance(w, (int, float)) \
+                    and not isinstance(w, bool) and w > 0 else 1.0
+                t = spec['tenant']
+                weights[t] = max(weights.get(t, 0.0), w)
+        used = {}
+        for run in self.running.values():
+            t = run.record['spec']['tenant']
+            used[t] = used.get(t, 0) + len(run.ranks)
+        return {t: (used.get(t, 0), w, used.get(t, 0) / total / w)
+                for t, w in sorted(weights.items())}
+
+    def _emit_shares(self, table):
+        """One ``tenant_share`` line per tenant whose accounting
+        CHANGED — the kfac-obs timeline gets the fair-share story at
+        O(changes), not one line per cycle."""
+        total = self._effective_slots()
+        for t, (used, w, share) in table.items():
+            snap = (used, total, round(share, 3))
+            if self._last_shares.get(t) == snap:
+                continue
+            self._last_shares[t] = snap
+            self.log.warning(
+                'service: tenant_share tenant=%s used=%d of=%d '
+                'weight=%s share=%.3f', t, used, total, w, share)
+        for t in set(self._last_shares) - set(table):
+            del self._last_shares[t]
+
+    def _lease_key(self, run, name):
+        """Backend key for ``name`` inside the job's lease namespace.
+        Its PodSupervisors run with the lease dir as their backend
+        root, so the key the scheduler writes here is the key they
+        read as plain ``name`` — on every backend (the POSIX paths
+        and the KV namespaces concatenate identically)."""
+        return (os.path.relpath(run.ns['lease'], self.service_dir)
+                + '/' + name)
+
+    def _request_suspend(self, run, *, reason, by=None):
+        """Deliver a checkpoint-suspend request into the victim pod's
+        lease namespace. Every rank's supervisor polls the key between
+        child polls, stops its trainer at the next checkpoint boundary
+        (the PreemptionGuard banks a lineage-stamped checkpoint) and
+        exits ``RC_SUSPENDED`` with no further commits; the grace
+        deadline arms the SIGKILL escalation in :meth:`_reap`."""
+        payload = {'job': run.record['id'], 'reason': reason,
+                   'wall': self.wall()}
+        if by is not None:
+            payload['by'] = by
+        try:
+            self.coord.put(self._lease_key(run, SUSPEND_KEY), payload,
+                           indent=2)
+        except CoordGiveUp:
+            raise
+        except OSError as e:
+            self.log.error('service: suspend request for job=%d could '
+                           'not be written: %s', run.record['id'], e)
+            return False
+        run.suspend = {'reason': reason, 'by': by,
+                       'deadline': self.clock.monotonic()
+                       + self.suspend_grace}
+        return True
+
+    def _preempt_for(self, record, shares):
+        """Make room for an unplaceable higher-priority ``record`` by
+        checkpoint-suspending victims: running, preemptible, strictly
+        lower priority — lowest priority first, most over-share tenant
+        first, youngest job first (least progress lost). Victims only
+        go out when the chosen set provably frees enough placeable
+        slots; slots already freeing under a pending suspend count
+        first, so the planner never stacks new victims every cycle
+        while one suspends. Returns True while room is BEING MADE
+        (victims newly requested or still winding down) — the step
+        loop then holds lower-priority admissions, so the freed slots
+        cannot be re-stolen (by, say, the victims themselves resuming)
+        before the pending job places on a later cycle."""
+        spec = record['spec']
+        need = spec.get('hosts', 1)
+        if need > self._effective_slots():
+            return False    # a capacity problem — the autoscale lane's
+        prio = spec.get('priority', 0)
+        used = self._used_slots()
+        for run in self.running.values():
+            if run.suspend is not None:
+                for h in run.ranks.values():
+                    used[h] = used.get(h, 0) - 1
+        if self._place(need, used=used) is not None:
+            return True     # enough is already draining out: hold the
+                            # freed slots for this record
+        cands = [run for run in self.running.values()
+                 if run.suspend is None
+                 and run.record['spec'].get('preemptible', True)
+                 and run.record['spec'].get('priority', 0) < prio]
+        cands.sort(key=lambda r: (
+            r.record['spec'].get('priority', 0),
+            -shares.get(r.record['spec']['tenant'],
+                        (0, 1.0, 0.0))[2],
+            -r.record['id']))
+        chosen = []
+        for run in cands:
+            chosen.append(run)
+            for h in run.ranks.values():
+                used[h] = used.get(h, 0) - 1
+            if self._place(need, used=used) is not None:
+                break
+        else:
+            return False    # even every victim cannot make room
+        for run in chosen:
+            if not self._request_suspend(run, reason='preempt',
+                                         by=record['id']):
+                continue
+            self.log.warning(
+                'service: job_preempt job=%d tenant=%s victim_of=%d '
+                'priority=%d by_priority=%d grace_s=%.1f',
+                run.record['id'], run.record['spec']['tenant'],
+                record['id'],
+                run.record['spec'].get('priority', 0), prio,
+                self.suspend_grace)
+        return True
+
+    def _emit_scale(self, jobs):
+        """Queue-driven capacity request: desired slots = live demand
+        (queued + running + suspended pod sizes). Written (and
+        logged) only when the desired total CHANGES; an external
+        responder — the fleet simulator's autoscaler in CI, a cloud
+        control loop in production — answers by rewriting
+        ``hosts.json``, which the ordinary capacity refresh adopts."""
+        demand = sum(r['spec'].get('hosts', 1) for r in jobs
+                     if r.get('state') in ('queued', 'running',
+                                           'suspended'))
+        if demand == self._last_scale:
+            return
+        cap = self._effective_slots()
+        queued = sum(1 for r in jobs if r.get('state') == 'queued')
+        susp = sum(1 for r in jobs if r.get('state') == 'suspended')
+        try:
+            self.coord.put('scale-request.json',
+                           {'desired_slots': demand, 'capacity': cap,
+                            'queued': queued, 'suspended': susp,
+                            'wall': self.wall()}, indent=2)
+        except CoordGiveUp:
+            raise
+        except OSError:
+            return          # re-derived and re-tried next change
+        self._last_scale = demand
+        self.log.warning(
+            'service: scale_request desired=%d capacity=%d queued=%d '
+            'suspended=%d', demand, cap, queued, susp)
 
     # -- launch ------------------------------------------------------------
 
@@ -497,6 +744,16 @@ class AdmissionController:
             'attempt=%d port=%d', record['id'], spec['tenant'],
             spec['trainer'], ','.join(run.hosts()),
             run.record.get('attempt', 0), port)
+        # a resumed suspension landing on different hosts IS the
+        # migration: the trainers reshard their factor state through
+        # the elastic world.json lane; the timeline gets the edge
+        prev = record.get('last_hosts')
+        if (record.get('last_reason') == 'resume' and prev
+                and prev != ','.join(run.hosts())):
+            self.log.warning(
+                'service: job_migrate job=%d tenant=%s from=%s to=%s '
+                'attempt=%d', record['id'], spec['tenant'], prev,
+                ','.join(run.hosts()), run.record.get('attempt', 0))
         return True
 
     # -- reaping -----------------------------------------------------------
@@ -517,6 +774,16 @@ class AdmissionController:
                 f.close()
 
     def _finish(self, run):
+        if run.suspend is not None:
+            # the suspend marker's job is done (or moot): scrub it so
+            # a resumed incarnation cannot re-read a stale request —
+            # the supervisor's own gen-0 scrub is the second belt
+            try:
+                self.coord.delete(self._lease_key(run, SUSPEND_KEY))
+            except CoordGiveUp:
+                raise
+            except OSError:
+                pass
         self.running.pop(run.record['id'], None)
         self.ports.release(run.record['id'])
         for f in run.files:
@@ -598,7 +865,53 @@ class AdmissionController:
                 out[k] = v
         return out
 
+    def _suspended(self, run, rc):
+        """One observed checkpoint-suspend landing: park the job
+        SUSPENDED (uncharged — the preemption/drain was the
+        scheduler's decision, not the tenant's failure), release its
+        port block for re-allocation at resume, carry the adopted-
+        knobs snapshot exactly like a requeue does, and stamp the
+        placement it left so the re-admit can tell a migration from a
+        same-hosts resume. Exactly-once by the queue's epoch CAS: a
+        replayed observation returns None and only the log line is
+        skipped."""
+        record = run.record
+        spec = record['spec']
+        info = run.suspend or {}
+        reason = info.get('reason', 'suspend')
+        extra = {}
+        adopted = self._adopted_knobs(run)
+        if adopted:
+            extra['adopted_knobs'] = adopted
+        new = self.queue.suspend(
+            record, rc=rc, reason=reason,
+            last_hosts=','.join(run.hosts()), **extra)
+        if new is not None:
+            self.log.warning(
+                'service: job_suspend job=%d tenant=%s rc=%d '
+                'reason=%s hosts=%s attempt=%d', record['id'],
+                spec['tenant'], rc if rc is not None else -1, reason,
+                ','.join(run.hosts()), record.get('attempt', 0))
+        self._finish(run)
+
     def _reap(self):
+        # suspend-grace escalation first: a victim that has not wound
+        # down within the grace window is SIGKILLed — the last banked
+        # checkpoint still carries the resume, and the exits fall into
+        # the ordinary reap below (run.suspend routes them to
+        # _suspended, never to a charged requeue)
+        mono = self.clock.monotonic()
+        for run in list(self.running.values()):
+            if (run.suspend is not None
+                    and mono >= run.suspend['deadline']
+                    and any(p.poll() is None
+                            for p in run.procs.values())):
+                self.log.warning(
+                    'service: job=%d suspend grace (%.1fs) expired — '
+                    'killing the pod; the last banked checkpoint '
+                    'carries the resume', run.record['id'],
+                    self.suspend_grace)
+                self._kill_run(run)
         for run in list(self.running.values()):
             for rank, proc in run.procs.items():
                 if rank in run.exits:
@@ -634,52 +947,108 @@ class AdmissionController:
                 if (run.record['id'] in self.running
                         and len(run.exits) == len(run.procs)):
                     # every rank down, none clean: the generation is
-                    # gone — one classification, one requeue
+                    # gone — one classification, one transition.
+                    # suspended outranks fenced (a suspend request
+                    # fans out to every rank; some may fence while
+                    # others suspend, and the verdict is the suspend)
                     rc = next(iter(run.exits.values()))
-                    for c in run.exits.values():
-                        if classify_rc(c) == 'fenced':
-                            rc = c
+                    for klass in ('suspended', 'fenced'):
+                        hit = next((c for c in run.exits.values()
+                                    if classify_rc(c) == klass), None)
+                        if hit is not None:
+                            rc = hit
                             break
-                    self._requeue(run, rc=rc, klass=classify_rc(rc))
+                    if (run.suspend is not None
+                            or classify_rc(rc) == 'suspended'):
+                        self._suspended(run, rc)
+                    else:
+                        self._requeue(run, rc=rc,
+                                      klass=classify_rc(rc))
 
     # -- the loop ----------------------------------------------------------
 
-    def step(self, ingest=True):
+    def step(self, ingest=True, scan=True):
         """One scheduling cycle; returns True while there is (or may
         be) work left. ``ingest=False`` skips the spool scan — the
         watch-driven loop passes it when the ``incoming/`` watch saw no
         changes AND the spool is empty (a non-empty spool always
         re-ingests: a torn or deferred entry produces no new key
-        event). Everything else stays unconditional: reaps, capacity
-        refresh and admissions are wall-clock-driven (``not_before``
-        backoffs, child exits), not key-change-driven."""
+        event). ``scan=False`` additionally skips the job-table scan —
+        passed when the ``jobs/`` watch saw no key changes; reaps and
+        the capacity refresh stay unconditional (child exits and
+        ``hosts.json`` are wall-clock facts, not key events) and set
+        the dirty flag that forces the scan after all, as does a
+        queued backoff deadline coming due."""
         if ingest:
-            self.queue.ingest(log=self.log)
+            if self.queue.ingest(log=self.log):
+                self._dirty = True
         # reap BEFORE refreshing capacity: a job that already finished
         # on a just-removed host must be marked done, not requeued
         self._reap()
         self._refresh_hosts()
         now = self.wall()
-        queued = [r for r in self.queue.jobs()
-                  if r['state'] == 'queued'
-                  and r.get('not_before', 0) <= now]
-        queued.sort(key=lambda r: (-r['spec'].get('priority', 0),
-                                   r['id']))
-        for record in queued:
-            ranks = self._place(record['spec'].get('hosts', 1))
+        if (not scan and not self._dirty
+                and not (self._next_wake is not None
+                         and now >= self._next_wake)):
+            return self._busy
+        self._dirty = False
+        jobs = self.queue.jobs()
+        shares = self._share_table(jobs)
+        self._emit_shares(shares)
+        # candidates: ready queued jobs plus parked suspensions (which
+        # resume — and possibly migrate — the moment they place),
+        # ordered by priority, then weighted fair share (the under-
+        # served tenant first), then age
+        ready, self._next_wake = [], None
+        for r in jobs:
+            if r['state'] == 'suspended':
+                ready.append(r)
+            elif r['state'] == 'queued':
+                nb = r.get('not_before', 0)
+                if nb <= now:
+                    ready.append(r)
+                elif (self._next_wake is None
+                        or nb < self._next_wake):
+                    self._next_wake = nb
+        ready.sort(key=lambda r: (
+            -r['spec'].get('priority', 0),
+            shares.get(r['spec']['tenant'], (0, 1.0, 0.0))[2],
+            r['id']))
+        # head-of-line blocking while a preemption is in flight: once
+        # an unplaceable record has victims winding down, records at or
+        # below its priority are NOT admitted this cycle — otherwise
+        # the freed slots are re-stolen (worst case by the resumed
+        # victims themselves) and the preemption livelocks
+        blocked = None
+        for record in ready:
+            prio = record['spec'].get('priority', 0)
+            if blocked is not None and prio <= blocked:
+                continue
+            need = record['spec'].get('hosts', 1)
+            ranks = self._place(need)
             if ranks is None:
-                need = record['spec'].get('hosts', 1)
                 if (record['id'] not in self._warned_unplaceable
-                        and need > sum(self.hosts.values())):
+                        and need > self._effective_slots()):
                     self._warned_unplaceable.add(record['id'])
                     self.log.warning(
                         'service: job=%d needs %d slot(s) but the pool '
                         'has %d — waiting for capacity', record['id'],
-                        need, sum(self.hosts.values()))
+                        need, self._effective_slots())
+                if self.preempt and self._preempt_for(record, shares):
+                    blocked = prio
                 continue
+            if record['state'] == 'suspended':
+                record = self.queue.resume(record)
+                if record is None:
+                    continue    # someone moved it; re-derive next scan
             self._admit(record, ranks)
-        counts = self.queue.counts()
-        return bool(self.running or counts.get('queued'))
+        if self.autoscale:
+            self._emit_scale(jobs)
+        self._busy = bool(
+            self.running or self._next_wake is not None
+            or any(r['state'] in ('queued', 'suspended')
+                   and r['id'] not in self.running for r in jobs))
+        return self._busy
 
     def run(self, *, drain=False, max_seconds=None):
         """Loop until stopped. ``drain``: exit once the queue is empty
@@ -694,17 +1063,16 @@ class AdmissionController:
         # cycles relax toward the cap, a fleet of schedulers against
         # one backend decorrelates, and the waited total is accounted
         pace = PollPacer.for_period(self.poll_period, clock=self.clock)
-        # settle scan: a version-diff watch over the spool replaces the
-        # per-cycle ingest list when the backend supports it (ROADMAP
-        # 4b). The PollPacer above stays as the degraded fallback — a
-        # watch error this cycle just scans the old way.
-        watch = None
-        watch_fn = getattr(self.queue.backend, 'watch', None)
-        if callable(watch_fn):
-            try:
-                watch = watch_fn('incoming/')
-            except (OSError, ValueError, NotImplementedError):
-                watch = None
+        # settle scan: version-diff watches over the spool AND the job
+        # table replace the per-cycle list/scan when the backend
+        # supports them (ROADMAP 4b) — idle service-lane coordination
+        # cost is O(changes). The PollPacer above stays as the
+        # degraded fallback — a watch error this cycle just scans the
+        # old way. The jobs/ watch sees this scheduler's OWN
+        # transitions too, so every local mutation forces the next
+        # cycle's scan without separate bookkeeping.
+        watch = self._watch('incoming/')
+        jobs_watch = self._watch('jobs/')
         try:
             self.queue.recover(log=self.log)
             while not self._stop:
@@ -721,7 +1089,15 @@ class AdmissionController:
                         raise
                     except (OSError, ValueError):
                         ingest, spool = True, None
-                busy = self.step(ingest=ingest)
+                scan = True
+                if jobs_watch is not None:
+                    try:
+                        scan = bool(jobs_watch.poll())
+                    except CoordGiveUp:
+                        raise
+                    except (OSError, ValueError):
+                        scan = True
+                busy = self.step(ingest=ingest, scan=scan)
                 if drain and not busy and not (
                         spool if spool is not None
                         else self.queue.backend.list('incoming/')):
@@ -747,6 +1123,15 @@ class AdmissionController:
                     self._requeue(run, rc=-int(_signal.SIGKILL),
                                   klass='scheduler_stop', charge=False)
         return 0
+
+    def _watch(self, prefix):
+        watch_fn = getattr(self.queue.backend, 'watch', None)
+        if not callable(watch_fn):
+            return None
+        try:
+            return watch_fn(prefix)
+        except (OSError, ValueError, NotImplementedError):
+            return None
 
     def stop(self):
         self._stop = True
@@ -829,6 +1214,23 @@ def main(argv=None):
     pr.add_argument('--sup-arg', action='append', default=[],
                     help='extra kfac-pod-supervise flag (repeatable, '
                          'e.g. --sup-arg=--settle=1)')
+    pr.add_argument('--preempt', dest='preempt', action='store_true',
+                    default=None,
+                    help='checkpoint-suspend lower-priority jobs to '
+                         'place higher-priority ones (default: '
+                         '$KFAC_PREEMPT, on)')
+    pr.add_argument('--no-preempt', dest='preempt',
+                    action='store_false',
+                    help='disable priority preemption')
+    pr.add_argument('--suspend-grace', type=float, default=None,
+                    help='seconds a preempted pod gets to bank its '
+                         'checkpoint and exit before SIGKILL '
+                         '(default: $KFAC_SUSPEND_GRACE, 30)')
+    pr.add_argument('--autoscale', dest='autoscale',
+                    action='store_true', default=None,
+                    help='emit scale-request.json capacity requests '
+                         'from queue depth for an external responder '
+                         '(default: $KFAC_AUTOSCALE, off)')
     pr.add_argument('--drain', action='store_true',
                     help='exit 0 once the queue is empty and idle')
     pr.add_argument('--max-seconds', type=float, default=None)
@@ -887,7 +1289,8 @@ def main(argv=None):
         poll_period=args.poll, max_restarts=args.max_restarts,
         hb_interval=args.hb_interval, hb_deadline=args.hb_deadline,
         backoff_base=args.backoff_base, backoff_max=args.backoff_max,
-        supervisor_args=sup_args)
+        supervisor_args=sup_args, preempt=args.preempt,
+        suspend_grace=args.suspend_grace, autoscale=args.autoscale)
 
     def _stop(signum, frame):
         ctl.stop()

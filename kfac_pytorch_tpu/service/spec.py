@@ -18,6 +18,8 @@ Schema (README "Training service")::
       "hosts":   1,                        # pod size (>= 1)
       "priority": 0,                       # higher admits first
       "retry_budget": 2,                   # requeues before job_lost
+      "weight":  1.0,                      # tenant fair-share weight (> 0)
+      "preemptible": true,                 # may be checkpoint-suspended
       "name":    "nightly-sweep"           # optional label
     }
 
@@ -66,7 +68,8 @@ _TENANT = re.compile(r'^[a-z0-9][a-z0-9_-]{0,62}$')
 _KNOB = re.compile(r'^[a-z][a-z0-9_]{0,62}$')
 _ENVKEY = re.compile(r'^(KFAC|JAX)_[A-Z0-9_]{1,62}$')
 _FIELDS = frozenset({'tenant', 'trainer', 'args', 'knobs', 'env',
-                     'hosts', 'priority', 'retry_budget', 'name'})
+                     'hosts', 'priority', 'retry_budget', 'weight',
+                     'preemptible', 'name'})
 
 
 class SpecError(ValueError):
@@ -83,7 +86,8 @@ class JobSpec:
     """A validated job spec. Construct through :func:`validate_spec`."""
 
     def __init__(self, tenant, trainer, args=(), knobs=None, env=None,
-                 hosts=1, priority=0, retry_budget=2, name=None):
+                 hosts=1, priority=0, retry_budget=2, weight=1.0,
+                 preemptible=True, name=None):
         self.tenant = tenant
         self.trainer = trainer
         self.args = tuple(args)
@@ -92,6 +96,8 @@ class JobSpec:
         self.hosts = int(hosts)
         self.priority = int(priority)
         self.retry_budget = int(retry_budget)
+        self.weight = float(weight)
+        self.preemptible = bool(preemptible)
         self.name = name
 
     def to_dict(self):
@@ -99,7 +105,8 @@ class JobSpec:
              'args': list(self.args), 'knobs': dict(self.knobs),
              'env': dict(self.env), 'hosts': self.hosts,
              'priority': self.priority,
-             'retry_budget': self.retry_budget}
+             'retry_budget': self.retry_budget,
+             'weight': self.weight, 'preemptible': self.preemptible}
         if self.name is not None:
             d['name'] = self.name
         return d
@@ -198,6 +205,17 @@ def validate_spec(payload, trainers=None):
         problems.append(f"'retry_budget' must be an integer >= 0, "
                         f'got {retry!r}')
         retry = 2
+    weight = payload.get('weight', 1.0)
+    if (not isinstance(weight, (int, float)) or isinstance(weight, bool)
+            or not weight > 0 or weight != weight or weight > 1e6):
+        problems.append(f"'weight' must be a number in (0, 1e6], "
+                        f'got {weight!r}')
+        weight = 1.0
+    preemptible = payload.get('preemptible', True)
+    if not isinstance(preemptible, bool):
+        problems.append(f"'preemptible' must be a boolean, "
+                        f'got {preemptible!r}')
+        preemptible = True
     name = payload.get('name')
     if name is not None and (not isinstance(name, str)
                              or len(name) > 128 or '\n' in name):
@@ -206,4 +224,5 @@ def validate_spec(payload, trainers=None):
         raise SpecError(problems)
     return JobSpec(tenant=tenant, trainer=trainer, args=args,
                    knobs=knobs, env=env, hosts=hosts, priority=priority,
-                   retry_budget=retry, name=name)
+                   retry_budget=retry, weight=weight,
+                   preemptible=preemptible, name=name)

@@ -33,6 +33,17 @@ def main(argv=None):
     p.add_argument('--partition-pods', type=int, default=4)
     p.add_argument('--jobs', type=int, default=10)
     p.add_argument('--fail-jobs', type=int, default=3)
+    p.add_argument('--service-hosts', type=int, default=2)
+    p.add_argument('--service-slots', type=int, default=4)
+    p.add_argument('--preempt-jobs', type=int, default=0,
+                   help='late high-priority jobs that force '
+                        'checkpoint-suspend preemption')
+    p.add_argument('--autoscale', action='store_true',
+                   help='arm the capacity responder answering '
+                        'scale-request.json with hosts.json rewrites')
+    p.add_argument('--drain-at', type=float, default=0.0,
+                   help='sim time to mark the last service host '
+                        'draining (0 = never)')
     p.add_argument('--out', default=None,
                    help='JSONL trace path (default: stdout summary only)')
     p.add_argument('--root', default=None,
@@ -53,7 +64,12 @@ def main(argv=None):
                     seed=args.seed, scenario=args.scenario,
                     kill_pods=args.kill_pods,
                     partition_pods=args.partition_pods,
-                    jobs=args.jobs, fail_jobs=args.fail_jobs)
+                    jobs=args.jobs, fail_jobs=args.fail_jobs,
+                    service_hosts=args.service_hosts,
+                    service_slots=args.service_slots,
+                    preempt_jobs=args.preempt_jobs,
+                    autoscale=args.autoscale,
+                    drain_at=args.drain_at)
     root = args.root or tempfile.mkdtemp(prefix='kfac-fleet-sim-')
     try:
         trace = run_fleet_sim(cfg, root)

@@ -57,7 +57,8 @@ from kfac_pytorch_tpu.coord import (
     TcpKvBackend, TcpKvServer)
 from kfac_pytorch_tpu.resilience.chaos_net import (
     NetFaultConfig, PartitionWindow)
-from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+from kfac_pytorch_tpu.resilience.elastic import (
+    RC_SUSPENDED, PodSupervisor)
 from kfac_pytorch_tpu.resilience.heartbeat import (
     BackendLeaseTransport, PeerHeartbeat)
 from kfac_pytorch_tpu.resilience.retry import ManualClock, RetryPolicy
@@ -85,6 +86,24 @@ class SimConfig:
     hb_deadline: float = 5.0
     hb_grace: float = 10.0
     service_period: float = 1.0     # sim seconds between ctrl.step()s
+    #: service-lane capacity pool (the scheduler's hosts.json):
+    #: ``service_hosts`` controller-exec hosts of ``service_slots``
+    #: slots each — small next to ``hosts`` because the POD lane is
+    #: where the fleet scale lives; this pool is the POLICY surface
+    service_hosts: int = 2
+    service_slots: int = 4
+    #: multi-tenant policy drills (ISSUE 17). ``preempt_jobs`` late
+    #: high-priority non-preemptible jobs, each wide enough that the
+    #: scheduler must checkpoint-suspend victims; ``autoscale`` arms
+    #: the sim's capacity responder (reads ``scale-request.json``,
+    #: rewrites ``hosts.json``); ``drain_at`` > 0 marks the last
+    #: service host draining at that sim time (zero-loss drain drill).
+    preempt_jobs: int = 0
+    autoscale: bool = False
+    autoscale_period: float = 2.0
+    drain_at: float = 0.0
+    suspend_latency: float = 0.4    # request -> RC_SUSPENDED exits
+    suspend_grace: float = 8.0      # scheduler SIGKILL escalation
     #: replica outages: (replica index, down at, back at) in sim
     #: seconds. Non-overlapping by construction — one replica down is
     #: the absorb drill; overlapping windows would be the loud
@@ -224,9 +243,17 @@ class FleetSim:
         self.servers = [TcpKvServer('127.0.0.1', 0, wall=self.wall)
                         for _ in range(3)]
         self._pid_ctr = 100_000
-        self._launches = {}           # job id -> launch count
-        self._procs = {}              # job id -> live SimProcess
-        self._job_seen = {}           # job id -> (state, requeues)
+        self._launches = {}           # queue id -> launch count
+        self._procs = {}              # queue id -> live SimProcess
+        self._job_seen = {}           # queue id -> (state, requeues,
+        #                               attempt)
+        self._suspend_driven = set()  # (queue id, attempt) already acting
+        # the queue assigns ids in INGEST order, which diverges from
+        # the plan's ids once a late preemptor submits between base
+        # jobs: map spool origin -> plan id so the trace (and the plan
+        # lookup driving durations/fail_rc) speaks ONE id space
+        self._origin_plan = {}        # spool name -> plan id
+        self._qid_plan = {}           # queue id -> plan id
         self._jobs_done = False
         self.kill_barriers_pending = 0
         self._plan()
@@ -278,6 +305,12 @@ class FleetSim:
         iter_s = perfmodel.predict()[
             cfg.scenario]['inverse_dp_freq10']['iter_s']
         self.iter_s = float(iter_s)
+        # unequal tenant weights make the fair-share property visible:
+        # with mixed demand the scheduler's weighted-dominant-share
+        # ordering must converge usage toward 1:2:4, and no nonzero-
+        # weight tenant may starve (the sweep test pins both)
+        self.tenant_weights = {'tenant0': 1.0, 'tenant1': 2.0,
+                               'tenant2': 4.0}
         self.job_plan = {}
         for j in range(1, cfg.jobs + 1):
             steps = rng.randrange(30, 90)
@@ -286,6 +319,21 @@ class FleetSim:
                 'steps': steps,
                 'duration': round(steps * self.iter_s, 3),
                 'fail_rc': 115 if j <= cfg.fail_jobs else 0}
+        # the preemption drill: late, wide, high-priority and NOT
+        # preemptible — the pool is already packed when these land, so
+        # the scheduler must checkpoint-suspend victims to place them
+        for k in range(1, cfg.preempt_jobs + 1):
+            jid = cfg.jobs + k
+            steps = rng.randrange(20, 40)
+            self.job_plan[jid] = {
+                'submit': round(1.8 + 0.9 * (k - 1), 3),
+                'steps': steps,
+                'duration': round(steps * self.iter_s, 3),
+                'fail_rc': 0, 'priority': 10,
+                # full-pool width: placing it REQUIRES suspending
+                # every running preemptible job
+                'hosts': cfg.service_hosts * cfg.service_slots,
+                'preemptible': False}
 
     # -- pod lane: heartbeat actors + barriers -----------------------------
 
@@ -452,12 +500,16 @@ class FleetSim:
                 f'127.0.0.1:{s.port}' for s in self.servers)}
         saved = {k: os.environ.get(k) for k in overlay}
         os.environ.update(overlay)
+        hosts = {f'h{i}': self.cfg.service_slots
+                 for i in range(self.cfg.service_hosts)}
         try:
             self.ctrl = AdmissionController(
-                self.service_dir, hosts={'h0': 4, 'h1': 4},
+                self.service_dir, hosts=hosts,
                 popen=self._popen, killer=lambda p: p.kill(),
                 clock=self.clock, wall=self.wall, backoff_base=1.0,
-                backoff_max=4.0, env={}, log=self.log)
+                backoff_max=4.0, env={}, preempt=True,
+                suspend_grace=self.cfg.suspend_grace,
+                autoscale=self.cfg.autoscale, log=self.log)
         finally:
             for k, v in saved.items():
                 if v is None:
@@ -469,25 +521,48 @@ class FleetSim:
         self._pid_ctr += 1
         return self._pid_ctr
 
+    def _plan_for(self, qid):
+        """queue id -> plan id, resolved once through the record's
+        spool ``origin`` (the only stable join between the two id
+        spaces); a record the queue cannot read right now falls back
+        to the queue id (retried next sighting)."""
+        plan_id = self._qid_plan.get(qid)
+        if plan_id is None:
+            rec = self.ctrl.queue.read(qid)
+            origin = (rec or {}).get('origin')
+            plan_id = self._origin_plan.get(origin)
+            if plan_id is None:
+                return qid
+            self._qid_plan[qid] = plan_id
+        return plan_id
+
     def _popen(self, argv, env=None, **kw):
-        jid = int(str((env or {}).get('KFAC_JOB_ID',
+        qid = int(str((env or {}).get('KFAC_JOB_ID',
                                       'job-0')).split('-')[-1])
-        self._launches[jid] = self._launches.get(jid, 0) + 1
-        plan = self.job_plan.get(jid) or {'duration': 1.0, 'fail_rc': 0}
-        rc = plan['fail_rc'] if self._launches[jid] == 1 else 0
+        self._launches[qid] = self._launches.get(qid, 0) + 1
+        plan = self.job_plan.get(self._plan_for(qid)) \
+            or {'duration': 1.0, 'fail_rc': 0}
+        rc = plan['fail_rc'] if self._launches[qid] == 1 else 0
         proc = SimProcess(self._next_pid())
-        self._procs[jid] = proc
+        self._procs[qid] = proc
         self.loop.after(max(plan['duration'], 0.001),
                         functools.partial(proc.finish, rc))
         return proc
 
     def _submit_job(self, jid):
         plan = self.job_plan[jid]
-        self.ctrl.queue.submit({
-            'tenant': f'tenant{(jid - 1) % 3}',
-            'trainer': 'cifar10_resnet', 'args': [], 'hosts': 1,
-            'priority': 0, 'retry_budget': 2})
-        self._trace('job_submit', job=jid, steps=plan['steps'])
+        tenant = f'tenant{(jid - 1) % 3}'
+        name = self.ctrl.queue.submit({
+            'tenant': tenant,
+            'trainer': 'cifar10_resnet', 'args': [],
+            'hosts': plan.get('hosts', 1),
+            'priority': plan.get('priority', 0), 'retry_budget': 2,
+            'weight': self.tenant_weights[tenant],
+            'preemptible': plan.get('preemptible', True)})
+        self._origin_plan[name] = jid
+        self._trace('job_submit', job=jid, tenant=tenant,
+                    priority=plan.get('priority', 0),
+                    steps=plan['steps'])
 
     def _service_step(self):
         try:
@@ -496,6 +571,7 @@ class FleetSim:
             self._trace('coord_lost', pod=None, detail=str(e))
             return
         self._diff_job_states()
+        self._drive_suspends()
         counts = self.ctrl.queue.counts()
         total = sum(counts.values())
         finished = (total >= len(self.job_plan)
@@ -508,16 +584,53 @@ class FleetSim:
 
     def _diff_job_states(self):
         for rec in self.ctrl.queue.jobs():
-            jid = rec.get('id')
-            now = (rec.get('state'), rec.get('requeues', 0))
-            before = self._job_seen.get(jid)
+            qid = rec.get('id')
+            now = (rec.get('state'), rec.get('requeues', 0),
+                   rec.get('attempt', 0))
+            before = self._job_seen.get(qid)
             if now == before:
                 continue
-            self._job_seen[jid] = now
-            state, requeues = now
+            self._job_seen[qid] = now
+            jid = self._plan_for(qid)    # trace in the plan's id space
+            state, requeues, attempt = now
             if state == 'running':
+                run = self.ctrl.running.get(qid)
+                hosts = ','.join(run.hosts()) if run is not None else ''
+                if (rec.get('last_reason') == 'resume'
+                        and before is not None and before[0] == 'running'
+                        and attempt > before[2]):
+                    # the park + resume + re-admit completed inside ONE
+                    # scheduler cycle (capacity was already free, e.g.
+                    # autoscale had grown the pool): the SUSPENDED state
+                    # was never observable between diffs, so surface the
+                    # suspend edge from the record's history — the trace
+                    # must still tell the whole story
+                    susp = next((h for h in
+                                 reversed(rec.get('history', []))
+                                 if h.get('to') == 'suspended'), {})
+                    self._trace('job_suspend', job=jid,
+                                rc=susp.get('last_rc'),
+                                reason=susp.get('last_reason'))
                 self._trace('job_admit', job=jid,
-                            attempt=rec.get('attempt', 0))
+                            attempt=attempt,
+                            hosts=hosts)
+                # a resumed suspension on different hosts IS the
+                # migration (the scheduler logs the same edge)
+                prev = rec.get('last_hosts')
+                if (rec.get('last_reason') == 'resume' and prev
+                        and hosts and prev != hosts):
+                    self._trace('job_migrate', job=jid, src=prev,
+                                dst=hosts)
+            elif state == 'suspended':
+                self._trace('job_suspend', job=jid,
+                            rc=rec.get('last_rc'),
+                            reason=rec.get('last_reason'))
+            elif state == 'queued' and before is not None \
+                    and before[0] == 'suspended':
+                # resume normally lands + re-admits inside one cycle
+                # (then job_suspend + job_admit show); this edge appears
+                # when placement fell through between resume and claim
+                self._trace('job_resume', job=jid)
             elif state == 'queued' and before is not None \
                     and requeues > before[1]:
                 self._trace('job_requeue', job=jid, requeues=requeues,
@@ -527,6 +640,128 @@ class FleetSim:
                             requeues=requeues)
             elif state == 'lost':
                 self._trace('job_lost', job=jid, requeues=requeues)
+
+    def _drive_suspends(self):
+        """The pod side of a checkpoint-suspend, simulated: once the
+        scheduler has requested a suspend (``run.suspend`` armed, the
+        ``suspend.json`` key written into the job's lease namespace),
+        every rank of that attempt exits :data:`RC_SUSPENDED` after
+        ``suspend_latency`` sim seconds — the time a real
+        PodSupervisor takes to stop its trainer at a checkpoint
+        boundary. The scheduler's reap then runs the REAL suspended
+        verdict (epoch-CAS park, port release, adopted-knobs carry)."""
+        for jid in sorted(self.ctrl.running):
+            run = self.ctrl.running[jid]
+            if run.suspend is None:
+                continue
+            key = (jid, run.record.get('attempt', 0))
+            if key in self._suspend_driven:
+                continue
+            self._suspend_driven.add(key)
+            self._trace('pod_suspend', job=self._plan_for(jid),
+                        reason=run.suspend.get('reason'))
+            procs = list(run.procs.values())
+
+            def _land(procs=procs):
+                for p in procs:
+                    p.finish(RC_SUSPENDED)
+            self.loop.after(self.cfg.suspend_latency, _land)
+
+    # -- capacity responder + drain (the operator side) --------------------
+
+    def _autoscale_step(self):
+        """The external capacity responder the scheduler's
+        ``scale_request`` lane is written for: read the latest
+        ``scale-request.json``, grow the pool with ``aN`` hosts until
+        capacity covers the desired slots, shrink by removing IDLE
+        ``aN`` hosts when demand falls — all through the same quorum
+        backend ``hosts.json`` rides on, so the scheduler adopts the
+        answer via its ordinary capacity refresh."""
+        try:
+            self._autoscale_respond()
+        except CoordGiveUp as e:
+            self._trace('coord_lost', pod=None, detail=str(e))
+            return
+        if (not self._jobs_done
+                and self.clock.now < self.cfg.max_sim_seconds):
+            self.loop.after(self.cfg.autoscale_period,
+                            self._autoscale_step)
+
+    def _autoscale_respond(self):
+        got = self.ctrl.coord.get('scale-request.json')
+        doc = None if got is None else got.value
+        if not isinstance(doc, dict):
+            return
+        desired = int(doc.get('desired_slots', 0))
+        got = self.ctrl.coord.get('hosts.json')
+        hosts_doc = None if got is None else got.value
+        if not (isinstance(hosts_doc, dict)
+                and isinstance(hosts_doc.get('hosts'), dict)):
+            return
+        raw = dict(hosts_doc['hosts'])
+        unit = self.cfg.service_slots
+
+        def _slots(e):
+            return e.get('slots', 0) if isinstance(e, dict) else e
+
+        cap = sum(_slots(e) for e in raw.values()
+                  if not (isinstance(e, dict) and e.get('draining')))
+        if desired > cap:
+            i, grown = 0, 0
+            while cap < desired and grown < 64:
+                name = f'a{i}'
+                i += 1
+                if name in raw:
+                    continue
+                raw[name] = unit
+                cap += unit
+                grown += 1
+            if grown:
+                self.ctrl.coord.put('hosts.json', {'hosts': raw},
+                                    indent=2)
+                self._trace('autoscale', action='grow',
+                            desired=desired, capacity=cap)
+        elif desired < cap:
+            busy = set()
+            for run in self.ctrl.running.values():
+                busy.update(run.hosts())
+            shrunk = 0
+            for name in sorted((n for n in raw
+                                if n.startswith('a')), reverse=True):
+                if cap - unit < desired or name in busy:
+                    continue
+                del raw[name]
+                cap -= unit
+                shrunk += 1
+            if shrunk:
+                self.ctrl.coord.put('hosts.json', {'hosts': raw},
+                                    indent=2)
+                self._trace('autoscale', action='shrink',
+                            desired=desired, capacity=cap)
+
+    def _drain_host(self, name):
+        """Mark one service host draining in ``hosts.json`` (the
+        operator's zero-loss drain gesture): the scheduler stops
+        placing on it and checkpoint-suspends its preemptible jobs
+        off; they resume — migrate — onto the remaining pool."""
+        try:
+            got = self.ctrl.coord.get('hosts.json')
+            doc = None if got is None else got.value
+            if not (isinstance(doc, dict)
+                    and isinstance(doc.get('hosts'), dict)):
+                return
+            raw = dict(doc['hosts'])
+            entry = raw.get(name)
+            if entry is None:
+                return
+            slots = entry.get('slots') if isinstance(entry, dict) \
+                else entry
+            raw[name] = {'slots': slots, 'draining': True}
+            self.ctrl.coord.put('hosts.json', {'hosts': raw}, indent=2)
+        except CoordGiveUp as e:
+            self._trace('coord_lost', pod=None, detail=str(e))
+            return
+        self._trace('host_drain', host=name)
 
     # -- run ---------------------------------------------------------------
 
@@ -562,6 +797,11 @@ class FleetSim:
                          functools.partial(self._submit_job, jid))
         self.loop.at(1.0, self._hb_round)
         self.loop.at(0.6, self._service_step)
+        if cfg.autoscale:
+            self.loop.at(1.4, self._autoscale_step)
+        if cfg.drain_at > 0:
+            self.loop.at(cfg.drain_at, functools.partial(
+                self._drain_host, f'h{cfg.service_hosts - 1}'))
         drained = self.loop.run(cfg.max_sim_seconds)
         repaired = sum(p.merged.counts.get('replica_repair', 0)
                        for p in self.pods)
@@ -575,6 +815,9 @@ class FleetSim:
             jobs_done=kinds.count('job_done'),
             jobs_requeued=kinds.count('job_requeue'),
             jobs_finished=bool(self._jobs_done),
+            jobs_suspended=kinds.count('job_suspend'),
+            jobs_migrated=kinds.count('job_migrate'),
+            autoscaled=kinds.count('autoscale'),
             repaired=bool(repaired), degraded=bool(degraded),
             coord_lost=kinds.count('coord_lost'))
         return self.trace

@@ -684,6 +684,73 @@ def test_queue_requeue_exactly_once_per_observation(
     assert final['state'] == 'queued' and final['requeues'] == 1
 
 
+def test_queue_suspend_resume_exactly_once_under_chaos(backend):
+    """The SUSPENDED -> queued -> RUNNING lane holds the same
+    exactly-once contract as requeue, on every backend (POSIX / KV /
+    replicated quorum) and through injected coordination faults: an
+    ack-lost suspend REPLAY no-ops (every rank's RC_SUSPENDED exit
+    observes the same epoch), a replayed resume no-ops, and the
+    resumed claim runs attempt 2 with the retry budget untouched."""
+    chaos = ChaosBackend(backend,
+                         CoordFaultConfig(seed=13, fail=0.05, torn=0.05,
+                                          cas=0.2))
+    q = _queue(chaos)
+    clean = _queue(backend)
+    clean.submit(_spec())
+    rec = clean.ingest()[0]
+
+    def apply(fn):
+        # ride out the fault schedule the way the scheduler's poll
+        # loop does: a raised fault or an exhausted CAS loop just
+        # retries from a fresh read next cycle
+        for _ in range(40):
+            try:
+                out = fn()
+            except CoordTimeout:
+                continue
+            if out is not None:
+                return out
+        return None
+
+    def replay_noops(fn):
+        # a REPLAY must never apply: every completed call answers None
+        # (a raised fault is a non-answer, not an apply)
+        for _ in range(5):
+            try:
+                assert fn() is None
+            except CoordTimeout:
+                pass
+
+    running = apply(lambda: q.claim(q.read(rec['id']) or rec))
+    assert running is not None
+    # two observers of the suspend (two ranks exiting 119) hold the
+    # SAME record; a chaos-swallowed ack makes the first caller retry —
+    # the epoch CAS still applies the park exactly once
+    parked = apply(lambda: q.suspend(dict(running), rc=119,
+                                     reason='preempt', last_hosts='h0'))
+    assert parked is not None and parked['state'] == 'suspended'
+    replay_noops(lambda: q.suspend(dict(running), rc=119,
+                                   reason='preempt'))
+    stored = clean.read(rec['id'])
+    assert stored['state'] == 'suspended'
+    assert stored['requeues'] == 0                       # uncharged
+    assert stored.get('charged_requeues', 0) == 0
+    # resume: exactly once too, ready immediately (no backoff)
+    resumed = apply(lambda: q.resume(dict(parked)))
+    assert resumed is not None
+    assert resumed['state'] == 'queued'
+    assert resumed['last_reason'] == 'resume'
+    assert resumed['not_before'] == 0.0
+    replay_noops(lambda: q.resume(dict(parked)))
+    claimed = apply(lambda: q.claim(q.read(rec['id']) or resumed))
+    assert claimed is not None
+    assert claimed['state'] == 'running' and claimed['attempt'] == 2
+    assert claimed['requeues'] == 0
+    # the whole arc burned exactly four epochs: claim, suspend,
+    # resume, claim — nothing double-applied under the faults
+    assert clean.read(rec['id'])['epoch'] == 4
+
+
 def test_queue_epoch_cas_survives_spurious_conflicts(tmp_path):
     """A chaos-injected CAS conflict must not swallow a transition:
     the bounded re-read/retry loop applies it exactly once (the epoch

@@ -827,6 +827,60 @@ def test_pod_supervisor_stop_rc_propagates(tmp_path):
     assert sup.restarts == 0
 
 
+def test_pod_supervisor_suspend_request_stops_trainer_rc119(tmp_path):
+    """The scheduler's checkpoint-suspend lane: a ``suspend.json``
+    marker landing in the lease namespace mid-run stops the (healthy)
+    trainer at the boundary and exits RC_SUSPENDED — a verdict the
+    scheduler asked for, never charged as a crash."""
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import (PodSupervisor,
+                                                     RC_SUSPENDED)
+    lease = tmp_path / 'lease'
+    # the trainer itself delivers the request once it is running (the
+    # gen-0 scrub would eat a marker planted before launch — see the
+    # stale-marker test below), then sleeps until SIGTERMed
+    child = [sys.executable, '-c',
+             'import json, os, sys, time\n'
+             'with open(os.path.join(sys.argv[1], "suspend.json"), '
+             '"w") as f:\n'
+             '    json.dump({"job": 1, "reason": "preempt", '
+             '"by": 2}, f)\n'
+             'time.sleep(600)\n', str(lease)]
+    sup = PodSupervisor(child, host_id=0, num_hosts=1,
+                        lease_dir=str(lease), max_restarts=1,
+                        backoff_base=0.01, poll_period=0.02,
+                        hb_interval=0.05)
+    assert sup.run() == RC_SUSPENDED
+    assert sup.crashes == 0 and sup.restarts == 0  # not budgeted
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    kinds = [e['kind'] for e in report['events']]
+    assert 'suspended' in kinds
+    assert not any(k in kinds for k in ('fenced', 'crash'))
+    assert report['counters'].get('suspended') == 1
+
+
+def test_pod_supervisor_scrubs_stale_suspend_marker_at_startup(tmp_path):
+    """A resume reuses the job's lease dir: a suspend request left over
+    from the PREVIOUS life (the scheduler's delete was lost) must be
+    scrubbed at generation 0, or the freshly resumed pod would
+    re-suspend the moment its suspend lane first polls."""
+    import json
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+    lease = tmp_path / 'lease'
+    lease.mkdir()
+    (lease / 'suspend.json').write_text(
+        '{"job": 1, "reason": "preempt"}')
+    sup = PodSupervisor([sys.executable, '-c', 'import time; '
+                         'time.sleep(0.5)'],
+                        host_id=0, num_hosts=1, lease_dir=str(lease),
+                        max_restarts=1, backoff_base=0.01,
+                        poll_period=0.02, hb_interval=0.05)
+    assert sup.run() == 0          # the stale request never re-fires
+    assert not (lease / 'suspend.json').exists()
+    report = json.loads((lease / 'incident-host0.json').read_text())
+    assert not any(e['kind'] == 'suspended' for e in report['events'])
+
+
 # ---------------------------------------------------------------------------
 # pod supervisor GROW lane (join announcements, grow barrier, --join
 # mode; the real 3-host churn drill is in tests/test_pod_chaos.py

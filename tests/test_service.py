@@ -25,6 +25,13 @@ drill lives in tests/test_service_chaos.py behind -m slow):
 6. Service events land in the shared incident grammar, so kfac-obs
    renders admit -> failure -> requeue -> done per tenant — and the
    new --follow mode tails them live.
+7. The multi-tenant policy lanes (ISSUE 17): priority preemption is a
+   checkpoint-suspend (victims park SUSPENDED uncharged, their port
+   blocks release for re-allocation, the preemptor admits the cycle
+   the slots free), weighted fair share orders admission, a draining
+   host suspend-migrates its preemptible jobs off (non-preemptible
+   ones finish in place), and queue demand drives scale-request.json
+   for an external capacity responder.
 """
 
 import json
@@ -37,9 +44,11 @@ import pytest
 
 from kfac_pytorch_tpu.obs import aggregate, metrics
 from kfac_pytorch_tpu.resilience.incident import IncidentReport
+from kfac_pytorch_tpu.resilience import atomic_write_json
 from kfac_pytorch_tpu.service import (
     AdmissionController, JobQueue, PortAllocator, PortConflictError,
     SpecError, classify_rc, validate_spec)
+from kfac_pytorch_tpu.service.scheduler import RC_SUSPENDED, SUSPEND_KEY
 
 pytestmark = pytest.mark.core
 
@@ -699,3 +708,314 @@ def test_obs_follow_survives_incident_rotation(tmp_path):
     aggregate.follow([str(inc)], interval=0.05, duration=0.6, out=out)
     t.join()
     assert out.getvalue().count('launch') == 2
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant policy: preemption / fair share / migration / autoscale
+# ---------------------------------------------------------------------------
+
+def test_suspend_rc_pinned_across_layers():
+    """scheduler.py spells RC_SUSPENDED / SUSPEND_KEY as literals (to
+    stay importable without the pod-supervisor stack): pin them equal
+    to the resilience layer's, and to the rc grammar everywhere the
+    suspend verdict travels."""
+    from kfac_pytorch_tpu.resilience import elastic
+    from kfac_pytorch_tpu.resilience.supervisor import STOP_RC_NAMES
+    assert RC_SUSPENDED == elastic.RC_SUSPENDED == 119
+    assert SUSPEND_KEY == elastic.SUSPEND_KEY == 'suspend.json'
+    assert STOP_RC_NAMES['suspended'] == 119
+    assert classify_rc(RC_SUSPENDED) == 'suspended'
+
+
+def test_spec_weight_and_preemptible_validation():
+    spec = validate_spec(_spec(weight=2.5, preemptible=False))
+    assert spec.weight == 2.5 and spec.preemptible is False
+    assert validate_spec(spec.to_dict()).to_dict() == spec.to_dict()
+    # defaults: weight 1.0, preemptible True
+    spec = validate_spec(_spec())
+    assert spec.weight == 1.0 and spec.preemptible is True
+    with pytest.raises(SpecError, match='weight'):
+        validate_spec(_spec(weight=0))
+    with pytest.raises(SpecError, match='weight'):
+        validate_spec(_spec(weight=True))      # a bool is not a number
+    with pytest.raises(SpecError, match='preemptible'):
+        validate_spec(_spec(preemptible=1))
+
+
+def test_preemption_suspends_victims_and_admits_high_priority(tmp_path,
+                                                              caplog):
+    """The whole preemption arc on fakes: an unplaceable high-priority
+    job checkpoint-suspends BOTH running victims (request delivered
+    into each pod's lease namespace), their RC_SUSPENDED exits park
+    them uncharged, the preemptor admits the same cycle the slots
+    free, and the victims resume once it finishes."""
+    ctl, popen = _controller(tmp_path)              # h0: 2 slots
+    ctl.queue.submit(_mini())                       # job 1 (alice)
+    ctl.queue.submit(_mini(tenant='bob'))           # job 2
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        assert len(popen.launches) == 2
+        ctl.queue.submit(_mini(tenant='carol', priority=10, hosts=2,
+                               preemptible=False))  # job 3: full pool
+        ctl.step()
+        # both victims asked to suspend; nothing new launched yet
+        assert len(popen.launches) == 2
+        for jid in (1, 2):
+            run = ctl.running[jid]
+            assert run.suspend is not None
+            assert run.suspend['reason'] == 'preempt'
+            # the request is a key the victim's supervisors read as
+            # plain suspend.json (their backend root is the lease dir)
+            req = ctl.coord.get(ctl._lease_key(run, SUSPEND_KEY))
+            assert req is not None
+            assert req.value['job'] == jid and req.value['by'] == 3
+            assert req.value['reason'] == 'preempt'
+        assert caplog.text.count('job_preempt') == 2
+        assert ('job_preempt job=1 tenant=alice victim_of=3 '
+                'priority=0 by_priority=10') in caplog.text
+        # the supervisors land the checkpoint-suspend
+        popen.procs[0].rc = RC_SUSPENDED
+        popen.procs[1].rc = RC_SUSPENDED
+        ctl.step()
+        for jid, tenant in ((1, 'alice'), (2, 'bob')):
+            rec = ctl.queue.read(jid)
+            assert rec['state'] == 'suspended'
+            assert rec['last_rc'] == RC_SUSPENDED
+            assert rec['last_reason'] == 'preempt'
+            assert rec['requeues'] == 0                  # uncharged
+            assert rec.get('charged_requeues', 0) == 0
+            assert rec['last_hosts'] == 'h0'
+            # exactly-once: one park, one line per victim
+            assert caplog.text.count(f'job_suspend job={jid} ') == 1
+        # the preemptor admitted in the SAME cycle the slots freed
+        assert ctl.queue.read(3)['state'] == 'running'
+        assert len(popen.launches) == 4                  # 2 ranks
+        # preemptor done -> victims resume (same host: no migrate edge)
+        popen.procs[2].rc = 0
+        popen.procs[3].rc = 0
+        ctl.step()
+    assert ctl.queue.read(3)['state'] == 'done'
+    for jid in (1, 2):
+        rec = ctl.queue.read(jid)
+        assert rec['state'] == 'running' and rec['attempt'] == 2
+        assert rec['last_reason'] == 'resume'
+    assert len(popen.launches) == 6
+    assert 'job_migrate' not in caplog.text
+
+
+def test_suspend_releases_port_block_for_reallocation(tmp_path):
+    """Satellite 2: a suspended job's KFAC_HB_PORT block releases (the
+    preemptor can re-pin the same port without a conflict) and is
+    re-claimed at resume."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1})
+    ctl.queue.submit(_mini(env={'KFAC_HB_PORT': '9100'}))
+    ctl.step()
+    assert ctl.queue.read(1)['port'] == 9100
+    # a higher-priority job pinning the SAME explicit port: only
+    # admissible because the suspend released the victim's block
+    ctl.queue.submit(_mini(tenant='bob', priority=5,
+                           env={'KFAC_HB_PORT': '9100'}))
+    ctl.step()                           # suspend requested
+    assert ctl.running[1].suspend is not None
+    popen.procs[0].rc = RC_SUSPENDED
+    ctl.step()                           # parked; bob admits on the pin
+    assert ctl.queue.read(1)['state'] == 'suspended'
+    rec2 = ctl.queue.read(2)
+    assert rec2['state'] == 'running' and rec2['port'] == 9100
+    assert len(popen.launches) == 2      # no PortConflictError path
+    # bob finishes: job 1 resumes and RE-claims its pinned block
+    popen.procs[1].rc = 0
+    ctl.step()
+    rec1 = ctl.queue.read(1)
+    assert rec1['state'] == 'running' and rec1['port'] == 9100
+    assert rec1['attempt'] == 2
+
+
+def test_weighted_fair_share_orders_admission(tmp_path, caplog):
+    """Equal priority, one free slot: the tenant with the LOWER
+    weighted dominant share (used / slots / weight) wins it — weight
+    scales entitlement, and the accounting lands as tenant_share."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 3})
+    ctl.queue.submit(_mini(weight=1.0))                    # job 1 alice
+    ctl.queue.submit(_mini(tenant='bob', weight=4.0))      # job 2
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        assert len(popen.launches) == 2                    # 1 slot left
+        ctl.queue.submit(_mini(weight=1.0))                # job 3 alice
+        ctl.queue.submit(_mini(tenant='bob', weight=4.0))  # job 4
+        ctl.step()
+    # alice: 1/3/1 = 0.333 > bob: 1/3/4 = 0.083 -> bob is under-served
+    assert ctl.queue.read(4)['state'] == 'running'
+    assert ctl.queue.read(3)['state'] == 'queued'
+    assert ('tenant_share tenant=alice used=1 of=3 weight=1.0 '
+            'share=0.333') in caplog.text
+    assert ('tenant_share tenant=bob used=1 of=3 weight=4.0 '
+            'share=0.083') in caplog.text
+
+
+def test_drain_suspends_and_migrates_preemptible_jobs(tmp_path, caplog):
+    """A hosts.json entry flipped to draining: its preemptible job is
+    ASKED to suspend (never killed), parks with its last placement
+    stamped, and resumes on a DIFFERENT host — the job_migrate edge —
+    once capacity frees there."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1, 'h1': 1})
+    ctl.queue.submit(_mini())                       # job 1 -> h0
+    ctl.queue.submit(_mini(tenant='bob'))           # job 2 -> h1
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        assert ctl.queue.read(2)['placement'] == {'0': 'h1'}
+        atomic_write_json(ctl.hosts_path,
+                          {'hosts': {'h0': 1, 'h1': {'slots': 1,
+                                                     'draining': True}}})
+        ctl.step()
+        # zero-loss: a drain asks, it does not kill
+        assert 'pool_shrink slots=2 -> 1' in caplog.text
+        assert ctl.running[2].suspend is not None
+        assert ctl.running[2].suspend['reason'] == 'drain'
+        assert ctl.running[1].suspend is None       # other host: untouched
+        assert not ctl._test_killed
+        popen.procs[1].rc = RC_SUSPENDED
+        ctl.step()
+        rec = ctl.queue.read(2)
+        assert rec['state'] == 'suspended'
+        assert rec['last_reason'] == 'drain'
+        assert rec['last_hosts'] == 'h1'
+        # h0 still busy: the suspension parks until capacity frees
+        popen.procs[0].rc = 0
+        ctl.step()                  # job 1 done -> job 2 resumes on h0
+    assert ctl.queue.read(1)['state'] == 'done'
+    rec = ctl.queue.read(2)
+    assert rec['state'] == 'running'
+    assert rec['placement'] == {'0': 'h0'}
+    assert ('job_migrate job=2 tenant=bob from=h1 to=h0 attempt=2'
+            in caplog.text)
+
+
+def test_drain_leaves_non_preemptible_jobs_in_place(tmp_path):
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1})
+    ctl.queue.submit(_mini(preemptible=False))
+    ctl.step()
+    atomic_write_json(ctl.hosts_path,
+                      {'hosts': {'h0': {'slots': 1, 'draining': True}}})
+    ctl.step()
+    assert ctl.running[1].suspend is None      # finishes in place
+    assert not ctl._test_killed
+    popen.procs[0].rc = 0
+    ctl.step()
+    assert ctl.queue.read(1)['state'] == 'done'
+
+
+def test_autoscale_emits_scale_requests_on_demand_change(tmp_path,
+                                                         caplog):
+    """Queue-driven capacity requests: scale-request.json carries live
+    demand, re-emitted only on CHANGE; a responder growing hosts.json
+    is adopted by the ordinary refresh and the queue drains into it."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1}, autoscale=True)
+    for tenant in ('alice', 'bob', 'carol'):
+        ctl.queue.submit(_mini(tenant=tenant))
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        req = ctl.coord.get('scale-request.json').value
+        assert req['desired_slots'] == 3 and req['capacity'] == 1
+        assert caplog.text.count('scale_request') == 1
+        ctl.step()                       # demand unchanged: no re-emit
+        assert caplog.text.count('scale_request') == 1
+        # the responder answers: capacity grows, the queue drains
+        atomic_write_json(ctl.hosts_path, {'hosts': {'h0': 1, 'a0': 2}})
+        ctl.step()
+        assert 'pool_grow' in caplog.text
+        states = {r['id']: r['state'] for r in ctl.queue.jobs()}
+        assert states == {1: 'running', 2: 'running', 3: 'running'}
+        for p in popen.procs:
+            p.rc = 0
+        ctl.step()                       # demand drops: a new request
+    assert ctl.coord.get('scale-request.json').value['desired_slots'] == 0
+    assert caplog.text.count('scale_request') == 2
+
+
+def test_suspend_grace_escalates_to_sigkill_and_still_parks(tmp_path,
+                                                            caplog):
+    """A victim that never winds down is SIGKILLed past the grace
+    deadline — and the -9 exits STILL park it SUSPENDED (run.suspend
+    routes the verdict), uncharged: the last banked checkpoint carries
+    the resume."""
+    ctl, popen = _controller(tmp_path, hosts={'h0': 1},
+                             suspend_grace=0.0)
+    ctl.queue.submit(_mini())
+    with caplog.at_level(logging.WARNING, logger='svc-test'):
+        ctl.step()
+        ctl.queue.submit(_mini(tenant='bob', priority=5))
+        ctl.step()                       # suspend requested, grace 0
+        assert ctl.running[1].suspend is not None
+        ctl.step()                       # deadline passed: escalate
+        assert popen.procs[0].pid in ctl._test_killed
+        assert 'suspend grace' in caplog.text
+        popen.procs[0].rc = -9           # the SIGKILL lands
+        ctl.step()
+    rec = ctl.queue.read(1)
+    assert rec['state'] == 'suspended'
+    assert rec['last_reason'] == 'preempt'
+    assert rec['requeues'] == 0 and rec.get('charged_requeues', 0) == 0
+    assert ctl.queue.read(2)['state'] == 'running'
+
+
+def test_watchless_scan_skip_returns_cached_verdict(tmp_path):
+    """Satellite 1's degraded half: with no watch events, no dirty
+    flag and no due backoff, step(scan=False) answers from the cached
+    verdict WITHOUT re-reading the job table; a capacity edit re-arms
+    the scan."""
+    ctl, popen = _controller(tmp_path)
+    ctl.queue.submit(_mini())
+    assert ctl.step() is True
+    calls = []
+    orig = ctl.queue.jobs
+    ctl.queue.jobs = lambda: calls.append(1) or orig()
+    assert ctl.step(ingest=False, scan=False) is True
+    assert calls == []                   # the scan really was skipped
+    atomic_write_json(ctl.hosts_path, {'hosts': {'h0': 4}})
+    assert ctl.step(ingest=False, scan=False) is True
+    assert calls == [1]                  # hosts change forced the scan
+
+
+MT_SERVICE_LOG = """\
+2026-08-03 11:00:01,000 service: tenant_share tenant=alice used=2 of=4 weight=1.0 share=0.500
+2026-08-03 11:00:01,100 service: scale_request desired=6 capacity=4 queued=2 suspended=0
+2026-08-03 11:00:02,000 service: job_preempt job=1 tenant=alice victim_of=3 priority=0 by_priority=10 grace_s=30.0
+2026-08-03 11:00:02,500 pod-supervisor: suspending on request — trainer stopped (grace checkpoint banked, trainer rc was -15), exiting rc=119 with no further commits [resilience: suspended=1]
+2026-08-03 11:00:03,000 service: job_suspend job=1 tenant=alice rc=119 reason=preempt hosts=h0 attempt=1
+2026-08-03 11:00:09,000 service: job_migrate job=1 tenant=alice from=h0 to=h1 attempt=2
+"""
+
+
+def test_incident_grammar_scrapes_multi_tenant_events(tmp_path):
+    """Every ISSUE-17 emit site speaks the shared grammar: the five
+    service events plus the supervisor's suspend verdict scrape with
+    their fields intact (kfac-obs needs zero new aggregation code)."""
+    log_path = tmp_path / 'service.log'
+    log_path.write_text(MT_SERVICE_LOG)
+    report = IncidentReport().scrape_path(str(log_path))
+    events = {e['kind']: e for e in report.events}
+    assert set(events) >= {'tenant_share', 'scale_request',
+                           'job_preempt', 'suspended', 'job_suspend',
+                           'job_migrate'}
+    assert events['tenant_share']['tenant'] == 'alice'
+    assert events['tenant_share']['used'] == 2
+    assert events['tenant_share']['weight'] == 1.0
+    assert events['scale_request']['desired'] == 6
+    assert events['scale_request']['capacity'] == 4
+    pre = events['job_preempt']
+    assert pre['job'] == 1 and pre['victim_of'] == 3
+    assert pre['priority'] == 0 and pre['by_priority'] == 10
+    sup = events['suspended']
+    assert sup['rc'] == 119 and sup['trainer_rc'] == -15
+    susp = events['job_suspend']
+    assert susp['rc'] == 119 and susp['why'] == 'preempt'
+    assert susp['on'] == 'h0'
+    mig = events['job_migrate']
+    assert mig['from'] == 'h0' and mig['to'] == 'h1'
+    # and the per-tenant timeline keeps causal order
+    timeline = aggregate.build_timeline([str(log_path)])
+    kinds = [e['kind'] for e in timeline['events']
+             if e['detail'].get('tenant') == 'alice'
+             and e['kind'].startswith('job_')]
+    assert kinds == ['job_preempt', 'job_suspend', 'job_migrate']

@@ -312,3 +312,231 @@ def test_service_survives_host_loss_zero_jobs_lost(tmp_path):
 @pytest.mark.nightly
 def test_service_drill_on_tcpkv_backend(tmp_path, tcpkv_coord):
     test_service_survives_host_loss_zero_jobs_lost(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# Preemption drill: priority preemption via checkpoint-suspend, then a
+# host drain forces the resumed victims to MIGRATE. Real processes end
+# to end — the ISSUE's multi-tenant acceptance run.
+# ---------------------------------------------------------------------------
+
+
+def test_service_preempts_suspends_and_migrates(tmp_path):
+    """Two low-priority tenants (alice w=1, bob w=2) fill a 2-host pool;
+    a non-preemptible priority-10 job (carol) needing the WHOLE pool
+    lands mid-run. The service must checkpoint-suspend both victims
+    (rc=119, uncharged), admit carol the same cycle, survive h0
+    starting to drain under carol (non-preemptible: finishes in
+    place), then resume both victims on the one surviving host — the
+    one that ran on h0 migrating — and finish all three jobs
+    schedule-equivalent to an undisturbed control."""
+    from kfac_pytorch_tpu import coord
+    from kfac_pytorch_tpu.obs import aggregate
+    from kfac_pytorch_tpu.service import JobQueue
+    from kfac_pytorch_tpu.service.scheduler import RC_SUSPENDED
+
+    p = subprocess.run(
+        [sys.executable, TRAINER, '--epochs', str(EPOCHS),
+         '--batch-size', str(BATCH), '--num-examples', str(EXAMPLES),
+         '--checkpoint-dir', str(tmp_path / 'ckpt_control')],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=540)
+    assert p.returncode == 0, p.stdout[-3000:]
+    control = _done_line(p.stdout)
+
+    svc = tmp_path / 'svc'
+    queue = JobQueue(svc, trainers={'mini': TRAINER})
+    queue.submit(dict(_spec('alice'), weight=1.0))
+    queue.submit(dict(_spec('bob'), weight=2.0))
+
+    sched_env = _env(KFAC_FAULT_SLOW_STEP='0:999',
+                     KFAC_FAULT_SLOW_SECS='0.5')
+    svc_out = tmp_path / 'svc.out'
+    sched_cmd = [
+        sys.executable, '-m', 'kfac_pytorch_tpu.service.scheduler',
+        'run', '--service-dir', str(svc),
+        '--hosts', 'h0=1,h1=1',
+        '--trainer', f'mini={TRAINER}',
+        '--poll', '0.3', '--backoff-base', '0.3', '--backoff-max', '2',
+        '--max-restarts', '2', '--hb-interval', '0.3',
+        '--hb-deadline', '3', '--suspend-grace', '60',
+        '--drain', '--max-seconds', '900']
+    f_out = open(svc_out, 'wb')
+    sched = subprocess.Popen(sched_cmd, env=sched_env, cwd=REPO,
+                             stdout=f_out, stderr=subprocess.STDOUT,
+                             start_new_session=True)
+
+    def _fail(msg):
+        tail = svc_out.read_text()[-3000:] if svc_out.exists() else ''
+        pytest.fail(f'{msg}; scheduler tail: {tail}')
+
+    def _ckpt0(rec):
+        ckpt = os.path.join(rec.get('ns', ''), 'ckpt')
+        return (os.path.isdir(os.path.join(ckpt, 'checkpoint-0'))
+                or os.path.exists(os.path.join(ckpt,
+                                               'checkpoint-0.pkl')))
+
+    def _by_tenant(state=None):
+        recs = {r['spec']['tenant']: r for r in queue.jobs()}
+        if state is None:
+            return recs
+        return {t: r for t, r in recs.items() if r['state'] == state}
+
+    victims = ('alice', 'bob')
+    try:
+        # both victims admitted, mid-schedule, checkpoint-0 banked
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if sched.poll() is not None:
+                _fail(f'scheduler exited rc={sched.returncode} before '
+                      'the preemptor landed')
+            running = _by_tenant('running')
+            if (set(running) == set(victims)
+                    and all(_ckpt0(r) for r in running.values())):
+                break
+            time.sleep(0.5)
+        else:
+            _fail('victims never reached running-with-checkpoint')
+        victim_host = {t: r['placement']['0']
+                       for t, r in _by_tenant('running').items()}
+
+        # the preemptor: the whole pool, top priority, not itself
+        # suspendable
+        queue.submit({'tenant': 'carol', 'trainer': 'mini',
+                      'args': _trainer_args(), 'hosts': 2,
+                      'priority': 10, 'preemptible': False,
+                      'retry_budget': 2})
+
+        # both victims park SUSPENDED (uncharged) and carol admits
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if sched.poll() is not None:
+                _fail(f'scheduler exited rc={sched.returncode} '
+                      'mid-preemption')
+            recs = _by_tenant()
+            if (all(recs[t]['state'] == 'suspended' for t in victims)
+                    and recs.get('carol', {}).get('state') == 'running'):
+                break
+            time.sleep(0.5)
+        else:
+            _fail('preemption never parked both victims with carol '
+                  'running')
+        for t in victims:
+            rec = _by_tenant()[t]
+            assert rec['last_rc'] == RC_SUSPENDED, rec
+            assert rec['last_reason'] == 'preempt', rec
+            assert rec['requeues'] == 0, rec          # uncharged
+            assert rec['last_hosts'] == victim_host[t], rec
+
+        # drain h0 under carol: non-preemptible, she finishes in
+        # place; the victims must resume on h1 only
+        coord.backend_from_env(str(svc), retry=False, chaos=False).put(
+            'hosts.json',
+            {'hosts': {'h0': {'slots': 1, 'draining': True},
+                       'h1': 1}}, indent=2)
+
+        rc = sched.wait(timeout=900)
+        assert rc == 0, _fail(f'scheduler rc={rc}')
+    finally:
+        if sched.poll() is None:
+            try:
+                os.killpg(os.getpgid(sched.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        f_out.close()
+
+    # -- all three done; victims uncharged, resumed once ---------------
+    by_tenant = _by_tenant()
+    assert set(by_tenant) == {'alice', 'bob', 'carol'}
+    assert all(r['state'] == 'done' for r in by_tenant.values()), \
+        {t: r['state'] for t, r in by_tenant.items()}
+    assert by_tenant['carol']['requeues'] == 0
+    for t in victims:
+        rec = by_tenant[t]
+        assert rec['requeues'] == 0, rec              # never charged
+        assert rec.get('charged_requeues', 0) == 0
+        assert rec['attempt'] == 2, rec               # exactly one resume
+        assert rec['last_reason'] == 'resume', rec
+
+    service_log = (svc / 'service.log').read_text()
+    for t in victims:
+        jid = by_tenant[t]['id']
+        assert service_log.count(f'job_preempt job={jid} ') == 1
+        assert service_log.count(f'job_suspend job={jid} ') == 1
+        assert f'job_suspend job={jid} tenant={t} rc={RC_SUSPENDED}' \
+            in service_log
+    assert 'job_lost' not in service_log
+    assert service_log.count('job_done') == 3
+    assert 'tenant_share' in service_log
+    # the victim that ran on the drained host crossed hosts on resume
+    migrant = next(t for t in victims if victim_host[t] == 'h0')
+    assert (f'job_migrate job={by_tenant[migrant]["id"]} '
+            f'tenant={migrant} from=h0 to=h1') in service_log
+
+    # -- schedule equivalence + the suspend fence held -----------------
+    for t in victims:
+        rec = by_tenant[t]
+        log = os.path.join(rec['ns'], 'logs', 'host0.out')
+        text = open(log, errors='replace').read()
+        assert _done_line(text) == control, (t, text[-2000:])
+        assert 'RESUMED from=checkpoint-' in text, text[-3000:]
+        assert 'suspending on request' in text, text[-3000:]
+        assert 'no further commits' in text, text[-3000:]
+
+    # -- kfac-obs: each victim's timeline tells the whole story --------
+    for t in victims:
+        ns = by_tenant[t]['ns']
+        timeline = aggregate.build_timeline(
+            [str(svc / 'service.log'), ns], recursive=True)
+        events = [e for e in timeline['events']
+                  if e['detail'].get('tenant') in (t, None)]
+
+        def first(kind, after=0, **match):
+            for i in range(after, len(events)):
+                e = events[i]
+                if e['kind'] == kind and all(
+                        e['detail'].get(k) == v
+                        for k, v in match.items()):
+                    return i
+            raise AssertionError(
+                f'{kind} {match or ""} missing after {after}; kinds: '
+                f'{sorted({e["kind"] for e in events})}')
+
+        i_admit = first('job_admit', attempt=1, tenant=t)
+        i_pre = first('job_preempt', after=i_admit, tenant=t)
+        i_susp = first('job_suspend', after=i_admit, tenant=t)
+        i_re = first('job_admit', after=i_susp, attempt=2, tenant=t)
+        i_done = first('job_done', after=i_re, tenant=t)
+        order = [i_admit, i_pre, i_susp, i_re, i_done]
+        assert order == sorted(order), (t, order)
+        if t == migrant:
+            i_mig = first('job_migrate', after=i_susp, tenant=t)
+            assert i_re <= i_mig <= i_done, (i_re, i_mig, i_done)
+
+    # -- CI artifact export --------------------------------------------
+    art = os.environ.get('KFAC_DRILL_ARTIFACTS')
+    if art:
+        import shutil
+        root = os.path.join(art, 'service-preempt')
+        os.makedirs(root, exist_ok=True)
+        shutil.copy(svc / 'service.log', root)
+        shutil.copy(svc_out, root)
+        if os.path.isdir(queue.jobs_dir):
+            shutil.copytree(queue.jobs_dir,
+                            os.path.join(root, 'queue-state'),
+                            dirs_exist_ok=True)
+        else:
+            with open(os.path.join(root, 'queue-state.json'), 'w') as f:
+                json.dump(queue.jobs(), f, indent=2, default=str)
+        for t, rec in by_tenant.items():
+            tdir = os.path.join(root, t)
+            os.makedirs(tdir, exist_ok=True)
+            shutil.copytree(os.path.join(rec['ns'], 'logs'),
+                            os.path.join(tdir, 'logs'),
+                            dirs_exist_ok=True)
+            tl = aggregate.build_timeline(
+                [str(svc / 'service.log'), rec['ns']], recursive=True)
+            with open(os.path.join(tdir, 'timeline.json'), 'w') as f:
+                json.dump({k: v for k, v in tl.items()
+                           if not k.startswith('_')}, f, indent=2,
+                          default=str)

@@ -205,6 +205,80 @@ def test_exactly_once_requeue_and_all_jobs_finish(fleet_trace):
     assert trace[-1]['jobs_finished']
 
 
+# -- multi-tenant sweep: preemption + autoscale + drain ----------------------
+
+
+@pytest.fixture(scope='module')
+def mt_trace(tmp_path_factory):
+    """One combined multi-tenant sweep shared by the policy property
+    tests: a high-priority preemptor lands mid-run, autoscale is armed,
+    and a service host drains at t=6 — on top of the usual kills,
+    partition and replica outage."""
+    cfg = SimConfig(hosts=48, pod_size=8, kill_pods=2, partition_pods=1,
+                    jobs=6, fail_jobs=1, seed=7, preempt_jobs=1,
+                    autoscale=True, drain_at=6.0)
+    trace = run_fleet_sim(cfg, tmp_path_factory.mktemp('mt'))
+    return cfg, trace
+
+
+def test_mt_sweep_same_seed_same_trace_bytes(mt_trace, tmp_path):
+    cfg, trace = mt_trace
+    again = run_fleet_sim(cfg, tmp_path / 'again')
+    assert _canon(trace) == _canon(again)
+
+
+def test_preemption_suspends_then_every_tenant_finishes(mt_trace):
+    cfg, trace = mt_trace
+    k = _kinds(trace)
+    suspended = k.get('job_suspend', [])
+    assert any(e['reason'] == 'preempt' for e in suspended)
+    assert all(e['rc'] == 119 for e in suspended)  # RC_SUSPENDED
+    # every suspend the scheduler requested was delivered to a pod
+    assert len(k.get('pod_suspend', [])) == len(suspended)
+    # no tenant starves: every submitted job — victims included — runs
+    # to completion, and nothing is ever lost
+    total = cfg.jobs + cfg.preempt_jobs
+    assert sorted(e['job'] for e in k['job_submit']) == \
+        list(range(1, total + 1))
+    assert sorted(e['job'] for e in k.get('job_done', [])) == \
+        list(range(1, total + 1))
+    assert 'job_lost' not in k
+    end = trace[-1]
+    assert end['jobs_finished'] and end['coord_lost'] == 0
+    assert end['jobs_suspended'] == len(suspended)
+
+
+def test_autoscale_requests_are_honored(mt_trace):
+    cfg, trace = mt_trace
+    scales = _kinds(trace).get('autoscale', [])
+    assert scales, 'autoscale armed but no scale event fired'
+    # queued demand grows the pool first; the drained queue shrinks it
+    assert scales[0]['action'] == 'grow'
+    assert scales[0]['capacity'] >= scales[0]['desired']
+    assert scales[-1]['action'] == 'shrink'
+    assert trace[-1]['autoscaled'] == len(scales)
+
+
+def test_drain_migrates_preemptible_jobs_off_the_host(mt_trace):
+    cfg, trace = mt_trace
+    k = _kinds(trace)
+    drains = k.get('host_drain', [])
+    assert len(drains) == 1
+    host = drains[0]['host']
+    drained = [e for e in k.get('job_suspend', [])
+               if e['reason'] == 'drain']
+    assert drained, 'drain never suspended a running job'
+    migrated = k.get('job_migrate', [])
+    assert migrated, 'suspended jobs never migrated'
+    # every drain-suspended job comes back on hosts that exclude the
+    # draining one
+    for e in drained:
+        moves = [m for m in migrated
+                 if m['job'] == e['job'] and m['t'] >= e['t']]
+        assert moves, f'job {e["job"]} never left {host}'
+        assert all(host not in m['dst'].split(',') for m in moves)
+
+
 # -- CLI ---------------------------------------------------------------------
 
 
