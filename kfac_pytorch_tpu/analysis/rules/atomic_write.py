@@ -25,11 +25,14 @@ from kfac_pytorch_tpu.analysis import astutil
 from kfac_pytorch_tpu.analysis.core import Finding, ModuleInfo, \
     RepoContext, Rule
 
-#: modules that IMPLEMENT the atomicity discipline (the shared helper
-#: and the coordination backends) — everything else routes through them
+#: modules that IMPLEMENT the atomicity discipline (the shared helper,
+#: the coordination backends, and the object-store backends whose
+#: tmp+fsync+replace put IS the checkpoint plane's atomic commit) —
+#: everything else routes through them
 IMPLEMENTATIONS = (
     'kfac_pytorch_tpu/resilience/__init__.py',
     'kfac_pytorch_tpu/coord/',
+    'kfac_pytorch_tpu/store/',
 )
 
 _WRITE_MODES = ('w', 'wt', 'w+', 'wb', 'x', 'xt')

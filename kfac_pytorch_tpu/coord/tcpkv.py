@@ -34,6 +34,7 @@ exactly as their directories did.
 """
 
 import argparse
+import bisect
 import contextlib
 import json
 import logging
@@ -63,6 +64,12 @@ class TcpKvServer:
         self._lock = threading.Lock()
         # key -> [value, version, expires|None, last_writer_token|None]
         self._store = {}
+        # sorted key index: every prefix op (get_many / list /
+        # delete_prefix) walks ONE contiguous bisect range instead of
+        # scanning the whole store — with thousands of co-hosted pod
+        # namespaces on one server (the 10k-host fleet), a full scan
+        # per heartbeat read is quadratic in fleet size
+        self._keys = []
         self._rev = 0
         self._stopped = False
         self._sweep_interval = float(sweep_interval)
@@ -89,6 +96,23 @@ class TcpKvServer:
         for key in [k for k, e in self._store.items()
                     if self._expired(e, now)]:
             del self._store[key]
+            self._index_drop(key)
+
+    def _index_drop(self, key):
+        i = bisect.bisect_left(self._keys, key)
+        if i < len(self._keys) and self._keys[i] == key:
+            del self._keys[i]
+
+    def _prefix_keys(self, prefix):
+        """Keys with ``prefix``, sorted — strings sharing a prefix are
+        one contiguous block in lexicographic order, so this is
+        O(log n + matches), never a whole-store scan."""
+        i = bisect.bisect_left(self._keys, prefix)
+        out = []
+        while i < len(self._keys) and self._keys[i].startswith(prefix):
+            out.append(self._keys[i])
+            i += 1
+        return out
 
     def op(self, req):
         """One request dict -> one response dict."""
@@ -125,28 +149,34 @@ class TcpKvServer:
                 self._rev += 1
                 ttl = req.get('ttl')
                 expires = now + float(ttl) if ttl else None
+                if key not in self._store:
+                    bisect.insort(self._keys, key)
                 self._store[key] = [req.get('value'), self._rev,
                                     expires, token]
                 return {'ok': True, 'version': self._rev}
             if kind == 'delete':
                 e = self._store.pop(key, None)
+                if e is not None:
+                    self._index_drop(key)
                 return {'ok': True,
                         'found': e is not None
                         and not self._expired(e, now)}
             if kind == 'delete_prefix':
-                hit = [k for k in self._store if k.startswith(key)]
+                hit = self._prefix_keys(key)
                 for k in hit:
                     del self._store[k]
+                if hit:
+                    i = bisect.bisect_left(self._keys, key)
+                    del self._keys[i:i + len(hit)]
                 return {'ok': True, 'count': len(hit)}
             if kind == 'list':
-                keys = sorted(k for k, e in self._store.items()
-                              if k.startswith(key)
-                              and not self._expired(e, now))
+                keys = [k for k in self._prefix_keys(key)
+                        if not self._expired(self._store[k], now)]
                 return {'ok': True, 'keys': keys}
             if kind == 'get_many':
-                live = {k: e for k, e in self._store.items()
-                        if k.startswith(key)
-                        and not self._expired(e, now)}
+                live = {k: self._store[k]
+                        for k in self._prefix_keys(key)
+                        if not self._expired(self._store[k], now)}
                 # versions ride along so a Watch poll is ONE round trip
                 # (clients on an older server fall back to per-key gets)
                 return {'ok': True,

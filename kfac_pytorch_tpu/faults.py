@@ -121,6 +121,10 @@ from kfac_pytorch_tpu.resilience.chaos_net import NET_ENVS  # noqa: E402
 # jax-free coord.chaos layer, registered here so the strict from_env
 # validates the whole drill surface at build time
 from kfac_pytorch_tpu.coord.chaos import COORD_ENVS  # noqa: E402
+# ... and the object-store chaos lanes (torn uploads, partial/stale
+# reads, 503 windows, lost put acks): defined and CONSUMED by the
+# jax-free store.chaos layer, registered here for the same reason
+from kfac_pytorch_tpu.store.chaos import STORE_ENVS  # noqa: E402
 # the central env registry: the strict check derives its known-set
 # from the declarations, so "documented" and "accepted" can never
 # drift apart (kfac-lint's env-contract rule checks the read sites
@@ -138,7 +142,7 @@ _CONSUMED = frozenset({
     ENV_NAN_GRAD, ENV_INF_GRAD, ENV_STATS, ENV_FACTOR, ENV_EIGH,
     ENV_SIGTERM, ENV_CKPT, ENV_HANG, ENV_SLOW, ENV_SLOW_SECS, ENV_CRASH,
     ENV_CRASH_MODE, ENV_DATA, ENV_ONCE_DIR, ENV_HB_STOP,
-}) | NET_ENVS | COORD_ENVS
+}) | NET_ENVS | COORD_ENVS | STORE_ENVS
 if _CONSUMED != KNOWN_ENVS:  # pragma: no cover — import-time contract
     raise RuntimeError(
         'faults/envspec drift: undeclared drill env(s) '
@@ -246,6 +250,11 @@ def from_env() -> FaultConfig:
     # time, like every other drill
     from kfac_pytorch_tpu.coord import chaos as _coord_chaos
     _coord_chaos.from_env()
+    # validate-only likewise: the object-store chaos schedule is
+    # consumed by store.chaos (every store construction site wraps
+    # through maybe_wrap), but a malformed spec must die here too
+    from kfac_pytorch_tpu.store import chaos as _store_chaos
+    _store_chaos.from_env()
     mode = os.environ.get(ENV_CKPT) or None
     if mode is not None and mode not in ('truncate', 'fail', 'eio_once'):
         raise ValueError(f'{ENV_CKPT} must be "truncate", "fail" or '

@@ -424,6 +424,46 @@ class PodSupervisor:
             period if period is not None else self.poll_period,
             clock=self.clock, rng=self.rng, total=self._poll_wait)
 
+    def _watch_set(self, *prefixes):
+        """Change feeds over ``prefixes``, or None when the plain
+        poll-paced scan must stay: backends without ``watch``, a feed
+        that failed to open, and chaos-net runs (the partition matrix
+        changes REACHABILITY with no key change — invisible to any
+        change feed). All-or-nothing: a partial set would make the
+        missing prefix's events silently invisible, so one failed feed
+        disables the whole gate rather than half of it."""
+        if self.net_chaos is not None:
+            return None
+        watch_fn = getattr(self.coord, 'watch', None)
+        if not callable(watch_fn):
+            return None
+        watches = []
+        for prefix in prefixes:
+            try:
+                watches.append(watch_fn(prefix))
+            except OSError:
+                return None
+        return watches or None
+
+    def _watch_changed(self, watches):
+        """True when ANY feed reports a change since the last call.
+        Every feed is polled — no short-circuit, each must drain its
+        own events or a quiet prefix masks a busy one forever — and a
+        failed poll counts as changed (the watch is an optimization,
+        never a correctness gate). A backend GIVE-UP propagates: the
+        calling loop exits :data:`RC_COORD_LOST` rather than settling
+        membership on a blind feed."""
+        changed = False
+        for w in watches:
+            try:
+                if w.poll():
+                    changed = True
+            except CoordGiveUp:
+                raise
+            except OSError:
+                changed = True
+        return changed
+
     # -- lineage epoch + graceful-departure markers -----------------------
 
     def _read_lineage(self):
@@ -839,14 +879,25 @@ class PodSupervisor:
         expected = set(self.members) - set(dead)
         start = self.clock.monotonic()
         pace = self._new_pace()
+        # watch-driven settle: both sides of the break condition only
+        # move on a key write — a claim under the barrier dir or a
+        # done- departure marker — so gate the re-reads on change feeds
+        # over exactly those two prefixes. Feeds open BEFORE the first
+        # scan (a claim landing in the gap surfaces in the first poll)
+        # and the first iteration always scans; PollPacer keeps pacing
+        # as the fallback for watchless backends and chaos-net runs.
+        watches = self._watch_set(claim_dir + '/', 'done-')
+        changed = True
         while self.clock.monotonic() - start < self.shrink_timeout:
             # a host that finishes cleanly MID-barrier never claims:
             # drop fresh departures from the expected set instead of
             # burning the whole timeout waiting for a ghost
-            if expected - self._departed() <= set(
+            if changed and expected - self._departed() <= set(
                     self._read_claims(claim_dir)):
                 break
             pace.sleep()
+            if watches is not None:
+                changed = self._watch_changed(watches)
         # settle: a late claim from a host we wrote off means it is
         # alive after all — better to keep it than split-brain
         self.clock.sleep(self.settle)
@@ -943,21 +994,20 @@ class PodSupervisor:
         start = self.clock.monotonic()
         pace = self._new_pace()
         # watch-driven settle (ISSUE 14 / coord follow-on): gate the
-        # expensive claim re-read on the backend's change feed over the
-        # barrier prefix instead of re-scanning every poll — a new
-        # claimant (including a joiner we never heard announce) shows
-        # up as a watch event before it can matter to the expected-set
-        # condition. PollPacer stays as the pacing fallback: backends
-        # without watch (a custom CoordBackend predating it) and
-        # chaos-net runs (the partition matrix changes REACHABILITY
-        # with no key change, which a pure change feed cannot see)
-        # keep the plain poll-paced scan.
-        watch = None
-        if self.net_chaos is None:
-            watch_fn = getattr(self.coord, 'watch', None)
-            if callable(watch_fn):
-                with contextlib.suppress(OSError):
-                    watch = watch_fn(claim_dir + '/')
+        # expensive claim re-reads on the backend's change feeds over
+        # the grow barrier, the rival SHRINK barrier, and the join
+        # announcements — a new claimant (including a joiner we never
+        # heard announce), a shrink claim that must win the lane, and
+        # a fresh announcer all arrive as key writes before they can
+        # matter to the loop's conditions. PollPacer stays as the
+        # pacing fallback: backends without watch (a custom
+        # CoordBackend predating it) and chaos-net runs (the partition
+        # matrix changes REACHABILITY with no key change, which a pure
+        # change feed cannot see) keep the plain poll-paced scan.
+        watches = self._watch_set(claim_dir + '/',
+                                  self._claim_dir(next_gen) + '/',
+                                  'join-')
+        changed = True
         while self.clock.monotonic() - start < self.grow_timeout:
             # SHRINK LANE WINS: a join announcement racing an
             # unconfirmed peer death can put peers in the shrink
@@ -966,8 +1016,10 @@ class PodSupervisor:
             # shrink claim (or a death our own monitor confirms
             # mid-barrier) abandons the grow: withdraw our claim so a
             # waiting joiner cannot stabilize on it, and let the
-            # normal shrink path run at the next loop.
-            if (self._read_claims(self._claim_dir(next_gen))
+            # normal shrink path run at the next loop. The monitor's
+            # verdict is local state (no key write), so it stays a
+            # per-iteration check even when the feeds are quiet.
+            if ((changed and self._read_claims(self._claim_dir(next_gen)))
                     or self._confirmed_dead()):
                 with contextlib.suppress(OSError):
                     self.coord.delete(
@@ -978,16 +1030,6 @@ class PodSupervisor:
                     'lane wins)', next_gen)
                 self.report.add_event('grow_yielded', gen=next_gen)
                 return False
-            changed = True
-            if watch is not None:
-                try:
-                    changed = bool(watch.poll())
-                except CoordGiveUp:
-                    raise
-                except OSError:
-                    # a failed poll degrades to the plain scan — the
-                    # watch is an optimization, never a correctness gate
-                    changed = True
             if changed:
                 claims = self._read_claims(claim_dir, prefix='member-')
                 # expected = incumbents + every announcer + everyone who
@@ -998,6 +1040,8 @@ class PodSupervisor:
                 if expected <= set(claims):
                     break
             pace.sleep()
+            if watches is not None:
+                changed = self._watch_changed(watches)
         # settle: a straggling claimant (joiner slow to scan the new
         # barrier dir, incumbent slow to stop its trainer) makes it in
         self.clock.sleep(self.settle)
@@ -1335,49 +1379,67 @@ class PodSupervisor:
         {'exit', 'peer_dead', 'fenced', 'grow', 'suspend'}."""
         next_lane_check = 0.0
         pace = self._new_pace()
+        # watch-driven lanes: every coordination read this loop
+        # interleaves with child polls — the next generation's shrink
+        # and grow barriers, the join announcements, the scheduler's
+        # suspend marker — is triggered by a key write, so gate them
+        # all on change feeds and the steady-state cost of a HEALTHY
+        # pod drops to O(changes) instead of O(polls). Watchless
+        # backends and chaos-net runs keep the old shape: the shrink
+        # scan every iteration, the join/suspend lanes once per
+        # hb_interval (two extra lease-dir listings per check is
+        # network traffic on the shared filesystems real pods use).
+        watches = self._watch_set(self._claim_dir(self.gen + 1) + '/',
+                                  self._grow_dir(self.gen + 1) + '/',
+                                  'join-', SUSPEND_KEY)
+        changed = True
         while True:
             rc = self.child.poll()
             if rc is not None:
                 return rc, 'exit'
             if self._terminating:
                 return self.child.wait(), 'exit'
+            # the monitor's verdict is local state (no key write): a
+            # per-iteration check whether or not the feeds are quiet
             if self._confirmed_dead():
                 self.log.warning('pod-supervisor: peer death confirmed '
                                  'while the trainer is still up — '
                                  'stopping it for the shrink')
                 self._terminate_child()
                 return self.child.poll(), 'peer_dead'
-            if self._peer_shrink_started():
+            if watches is not None:
+                scan_shrink = scan_lanes = changed
+            else:
+                scan_shrink = True
+                now = self.clock.monotonic()
+                scan_lanes = now >= next_lane_check
+                if scan_lanes:
+                    next_lane_check = now + self.hb_interval
+            if scan_shrink and self._peer_shrink_started():
                 dead = self._wait_for_confirmation(
                     'peers began a shrink')
                 if dead:
                     self._terminate_child()
                     return self.child.poll(), 'peer_dead'
                 return None, 'fenced'
-            # the join lane: a repaired host announced itself (or a
-            # peer already opened the grow barrier we missed the
-            # announcement for). Unlike uncorroborated SHRINK claims
-            # this is never a fence signal — the claims include us.
-            # Stop the trainer at this boundary and run the barrier.
-            # Scanned once per hb_interval, not per poll: these are
-            # two extra lease-dir listings + reads, and on the shared
-            # filesystems real pods use that is network traffic — join
-            # latency is bounded by the barrier timeouts anyway.
-            now = self.clock.monotonic()
-            if now >= next_lane_check:
-                next_lane_check = now + self.hb_interval
+            if scan_lanes:
                 # the suspend lane: the scheduler asked this pod to
                 # checkpoint-suspend (preemption / drain). Stop the
                 # trainer at this boundary (SIGTERM — its
                 # PreemptionGuard banks the grace-window checkpoint)
-                # and exit RC_SUSPENDED; paced with the join lane for
-                # the same reason (a lease-namespace read per check).
+                # and exit RC_SUSPENDED.
                 if self._suspend_requested() is not None:
                     self.log.warning('pod-supervisor: suspend '
                                      'requested — stopping the trainer '
                                      'at this checkpoint boundary')
                     self._terminate_child()
                     return self.child.poll(), 'suspend'
+                # the join lane: a repaired host announced itself (or
+                # a peer already opened the grow barrier we missed the
+                # announcement for). Unlike uncorroborated SHRINK
+                # claims this is never a fence signal — the claims
+                # include us. Stop the trainer at this boundary and
+                # run the barrier.
                 if self._join_announced() or self._peer_grow_started():
                     self.log.warning('pod-supervisor: join announced — '
                                      'stopping the trainer for the grow '
@@ -1385,6 +1447,8 @@ class PodSupervisor:
                     self._terminate_child()
                     return self.child.poll(), 'grow'
             pace.sleep()
+            if watches is not None:
+                changed = self._watch_changed(watches)
 
     def _run_loop(self):
         from kfac_pytorch_tpu.utils.runlog import resilience_suffix

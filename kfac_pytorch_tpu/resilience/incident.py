@@ -34,6 +34,7 @@ _CUMULATIVE = frozenset({
     'shrinks', 'grows', 'joins', 'straggler_level',
     'partition_suspected', 'quorum_lost', 'coord_lost',
     'coord_retries', 'coord_gave_ups', 'poll_wait_s',
+    'store_lost', 'store_retries', 'store_gave_ups',
 })
 # (the replicated backend's replica_down/replica_repair/quorum_degraded
 # suffixes are per-event deltas — =1 each emission — so they take the
@@ -107,6 +108,32 @@ _PATTERNS = (
         r'(?P<attempts>\d+) attempts')),
     ('coord_lost', re.compile(
         r'coordination backend lost — .*exiting rc=(?P<rc>\d+)')),
+    # the durable checkpoint plane (kfac_pytorch_tpu/store): per-op
+    # retries surface as store_retries= counters; a spent budget is the
+    # give-up on ONE op (store.base.RetryingStore) and the trainer/
+    # verifier-level verdict that follows (rc=120, check the OBJECT
+    # STORE, not the pod and not the coord backend). The manifest
+    # lifecycle narrates alongside: the commit point of every save, the
+    # scrub's clean verdict, each corrupt blob it (or a restore's hash
+    # check) caught, and each repair — so a durability timeline reads
+    # ckpt_commit -> ckpt_corrupt -> ckpt_repair -> ckpt_verify with
+    # zero new aggregation code
+    ('store_gave_up', re.compile(
+        r'store: giving up op=(?P<op>[\w_]+) key=(?P<key>\S*) after '
+        r'(?P<attempts>\d+) attempts')),
+    ('store_lost', re.compile(
+        r'checkpoint store lost — .*exiting rc=(?P<rc>\d+)')),
+    ('ckpt_commit', re.compile(
+        r'ckpt: committed manifest epoch=(?P<epoch>\d+) '
+        r'blobs=(?P<blobs>\d+) kind=(?P<kind>\w+)')),
+    ('ckpt_verify', re.compile(
+        r'ckpt: verified epoch=(?P<epoch>\d+) blobs=(?P<blobs>\d+)')),
+    ('ckpt_corrupt', re.compile(
+        r'ckpt: corrupt blob key=(?P<key>\S+) epoch=(?P<epoch>\d+) '
+        r'reason=(?P<reason>\w+)')),
+    ('ckpt_repair', re.compile(
+        r'ckpt: repaired blob key=(?P<key>\S+) epoch=(?P<epoch>\d+) '
+        r'source=(?P<source>\S+)')),
     # the replicated quorum (coord.replicated): one replica's loss,
     # its read-through catch-up after a restart, and the degraded-
     # but-answering state between them — so an operator's timeline

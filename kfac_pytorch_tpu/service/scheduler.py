@@ -93,7 +93,8 @@ log = logging.getLogger(__name__)
 #: STOP_RC_NAMES inverted, plus 0); anything else nonzero is a crash.
 RC_CLASSES = {0: 'done', 113: 'crash', 114: 'hang', 115: 'peer_dead',
               116: 'join_failed', 117: 'fenced',
-              RC_COORD_LOST: 'coord_lost', 119: 'suspended'}
+              RC_COORD_LOST: 'coord_lost', 119: 'suspended',
+              120: 'store_lost'}
 
 #: resilience.elastic's RC_SUSPENDED / SUSPEND_KEY spelled as literals
 #: (the supervisor.py precedent for 113) so the scheduler stays

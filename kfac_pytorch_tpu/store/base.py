@@ -1,0 +1,298 @@
+"""The object-store contract: the durability plane under the
+checkpoint system, named the way ``coord.base`` names the
+coordination plane.
+
+Checkpoints, manifests and run artifacts are *objects*: opaque byte
+blobs under hierarchical keys, written whole, read whole, and — the
+property everything above relies on — **never observable half-written**.
+:class:`ObjectStore` names the five primitives the checkpoint plane
+actually uses:
+
+- ``get(key) -> Blob | None`` — the full bytes plus the generation
+  token they were committed under; missing is ``None`` (transient
+  backend failures raise, they are not "missing").
+- ``head(key) -> Meta | None`` — generation + size without the bytes
+  (the scrub scan's primitive: a verifier sizing a namespace must not
+  download it).
+- ``put(key, data, if_generation=...) -> generation | None`` —
+  atomic whole-object commit with an optional precondition:
+  :data:`ANY` skips the check (unconditional), ``None`` means *create
+  only if absent*, a generation token means *replace exactly that
+  version*. ``None`` return is a precondition ANSWER (someone else
+  moved the object), never an error.
+- ``delete(key)`` / ``delete_prefix(prefix)`` — idempotent removal.
+- ``list(prefix)`` / ``list_meta(prefix)`` — prefix scans.
+
+Generations are content hashes (sha256 of the object bytes,
+truncated) on every backend, so the SAME object has the SAME
+generation on the posix store and on the HTTP store — the contract
+tests pin that, and it is what lets ``kfac-ckpt-verify`` repair a blob
+from a mirror by token equality alone.
+
+Error model: every transient failure raises :class:`StoreTimeout` (an
+:class:`OSError` subclass — the callers' existing flaky-filesystem
+handling applies verbatim); :class:`RetryingStore` adds the bounded
+per-op retry with the loud ``[resilience: store_gave_up=1]`` give-up
+that escalates to :data:`~kfac_pytorch_tpu.store.RC_STORE_LOST`.
+
+Zero dependencies, jax-free (``kfac-ckpt-verify`` runs without a
+training environment).
+"""
+
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def _res():
+    # lazy: the resilience package may import store consumers — a
+    # module-level import back into it would make import order matter
+    from kfac_pytorch_tpu import resilience
+    return resilience
+
+
+class StoreError(OSError):
+    """Base class for object-store failures. An ``OSError`` on
+    purpose: checkpoint writers already treat storage failures as
+    OSErrors (retry policies, scan-downward resume)."""
+
+
+class StoreTimeout(StoreError):
+    """A transient backend failure (unreachable server, 503 window,
+    upload died mid-stream). Retryable."""
+
+
+class StoreGiveUp(StoreError):
+    """The retry budget for one operation is spent. Raised by
+    :class:`RetryingStore` after logging the loud give-up form; the
+    checkpoint plane exits :data:`~kfac_pytorch_tpu.store.RC_STORE_LOST`
+    on it instead of wedging against a dead durability plane."""
+
+
+class _Any:
+    def __repr__(self):
+        return '<store.ANY>'
+
+
+#: ``put`` precondition sentinel: skip the generation check
+#: (unconditional write — distinct from ``if_generation=None``, which
+#: means "create only if the object does not exist yet").
+ANY = _Any()
+
+
+class Blob:
+    """A read result: the object bytes plus the generation token they
+    were committed under (feed it back to ``put(if_generation=...)``)."""
+
+    __slots__ = ('data', 'generation')
+
+    def __init__(self, data, generation):
+        self.data = data
+        self.generation = generation
+
+    def __iter__(self):  # tuple-unpack convenience: data, gen = blob
+        yield self.data
+        yield self.generation
+
+    def __repr__(self):
+        return (f'Blob({len(self.data)} bytes, '
+                f'generation={self.generation!r})')
+
+
+class Meta:
+    """A ``head`` result: generation + size, no bytes."""
+
+    __slots__ = ('generation', 'size')
+
+    def __init__(self, generation, size):
+        self.generation = generation
+        self.size = int(size)
+
+    def __repr__(self):
+        return f'Meta(generation={self.generation!r}, size={self.size})'
+
+
+def check_key(key):
+    """Keys are relative ``/``-joined paths; reject escapes so a POSIX
+    backend can never be walked out of its root."""
+    key = str(key)
+    if not key or key.startswith('/') or '\\' in key:
+        raise ValueError(f'bad store key {key!r}')
+    if any(part in ('', '.', '..') for part in key.split('/')):
+        raise ValueError(f'bad store key {key!r}')
+    return key
+
+
+def check_prefix(prefix):
+    """Prefixes share the key grammar ('' = everything, one trailing
+    ``/`` allowed) — and the same escape rejection."""
+    prefix = str(prefix)
+    if not prefix:
+        return prefix
+    if prefix.startswith('/') or '\\' in prefix:
+        raise ValueError(f'bad store prefix {prefix!r}')
+    parts = prefix.split('/')
+    if parts and parts[-1] == '':
+        parts = parts[:-1]
+    if any(part in ('', '.', '..') for part in parts):
+        raise ValueError(f'bad store prefix {prefix!r}')
+    return prefix
+
+
+class ObjectStore:
+    """Interface + shared conveniences. Subclasses implement ``get``,
+    ``head``, ``put``, ``delete``, ``delete_prefix`` and ``list``."""
+
+    # -- required primitives ----------------------------------------------
+
+    def get(self, key):
+        raise NotImplementedError
+
+    def head(self, key):
+        raise NotImplementedError
+
+    def put(self, key, data, *, if_generation=ANY, token=None):
+        """``token``: optional idempotency token for replay-safe puts
+        over a lossy wire — a backend that can remember the last
+        applied writer (the HTTP server) answers a REPLAY of the same
+        token with the original success instead of a precondition
+        conflict against its own write. Local backends may ignore it
+        (their commit cannot lose an ack)."""
+        raise NotImplementedError
+
+    def delete(self, key):
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix):
+        raise NotImplementedError
+
+    def list(self, prefix=''):
+        raise NotImplementedError
+
+    # -- derived ----------------------------------------------------------
+
+    def list_meta(self, prefix=''):
+        """{key: Meta} for every object under ``prefix`` — the scrub
+        scan. Derived default is list + head per key; backends with a
+        server-side scan override it with ONE round trip."""
+        out = {}
+        for key in self.list(prefix):
+            meta = self.head(key)
+            if meta is not None:
+                out[key] = meta
+        return out
+
+    def close(self):
+        pass
+
+
+def default_retry_policy():
+    """Default per-op policy: small, bounded, jittered — a store op
+    sits inside the checkpoint critical path (and the preemption grace
+    window), so the whole budget must stay in the seconds range (give
+    up loudly rather than stall a grace-window save past its
+    deadline)."""
+    from kfac_pytorch_tpu.resilience.retry import RetryPolicy
+    return RetryPolicy(attempts=5, base_delay=0.1, max_delay=2.0,
+                       multiplier=2.0, jitter=0.5,
+                       retry_on=(StoreTimeout,))
+
+
+class RetryingStore(ObjectStore):
+    """Per-op bounded retry (backoff + jitter) around any store.
+
+    Every retry bumps the process-global ``store_retries`` counter;
+    exhausting the budget logs the machine-greppable give-up form and
+    raises :class:`StoreGiveUp` so the caller can exit
+    :data:`~kfac_pytorch_tpu.store.RC_STORE_LOST` instead of wedging.
+    Precondition conflicts are answers, not failures — they never
+    retry.
+    """
+
+    def __init__(self, inner, *, policy=None, clock=None, rng=None,
+                 log=None):
+        import random
+
+        from kfac_pytorch_tpu.resilience.retry import REAL_CLOCK
+        self.inner = inner
+        self.policy = policy or default_retry_policy()
+        self.clock = clock or REAL_CLOCK
+        self.rng = rng or random
+        self.log = log if log is not None else logging.getLogger(__name__)
+        self._lock = threading.Lock()
+        self._retries = 0
+        self._gave_up = 0
+        self._wait_s = 0.0
+
+    def stats(self):
+        with self._lock:
+            return {'retries': self._retries, 'gave_up': self._gave_up,
+                    'wait_s': self._wait_s}
+
+    def _call(self, op, key, fn):
+        last = None
+        for attempt in range(self.policy.attempts):
+            try:
+                return fn()
+            except self.policy.retry_on as e:
+                last = e
+                if attempt == self.policy.attempts - 1:
+                    break
+                delay = self.policy.delay(attempt, self.rng)
+                with self._lock:
+                    self._retries += 1
+                    self._wait_s += delay
+                _res().counters.bump('store_retries')
+                self.log.warning(
+                    'store: retry %d/%d op=%s key=%s in %.2fs after: %s',
+                    attempt + 1, self.policy.attempts - 1, op, key,
+                    delay, e)
+                self.clock.sleep(delay)
+        with self._lock:
+            self._gave_up += 1
+        _res().counters.bump('store_gave_ups')
+        self.log.error(
+            'store: giving up op=%s key=%s after %d attempts (%s) '
+            '[resilience: store_gave_up=1]', op, key,
+            self.policy.attempts, last)
+        raise StoreGiveUp(
+            f'object store op {op} on {key!r} failed '
+            f'{self.policy.attempts} times: {last}') from last
+
+    # -- delegated ops ----------------------------------------------------
+
+    def get(self, key):
+        return self._call('get', key, lambda: self.inner.get(key))
+
+    def head(self, key):
+        return self._call('head', key, lambda: self.inner.head(key))
+
+    def put(self, key, data, *, if_generation=ANY, token=None):
+        # ONE idempotency token per logical put, shared by every retry
+        # attempt: an ack lost after the server committed the object
+        # must read as success on the replay, never as a precondition
+        # self-conflict that makes the caller believe someone else
+        # moved the object
+        if token is None:
+            import os as _os
+            token = _os.urandom(8).hex()
+        return self._call('put', key, lambda: self.inner.put(
+            key, data, if_generation=if_generation, token=token))
+
+    def delete(self, key):
+        return self._call('delete', key, lambda: self.inner.delete(key))
+
+    def delete_prefix(self, prefix):
+        return self._call('delete_prefix', prefix,
+                          lambda: self.inner.delete_prefix(prefix))
+
+    def list(self, prefix=''):
+        return self._call('list', prefix, lambda: self.inner.list(prefix))
+
+    def list_meta(self, prefix=''):
+        return self._call('list_meta', prefix,
+                          lambda: self.inner.list_meta(prefix))
+
+    def close(self):
+        self.inner.close()

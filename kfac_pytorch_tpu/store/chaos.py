@@ -1,0 +1,299 @@
+"""Deterministic fault injection at the object-store level — the
+``coord.chaos`` idiom applied to the durability plane.
+
+``KFAC_FAULT_CKPT_*`` makes the checkpoint *writer* misbehave (one
+injected EIO, a truncated file). What it cannot exercise is the store
+*itself* failing under a correct writer: an upload dying mid-stream, a
+read coming back short or stale, the backend serving 503s for a
+window, or a committed put whose ack never arrives. :class:`ChaosStore`
+wraps any :class:`~.base.ObjectStore` and injects exactly those, with
+every decision a pure SHA-256 function of ``(seed, op, key, attempt)``
+— identical env + identical op sequence ⇒ identical fault schedule,
+which is what the determinism tests pin.
+
+Env contract (``KFAC_FAULT_STORE_*``, registered in ``faults.py``'s
+STRICT ``from_env`` so a typo'd drill fails loudly at build time):
+
+  KFAC_FAULT_STORE_SEED     int; presence arms the chaos layer
+  KFAC_FAULT_STORE_FAIL     P(an op raises StoreTimeout)         [0, 1]
+  KFAC_FAULT_STORE_TORN     P(a put dies mid-upload: NOTHING is
+                            committed — the torn-upload drill; the
+                            atomicity contract says a reader must see
+                            the old object or none, never a partial)
+  KFAC_FAULT_STORE_PARTIAL  P(a get returns a PREFIX of the bytes —
+                            the bit-rot/short-transfer drill the
+                            manifest hash check must catch)
+  KFAC_FAULT_STORE_STALE    P(a get returns the PREVIOUS blob this
+                            process saw for the key)
+  KFAC_FAULT_STORE_ACK_LOST P(a put COMMITS but its ack is lost — the
+                            replay drill: the retry must land as the
+                            original success via the idempotency
+                            token, never as a self-conflict)
+  KFAC_FAULT_STORE_WINDOWS  unavailability windows "10:40;90:95"
+                            relative to T0 — every op inside a window
+                            raises StoreTimeout (the 503-outage drill
+                            the RetryPolicy must ride out or give up
+                            on loudly)
+  KFAC_FAULT_STORE_T0       wall-clock base of the windows (default:
+                            config load time)
+
+Faults apply at the WRAPPER, so both backends (and any future one) are
+drillable identically; the retry layer sits OUTSIDE the chaos wrapper,
+which is the point — retries are the system under test.
+"""
+
+import collections
+import dataclasses
+import hashlib
+import os
+import time
+from typing import Tuple
+
+from kfac_pytorch_tpu.store.base import (
+    ANY, Blob, ObjectStore, StoreTimeout)
+
+ENV_STORE_SEED = 'KFAC_FAULT_STORE_SEED'
+ENV_STORE_FAIL = 'KFAC_FAULT_STORE_FAIL'
+ENV_STORE_TORN = 'KFAC_FAULT_STORE_TORN'
+ENV_STORE_PARTIAL = 'KFAC_FAULT_STORE_PARTIAL'
+ENV_STORE_STALE = 'KFAC_FAULT_STORE_STALE'
+ENV_STORE_ACK_LOST = 'KFAC_FAULT_STORE_ACK_LOST'
+ENV_STORE_WINDOWS = 'KFAC_FAULT_STORE_WINDOWS'
+ENV_STORE_T0 = 'KFAC_FAULT_STORE_T0'
+
+STORE_ENVS = frozenset({
+    ENV_STORE_SEED, ENV_STORE_FAIL, ENV_STORE_TORN, ENV_STORE_PARTIAL,
+    ENV_STORE_STALE, ENV_STORE_ACK_LOST, ENV_STORE_WINDOWS,
+    ENV_STORE_T0,
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreFaultConfig:
+    seed: int = 0
+    fail: float = 0.0
+    torn: float = 0.0
+    partial: float = 0.0
+    stale: float = 0.0
+    ack_lost: float = 0.0
+    windows: Tuple[Tuple[float, float], ...] = ()
+    t0: float = 0.0
+
+    @property
+    def any_chaos(self):
+        return bool(self.fail or self.torn or self.partial or self.stale
+                    or self.ack_lost or self.windows)
+
+    def unavailable(self, wall):
+        rel = wall - self.t0
+        return any(lo <= rel < hi for lo, hi in self.windows)
+
+
+def _prob_env(env, e):
+    raw = e.get(env)
+    if not raw:
+        return 0.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f'{env} must be a probability in [0, 1], '
+                         f'got {raw!r}') from None
+    if not 0.0 <= v <= 1.0:
+        raise ValueError(f'{env} must be in [0, 1], got {v}')
+    return v
+
+
+def from_env(env=None):
+    """Snapshot the store-fault environment, or None when no
+    ``KFAC_FAULT_STORE_*`` variable is set. STRICT like
+    ``faults.from_env`` (which delegates validation here)."""
+    from kfac_pytorch_tpu.coord.chaos import parse_windows
+    e = os.environ if env is None else env
+    if not any(k in e for k in STORE_ENVS):
+        return None
+    raw_seed = e.get(ENV_STORE_SEED, '0')
+    try:
+        seed = int(raw_seed)
+    except ValueError:
+        raise ValueError(f'{ENV_STORE_SEED} must be an integer, '
+                         f'got {raw_seed!r}') from None
+    raw_t0 = e.get(ENV_STORE_T0)
+    try:
+        t0 = float(raw_t0) if raw_t0 else time.time()
+    except ValueError:
+        raise ValueError(f'{ENV_STORE_T0} must be a wall timestamp, '
+                         f'got {raw_t0!r}') from None
+    spec = e.get(ENV_STORE_WINDOWS)
+    return StoreFaultConfig(
+        seed=seed,
+        fail=_prob_env(ENV_STORE_FAIL, e),
+        torn=_prob_env(ENV_STORE_TORN, e),
+        partial=_prob_env(ENV_STORE_PARTIAL, e),
+        stale=_prob_env(ENV_STORE_STALE, e),
+        ack_lost=_prob_env(ENV_STORE_ACK_LOST, e),
+        windows=(parse_windows(spec, env=ENV_STORE_WINDOWS)
+                 if spec else ()),
+        t0=t0)
+
+
+def _u(cfg, op, key, attempt, lane):
+    """One uniform draw in [0, 1): a pure function of
+    ``(seed, op, key, attempt)`` per fault lane — the determinism
+    contract (SHA-256, stable across runs and interpreters)."""
+    digest = hashlib.sha256(
+        f'{cfg.seed}:{op}:{key}:{attempt}'.encode()).digest()
+    i = lane * 8
+    return int.from_bytes(digest[i:i + 8], 'big') / 2 ** 64
+
+
+class ChaosStore(ObjectStore):
+    """Wrap a store; inject the seeded fault schedule. ``trace``
+    records every injected fault as ``(kind, op, key, attempt)`` —
+    bounded, like the coordination chaos trace."""
+
+    def __init__(self, inner, cfg, *, wall=time.time):
+        self.inner = inner
+        self.cfg = cfg
+        self._wall = wall
+        self._attempts = {}          # (op, key) -> count
+        self._last_seen = {}         # key -> previous Blob (stale lane)
+        self.trace = collections.deque(maxlen=65536)
+        self.counts = collections.Counter()
+
+    def __repr__(self):
+        return f'ChaosStore({self.inner!r})'
+
+    def _attempt(self, op, key):
+        if len(self._attempts) > 65536:
+            # bounded backstop (delete-op counters survive eviction):
+            # keep the most recent half, insertion-ordered
+            self._attempts = dict(
+                list(self._attempts.items())[-32768:])
+        k = (op, str(key))
+        self._attempts[k] = n = self._attempts.get(k, 0) + 1
+        return n
+
+    def _inject(self, kind, op, key, attempt):
+        self.counts[kind] += 1
+        self.trace.append((kind, op, str(key), attempt))
+
+    def _gate(self, op, key):
+        """The fail/window lane shared by every op; returns the attempt
+        index for the op-specific lanes."""
+        attempt = self._attempt(op, key)
+        if self.cfg.windows and self.cfg.unavailable(self._wall()):
+            self._inject('window', op, key, attempt)
+            raise StoreTimeout(
+                f'injected store 503 window (op={op} key={key})')
+        if self.cfg.fail and _u(self.cfg, op, key, attempt, 0) \
+                < self.cfg.fail:
+            self._inject('fail', op, key, attempt)
+            raise StoreTimeout(
+                f'injected store op failure (op={op} key={key} '
+                f'attempt={attempt})')
+        return attempt
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, key):
+        attempt = self._gate('get', key)
+        got = self.inner.get(key)
+        if got is None:
+            return None
+        if self.cfg.partial and _u(self.cfg, 'get', key, attempt, 1) \
+                < self.cfg.partial:
+            # a short transfer: the bytes come back truncated but the
+            # generation header is the committed one — exactly the
+            # corruption shape only a content-hash check catches
+            self._inject('partial', 'get', key, attempt)
+            return Blob(got.data[:max(1, len(got.data) // 2)],
+                        got.generation)
+        prev = self._last_seen.get(key)
+        if (prev is not None and prev.generation != got.generation
+                and self.cfg.stale
+                and _u(self.cfg, 'get', key, attempt, 2)
+                < self.cfg.stale):
+            self._inject('stale', 'get', key, attempt)
+            return prev
+        self._last_seen[key] = got
+        return got
+
+    def head(self, key):
+        self._gate('head', key)
+        return self.inner.head(key)
+
+    def list(self, prefix=''):
+        self._gate('list', prefix)
+        return self.inner.list(prefix)
+
+    def list_meta(self, prefix=''):
+        self._gate('list_meta', prefix)
+        return self.inner.list_meta(prefix)
+
+    # -- writes ------------------------------------------------------------
+
+    def put(self, key, data, *, if_generation=ANY, token=None):
+        attempt = self._gate('put', key)
+        if self.cfg.torn and _u(self.cfg, 'put', key, attempt, 1) \
+                < self.cfg.torn:
+            # the upload died mid-stream; the server discarded the
+            # partial (the atomicity contract) — nothing committed,
+            # the writer sees a transient failure and retries
+            self._inject('torn', 'put', key, attempt)
+            raise StoreTimeout(
+                f'injected torn upload (op=put key={key} '
+                f'attempt={attempt})')
+        gen = self.inner.put(key, data, if_generation=if_generation,
+                             token=token)
+        if self.cfg.ack_lost and _u(self.cfg, 'put', key, attempt, 3) \
+                < self.cfg.ack_lost:
+            # the object COMMITTED but the ack was lost on the wire —
+            # the retry above must replay the same idempotency token
+            # and land as the original success
+            self._inject('ack_lost', 'put', key, attempt)
+            raise StoreTimeout(
+                f'injected lost put ack (op=put key={key} '
+                f'attempt={attempt})')
+        return gen
+
+    def delete(self, key):
+        self._gate('delete', key)
+        self._evict(key)
+        return self.inner.delete(key)
+
+    def delete_prefix(self, prefix):
+        self._gate('delete_prefix', prefix)
+        for key in [k for k in self._last_seen
+                    if k.startswith(str(prefix))]:
+            self._evict(key)
+        for key in {k for _op, k in self._attempts
+                    if k.startswith(str(prefix))}:
+            self._evict(key)
+        return self.inner.delete_prefix(prefix)
+
+    def _evict(self, key):
+        """Deleted keys drop their fault-lane state: checkpoint keys
+        are pruned over a long run and these maps must not grow
+        monotonically. The delete ops' own counters are KEPT —
+        resetting them mid-retry would redraw attempt 1 forever and
+        turn one injected delete failure into a permanent one."""
+        key = str(key)
+        self._last_seen.pop(key, None)
+        for pair in [p for p in self._attempts
+                     if p[1] == key
+                     and p[0] not in ('delete', 'delete_prefix')]:
+            del self._attempts[pair]
+
+    def close(self):
+        self.inner.close()
+
+
+def maybe_wrap(store, cfg=None):
+    """Wrap ``store`` in a :class:`ChaosStore` when the chaos env is
+    armed (or an explicit ``cfg`` is given); otherwise return it
+    untouched — the one-liner every store construction site uses."""
+    if cfg is None:
+        cfg = from_env()
+    if cfg is None or not cfg.any_chaos:
+        return store
+    return ChaosStore(store, cfg)

@@ -1,0 +1,443 @@
+"""A GCS-style HTTP object store: stdlib single-process server
+(``kfac-store-serve``) + client backend — no shared filesystem
+anywhere in the durability plane.
+
+Protocol (deliberately a miniature of the GCS JSON/XML API shape —
+whole-object semantics, generation preconditions, list-by-prefix):
+
+  ``PUT /o/<key>``       body = object bytes; commit is atomic under
+                         the server lock. Preconditions ride headers:
+                         ``X-Kfac-If-Generation: <gen>`` (replace that
+                         exact version), ``X-Kfac-If-Generation:
+                         absent`` (create only), no header =
+                         unconditional. ``X-Kfac-Token`` is the
+                         idempotency token: a REPLAY of the last
+                         applied token for a key answers 200 with the
+                         original generation — an ack lost on the wire
+                         must not turn the retry into a self-conflict.
+                         412 = precondition failed (an ANSWER).
+  ``GET /o/<key>``       200 body + ``X-Kfac-Generation``; 404 missing.
+  ``HEAD /o/<key>``      as GET, no body, plus ``X-Kfac-Size``.
+  ``DELETE /o/<key>``    200 ``{"deleted": true|false}``.
+  ``GET /list?prefix=``  200 ``{"keys": {key: {"generation": g,
+                         "size": n}}}`` — ONE round trip for the whole
+                         scrub scan.
+  ``POST /delete-prefix?prefix=``  200 ``{"deleted": n}``.
+
+Generations are the same content hashes the posix backend mints
+(sha256 of the bytes, truncated), so an object has ONE token no matter
+which backend holds it — ``kfac-ckpt-verify`` repairs across backends
+by token equality.
+
+Objects live in server memory: the server is the durability *boundary*
+for the processes it serves (a SIGKILLed trainer's committed objects
+survive in it), exactly the role the in-process KV server plays for
+the coordination plane. Client-side transient failures (connection
+refused, torn response) raise :class:`~.base.StoreTimeout`; the retry
+wrapper above decides how hard to try.
+"""
+
+import argparse
+import http.client
+import http.server
+import json
+import logging
+import signal
+import threading
+import urllib.parse
+
+from kfac_pytorch_tpu.store.base import (
+    ANY, Blob, Meta, ObjectStore, StoreTimeout, check_key, check_prefix)
+from kfac_pytorch_tpu.store.posix import generation_of
+
+log = logging.getLogger(__name__)
+
+DEFAULT_STORE_PORT = 8490
+
+
+class StoreHttpServer:
+    """Single-process in-memory object store behind a threading HTTP
+    server. ``start()`` binds (port 0 picks a free port), ``stop()``
+    shuts down; state is one dict under one lock — whole-object
+    commits are atomic by construction, a reader can NEVER observe a
+    partial object."""
+
+    def __init__(self, host='127.0.0.1', port=DEFAULT_STORE_PORT):
+        self.host = host
+        self.port = int(port)
+        self._objects = {}    # key -> (bytes, generation)
+        self._tokens = {}     # key -> (token, generation) last applied
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._thread = None
+
+    # -- object ops (server side, under the lock) --------------------------
+
+    def _op_put(self, key, data, if_generation, token):
+        with self._lock:
+            if token is not None:
+                last = self._tokens.get(key)
+                if last is not None and last[0] == token:
+                    # idempotent replay: the previous attempt committed
+                    # and only its ack was lost — answer the original
+                    # success, do NOT re-evaluate the precondition
+                    # against our own write
+                    return last[1]
+            cur = self._objects.get(key)
+            if if_generation == 'absent':
+                if cur is not None:
+                    return None
+            elif if_generation is not None:
+                if cur is None or cur[1] != if_generation:
+                    return None
+            gen = generation_of(data)
+            self._objects[key] = (bytes(data), gen)
+            if token is not None:
+                self._tokens[key] = (token, gen)
+            return gen
+
+    def _op_get(self, key):
+        with self._lock:
+            return self._objects.get(key)
+
+    def _op_delete(self, key):
+        with self._lock:
+            self._tokens.pop(key, None)
+            return self._objects.pop(key, None) is not None
+
+    def _op_list(self, prefix):
+        with self._lock:
+            return {k: {'generation': g, 'size': len(d)}
+                    for k, (d, g) in sorted(self._objects.items())
+                    if k.startswith(prefix)}
+
+    def _op_delete_prefix(self, prefix):
+        with self._lock:
+            hit = [k for k in self._objects if k.startswith(prefix)]
+            for k in hit:
+                self._objects.pop(k, None)
+                self._tokens.pop(k, None)
+            return len(hit)
+
+    # -- http plumbing -----------------------------------------------------
+
+    def start(self):
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, fmt, *args):  # route through logging
+                log.debug('store-serve: ' + fmt, *args)
+
+            def _reply(self, status, payload=None, headers=(),
+                       body=None):
+                raw = body
+                if raw is None:
+                    raw = (json.dumps(payload).encode()
+                           if payload is not None else b'')
+                self.send_response(status)
+                self.send_header('Content-Length', str(len(raw)))
+                for name, value in headers:
+                    self.send_header(name, value)
+                self.end_headers()
+                if self.command != 'HEAD':
+                    self.wfile.write(raw)
+
+            def _key(self):
+                path = urllib.parse.urlparse(self.path).path
+                if not path.startswith('/o/'):
+                    return None
+                return urllib.parse.unquote(path[len('/o/'):])
+
+            def _query(self, name):
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                return q.get(name, [''])[0]
+
+            def do_GET(self):
+                path = urllib.parse.urlparse(self.path).path
+                if path == '/list':
+                    self._reply(200, {'keys': server._op_list(
+                        self._query('prefix'))})
+                    return
+                key = self._key()
+                if key is None:
+                    self._reply(404, {'error': 'bad path'})
+                    return
+                got = server._op_get(key)
+                if got is None:
+                    self._reply(404, {'error': 'not found'})
+                    return
+                data, gen = got
+                self._reply(200, headers=(
+                    ('X-Kfac-Generation', gen),
+                    ('X-Kfac-Size', str(len(data)))), body=data)
+
+            def do_HEAD(self):
+                key = self._key()
+                got = server._op_get(key) if key else None
+                if got is None:
+                    self._reply(404)
+                    return
+                data, gen = got
+                self._reply(200, headers=(
+                    ('X-Kfac-Generation', gen),
+                    ('X-Kfac-Size', str(len(data)))), body=b'')
+
+            def do_PUT(self):
+                key = self._key()
+                if key is None:
+                    self._reply(404, {'error': 'bad path'})
+                    return
+                length = int(self.headers.get('Content-Length') or 0)
+                data = self.rfile.read(length)
+                if len(data) != length:
+                    # the upload died mid-stream: discard the partial —
+                    # a torn upload must never become a visible object
+                    self._reply(400, {'error': 'torn upload discarded'})
+                    return
+                gen = server._op_put(
+                    key, data,
+                    self.headers.get('X-Kfac-If-Generation'),
+                    self.headers.get('X-Kfac-Token'))
+                if gen is None:
+                    self._reply(412, {'error': 'precondition failed'})
+                    return
+                self._reply(200, {'generation': gen})
+
+            def do_DELETE(self):
+                key = self._key()
+                if key is None:
+                    self._reply(404, {'error': 'bad path'})
+                    return
+                self._reply(200, {'deleted': server._op_delete(key)})
+
+            def do_POST(self):
+                path = urllib.parse.urlparse(self.path).path
+                if path == '/delete-prefix':
+                    prefix = self._query('prefix')
+                    if not prefix:
+                        self._reply(400, {'error': 'empty prefix'})
+                        return
+                    self._reply(200, {
+                        'deleted': server._op_delete_prefix(prefix)})
+                    return
+                self._reply(404, {'error': 'bad path'})
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name='kfac-store-serve',
+            daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def address(self):
+        return f'{self.host}:{self.port}'
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class HttpStore(ObjectStore):
+    """Client for :class:`StoreHttpServer`. ``namespace`` prefixes
+    every key (the per-tenant checkpoint dir path), so disjoint
+    directories stay disjoint stores on one server — the same
+    namespacing contract the KV backend uses."""
+
+    def __init__(self, addr, namespace='', timeout=5.0):
+        host, _, port = str(addr).rpartition(':')
+        if not host or not port.isdigit():
+            raise ValueError(
+                f'store address must be "host:port", got {addr!r}')
+        self.host, self.port = host, int(port)
+        self.namespace = str(namespace).strip('/')
+        self.timeout = float(timeout)
+        self._local = threading.local()
+
+    def __repr__(self):
+        return (f'HttpStore({self.host}:{self.port}, '
+                f'namespace={self.namespace!r})')
+
+    def _full(self, key):
+        key = check_key(key)
+        return f'{self.namespace}/{key}' if self.namespace else key
+
+    def _full_prefix(self, prefix):
+        prefix = check_prefix(prefix)
+        if not self.namespace:
+            return prefix
+        return f'{self.namespace}/{prefix}' if prefix \
+            else f'{self.namespace}/'
+
+    def _strip(self, key):
+        if self.namespace and key.startswith(self.namespace + '/'):
+            return key[len(self.namespace) + 1:]
+        return key
+
+    def _request(self, method, path, body=None, headers=()):
+        conn = getattr(self._local, 'conn', None)
+        for fresh in (False, True):
+            if conn is None or fresh:
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout)
+                self._local.conn = conn
+            try:
+                conn.request(method, path, body=body,
+                             headers=dict(headers))
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.getheaders()), data
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                self._local.conn = None
+                conn = None
+                if fresh:
+                    raise StoreTimeout(
+                        f'store server {self.host}:{self.port} '
+                        f'unreachable: {e}') from e
+                # one silent reconnect: the server may have closed an
+                # idle keep-alive connection between ops
+        raise AssertionError('unreachable')
+
+    def _obj_path(self, full_key):
+        return '/o/' + urllib.parse.quote(full_key)
+
+    # -- ops ---------------------------------------------------------------
+
+    def get(self, key):
+        status, headers, data = self._request(
+            'GET', self._obj_path(self._full(key)))
+        if status == 404:
+            return None
+        if status != 200:
+            raise StoreTimeout(f'store get {key!r}: HTTP {status}')
+        return Blob(data, headers.get('X-Kfac-Generation', ''))
+
+    def head(self, key):
+        status, headers, _ = self._request(
+            'HEAD', self._obj_path(self._full(key)))
+        if status == 404:
+            return None
+        if status != 200:
+            raise StoreTimeout(f'store head {key!r}: HTTP {status}')
+        return Meta(headers.get('X-Kfac-Generation', ''),
+                    int(headers.get('X-Kfac-Size') or 0))
+
+    def put(self, key, data, *, if_generation=ANY, token=None):
+        headers = []
+        if if_generation is None:
+            headers.append(('X-Kfac-If-Generation', 'absent'))
+        elif if_generation is not ANY:
+            headers.append(('X-Kfac-If-Generation', str(if_generation)))
+        if token is not None:
+            headers.append(('X-Kfac-Token', str(token)))
+        status, _, body = self._request(
+            'PUT', self._obj_path(self._full(key)), body=bytes(data),
+            headers=headers)
+        if status == 412:
+            return None  # precondition answer, never an error
+        if status != 200:
+            raise StoreTimeout(f'store put {key!r}: HTTP {status}')
+        try:
+            return json.loads(body.decode())['generation']
+        except (ValueError, KeyError) as e:
+            raise StoreTimeout(
+                f'store put {key!r}: torn response') from e
+
+    def delete(self, key):
+        status, _, body = self._request(
+            'DELETE', self._obj_path(self._full(key)))
+        if status != 200:
+            raise StoreTimeout(f'store delete {key!r}: HTTP {status}')
+        try:
+            return bool(json.loads(body.decode())['deleted'])
+        except (ValueError, KeyError) as e:
+            raise StoreTimeout(
+                f'store delete {key!r}: torn response') from e
+
+    def _list_meta_raw(self, prefix):
+        full = self._full_prefix(prefix)
+        status, _, body = self._request(
+            'GET', '/list?prefix=' + urllib.parse.quote(full, safe=''))
+        if status != 200:
+            raise StoreTimeout(f'store list {prefix!r}: HTTP {status}')
+        try:
+            keys = json.loads(body.decode())['keys']
+        except (ValueError, KeyError) as e:
+            raise StoreTimeout(
+                f'store list {prefix!r}: torn response') from e
+        return {self._strip(k): v for k, v in keys.items()}
+
+    def list(self, prefix=''):
+        return sorted(self._list_meta_raw(prefix))
+
+    def list_meta(self, prefix=''):
+        # ONE round trip for the whole scan — the scrub contract
+        return {k: Meta(v.get('generation', ''), v.get('size', 0))
+                for k, v in self._list_meta_raw(prefix).items()}
+
+    def delete_prefix(self, prefix):
+        prefix = check_prefix(prefix)
+        if not prefix:
+            raise ValueError('delete_prefix needs a non-empty prefix '
+                             '(refusing to wipe the whole namespace)')
+        full = self._full_prefix(prefix)
+        status, _, body = self._request(
+            'POST',
+            '/delete-prefix?prefix=' + urllib.parse.quote(full, safe=''))
+        if status != 200:
+            raise StoreTimeout(
+                f'store delete_prefix {prefix!r}: HTTP {status}')
+        try:
+            return int(json.loads(body.decode())['deleted'])
+        except (ValueError, KeyError) as e:
+            raise StoreTimeout(
+                f'store delete_prefix {prefix!r}: torn response') from e
+
+    def close(self):
+        conn = getattr(self._local, 'conn', None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+def main(argv=None):
+    """``kfac-store-serve``: run the object-store server in the
+    foreground until SIGTERM/SIGINT."""
+    parser = argparse.ArgumentParser(
+        prog='kfac-store-serve',
+        description='single-process GCS-style object store for the '
+                    'kfac checkpoint plane')
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_STORE_PORT,
+                        help='listen port (0 picks a free one)')
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(name)s %(levelname)s %(message)s')
+    server = StoreHttpServer(args.host, args.port).start()
+    print(f'kfac-store-serve: listening on {server.address}',
+          flush=True)
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    try:
+        done.wait()
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

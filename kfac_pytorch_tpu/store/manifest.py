@@ -1,0 +1,128 @@
+"""Checkpoint manifests: the atomic commit point of the durable
+checkpoint plane.
+
+A checkpoint epoch is COMMITTED exactly when its manifest object
+exists. The writer uploads every blob first, then writes
+``checkpoint-<epoch>.manifest.json`` **last** — one atomic put — so a
+crash at any earlier point leaves blobs with no manifest (an
+uncommitted epoch the resume scan skips), never a manifest naming
+blobs that do not exist yet. The manifest records a full sha256 + size
+per blob, which is what makes durability *verifiable*: ``auto_resume``
+checks the bytes it restores against the manifest, and
+``kfac-ckpt-verify`` scrubs whole namespaces offline, repairing from a
+mirror or an older epoch by hash equality.
+
+Manifests are lineage-stamped: the writer copies ``lineage``/``gen``/
+``num_devices`` out of the ``world.json`` stamp it just wrote through
+the :func:`~kfac_pytorch_tpu.utils.checkpoint.write_world_stamp` fence,
+so a fenced fork's manifest is refusable by the same monotonic lineage
+rule that fences the stamp itself.
+
+jax-free: the verifier CLI runs without a training environment.
+"""
+
+import hashlib
+import json
+import re
+
+FORMAT = 1
+
+#: a committed epoch's manifest object, at the namespace top level
+MANIFEST_RE = re.compile(r'^checkpoint-(\d+)\.manifest\.json$')
+
+
+def manifest_key(epoch):
+    return f'checkpoint-{int(epoch)}.manifest.json'
+
+
+def blob_sha256(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+def build_manifest(epoch, kind, blobs, stamp=None):
+    """``blobs``: {key: bytes} or {key: (sha256_hex, size)}. ``stamp``:
+    the ``world.json`` payload to copy lineage provenance from."""
+    entries = {}
+    for key, spec in blobs.items():
+        if isinstance(spec, (bytes, bytearray, memoryview)):
+            entries[str(key)] = {'sha256': blob_sha256(spec),
+                                 'size': len(spec)}
+        else:
+            sha, size = spec
+            entries[str(key)] = {'sha256': str(sha), 'size': int(size)}
+    manifest = {'format': FORMAT, 'epoch': int(epoch),
+                'kind': str(kind), 'blobs': entries}
+    for field in ('num_devices', 'gen', 'lineage'):
+        if stamp and isinstance(stamp.get(field), int):
+            manifest[field] = stamp[field]
+    return manifest
+
+
+def encode_manifest(manifest):
+    return (json.dumps(manifest, sort_keys=True, indent=1)
+            + '\n').encode()
+
+
+def parse_manifest(raw):
+    """Decode manifest bytes; ``None`` for anything unparseable or
+    structurally wrong — a torn/corrupt manifest is an UNCOMMITTED
+    epoch, never a crash."""
+    try:
+        manifest = json.loads(bytes(raw).decode())
+        if (not isinstance(manifest, dict)
+                or not isinstance(manifest.get('blobs'), dict)
+                or not isinstance(manifest.get('epoch'), int)):
+            return None
+        for spec in manifest['blobs'].values():
+            if (not isinstance(spec, dict)
+                    or not isinstance(spec.get('sha256'), str)
+                    or not isinstance(spec.get('size'), int)):
+                return None
+        return manifest
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+def manifest_epochs(store):
+    """{epoch: manifest key} for every committed epoch in the
+    namespace — the resume scan's candidate set."""
+    out = {}
+    for key in store.list(''):
+        m = MANIFEST_RE.match(key)
+        if m:
+            out[int(m.group(1))] = key
+    return out
+
+
+def read_manifest(store, epoch):
+    """The parsed manifest for ``epoch``, or ``None`` (absent or
+    unparseable — either way the epoch is uncommitted)."""
+    blob = store.get(manifest_key(epoch))
+    if blob is None:
+        return None
+    return parse_manifest(blob.data)
+
+
+def verify_blob(store, key, spec):
+    """``None`` when the stored object matches its manifest entry,
+    else the reason (``'missing'`` | ``'size_mismatch'`` |
+    ``'hash_mismatch'``)."""
+    blob = store.get(key)
+    if blob is None:
+        return 'missing'
+    if len(blob.data) != spec['size']:
+        return 'size_mismatch'
+    if blob_sha256(blob.data) != spec['sha256']:
+        return 'hash_mismatch'
+    return None
+
+
+def verify_epoch(store, manifest):
+    """[(key, reason)] for every blob of ``manifest`` that fails
+    verification — empty means the epoch is intact."""
+    problems = []
+    for key in sorted(manifest['blobs']):
+        reason = verify_blob(store, key, manifest['blobs'][key])
+        if reason is not None:
+            problems.append((key, reason))
+    return problems

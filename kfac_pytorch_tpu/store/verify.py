@@ -1,0 +1,184 @@
+"""``kfac-ckpt-verify``: scrub a checkpoint namespace against its
+manifests, repair what can be repaired, report the rest.
+
+The scrubber walks every committed epoch (every manifest) in a
+namespace, re-hashes every blob, and classifies each mismatch as
+``missing`` / ``size_mismatch`` / ``hash_mismatch``. A corrupt blob is
+repaired from, in order:
+
+1. a **mirror** namespace (``--mirror DIR``): a second copy of the
+   same keys — the replica-repair path; a candidate is accepted only
+   if its bytes hash to the manifest's recorded sha256, so a corrupt
+   mirror can never "repair" corruption into place;
+2. an **older committed epoch** holding a blob with the SAME recorded
+   hash — identical content under a different key (hash equality is
+   the match, so this can never substitute different state).
+
+``--sync-mirror`` additionally copies every blob that verifies clean
+(and the manifest itself) INTO the mirror — the scrub doubles as the
+backup pass that makes the next scrub's repairs possible.
+
+Every event is one greppable log line in the incident grammar
+(``ckpt: verified/corrupt/repaired ...``), so the ``kfac-obs``
+timeline renders a scrub with zero new aggregation code. Exit code:
+0 when every epoch verifies (possibly after repair), 1 when
+unrepaired corruption remains, ``RC_STORE_LOST`` (120) when the store
+itself is gone.
+
+jax-free by design: the scrubber runs on any host that can reach the
+store, training environment or not.
+"""
+
+import argparse
+import logging
+import sys
+
+from kfac_pytorch_tpu.store import (
+    RC_STORE_LOST, PosixStore, RetryingStore, StoreGiveUp,
+    store_from_env)
+from kfac_pytorch_tpu.store.manifest import (
+    blob_sha256, manifest_epochs, manifest_key, parse_manifest,
+    verify_blob)
+
+log = logging.getLogger(__name__)
+
+
+def _repair_from_mirror(store, mirror, key, spec):
+    if mirror is None:
+        return False
+    blob = mirror.get(key)
+    if blob is None or blob_sha256(blob.data) != spec['sha256'] \
+            or len(blob.data) != spec['size']:
+        return False
+    store.put(key, blob.data)
+    return True
+
+
+def _repair_from_epoch(store, manifests, epoch, spec):
+    """Find an OLDER committed epoch holding a blob whose recorded
+    hash equals ``spec``'s, read it, and return its bytes if they
+    still verify — content-addressed repair, never state substitution."""
+    for other in sorted((e for e in manifests if e < epoch),
+                        reverse=True):
+        manifest = manifests[other]
+        for other_key, other_spec in sorted(manifest['blobs'].items()):
+            if other_spec['sha256'] != spec['sha256'] \
+                    or other_spec['size'] != spec['size']:
+                continue
+            blob = store.get(other_key)
+            if blob is not None \
+                    and blob_sha256(blob.data) == spec['sha256']:
+                return other, blob.data
+    return None, None
+
+
+def scrub(store, *, mirror=None, repair=True, sync_mirror=False):
+    """Verify every committed epoch in ``store``; returns
+    ``(verified_epochs, repaired, unrepaired)`` counts. ``mirror`` is
+    a plain :class:`ObjectStore` (or None)."""
+    epochs = manifest_epochs(store)
+    manifests = {}
+    for epoch in sorted(epochs):
+        blob = store.get(epochs[epoch])
+        manifest = parse_manifest(blob.data) if blob is not None \
+            else None
+        if manifest is None:
+            log.warning(
+                'ckpt: corrupt blob key=%s epoch=%d reason=%s',
+                epochs[epoch], epoch, 'bad_manifest')
+            continue
+        manifests[epoch] = manifest
+    verified = repaired = unrepaired = 0
+    for epoch in sorted(manifests):
+        manifest = manifests[epoch]
+        bad = 0
+        for key in sorted(manifest['blobs']):
+            spec = manifest['blobs'][key]
+            reason = verify_blob(store, key, spec)
+            if reason is None:
+                continue
+            log.warning('ckpt: corrupt blob key=%s epoch=%d reason=%s',
+                        key, epoch, reason)
+            if repair:
+                if _repair_from_mirror(store, mirror, key, spec):
+                    source = 'mirror'
+                else:
+                    other, data = _repair_from_epoch(
+                        store, manifests, epoch, spec)
+                    source = None
+                    if data is not None:
+                        store.put(key, data)
+                        source = f'epoch-{other}'
+                if source is not None \
+                        and verify_blob(store, key, spec) is None:
+                    log.warning(
+                        'ckpt: repaired blob key=%s epoch=%d source=%s '
+                        '[resilience: ckpt_repaired=1]',
+                        key, epoch, source)
+                    repaired += 1
+                    continue
+            bad += 1
+            unrepaired += 1
+        if bad == 0:
+            verified += 1
+            log.info('ckpt: verified epoch=%d blobs=%d',
+                     epoch, len(manifest['blobs']))
+            if sync_mirror and mirror is not None:
+                for key in sorted(manifest['blobs']):
+                    blob = store.get(key)
+                    if blob is not None:
+                        mirror.put(key, blob.data)
+                mblob = store.get(manifest_key(epoch))
+                if mblob is not None:
+                    mirror.put(manifest_key(epoch), mblob.data)
+        else:
+            log.error(
+                'ckpt: epoch %d has %d unrepaired corrupt blob(s) — '
+                'auto_resume will skip it', epoch, bad)
+    return verified, repaired, unrepaired
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='kfac-ckpt-verify',
+        description='scrub a checkpoint namespace against its '
+                    'manifests; repair corrupt blobs from a mirror or '
+                    'an older epoch')
+    parser.add_argument('--root', required=True,
+                        help='checkpoint namespace (the run/tenant '
+                             'ckpt dir; backend selection rides '
+                             'KFAC_STORE_BACKEND / KFAC_STORE_ADDR)')
+    parser.add_argument('--mirror', default=None, metavar='DIR',
+                        help='posix mirror namespace used as a repair '
+                             'source')
+    parser.add_argument('--sync-mirror', action='store_true',
+                        help='copy verified blobs + manifests into '
+                             '--mirror (the backup pass)')
+    parser.add_argument('--no-repair', action='store_true',
+                        help='report only; never write to the store')
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(name)s %(levelname)s %(message)s')
+    store = store_from_env(args.root)
+    mirror = None
+    if args.mirror:
+        # the repair source must stay truthful: retry for liveness,
+        # but never chaos-wrap the mirror a drill repairs from
+        mirror = RetryingStore(PosixStore(args.mirror))
+    try:
+        verified, repaired, unrepaired = scrub(
+            store, mirror=mirror, repair=not args.no_repair,
+            sync_mirror=args.sync_mirror)
+    except StoreGiveUp as e:
+        log.error(
+            'checkpoint store lost — %s; exiting rc=%d '
+            '[resilience: store_lost=1]', e, RC_STORE_LOST)
+        return RC_STORE_LOST
+    log.info('ckpt-verify: %d epoch(s) verified, %d blob(s) repaired, '
+             '%d unrepaired', verified, repaired, unrepaired)
+    return 1 if unrepaired else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -16,6 +16,9 @@ import re
 import jax
 import numpy as np
 
+from kfac_pytorch_tpu import store as _store
+from kfac_pytorch_tpu.store import manifest as _manifest
+
 try:
     import orbax.checkpoint as ocp
     _HAS_ORBAX = True
@@ -28,6 +31,92 @@ def _ckpt_dir(base, epoch):
 
 
 _ASYNC_CKPTR = None  # lazily-created persistent checkpointer (async saves)
+
+#: (base_dir, epoch) of an async orbax save whose manifest commit is
+#: deferred until the save is durable — the manifest IS the commit
+#: point, so it may only ever be written after wait_until_finished
+_PENDING_MANIFEST = None
+
+
+class CheckpointCorruptError(OSError):
+    """A restored blob failed its manifest hash/size check — silent
+    storage corruption, not a transient read failure. ``auto_resume``
+    treats it like any unreadable checkpoint: log and scan down."""
+
+
+def _store_for(base_dir):
+    """The object-store stack for a checkpoint namespace (posix by
+    default — byte-compatible with the pre-store file layout;
+    ``KFAC_STORE_BACKEND=http`` routes everything through the
+    kfac-store-serve object server)."""
+    return _store.store_from_env(os.path.abspath(str(base_dir)))
+
+
+def _store_guard(fn):
+    """Run one store operation; a spent retry budget means the
+    durability plane is GONE — exit loudly with the dedicated rc
+    rather than letting the trainer continue with nothing durable
+    behind it (or mis-classify the failure as a corrupt checkpoint)."""
+    try:
+        return fn()
+    except _store.StoreGiveUp as e:
+        import logging
+        logging.getLogger(__name__).error(
+            'checkpoint store lost — %s; exiting rc=%d '
+            '[resilience: store_lost=1]', e, _store.RC_STORE_LOST)
+        raise SystemExit(_store.RC_STORE_LOST) from e
+
+
+def _commit_manifest(base_dir, store, epoch, kind, blobs):
+    """The atomic commit point: every blob is already durable, the
+    manifest names them all (content hash + size each) and lands
+    LAST with one atomic put. Lineage/gen/world provenance is copied
+    from the ``world.json`` stamp written through the
+    :func:`write_world_stamp` fence, so a fenced fork's manifest is
+    refusable by the same monotonic-lineage rule."""
+    stamp = read_world_stamp_info(base_dir)
+    manifest = _manifest.build_manifest(epoch, kind, blobs, stamp=stamp)
+    raw = _manifest.encode_manifest(manifest)
+    _store_guard(
+        lambda: store.put(_manifest.manifest_key(epoch), raw))
+    import logging
+    logging.getLogger(__name__).info(
+        'ckpt: committed manifest epoch=%d blobs=%d kind=%s',
+        int(epoch), len(manifest['blobs']), kind)
+
+
+def _commit_manifest_tree(base_dir, epoch):
+    """Hash (and, on a remote store, upload) a finished orbax
+    checkpoint tree, then commit its manifest. Rank-0 only, called
+    strictly AFTER the async writer reported the tree durable."""
+    root = _ckpt_dir(base_dir, epoch)
+    if not os.path.isdir(root):
+        return
+    store = _store_for(base_dir)
+    local = _store.local_root(store) == os.path.abspath(str(base_dir))
+    rel_root = f'checkpoint-{int(epoch)}'
+    blobs = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            path = os.path.join(dirpath, name)
+            with open(path, 'rb') as f:
+                data = f.read()
+            key = (rel_root + '/'
+                   + os.path.relpath(path, root).replace(os.sep, '/'))
+            if not local:
+                _store_guard(
+                    lambda key=key, data=data: store.put(key, data))
+            blobs[key] = (_manifest.blob_sha256(data), len(data))
+    _commit_manifest(base_dir, store, epoch, 'orbax', blobs)
+
+
+def _flush_pending_manifest():
+    global _PENDING_MANIFEST
+    if _PENDING_MANIFEST is None:
+        return
+    base_dir, epoch = _PENDING_MANIFEST
+    _PENDING_MANIFEST = None
+    _commit_manifest_tree(base_dir, epoch)
 
 
 def save_checkpoint(base_dir, epoch, state, include_kfac=True, block=True,
@@ -73,9 +162,29 @@ def _save_checkpoint_once(base_dir, epoch, state, include_kfac, block):
         payload = state.replace(kfac_state=None)
     path = _ckpt_dir(base_dir, epoch)
     if _HAS_ORBAX:
+        from kfac_pytorch_tpu import faults as _faults
+        fault = (_faults.checkpoint_fault_mode()
+                 if jax.process_index() == 0 else None)
+        if fault == 'eio_once':
+            if _faults.claim_ckpt_eio_once():
+                import errno
+                import logging
+                logging.getLogger(__name__).warning(
+                    'CHAOS FAULT ACTIVE: %s=eio_once — failing this '
+                    'checkpoint write once', _faults.ENV_CKPT)
+                raise OSError(errno.EIO,
+                              'injected transient checkpoint write '
+                              f'failure ({_faults.ENV_CKPT}=eio_once)')
+            fault = None
+        if fault:
+            import logging
+            logging.getLogger(__name__).warning(
+                'CHAOS FAULT ACTIVE: %s=%s — deliberately corrupting the '
+                'checkpoint write for epoch %s', _faults.ENV_CKPT, fault,
+                epoch)
         if jax.process_index() == 0:
             os.makedirs(base_dir, exist_ok=True)
-        global _ASYNC_CKPTR
+        global _ASYNC_CKPTR, _PENDING_MANIFEST
         if _ASYNC_CKPTR is None:
             _ASYNC_CKPTR = ocp.StandardCheckpointer()
         else:
@@ -86,13 +195,41 @@ def _save_checkpoint_once(base_dir, epoch, state, include_kfac, block):
                 _ASYNC_CKPTR.wait_until_finished()
             except Exception:  # noqa: BLE001 — log and keep checkpointing
                 import logging
+                _PENDING_MANIFEST = None  # that save never became durable
                 logging.getLogger(__name__).exception(
                     'a previous async checkpoint save failed; attempting '
                     'this save anyway')
                 _ASYNC_CKPTR = ocp.StandardCheckpointer()
+            else:
+                _flush_pending_manifest()
         _ASYNC_CKPTR.save(path, payload, force=True)
-        if block:
+        if block or fault:
             _ASYNC_CKPTR.wait_until_finished()
+        if jax.process_index() != 0:
+            return
+        if fault == 'truncate':
+            # chaos drill: silent storage corruption AFTER the tree
+            # landed — one published file truncated in place, and no
+            # manifest, so the resume scan refuses the epoch outright
+            for dirpath, _dirs, files in sorted(os.walk(path)):
+                for name in sorted(files):
+                    target = os.path.join(dirpath, name)
+                    size = os.path.getsize(target)
+                    with open(target, 'r+b') as f:
+                        f.truncate(max(1, size // 2))
+                    return
+            return
+        if fault == 'fail':
+            # the commit dies between the tree and its manifest — the
+            # exact torn-commit window the manifest-last protocol makes
+            # harmless (epoch uncommitted, scan-down resumes older)
+            raise OSError('injected checkpoint write failure '
+                          f'({_faults.ENV_CKPT}=fail)')
+        if block:
+            _commit_manifest_tree(base_dir, epoch)
+        else:
+            _PENDING_MANIFEST = (os.path.abspath(str(base_dir)),
+                                 int(epoch))
     else:
         if jax.process_index() != 0:
             return
@@ -101,7 +238,7 @@ def _save_checkpoint_once(base_dir, epoch, state, include_kfac, block):
 
         from kfac_pytorch_tpu import faults as _faults
         blob = pickle.dumps(jax.tree.map(np.asarray, payload))
-        final, tmp = path + '.pkl', path + '.pkl.tmp'
+        key = f'checkpoint-{epoch}.pkl'
         fault = _faults.checkpoint_fault_mode()
         if fault == 'eio_once':
             # transient-storage drill: the FIRST write attempt dies with
@@ -125,27 +262,29 @@ def _save_checkpoint_once(base_dir, epoch, state, include_kfac, block):
                 'CHAOS FAULT ACTIVE: %s=%s — deliberately corrupting the '
                 'checkpoint write for epoch %s', _faults.ENV_CKPT, fault,
                 epoch)
+        store = _store_for(base_dir)
         if fault == 'truncate':
-            # chaos drill: the PRE-atomic behavior — a crash mid-write
-            # leaves a truncated file under the final name, which
-            # find_resume_epoch happily selects (auto_resume must then
-            # fall back to the next-older epoch)
-            with open(final, 'wb') as f:
-                f.write(blob[:max(1, len(blob) // 2)])
+            # chaos drill: a torn object lands under the FINAL key with
+            # no manifest — the manifest-aware resume scan refuses the
+            # epoch without ever reading it (pre-manifest behavior was
+            # to select it and crash into the truncation)
+            _store_guard(lambda: store.put(
+                key, blob[:max(1, len(blob) // 2)]))
             return
-        # atomic: full write to a tmp name, fsync, then rename — a crash
-        # at any point leaves either the old file or the new one, never a
-        # truncated final file
-        with open(tmp, 'wb') as f:
-            if fault == 'fail':
+        if fault == 'fail':
+            # the write dies mid-upload: a partial tmp file, never a
+            # final object and never a manifest
+            with open(path + '.pkl.tmp', 'wb') as f:
                 f.write(blob[:max(1, len(blob) // 2)])
                 f.flush()
-                raise OSError('injected checkpoint write failure '
-                              f'({_faults.ENV_CKPT}=fail)')
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, final)
+            raise OSError('injected checkpoint write failure '
+                          f'({_faults.ENV_CKPT}=fail)')
+        # atomic put (posix: full write to a tmp name, fsync, rename) —
+        # a crash at any point leaves either the old object or the new
+        # one, never a truncated final object — then the manifest LAST:
+        # the epoch is committed only once its content hash is recorded
+        _store_guard(lambda: store.put(key, blob))
+        _commit_manifest(base_dir, store, epoch, 'pickle', {key: blob})
 
 
 def reshard_kfac_state(pre_old, pre_new, kfac_state, carry_decomp=False):
@@ -326,9 +465,17 @@ def read_world_stamp(base_dir):
 
 
 def wait_for_checkpoints():
-    """Block until all in-flight async saves are durable on disk."""
+    """Block until all in-flight async saves are durable on disk, then
+    commit any deferred manifest — only after this returns is the last
+    ``block=False`` save actually restorable."""
+    global _PENDING_MANIFEST
     if _ASYNC_CKPTR is not None:
-        _ASYNC_CKPTR.wait_until_finished()
+        try:
+            _ASYNC_CKPTR.wait_until_finished()
+        except Exception:
+            _PENDING_MANIFEST = None  # that save never became durable
+            raise
+    _flush_pending_manifest()
 
 
 def prune_checkpoints(base_dir, keep):
@@ -345,7 +492,7 @@ def prune_checkpoints(base_dir, keep):
     :func:`wait_for_checkpoints` first."""
     if keep is None or keep <= 0 or jax.process_index() != 0:
         return
-    pat = re.compile(r'^checkpoint-(\d+)(\.pkl)?$')
+    pat = re.compile(r'^checkpoint-(\d+)(\.pkl|\.manifest\.json)?$')
     by_epoch = {}
     for name in (os.listdir(base_dir) if os.path.isdir(base_dir) else ()):
         m = pat.match(name)
@@ -359,15 +506,56 @@ def prune_checkpoints(base_dir, keep):
                 shutil.rmtree(target, ignore_errors=True)
             else:
                 os.remove(target)
+    # a REMOTE store holds its own copies of the same epochs — apply
+    # the identical retention there (manifest first, so a crash mid-
+    # prune leaves an uncommitted epoch, never a committed torso).
+    # Housekeeping only: a store outage here must not kill the trainer.
+    store = _store_for(base_dir)
+    if _store.local_root(store) == os.path.abspath(str(base_dir)):
+        return
+    try:
+        epochs = _manifest.manifest_epochs(store)
+        for epoch in sorted(epochs)[:-keep]:
+            manifest = _manifest.read_manifest(store, epoch)
+            store.delete(epochs[epoch])
+            for bkey in (sorted(manifest['blobs'])
+                         if manifest is not None else ()):
+                store.delete(bkey)
+    except OSError:
+        import logging
+        logging.getLogger(__name__).warning(
+            'store-side checkpoint prune failed; will retry at the '
+            'next prune', exc_info=True)
 
 
 def find_resume_epoch(base_dir, max_epoch):
     """Scan checkpoint-{epoch} downward from max_epoch (reference:
-    pytorch_imagenet_resnet.py:162-167). Returns the epoch or None."""
+    pytorch_imagenet_resnet.py:162-167). Returns the epoch or None.
+
+    Manifest-aware: an epoch whose manifest exists is COMMITTED and
+    always eligible. Local files newer than the newest manifest but
+    without one of their own are torn commits (the writer died between
+    the blobs and the manifest) and are skipped. Files older than every
+    manifest are legacy pre-manifest checkpoints and stay eligible —
+    upgrading the code must not orphan existing checkpoints."""
+    store = _store_for(base_dir)
+    manifested = _store_guard(
+        lambda: set(_manifest.manifest_epochs(store)))
+    newest = max(manifested) if manifested else None
     for e in range(max_epoch, -1, -1):
-        if (os.path.isdir(_ckpt_dir(base_dir, e))
-                or os.path.exists(_ckpt_dir(base_dir, e) + '.pkl')):
+        if e in manifested:
             return e
+        present = (os.path.isdir(_ckpt_dir(base_dir, e))
+                   or os.path.exists(_ckpt_dir(base_dir, e) + '.pkl'))
+        if not present:
+            continue
+        if newest is not None and e > newest:
+            import logging
+            logging.getLogger(__name__).warning(
+                'checkpoint-%d in %s has no manifest (torn commit); '
+                'skipping it in the resume scan', e, base_dir)
+            continue
+        return e
     return None
 
 
@@ -385,6 +573,12 @@ def restore_checkpoint(base_dir, epoch, target_state, retry=None):
 
 
 def _restore_checkpoint_once(base_dir, epoch, target_state):
+    store = _store_for(base_dir)
+    manifest = _store_guard(lambda: _manifest.read_manifest(store, epoch))
+    if manifest is not None:
+        return _restore_manifested(base_dir, epoch, manifest, store,
+                                   target_state)
+    # legacy pre-manifest checkpoint: restore straight off the files
     path = _ckpt_dir(base_dir, epoch)
     if _HAS_ORBAX and os.path.isdir(path):
         ckptr = ocp.StandardCheckpointer()
@@ -392,6 +586,61 @@ def _restore_checkpoint_once(base_dir, epoch, target_state):
     import pickle
     with open(path + '.pkl', 'rb') as f:
         return pickle.load(f)
+
+
+def _verified_blob(store, key, spec):
+    """Fetch one manifested blob and verify it against its recorded
+    hash/size; ``(data, None)`` or ``(None, reason)``."""
+    blob = _store_guard(lambda: store.get(key))
+    if blob is None:
+        return None, 'missing'
+    if len(blob.data) != spec['size']:
+        return None, 'size_mismatch'
+    if _manifest.blob_sha256(blob.data) != spec['sha256']:
+        return None, 'hash_mismatch'
+    return blob.data, None
+
+
+def _restore_manifested(base_dir, epoch, manifest, store, target_state):
+    """Restore a COMMITTED epoch: every blob is re-verified against the
+    manifest's content hash before a byte of it reaches the trainer —
+    silent corruption surfaces here as :class:`CheckpointCorruptError`
+    (which ``auto_resume`` turns into a scan-down), never as a
+    mysterious unpickling/orbax failure three layers deeper."""
+    import logging
+    log = logging.getLogger(__name__)
+    problems = []
+    blobs = {}
+    local = _store.local_root(store) == os.path.abspath(str(base_dir))
+    for key in sorted(manifest['blobs']):
+        data, reason = _verified_blob(store, key, manifest['blobs'][key])
+        if reason is not None:
+            log.warning('ckpt: corrupt blob key=%s epoch=%d reason=%s',
+                        key, int(epoch), reason)
+            problems.append((key, reason))
+            continue
+        blobs[key] = data
+    if problems:
+        raise CheckpointCorruptError(
+            f'checkpoint-{epoch} failed manifest verification: '
+            + ', '.join(f'{k} ({r})' for k, r in problems))
+    if manifest.get('kind') == 'pickle':
+        import pickle
+        (data,) = blobs.values()
+        return pickle.loads(data)
+    # orbax tree: materialize verified bytes locally when the store is
+    # remote (orbax restores from a directory), then restore as usual
+    if not local:
+        for key, data in blobs.items():
+            target = os.path.join(os.path.abspath(str(base_dir)),
+                                  *key.split('/'))
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            tmp = target + f'.tmp-{os.getpid()}'
+            with open(tmp, 'wb') as f:
+                f.write(data)
+            os.replace(tmp, target)
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(_ckpt_dir(base_dir, epoch), target_state)
 
 
 def _saved_comm_err_zeros(path):

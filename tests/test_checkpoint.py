@@ -229,3 +229,106 @@ def test_prune_checkpoints(tmp_path):
     # tmp dir and foreign file untouched
     assert (tmp_path / 'checkpoint-11.orbax-checkpoint-tmp').exists()
     assert (tmp_path / 'other-file').exists()
+
+
+# -- the durable checkpoint plane (manifests + object store) --------------
+
+def test_save_commits_content_hash_manifest(tmp_path, trained_state):
+    """Every successful save writes a manifest LAST: the content hashes
+    of every blob, stamped with the world.json lineage when present."""
+    import json
+
+    checkpoint.write_world_stamp(tmp_path, 4, gen=2, lineage=1)
+    checkpoint.save_checkpoint(tmp_path, 6, trained_state)
+    manifest = json.loads(
+        (tmp_path / 'checkpoint-6.manifest.json').read_text())
+    assert manifest['epoch'] == 6 and manifest['blobs']
+    assert manifest['num_devices'] == 4
+    assert manifest['gen'] == 2 and manifest['lineage'] == 1
+    from kfac_pytorch_tpu.store import PosixStore
+    from kfac_pytorch_tpu.store.manifest import verify_epoch
+    assert verify_epoch(PosixStore(str(tmp_path)), manifest) == []
+
+
+def test_async_save_defers_manifest_until_durable(tmp_path,
+                                                  trained_state):
+    """block=False: the manifest (the commit point) must not exist
+    before wait_for_checkpoints confirms the tree is durable."""
+    if not checkpoint._HAS_ORBAX:
+        pytest.skip('orbax not available')
+    checkpoint.save_checkpoint(tmp_path, 1, trained_state, block=False)
+    manifest = tmp_path / 'checkpoint-1.manifest.json'
+    checkpoint.wait_for_checkpoints()
+    assert manifest.exists()
+
+
+def test_corrupt_manifested_epoch_scans_down(tmp_path, monkeypatch,
+                                             caplog):
+    """Bit-rot inside a COMMITTED epoch: the restore's hash check
+    raises CheckpointCorruptError and auto_resume lands on the older
+    committed epoch — the same length is the corruption shape only a
+    content hash catches."""
+    import logging
+
+    monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
+    payload = {'w': np.arange(64, dtype=np.float32)}
+    checkpoint.save_checkpoint(tmp_path, 0, payload)
+    checkpoint.save_checkpoint(tmp_path, 1, payload)
+    raw = bytearray((tmp_path / 'checkpoint-1.pkl').read_bytes())
+    raw[-1] ^= 0xFF
+    (tmp_path / 'checkpoint-1.pkl').write_bytes(bytes(raw))
+    assert checkpoint.find_resume_epoch(tmp_path, 10) == 1
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.restore_checkpoint(tmp_path, 1, payload)
+    with caplog.at_level(logging.WARNING):
+        restored, epoch = checkpoint.auto_resume(tmp_path, 10, payload)
+    assert epoch == 0
+    np.testing.assert_array_equal(restored['w'], payload['w'])
+    assert any('ckpt: corrupt blob key=checkpoint-1.pkl epoch=1 '
+               'reason=hash_mismatch' in rec.getMessage()
+               for rec in caplog.records)
+
+
+def test_store_give_up_exits_rc_120(tmp_path, monkeypatch, caplog):
+    """A dead object store is LOUD: save exits SystemExit(120)
+    (RC_STORE_LOST), never a silent scan-down or a wedge."""
+    import logging
+
+    monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
+    monkeypatch.setenv('KFAC_STORE_BACKEND', 'http')
+    monkeypatch.setenv('KFAC_STORE_ADDR', '127.0.0.1:1')
+    with caplog.at_level(logging.ERROR):
+        with pytest.raises(SystemExit) as exc:
+            checkpoint.save_checkpoint(tmp_path, 0,
+                                       {'w': np.zeros(8)})
+    assert exc.value.code == 120
+    assert any('checkpoint store lost' in rec.getMessage()
+               and 'store_lost=1' in rec.getMessage()
+               for rec in caplog.records)
+
+
+def test_pickle_roundtrip_through_http_store(tmp_path, monkeypatch):
+    """KFAC_STORE_BACKEND=http: the pickle save/resume path runs
+    entirely against the object server — no checkpoint blobs or
+    manifests on the local filesystem."""
+    from kfac_pytorch_tpu.store import StoreHttpServer
+    monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
+    srv = StoreHttpServer('127.0.0.1', 0).start()
+    try:
+        monkeypatch.setenv('KFAC_STORE_BACKEND', 'http')
+        monkeypatch.setenv('KFAC_STORE_ADDR', srv.address)
+        payload = {'w': np.arange(32, dtype=np.float32)}
+        checkpoint.save_checkpoint(tmp_path, 2, payload)
+        assert not (tmp_path / 'checkpoint-2.pkl').exists()
+        assert checkpoint.find_resume_epoch(tmp_path, 10) == 2
+        restored, epoch = checkpoint.auto_resume(tmp_path, 10, payload)
+        assert epoch == 2
+        np.testing.assert_array_equal(restored['w'], payload['w'])
+        # retention applies to the remote copies too
+        checkpoint.save_checkpoint(tmp_path, 3, payload)
+        checkpoint.prune_checkpoints(str(tmp_path), 1)
+        assert checkpoint.find_resume_epoch(tmp_path, 10) == 3
+        assert checkpoint.auto_resume(tmp_path, 2, payload) == (None,
+                                                                None)
+    finally:
+        srv.stop()

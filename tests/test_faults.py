@@ -218,9 +218,10 @@ def test_sigterm_fault_trips_preemption_guard(monkeypatch):
 
 def test_checkpoint_truncate_then_auto_resume_falls_back(tmp_path,
                                                          monkeypatch):
-    """'truncate' drill: the pre-atomic crash-mid-save behavior leaves a
-    truncated FINAL file that find_resume_epoch selects; auto_resume must
-    scan down to the older readable epoch instead of crashing."""
+    """'truncate' drill: a torn object under the FINAL name, with no
+    manifest. The manifest-aware resume scan now refuses the epoch
+    outright (it used to select it and rely on auto_resume crashing into
+    the truncation), and auto_resume lands on the older committed one."""
     monkeypatch.setattr(checkpoint, '_HAS_ORBAX', False)
     payload = {'w': np.arange(1000, dtype=np.float32), 'epoch': np.int32(0)}
     checkpoint.save_checkpoint(tmp_path, 0, payload)
@@ -228,9 +229,11 @@ def test_checkpoint_truncate_then_auto_resume_falls_back(tmp_path,
     checkpoint.save_checkpoint(tmp_path, 1, {'w': np.ones(1000)})
     monkeypatch.delenv(faults.ENV_CKPT)
     assert (tmp_path / 'checkpoint-1.pkl').exists()
+    assert not (tmp_path / 'checkpoint-1.manifest.json').exists()
     with pytest.raises(Exception):
         checkpoint.restore_checkpoint(tmp_path, 1, payload)
-    assert checkpoint.find_resume_epoch(tmp_path, 10) == 1
+    # the torn epoch is skipped without ever being read
+    assert checkpoint.find_resume_epoch(tmp_path, 10) == 0
     restored, epoch = checkpoint.auto_resume(tmp_path, 10, payload)
     assert epoch == 0
     np.testing.assert_array_equal(restored['w'], payload['w'])

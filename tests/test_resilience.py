@@ -1090,6 +1090,84 @@ def test_grow_yields_to_concurrent_shrink_at_same_generation(tmp_path):
     assert 'grow_yielded' in events and 'grow' not in events
 
 
+def test_wait_child_idle_lane_reads_are_o_changes(tmp_path):
+    """Watch-driven settle regression: a HEALTHY pod's supervisor loop
+    must not re-scan the shrink/grow/join/suspend lanes on every child
+    poll — the decoded reads are gated on the backend's change feeds,
+    so dozens of idle iterations cost one baseline scan, and a single
+    key write (here: a join announcement) triggers exactly one more
+    round. hb_interval is set far beyond the test so the OLD paced
+    path could never have seen the announcement — reacting to it at
+    all proves the lanes now ride the watch, and the read counter
+    proves the idle cost is O(changes), not O(polls)."""
+    from kfac_pytorch_tpu import coord as coord_mod
+    from kfac_pytorch_tpu.resilience.elastic import PodSupervisor
+
+    class CountingCoord:
+        """Counts the DECODED protocol reads (the expensive scans the
+        watch gate exists to skip); watch/get_many_versioned pass
+        through to the inner backend uncounted."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.reads = 0
+
+        def get(self, key):
+            self.reads += 1
+            return self._inner.get(key)
+
+        def get_many(self, prefix):
+            self.reads += 1
+            return self._inner.get_many(prefix)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    lease = tmp_path / 'lease'
+    lease.mkdir()
+
+    class FakeChild:
+        """Stays alive for many supervisor polls, announces a joiner
+        partway through, and exits late as a safety valve (reaching
+        the valve means the watch never delivered — the reason
+        assertion below then fails loudly instead of hanging)."""
+
+        def __init__(self):
+            self.polls = 0
+
+        def poll(self):
+            self.polls += 1
+            if self.polls == 30:
+                resilience.atomic_write_json(
+                    str(lease / 'join-7.json'), {'host': 7, 'addr': None})
+            return 0 if self.polls >= 400 else None
+
+        def wait(self):
+            return 0
+
+        def terminate(self):
+            self.polls = 10 ** 6
+
+        def kill(self):
+            self.polls = 10 ** 6
+
+    counting = CountingCoord(coord_mod.backend_from_env(str(lease)))
+    sup = PodSupervisor(['t'], host_id=0, num_hosts=2,
+                        lease_dir=str(lease), poll_period=0.005,
+                        hb_interval=300.0, coord=counting)
+    sup.child = FakeChild()
+    rc, reason = sup._wait_child()
+    assert reason == 'grow' and rc == 0
+    # many idle iterations actually happened...
+    assert sup.child.polls >= 25
+    # ...but only two read rounds: the first-iteration baseline (4
+    # reads: shrink claims, suspend marker, join announcements, grow
+    # claims) and the announcement-triggered round. Headroom to 10 so
+    # an extra lane read is a tweak, not a flake; the old per-poll
+    # shrink scan alone would exceed it several times over.
+    assert counting.reads <= 10, counting.reads
+
+
 def test_join_timeout_withdraws_orphan_barrier_claim(tmp_path):
     """Review finding: a joiner that claimed into a barrier but timed
     out before admission must take its claim back out — the incumbents

@@ -279,6 +279,42 @@ def test_drain_migrates_preemptible_jobs_off_the_host(mt_trace):
         assert all(host not in m['dst'].split(',') for m in moves)
 
 
+# -- the 10,000-host envelope (slow) -----------------------------------------
+
+
+@pytest.mark.slow
+def test_ten_thousand_hosts_byte_identical_within_budget(tmp_path):
+    """The full coordination envelope (ISSUE 18): 10,000 hosts — 1,250
+    pods of 8 on one shared KV plane — with the default fault profile
+    (kills, partitions, both replica outages) PLUS the multi-tenant
+    policies (preemption, autoscale, drain) armed, run TWICE: the
+    traces must be byte-identical, nothing may be lost, and the wall
+    budget pins the prefix-indexed KV scan (a whole-store scan per
+    heartbeat read is quadratic in fleet size and blows this budget by
+    an order of magnitude)."""
+    cfg = SimConfig(hosts=10000, preempt_jobs=2, autoscale=True,
+                    drain_at=6.0)
+    t0 = time.monotonic()
+    a = run_fleet_sim(cfg, tmp_path / 'a')
+    wall = time.monotonic() - t0
+    b = run_fleet_sim(cfg, tmp_path / 'b')
+    pa = write_trace(a, tmp_path / 'a.jsonl')
+    pb = write_trace(b, tmp_path / 'b.jsonl')
+    assert open(pa, 'rb').read() == open(pb, 'rb').read()
+    assert wall < 420.0, f'10k-host sweep took {wall:.1f}s'
+    start, end = a[0], a[-1]
+    assert start['kind'] == 'sim_start' and start['hosts'] == 10000
+    assert end['kind'] == 'sim_end'
+    assert end['coord_lost'] == 0
+    assert end['jobs_finished'] and end['drained'] and end['repaired']
+    k = _kinds(a)
+    assert 'job_lost' not in k
+    assert len(k['host_kill']) == cfg.kill_pods
+    assert len(k['partition']) == cfg.partition_pods
+    assert sorted(e['job'] for e in k['job_done']) == \
+        list(range(1, cfg.jobs + cfg.preempt_jobs + 1))
+
+
 # -- CLI ---------------------------------------------------------------------
 
 

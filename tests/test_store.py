@@ -1,0 +1,445 @@
+"""The durable checkpoint plane's object store (kfac_pytorch_tpu/store/).
+
+Pins the tentpole contracts with NO jax and no subprocesses (the
+real-process store-chaos drill lives in CI):
+
+1. Both backends honor the primitive contract — whole-object get/put,
+   head, prefix list, preconditioned puts (create-only / replace-exact
+   / ANY) where a conflict is an ANSWER (None), not an error — and
+   generations are CONTENT HASHES, so the same bytes carry the same
+   token on the posix store and on the HTTP store (what lets
+   kfac-ckpt-verify repair from a mirror by token equality).
+2. Torn uploads are atomic: a put that dies mid-stream commits NOTHING
+   — a reader sees the old object or none, never a partial.
+3. Ack-lost puts replay idempotently: the HTTP server's token memory
+   answers the retry with the ORIGINAL success, so a create-only put
+   whose ack was lost never self-conflicts.
+4. ChaosStore's fault schedule is a pure function of
+   (seed, op, key, attempt) — identical runs, identical traces — and
+   the strict faults.from_env surface rejects typo'd drills.
+5. RetryingStore rides out transients with bounded jittered backoff,
+   counts every retry, and gives up LOUDLY (StoreGiveUp + the
+   machine-greppable form that escalates to RC_STORE_LOST=120).
+6. The manifest plane: build/parse roundtrip, corrupt-blob
+   classification, and the kfac-ckpt-verify scrub repairing from a
+   mirror and from an older epoch holding the same content.
+"""
+
+import json
+import logging
+import os
+
+import pytest
+
+from kfac_pytorch_tpu.store import (
+    ANY, ChaosStore, HttpStore, PosixStore, RC_STORE_LOST,
+    RetryingStore, StoreFaultConfig, StoreGiveUp, StoreHttpServer,
+    StoreTimeout, generation_of, store_from_env)
+from kfac_pytorch_tpu.store import chaos as store_chaos
+from kfac_pytorch_tpu.store import verify as store_verify
+from kfac_pytorch_tpu.store.manifest import (
+    build_manifest, encode_manifest, manifest_epochs, manifest_key,
+    parse_manifest, verify_blob, verify_epoch)
+from kfac_pytorch_tpu.resilience.retry import ManualClock, RetryPolicy
+
+pytestmark = pytest.mark.core
+
+
+@pytest.fixture(scope='module')
+def http_server():
+    srv = StoreHttpServer('127.0.0.1', 0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(params=['posix', 'http'])
+def store(request, tmp_path, http_server):
+    if request.param == 'posix':
+        yield PosixStore(str(tmp_path / 'root'))
+    else:
+        s = HttpStore(f'127.0.0.1:{http_server.port}',
+                      namespace=str(tmp_path / 'root'))
+        yield s
+        s.close()
+
+
+# -- 1. the primitive contract, identically on both backends --------------
+
+def test_put_get_head_roundtrip(store):
+    assert store.get('a/b.bin') is None
+    assert store.head('a/b.bin') is None
+    gen = store.put('a/b.bin', b'payload')
+    assert gen == generation_of(b'payload')
+    data, got_gen = store.get('a/b.bin')
+    assert data == b'payload' and got_gen == gen
+    meta = store.head('a/b.bin')
+    assert meta.generation == gen and meta.size == len(b'payload')
+
+
+def test_generations_are_content_hashes_cross_backend(tmp_path,
+                                                      http_server):
+    posix = PosixStore(str(tmp_path / 'p'))
+    http = HttpStore(f'127.0.0.1:{http_server.port}',
+                     namespace=str(tmp_path / 'h'))
+    try:
+        assert posix.put('k', b'hello world') \
+            == http.put('k', b'hello world')
+    finally:
+        http.close()
+
+
+def test_preconditions_are_answers_not_errors(store):
+    gen = store.put('k', b'v1', if_generation=None)   # create-only
+    assert gen is not None
+    # create-only against an existing object: conflict answer
+    assert store.put('k', b'v2', if_generation=None) is None
+    # replace-exact with the right token wins...
+    gen2 = store.put('k', b'v2', if_generation=gen)
+    assert gen2 == generation_of(b'v2')
+    # ...and a stale token answers None without clobbering
+    assert store.put('k', b'v3', if_generation=gen) is None
+    assert store.get('k').data == b'v2'
+    # ANY is unconditional
+    assert store.put('k', b'v3') == generation_of(b'v3')
+
+
+def test_list_and_delete_prefix(store):
+    for name in ('checkpoint-1.pkl', 'checkpoint-1.manifest.json',
+                 'checkpoint-2/a/b.bin', 'other.txt'):
+        store.put(name, b'x')
+    assert sorted(store.list('checkpoint-1')) == [
+        'checkpoint-1.manifest.json', 'checkpoint-1.pkl']
+    metas = store.list_meta('checkpoint-2/')
+    assert set(metas) == {'checkpoint-2/a/b.bin'}
+    assert metas['checkpoint-2/a/b.bin'].size == 1
+    assert store.delete('other.txt') is True
+    assert store.delete('other.txt') is False   # idempotent
+    store.delete_prefix('checkpoint-2/')
+    assert store.list('checkpoint-2/') == []
+    assert sorted(store.list('')) == [
+        'checkpoint-1.manifest.json', 'checkpoint-1.pkl']
+
+
+def test_bad_keys_rejected(store):
+    for bad in ('/abs', 'a/../b', '', 'a//b', '..'):
+        with pytest.raises(ValueError):
+            store.put(bad, b'x')
+        with pytest.raises(ValueError):
+            store.get(bad)
+
+
+def test_dead_http_server_is_a_timeout_not_a_hang():
+    s = HttpStore('127.0.0.1:1', namespace='ns', timeout=0.5)
+    try:
+        with pytest.raises(StoreTimeout):
+            s.get('k')
+    finally:
+        s.close()
+
+
+def test_http_namespace_isolation(http_server):
+    a = HttpStore(f'127.0.0.1:{http_server.port}', namespace='ns-a')
+    b = HttpStore(f'127.0.0.1:{http_server.port}', namespace='ns-b')
+    try:
+        a.put('k', b'from-a')
+        assert b.get('k') is None
+        assert b.list('') == []
+    finally:
+        a.close()
+        b.close()
+
+
+# -- 2. torn uploads are atomic -------------------------------------------
+
+def test_torn_upload_commits_nothing(store):
+    store.put('k', b'old')
+    chaos = ChaosStore(store, StoreFaultConfig(seed=7, torn=1.0))
+    with pytest.raises(StoreTimeout):
+        chaos.put('k', b'new-longer-payload')
+    assert chaos.counts['torn'] == 1
+    # the atomicity contract: old object intact, generation unchanged
+    blob = store.get('k')
+    assert blob.data == b'old' and blob.generation == generation_of(b'old')
+
+
+def test_http_server_discards_short_body(http_server, tmp_path):
+    """A PUT whose connection died mid-body (Content-Length mismatch)
+    must be rejected by the server with nothing committed."""
+    import http.client
+    s = HttpStore(f'127.0.0.1:{http_server.port}',
+                  namespace=str(tmp_path / 'torn'))
+    try:
+        s.put('k', b'old')
+        conn = http.client.HTTPConnection(
+            '127.0.0.1', http_server.port, timeout=5)
+        conn.putrequest('PUT', s._obj_path(s._full('k')))
+        conn.putheader('Content-Length', '100')   # promises 100 bytes
+        conn.endheaders()
+        conn.send(b'partial')                      # delivers 7, dies
+        conn.close()
+        blob = s.get('k')
+        assert blob.data == b'old'
+    finally:
+        s.close()
+
+
+# -- 3. ack-lost replay is idempotent -------------------------------------
+
+def _seed_firing_once(op, key, lane, p):
+    """A seed whose lane fires on attempt 1 but not attempt 2 — the
+    deterministic schedule makes this a pure search, no flakiness."""
+    for seed in range(1, 2000):
+        cfg = StoreFaultConfig(seed=seed)
+        if store_chaos._u(cfg, op, key, 1, lane) < p \
+                and store_chaos._u(cfg, op, key, 2, lane) >= p:
+            return seed
+    raise AssertionError('no such seed in range')
+
+
+def test_ack_lost_create_only_replay_lands_as_original_success(
+        http_server, tmp_path):
+    """The commit lands, the ack dies, the retry replays the same
+    idempotency token — the server answers the ORIGINAL success
+    instead of a create-only self-conflict."""
+    seed = _seed_firing_once('put', 'k', lane=3, p=0.5)
+    inner = HttpStore(f'127.0.0.1:{http_server.port}',
+                      namespace=str(tmp_path / 'ack'))
+    chaos = ChaosStore(inner, StoreFaultConfig(seed=seed, ack_lost=0.5))
+    retrying = RetryingStore(chaos, clock=ManualClock())
+    try:
+        gen = retrying.put('k', b'payload', if_generation=None)
+        assert chaos.counts['ack_lost'] == 1
+        assert retrying.stats()['retries'] == 1
+        assert gen == generation_of(b'payload')
+        assert inner.get('k').data == b'payload'
+    finally:
+        retrying.close()
+
+
+def test_ack_lost_unconditional_replay_is_idempotent_on_posix(tmp_path):
+    """Local backends have no token memory and need none for ANY puts:
+    replaying the same bytes re-commits the same content hash."""
+    seed = _seed_firing_once('put', 'k', lane=3, p=0.5)
+    inner = PosixStore(str(tmp_path / 'root'))
+    chaos = ChaosStore(inner, StoreFaultConfig(seed=seed, ack_lost=0.5))
+    retrying = RetryingStore(chaos, clock=ManualClock())
+    gen = retrying.put('k', b'payload')
+    assert chaos.counts['ack_lost'] == 1
+    assert gen == generation_of(b'payload')
+
+
+# -- 4. deterministic chaos, strict env -----------------------------------
+
+def test_chaos_schedule_is_deterministic(tmp_path):
+    def run(name):
+        cfg = StoreFaultConfig(seed=11, fail=0.4, torn=0.4,
+                               partial=0.4, ack_lost=0.2)
+        chaos = ChaosStore(PosixStore(str(tmp_path / name)), cfg)
+        for i in range(30):
+            key = f'k{i % 3}'
+            try:
+                chaos.put(key, f'v{i}'.encode())
+            except StoreTimeout:
+                pass
+            try:
+                chaos.get(key)
+            except StoreTimeout:
+                pass
+        return list(chaos.trace)
+    first, second = run('a'), run('b')
+    assert first == second
+    assert first   # the probabilities above must actually fire
+
+
+def test_partial_read_presents_committed_generation(tmp_path):
+    """The bit-rot shape only a content-hash check catches: truncated
+    bytes under the REAL generation token."""
+    inner = PosixStore(str(tmp_path / 'root'))
+    inner.put('k', b'0123456789')
+    chaos = ChaosStore(inner, StoreFaultConfig(seed=3, partial=1.0))
+    blob = chaos.get('k')
+    assert blob.data == b'01234'
+    assert blob.generation == generation_of(b'0123456789')
+
+
+def test_store_chaos_env_contract_is_strict():
+    env = {store_chaos.ENV_STORE_TORN: '2.0'}
+    with pytest.raises(ValueError):
+        store_chaos.from_env(env=env)
+    with pytest.raises(ValueError):
+        store_chaos.from_env(env={store_chaos.ENV_STORE_SEED: 'abc'})
+    assert store_chaos.from_env(env={}) is None
+    cfg = store_chaos.from_env(env={
+        store_chaos.ENV_STORE_SEED: '9',
+        store_chaos.ENV_STORE_ACK_LOST: '0.25',
+        store_chaos.ENV_STORE_WINDOWS: '10:40;90:95',
+        store_chaos.ENV_STORE_T0: '100.0'})
+    assert cfg.seed == 9 and cfg.ack_lost == 0.25
+    assert cfg.windows == ((10.0, 40.0), (90.0, 95.0))
+    assert cfg.unavailable(120.0) and not cfg.unavailable(150.0)
+
+
+def test_faults_from_env_registers_store_drills(monkeypatch):
+    from kfac_pytorch_tpu import faults
+    monkeypatch.setenv(store_chaos.ENV_STORE_SEED, '5')
+    monkeypatch.setenv(store_chaos.ENV_STORE_FAIL, '0.1')
+    faults.from_env()   # strict surface accepts the armed drill
+    monkeypatch.setenv(store_chaos.ENV_STORE_FAIL, 'banana')
+    with pytest.raises(ValueError):
+        faults.from_env()
+
+
+# -- 5. bounded retries, loud give-up -------------------------------------
+
+def _retrying(inner, attempts=4):
+    return RetryingStore(
+        inner,
+        policy=RetryPolicy(attempts=attempts, base_delay=0.01,
+                           max_delay=0.02, jitter=0.0,
+                           retry_on=(StoreTimeout,)),
+        clock=ManualClock())
+
+
+def test_retrying_store_rides_out_transients(tmp_path):
+    seed = _seed_firing_once('put', 'k', lane=1, p=0.5)
+    chaos = ChaosStore(PosixStore(str(tmp_path / 'root')),
+                       StoreFaultConfig(seed=seed, torn=0.5))
+    retrying = _retrying(chaos)
+    assert retrying.put('k', b'v') == generation_of(b'v')
+    stats = retrying.stats()
+    assert stats['retries'] == 1 and stats['gave_up'] == 0
+
+
+def test_retrying_store_gives_up_loudly(tmp_path, caplog):
+    cfg = StoreFaultConfig(seed=1, windows=((0.0, float('inf')),),
+                           t0=0.0)
+    chaos = ChaosStore(PosixStore(str(tmp_path / 'root')), cfg)
+    retrying = _retrying(chaos, attempts=3)
+    with caplog.at_level(logging.WARNING, logger='kfac_pytorch_tpu'
+                                                 '.store.base'):
+        with pytest.raises(StoreGiveUp):
+            retrying.get('k')
+    assert retrying.stats() == {'retries': 2, 'gave_up': 1,
+                                'wait_s': pytest.approx(0.03)}
+    assert any('store: giving up op=get key=k after 3 attempts' in r
+               and '[resilience: store_gave_up=1]' in r
+               for r in (rec.getMessage() for rec in caplog.records))
+
+
+def test_store_from_env_selection(tmp_path, http_server, monkeypatch):
+    s = store_from_env(str(tmp_path / 'a'), env={})
+    assert isinstance(s, RetryingStore) \
+        and isinstance(s.inner, PosixStore)
+    env = {'KFAC_STORE_BACKEND': 'http',
+           'KFAC_STORE_ADDR': f'127.0.0.1:{http_server.port}'}
+    h = store_from_env(str(tmp_path / 'a'), env=env)
+    assert isinstance(h.inner, HttpStore)
+    h.close()
+    with pytest.raises(ValueError):
+        store_from_env(str(tmp_path / 'a'),
+                       env={'KFAC_STORE_BACKEND': 'http'})
+    with pytest.raises(ValueError):
+        store_from_env(str(tmp_path / 'a'),
+                       env={'KFAC_STORE_BACKEND': 'ftp'})
+    chaotic = store_from_env(
+        str(tmp_path / 'a'),
+        env={store_chaos.ENV_STORE_SEED: '3',
+             store_chaos.ENV_STORE_FAIL: '0.5'})
+    assert isinstance(chaotic.inner, ChaosStore)
+
+
+# -- 6. the manifest plane and the scrub ----------------------------------
+
+def _commit_epoch(store, epoch, data):
+    key = f'checkpoint-{epoch}.pkl'
+    store.put(key, data)
+    manifest = build_manifest(epoch, 'pickle', {key: data})
+    store.put(manifest_key(epoch), encode_manifest(manifest))
+    return key
+
+
+def test_manifest_roundtrip_and_epochs(store):
+    _commit_epoch(store, 0, b'state-0')
+    _commit_epoch(store, 2, b'state-2')
+    store.put('checkpoint-1.pkl', b'torn')   # blob without manifest
+    assert sorted(manifest_epochs(store)) == [0, 2]
+    manifest = parse_manifest(store.get(manifest_key(2)).data)
+    assert manifest['epoch'] == 2 and manifest['kind'] == 'pickle'
+    assert verify_epoch(store, manifest) == []
+    assert parse_manifest(b'not json') is None
+    assert parse_manifest(json.dumps({'format': 99}).encode()) is None
+
+
+def test_verify_blob_classifies_corruption(store):
+    key = _commit_epoch(store, 0, b'0123456789')
+    manifest = parse_manifest(store.get(manifest_key(0)).data)
+    spec = manifest['blobs'][key]
+    assert verify_blob(store, key, spec) is None
+    store.put(key, b'0123456789'[:5])
+    assert verify_blob(store, key, spec) == 'size_mismatch'
+    store.put(key, b'012345678X')
+    assert verify_blob(store, key, spec) == 'hash_mismatch'
+    store.delete(key)
+    assert verify_blob(store, key, spec) == 'missing'
+
+
+def test_scrub_repairs_from_mirror(store, tmp_path, caplog):
+    key = _commit_epoch(store, 0, b'precious-state')
+    mirror = PosixStore(str(tmp_path / 'mirror'))
+    with caplog.at_level(logging.INFO,
+                         logger='kfac_pytorch_tpu.store.verify'):
+        # backup pass: the clean scrub populates the mirror
+        assert store_verify.scrub(store, mirror=mirror,
+                                  sync_mirror=True) == (1, 0, 0)
+        assert mirror.get(key).data == b'precious-state'
+        # bit-rot lands; the next scrub repairs it from the mirror
+        store.put(key, b'precious-stat3')
+        assert store_verify.scrub(store, mirror=mirror) == (1, 1, 0)
+    assert store.get(key).data == b'precious-state'
+    messages = [rec.getMessage() for rec in caplog.records]
+    assert any('ckpt: corrupt blob key=%s' % key in m
+               and 'reason=hash_mismatch' in m for m in messages)
+    assert any('ckpt: repaired blob key=%s' % key in m
+               and 'source=mirror' in m
+               and '[resilience: ckpt_repaired=1]' in m
+               for m in messages)
+    assert any('ckpt: verified epoch=0 blobs=1' in m for m in messages)
+
+
+def test_scrub_repairs_from_older_epoch_by_content_hash(store):
+    """Same bytes under an older epoch's key repair a newer epoch —
+    content-addressed, never state substitution."""
+    _commit_epoch(store, 1, b'converged-state')
+    key2 = _commit_epoch(store, 2, b'converged-state')
+    store.delete(key2)
+    assert store_verify.scrub(store) == (2, 1, 0)
+    assert store.get(key2).data == b'converged-state'
+
+
+def test_scrub_reports_unrepairable(store):
+    key = _commit_epoch(store, 0, b'only-copy')
+    store.put(key, b'only-cop?')
+    verified, repaired, unrepaired = store_verify.scrub(store)
+    assert (verified, repaired, unrepaired) == (0, 0, 1)
+
+
+def test_verify_cli_roundtrip(tmp_path, monkeypatch):
+    root = tmp_path / 'ckpt'
+    store = PosixStore(str(root))
+    key = _commit_epoch(store, 3, b'cli-state')
+    monkeypatch.delenv('KFAC_STORE_BACKEND', raising=False)
+    assert store_verify.main(['--root', str(root)]) == 0
+    (root / key).write_bytes(b'cli-stat3')
+    # no repair source: unrepaired corruption is exit 1
+    assert store_verify.main(['--root', str(root), '--no-repair']) == 1
+
+
+def test_verify_cli_store_lost_exits_120(monkeypatch, caplog):
+    monkeypatch.setenv('KFAC_STORE_BACKEND', 'http')
+    monkeypatch.setenv('KFAC_STORE_ADDR', '127.0.0.1:1')
+    with caplog.at_level(logging.ERROR):
+        assert store_verify.main(['--root', 'ns']) == RC_STORE_LOST
+    assert any('checkpoint store lost' in rec.getMessage()
+               and 'store_lost=1' in rec.getMessage()
+               for rec in caplog.records)
