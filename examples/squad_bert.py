@@ -83,6 +83,19 @@ def parse_args():
                         "inverse update's gathered decomposition for "
                         'the NEXT step so the gather overlaps the pred '
                         'einsums (one step of decomposition staleness)')
+    p.add_argument('--kfac-capture-impl',
+                   default=os.environ.get('KFAC_CAPTURE_IMPL') or None,
+                   choices=['xla', 'pallas', 'auto'],
+                   help='capture kernels (default from '
+                        '$KFAC_CAPTURE_IMPL; unset = the legacy '
+                        'capture path, hidden from the autotuner): '
+                        'xla = patch-extract + factor GEMM + EMA as '
+                        'separate XLA ops; pallas = the fused Pallas '
+                        'kernels (no HBM patch matrix, EMA / wire-'
+                        'quantize folded into the epilogues); auto = '
+                        'the fused rung. An explicit value makes this '
+                        'a live autotuner ladder rung (see README '
+                        '"Capture hot path")')
     p.add_argument('--kfac-decomp-impl',
                    default=os.environ.get('KFAC_DECOMP_IMPL') or None,
                    choices=['xla', 'auto', 'jacobi', 'subspace',
@@ -235,6 +248,7 @@ def main():
             comm_mode=args.kfac_comm_mode,
             comm_prefetch=args.kfac_comm_prefetch,
             decomp_impl=args.kfac_decomp_impl,
+            capture_impl=args.kfac_capture_impl,
             decomp_shard=args.kfac_decomp_shard,
             kl_clip=args.kl_clip, factor_decay=args.stat_decay,
             exclude_vocabulary_size=cfg.vocab_size,

@@ -70,7 +70,8 @@ from collections import deque
 #: queues a ``KFAC.request_replan`` so the trainer rebuilds the
 #: FactorPlan and swaps the (verbatim-carried) state between steps.
 KNOB_ATTRS = ('fac_update_freq', 'kfac_update_freq', 'damping',
-              'comm_precision', 'decomp_impl', 'comm_mode')
+              'comm_precision', 'decomp_impl', 'comm_mode',
+              'capture_impl')
 
 #: the wire-dtype ladder the tuner climbs (successive halving of the
 #: collective payload; collectives.WIRE_DTYPES order).
@@ -91,6 +92,15 @@ DECOMP_IMPLS = ('xla', 'auto', 'jacobi', 'subspace', 'newton_schulz')
 DECOMP_LADDERS = {'eigh': ('xla', 'subspace'),
                   'cholesky': ('xla', 'newton_schulz')}
 
+#: the capture-kernel ladder (ISSUE 19): the reference XLA capture path
+#: vs the fused Pallas kernels (patch-extract + factor GEMM + EMA /
+#: wire-quantize epilogues). Method-independent — every factor kind has
+#: a fused kernel — so one two-rung ladder serves all variants.
+#: Restates preconditioner.CAPTURE_IMPLS (this module must stay
+#: stdlib-importable; agreement pinned by tests/test_autotune.py).
+CAPTURE_IMPLS = ('xla', 'pallas', 'auto')
+CAPTURE_LADDER = ('xla', 'pallas')
+
 #: arbiter knob -> the spec/trainer-flag name a relaunch carries it
 #: back through (service.spec.KFAC_KNOBS grammar; lockstep with the
 #: trainers' ``--kfac-*`` flags). ``damping`` is deliberately absent:
@@ -102,6 +112,7 @@ ADOPTED_KNOB_FLAGS = {
     'comm_precision': 'kfac_comm_precision',
     'decomp_impl': 'kfac_decomp_impl',
     'comm_mode': 'kfac_comm_mode',
+    'capture_impl': 'kfac_capture_impl',
 }
 
 #: the adopted-knob snapshot filename (written next to the decision
@@ -137,6 +148,7 @@ def _capture(precond):
         'comm_precision': getattr(precond, 'comm_precision', None),
         'decomp_impl': getattr(precond, 'decomp_impl', None),
         'comm_mode': getattr(precond, 'comm_mode', None),
+        'capture_impl': getattr(precond, 'capture_impl', None),
     }
 
 
@@ -231,6 +243,9 @@ class KnobArbiter:
             if 'comm_mode' in changed:
                 self.tuner.pop('comm_mode', None)
                 self.base['comm_mode'] = cur['comm_mode']
+            if 'capture_impl' in changed:
+                self.tuner.pop('capture_impl', None)
+                self.base['capture_impl'] = cur['capture_impl']
             self._applied = cur
             return True
 
@@ -328,6 +343,8 @@ class KnobArbiter:
             'decomp_impl', self.base['decomp_impl'])
         eff['comm_mode'] = self.tuner.get(
             'comm_mode', self.base['comm_mode'])
+        eff['capture_impl'] = self.tuner.get(
+            'capture_impl', self.base['capture_impl'])
         return eff
 
     def _commit(self, source):
@@ -351,6 +368,11 @@ class KnobArbiter:
             raise ValueError(
                 f'decomp_impl must be one of {DECOMP_IMPLS}, '
                 f'got {eff["decomp_impl"]!r}')
+        if ('capture_impl' in changed
+                and eff['capture_impl'] not in CAPTURE_IMPLS):
+            raise ValueError(
+                f'capture_impl must be one of {CAPTURE_IMPLS}, '
+                f'got {eff["capture_impl"]!r}')
         if 'comm_mode' in changed:
             if eff['comm_mode'] not in COMM_MODES:
                 raise ValueError(f'comm_mode must be one of {COMM_MODES}, '
@@ -386,13 +408,14 @@ class KnobArbiter:
             if request is not None:
                 request(comm_mode=eff['comm_mode'], _invalidate=False)
         if ('comm_precision' in changed or 'decomp_impl' in changed
-                or 'comm_mode' in changed):
-            # the wire dtype AND the decomposition kernel are baked
-            # into the traced programs (comm_precision also into the
-            # EF-residual state structure; comm_mode into the whole
-            # collective schedule): every attached trainer's variant
-            # cache must retrace; training.step_fn re-seeds / drops
-            # KFACState.comm_err host-side on the next dispatch
+                or 'comm_mode' in changed or 'capture_impl' in changed):
+            # the wire dtype AND the decomposition kernel AND the
+            # capture kernels are baked into the traced programs
+            # (comm_precision also into the EF-residual state
+            # structure; comm_mode into the whole collective schedule):
+            # every attached trainer's variant cache must retrace;
+            # training.step_fn re-seeds / drops KFACState.comm_err
+            # host-side on the next dispatch
             self.invalidate()
         self.changes += 1
         self._applied = _capture(self.precond)
@@ -583,7 +606,8 @@ class KnobController:
     def __init__(self, precond, *, window=16, settle=2, rel_improve=0.03,
                  dwell_windows=2, cooldown=6, steady_every=50,
                  tune=('kfac_update_freq', 'fac_update_freq',
-                       'comm_precision', 'decomp_impl', 'comm_mode'),
+                       'comm_precision', 'decomp_impl', 'comm_mode',
+                       'capture_impl'),
                  freq_bounds=None, comm_precisions=COMM_PRECISIONS,
                  predicted=None, platform=None, variant=None,
                  anchor='central', decision_log=None, log=None,
@@ -697,9 +721,10 @@ class KnobController:
 
     def _seed(self):
         self._seeded = 'done'
-        # kernel first: the freq prior prices the decomposition phase
+        # kernels first: the freq prior prices the decomposition phase
         # at the kernel the run will actually execute
         self._seed_decomp_impl()
+        self._seed_capture_impl()
         self._seed_freq()
 
     def _seed_freq(self):
@@ -752,6 +777,40 @@ class KnobController:
         self.log.info('autotune: seeded decomp_impl=%s from perfmodel '
                       'prior (%s)', best, self.anchor)
         self._instant('autotune_seed', decomp_impl=best)
+        self._settle_left = self.settle
+
+    def _seed_capture_impl(self):
+        """Seed the capture-kernel rung from the perf model's fusion
+        priors (perfmodel.capture_impl_priors): when the fused Pallas
+        capture's predicted ComputeFactor phase undercuts the unfused
+        XLA path's, start there — the win is the skipped HBM patch
+        matrix and the folded EMA/quantize epilogues, which the roofline
+        prices without a probe."""
+        if 'capture_impl' not in self.tune:
+            return
+        cur = getattr(self.precond, 'capture_impl', None)
+        if cur is None:
+            # None = the legacy capture path AND the rung hidden from
+            # the tuner (preconditioner.CAPTURE_IMPLS contract)
+            return
+        try:
+            from kfac_pytorch_tpu.perfmodel import capture_impl_priors
+            priors = capture_impl_priors(self.predicted,
+                                         anchor=self.anchor)
+        except Exception:  # noqa: BLE001 — priors are best-effort
+            return
+        if not priors:
+            return
+        best = min(priors, key=priors.get)
+        eff = (CAPTURE_LADDER[1] if cur == 'auto' else cur)
+        if best == eff:
+            return
+        self.arbiter.propose('tuner', capture_impl=best)
+        self._decision('seed', knob='capture_impl', frm=cur, to=best,
+                       prior_s=priors)
+        self.log.info('autotune: seeded capture_impl=%s from perfmodel '
+                      'prior (%s)', best, self.anchor)
+        self._instant('autotune_seed', capture_impl=best)
         self._settle_left = self.settle
 
     # -- the window --------------------------------------------------------
@@ -833,6 +892,19 @@ class KnobController:
                 # 'auto' sits on the method's warm rung
                 eff = ladder[1] if cur == 'auto' else cur
                 out.extend((knob, cur, v) for v in ladder if v != eff)
+            elif knob == 'capture_impl':
+                # the fused-capture ladder (ISSUE 19): method-
+                # independent — every factor kind has a fused kernel —
+                # but tunable only when the knob was EXPLICITLY
+                # configured (None = the legacy capture path, which the
+                # tuner must not silently take over)
+                cur = getattr(self.precond, 'capture_impl', None)
+                if cur is None:
+                    continue
+                # 'auto' sits on the fused rung
+                eff = CAPTURE_LADDER[1] if cur == 'auto' else cur
+                out.extend((knob, cur, v) for v in CAPTURE_LADDER
+                           if v != eff)
             elif knob == 'comm_mode':
                 # the applied comm-mode switch (ISSUE 14): probeable
                 # only where the replan path exists — a meshed, set-up
@@ -1001,6 +1073,10 @@ class KnobController:
                 # full-eigh band and the gate would veto the very win
                 # it exists to protect
                 decomp_impl=getattr(self.precond, 'decomp_impl', None),
+                # likewise bind ComputeFactor to the capture kernel the
+                # probe actually ran — the fused band sits well under
+                # the unfused one on the modeled chip
+                capture_impl=getattr(self.precond, 'capture_impl', None),
                 source='autotune')
             if verdict == 'drift':
                 self.vetoes += 1
@@ -1136,6 +1212,13 @@ class KnobController:
                 if eff in ladder:
                     registry.gauge('autotune/decomp_impl_rung').set(
                         ladder.index(eff))
+        if k['capture_impl'] is not None:
+            # gauge by ladder index (0 = unfused XLA, 1 = fused Pallas)
+            eff = CAPTURE_LADDER[1] if k['capture_impl'] == 'auto' \
+                else k['capture_impl']
+            if eff in CAPTURE_LADDER:
+                registry.gauge('autotune/capture_impl_rung').set(
+                    CAPTURE_LADDER.index(eff))
         try:
             from kfac_pytorch_tpu.parallel.collectives import \
                 WIRE_COMPRESSION

@@ -96,20 +96,38 @@ def _pad_mat(mat, dg, da):
 # Phase 1: factor statistics
 # ---------------------------------------------------------------------------
 
-def compute_layer_stats(plan, acts, gs, batch_averaged=True):
-    """Per-layer Kronecker factor statistics from captured (a, g)."""
+def _capture_backend(capture_impl):
+    """Resolve the capture knob to (module, kwargs) — 'pallas' routes
+    through the fused kernels (ops/pallas_capture.py, imported lazily so
+    the reference path never pays the Pallas import), anything else
+    stays on the ops/factors.py reference."""
+    if capture_impl == 'pallas':
+        from kfac_pytorch_tpu.ops import pallas_capture
+        return pallas_capture, {
+            'interpret': pallas_capture.interpret_default()}
+    return ops, {}
+
+
+def compute_layer_stats(plan, acts, gs, batch_averaged=True,
+                        capture_impl=None):
+    """Per-layer Kronecker factor statistics from captured (a, g).
+
+    ``capture_impl='pallas'`` computes every statistic with the fused
+    Pallas kernels (interpreter mode off-TPU) — numerically pinned to
+    the reference by tests/test_pallas_capture.py."""
+    back, kw = _capture_backend(capture_impl)
     a_list, g_list = [], []
     for meta in plan.metas:
         a = capture.layer_act(acts, meta)
         g = capture.layer_g(gs, meta)
         if meta.kind == 'dense':
-            a_list.append(ops.compute_a_dense(a, meta.use_bias))
-            g_list.append(ops.compute_g_dense(g, batch_averaged))
+            a_list.append(back.compute_a_dense(a, meta.use_bias, **kw))
+            g_list.append(back.compute_g_dense(g, batch_averaged, **kw))
         else:
-            a_list.append(ops.compute_a_conv(
+            a_list.append(back.compute_a_conv(
                 a, meta.kernel_size, meta.strides, meta.padding,
-                meta.use_bias))
-            g_list.append(ops.compute_g_conv(g, batch_averaged))
+                meta.use_bias, **kw))
+            g_list.append(back.compute_g_conv(g, batch_averaged, **kw))
     return a_list, g_list
 
 
@@ -131,9 +149,74 @@ def stack_stats(plan, a_list, g_list):
     return out
 
 
+def update_factors_fused(plan, factors_local, acts, gs, batch_averaged,
+                         factor_decay):
+    """World=1 local-stats capture with the EMA folded into the kernels.
+
+    The fully fused form of compute_layer_stats -> stack_stats ->
+    update_factors for the case with no factor communication and no
+    row slicing (``stats_reduce='local'``, ``plan.num_devices == 1``):
+    each real factor row is ONE Pallas kernel launch whose accumulator
+    epilogue emits ``update_running_avg(stat, current, factor_decay)``
+    directly — the stacked ``[rows, D, D]`` statistics tensor is never
+    built. The statistic entering the EMA is bit-identical to the
+    unfused capture; identity padding and dummy rows run the exact
+    unfused arithmetic (``update_running_avg`` against
+    ``identity_pad``'s eye padding / the eye dummy); the fused EMA
+    combine itself is within one fp32 FMA rounding of the unfused
+    program (see pallas_capture's numerical contract) and
+    deterministic across steps. Returns the new factors dict.
+    """
+    from kfac_pytorch_tpu.ops import pallas_capture as pc
+    interpret = pc.interpret_default()
+    kw = {'interpret': interpret}
+    new = {}
+    for bdim in plan.bucket_dims:
+        key = _key(bdim)
+        b = plan.buckets[bdim]
+        rows = []
+        for r, s in enumerate(b.slot_of_row):
+            cur = factors_local[key][r]
+            if s is None:
+                rows.append(ops.update_running_avg(
+                    jnp.eye(bdim, dtype=jnp.float32), cur, factor_decay))
+                continue
+            meta = plan.metas[s.layer_idx]
+            f = meta.in_dim if s.side == 'A' else meta.out_dim
+            ema = (cur[:f, :f], factor_decay)
+            if s.side == 'A':
+                a = capture.layer_act(acts, meta)
+                if meta.kind == 'dense':
+                    stat = pc.compute_a_dense(a, meta.use_bias, ema=ema,
+                                              **kw)
+                else:
+                    stat = pc.compute_a_conv(
+                        a, meta.kernel_size, meta.strides, meta.padding,
+                        meta.use_bias, ema=ema, **kw)
+            else:
+                g = capture.layer_g(gs, meta)
+                if meta.kind == 'dense':
+                    stat = pc.compute_g_dense(g, batch_averaged, ema=ema,
+                                              **kw)
+                else:
+                    stat = pc.compute_g_conv(g, batch_averaged, ema=ema,
+                                             **kw)
+            if f == bdim:
+                rows.append(stat)
+            else:
+                # pad region: EMA against identity_pad's eye padding —
+                # elementwise identical to the unfused stacked update
+                tmpl = ops.identity_pad(jnp.zeros((f, f), jnp.float32),
+                                        bdim)
+                row = ops.update_running_avg(tmpl, cur, factor_decay)
+                rows.append(row.at[:f, :f].set(stat))
+        new[key] = jnp.stack(rows)
+    return new
+
+
 def update_factors(plan, factors_local, stats_stacked, factor_decay,
                    stats_reduce, axis_name, comm_precision='fp32',
-                   comm_err=None):
+                   comm_err=None, capture_impl=None):
     """Running-average update of the local factor shard.
 
     ``stats_reduce='pmean'``: MPD semantics — factors are the global-batch
@@ -151,6 +234,11 @@ def update_factors(plan, factors_local, stats_stacked, factor_decay,
     contribution to the factor EMAs stays unbiased. Returns
     ``(new_factors, new_comm_err)``; ``comm_err`` passes through
     untouched on the fp32 / local / world=1 paths.
+
+    ``capture_impl='pallas'`` fuses the lossy reduce's wire-quantize +
+    error-feedback prep into one Pallas pass
+    (:func:`pallas_capture.ef_quantize`) — same wire bytes, one fewer
+    elementwise sweep over the stacked stats.
     """
     new = {}
     new_err = None if comm_err is None else dict(comm_err)
@@ -165,7 +253,8 @@ def update_factors(plan, factors_local, stats_stacked, factor_decay,
             with jax.named_scope('kfac.CommunicateFactor'):
                 local, err = coll.pmean_scatter_ef(
                     stats, axis_name, comm_precision,
-                    None if comm_err is None else comm_err[key])
+                    None if comm_err is None else comm_err[key],
+                    fused=(capture_impl == 'pallas'))
             if new_err is not None and err is not None:
                 new_err[key] = err
         else:

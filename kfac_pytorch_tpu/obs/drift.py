@@ -135,7 +135,8 @@ def measured_from_bench_extras(extra):
     return out
 
 
-def _predicted_phase(phases_s, name, variant, decomp_impl=None):
+def _predicted_phase(phases_s, name, variant, decomp_impl=None,
+                     capture_impl=None):
     """Predicted seconds for one (possibly joint) taxonomy name, or
     None when any component has no prediction. 'ComputeInverse' binds
     to the variant's decomposition kernel (Cholesky for inverse_*,
@@ -143,7 +144,10 @@ def _predicted_phase(phases_s, name, variant, decomp_impl=None):
     rebinds to its GEMM-roofline rung ('ComputeInverse_subspace' /
     'ComputeInverse_ns') — without the rebind, a run on the iterative
     rung would land seconds under the fenced full-eigh band and the
-    gate would read the speedup as drift."""
+    gate would read the speedup as drift. 'ComputeFactor' likewise
+    rebinds to 'ComputeFactor_pallas' under the fused capture rung
+    (``capture_impl`` 'pallas'/'auto', ISSUE 19) — its band sits under
+    the unfused one by the skipped patch-matrix HBM traffic."""
     eigen = variant.startswith('eigen') or variant.startswith('ekfac')
     total = 0.0
     for part in name.split('+'):
@@ -156,6 +160,9 @@ def _predicted_phase(phases_s, name, variant, decomp_impl=None):
                 key = 'ComputeInverse_eigh_full'
             else:
                 key = 'ComputeInverse_chol'
+        elif (part == 'ComputeFactor'
+                and capture_impl in ('pallas', 'auto')):
+            key = 'ComputeFactor_pallas'
         else:
             key = part
         v = phases_s.get(key)
@@ -167,7 +174,8 @@ def _predicted_phase(phases_s, name, variant, decomp_impl=None):
 
 def drift_block(measured_s, predicted_block, *, platform=None,
                 variant='inverse_dp', anchor='central', tolerance=1.0,
-                source=None, comm_precision='fp32', decomp_impl=None):
+                source=None, comm_precision='fp32', decomp_impl=None,
+                capture_impl=None):
     """Assemble the ``drift`` block for a bench emission.
 
     Args:
@@ -192,6 +200,10 @@ def drift_block(measured_s, predicted_block, *, platform=None,
         prediction to the matching rung (see
         :func:`_predicted_phase`), so an iterative-kernel run is
         judged against its own roofline, not the cold kernel's.
+      capture_impl: the capture kernel the measured run selected (KFAC
+        ``capture_impl`` knob, ISSUE 19) — rebinds ComputeFactor to
+        the fused-Pallas band the same way, so a fused-capture run is
+        not read as drift for being faster than the unfused roofline.
 
     Returns a dict; never raises on malformed inputs (a drift block
     must never take the bench down — errors are reported in-band).
@@ -210,7 +222,8 @@ def drift_block(measured_s, predicted_block, *, platform=None,
         for name, meas in sorted((measured_s or {}).items()):
             if meas is None:
                 continue
-            pred = {scen: _predicted_phase(ph, name, variant, decomp_impl)
+            pred = {scen: _predicted_phase(ph, name, variant, decomp_impl,
+                                           capture_impl)
                     for scen, ph in per_scen.items()}
             pred = {k: v for k, v in pred.items() if v is not None}
             entry = {'measured_s': round(float(meas), 6),
@@ -249,6 +262,7 @@ def drift_block(measured_s, predicted_block, *, platform=None,
             'comparable': comparable,
             'comm_precision': comm_precision,
             'decomp_impl': decomp_impl,
+            'capture_impl': capture_impl,
             'anchor_scenario': anchor,
             'tolerance': tolerance,
             'phases': phases,

@@ -1,4 +1,11 @@
-"""Pure-functional math ops for K-FAC on TPU (MXU-batched, fp32 factors)."""
+"""Pure-functional math ops for K-FAC on TPU (MXU-batched, fp32 factors).
+
+The fused capture kernels (``ops.pallas_capture``: patch-extract +
+factor GEMM + EMA / wire-quantize epilogues, ISSUE 19) are deliberately
+NOT imported here — like ``ops.pallas_attention`` they pull in Pallas,
+which the reference capture path never needs; consumers import the
+submodule lazily (engine._capture_backend, collectives.pmean_scatter_ef).
+"""
 
 from kfac_pytorch_tpu.ops.factors import (
     extract_patches,

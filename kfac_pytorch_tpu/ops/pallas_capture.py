@@ -1,0 +1,518 @@
+"""Fused Pallas TPU kernels for the K-FAC capture hot path.
+
+The per-step capture cost (ROADMAP item 2) is four XLA-scheduled passes
+over the same activations/gradients, each paying its own HBM round trip:
+
+  extract_patches -> A/G statistic GEMMs -> EMA update -> wire quantize
+
+This module fuses them (`ops/pallas_attention.py` is the in-repo idiom
+exemplar):
+
+- :func:`compute_a_conv` builds im2col patch rows IN-KERNEL from the
+  (zero-padded) NHWC activation tile and feeds them straight into the
+  A-factor covariance GEMM — the ``[N*OH*OW, kh*kw*C]`` patch matrix is
+  never materialized in HBM;
+- :func:`compute_a_dense` / :func:`compute_g_dense` /
+  :func:`compute_g_conv` run the statistic GEMM with the row scalings
+  (batch-averaged undo, spatial normalization, bias ones-column) applied
+  to the tile in VMEM;
+- every kernel takes an optional ``ema=(current, alpha)`` epilogue that
+  folds ``ops.update_running_avg`` into the fp32 accumulator emit — the
+  factor EMA stops being a separate elementwise pass over ``[F, F]``;
+- :func:`ef_quantize` is the wire-dtype epilogue of the compressed
+  factor reduce (PR 8): one pass producing both the bf16 wire payload
+  and the error-feedback residual, replacing the two-pass
+  add/cast/subtract chain in ``collectives.pmean_scatter_ef``. The
+  collective itself (psum_scatter) stays outside — fusion moves compute,
+  not wire bytes (pinned by scripts/comm_count.py's ``+pallas`` spec).
+
+Numerical contract (pinned by tests/test_pallas_capture.py under the
+Pallas interpreter on CPU): every STAT kernel reproduces the
+corresponding ``ops/factors.py`` reference BIT-FOR-BIT when the whole
+row reduction fits one grid step (the default tile below the VMEM
+budget) — same elementwise scalings in the same order, one
+``dot_general`` of the same shape with ``preferred_element_type=f32``,
+with strict-mode pins (``_pin``/``_div``) holding XLA's jit-time
+rewrites (reciprocal-multiply, scalar hoisting across the dot) to the
+reference's eager rounding sequence. Multi-tile runs accumulate the
+same fp32 partial products in row-tile order (value-equal up to fp32
+summation order). The EMA epilogue is the exception: its final
+``cur*(1-a) + stat*a`` combine FMA-contracts under any jit (barriers
+do not stop LLVM contraction on CPU), so it is pinned as algebraically
+identical, deterministic across steps, and within one fp32 rounding of
+the unfused program — while the statistic feeding it stays bitwise.
+
+Implementation selection follows the repo convention ('xla' | 'pallas' |
+'auto'): :func:`interpret_default` returns True off-TPU so the same
+traced program runs under the interpreter in the CPU test tier.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kfac_pytorch_tpu.ops import factors as _ref
+
+#: fp32 elements a row tile may occupy (~1 MiB) — one tile plus the
+#: [F, F] accumulator must double-buffer inside the ~16 MiB VMEM.
+_TILE_ELEMS = 1 << 18
+
+#: largest fused factor dimension: the kernels keep the full [F, F]
+#: fp32 accumulator in VMEM scratch (F=1024 -> 4 MiB); a wider factor
+#: falls back to the XLA reference per layer. KFAC_CAPTURE_MAX_F
+#: overrides (an on-chip sweep knob, like KFAC_FLASH_TQ/TK).
+_MAX_FUSED_F = 1024
+
+_WARNED = set()
+
+
+def _warn_once(key, msg):
+    if key not in _WARNED:
+        _WARNED.add(key)
+        import sys
+        # host-side stderr warning, keyed once per process; no traced
+        # value flows through it
+        print(f'kfac_pytorch_tpu: {msg}',  # kfac-lint: disable=trace-purity
+              file=sys.stderr)
+
+
+def interpret_default():
+    """Run the kernels under the Pallas interpreter off-TPU — the CPU
+    tier-1 / simulated-mesh path (same convention as ring_attention's
+    'pallas_interpret' block impl)."""
+    return jax.default_backend() != 'tpu'
+
+
+def _max_fused_f():
+    # deliberate trace-time shape knob (the KFAC_FLASH_TQ/TK
+    # precedent): moves the fused-vs-fallback split, never a traced
+    # value; declared in envspec.py
+    # kfac-lint: disable=trace-purity -- trace-time shape knob
+    raw = os.environ.get('KFAC_CAPTURE_MAX_F')
+    if raw is None:
+        return _MAX_FUSED_F
+    try:
+        return int(raw)
+    except ValueError:
+        _warn_once('KFAC_CAPTURE_MAX_F',
+                   f'KFAC_CAPTURE_MAX_F={raw!r} is not an int — using '
+                   f'the default cap {_MAX_FUSED_F}')
+        return _MAX_FUSED_F
+
+
+def _row_tile(rows, elems_per_row):
+    """Rows per grid step: the WHOLE reduction when it fits the VMEM
+    budget (one grid step = one dot_general with the reference's exact
+    shape — the bit-identity case), else the largest divisor of ``rows``
+    under the budget. KFAC_CAPTURE_TR overrides (trace-time knob, like
+    KFAC_FLASH_TQ/TK — lowered to the nearest divisor)."""
+    # deliberate trace-time tiling knob (the KFAC_FLASH_TQ/TK
+    # precedent): picks the grid split, never a traced value; declared
+    # in envspec.py
+    # kfac-lint: disable=trace-purity -- trace-time tiling knob
+    raw = os.environ.get('KFAC_CAPTURE_TR')
+    cap = max(1, _TILE_ELEMS // max(1, elems_per_row))
+    if raw is not None:
+        try:
+            cap = max(1, int(raw))
+        except ValueError:
+            _warn_once('KFAC_CAPTURE_TR',
+                       f'KFAC_CAPTURE_TR={raw!r} is not an int — using '
+                       'the default VMEM-budget tile')
+    t = max(1, min(cap, rows))
+    while rows % t:
+        t -= 1
+    return t
+
+
+def _vma(*arrays):
+    """Union of the varying-manual-axes of the inputs — under shard_map
+    the outputs vary over every axis the inputs do (the
+    pallas_attention.py idiom)."""
+    vma = frozenset()
+    for x in arrays:
+        vma = vma | getattr(jax.typeof(x), 'vma', frozenset())
+    return vma
+
+
+try:  # vma landed with the varying-axis shard_map type system; older
+    jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+    _HAS_VMA = True
+except TypeError:  # jax (the CPU test container) has no kwarg — and no
+    _HAS_VMA = False  # vma-typed avals to propagate either
+
+
+def _sds(shape, dtype, vma):
+    if _HAS_VMA and vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _params(interpret, semantics):
+    if interpret:
+        return {}
+    cp = getattr(pltpu, 'CompilerParams', None) or pltpu.TPUCompilerParams
+    return {'compiler_params': cp(dimension_semantics=semantics)}
+
+
+def _pin(v, strict):
+    """Pin an intermediate against reassociation. The eager reference
+    (ops/factors.py) rounds after every op; the interpreter runs the
+    whole kernel under one jit, where XLA's algebraic simplifier hoists
+    scalar scalings across the dot (``dot(x*c, y) -> dot(x, y)*c``) and
+    fuses mul+add into FMAs — one rounding where the reference has two.
+    Strict (interpret) mode inserts an optimization barrier after each
+    rounding step so the bit pattern matches the reference exactly; the
+    Mosaic path skips them (no XLA simplifier runs inside the kernel,
+    and the barrier may not lower)."""
+    return lax.optimization_barrier(v) if strict else v
+
+
+def _div(v, denom, strict):
+    """True division matching the eager reference bit-for-bit: under a
+    jit, XLA rewrites ``x / const`` into ``x * (1/const)`` — a
+    different rounding whenever the reciprocal is inexact. Hiding the
+    denominator behind a barrier (strict mode) forces the real divide
+    instruction, exactly what the eager ``ops/factors.py`` ops emit."""
+    if strict:
+        denom = lax.optimization_barrier(jnp.float32(denom))
+    return v / denom
+
+
+def _ema_static(ema):
+    """An EMA epilogue is foldable only with a STATIC decay (the
+    preconditioner's python-float ``factor_decay``); a traced alpha
+    cannot be closed over by the kernel — callers two-pass it."""
+    return (ema is not None
+            and isinstance(ema[1], (int, float))
+            and not isinstance(ema[1], bool))
+
+
+def _apply_ema(stat, ema):
+    if ema is None:
+        return stat
+    cur, alpha = ema
+    return _ref.update_running_avg(stat, cur, alpha)
+
+
+# ---------------------------------------------------------------------------
+# generic row-tiled statistic GEMM (dense A/G, conv G)
+# ---------------------------------------------------------------------------
+
+def _stat_kernel(*refs, denom, mults, append_ones, nsteps, ema_alpha,
+                 has_ema, strict):
+    if has_ema:
+        x_ref, cur_ref, o_ref, acc_ref = refs
+    else:
+        x_ref, o_ref, acc_ref = refs
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    t = x_ref[...]
+    # same elementwise scalings in the same order as ops/factors.py
+    # (g*n then g*spatial; the ones column appended in the input dtype)
+    for m in mults:
+        t = _pin(t * m, strict)
+    if append_ones:
+        t = jnp.concatenate(
+            [t, jnp.ones(t.shape[:-1] + (1,), t.dtype)], axis=-1)
+    acc_ref[...] += lax.dot_general(
+        t, _pin(_div(t, denom, strict), strict),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.float32)
+
+    @pl.when(i == nsteps - 1)
+    def _emit():
+        acc = acc_ref[...]
+        if has_ema:
+            # ops.update_running_avg folded into the accumulator emit:
+            # current*(1-alpha) + new*alpha. The complement is computed
+            # in f32 arithmetic (1.0 - f32(alpha)) because that is
+            # EXACTLY what the reference does — update_running_avg
+            # converts alpha to the factor dtype before subtracting
+            alpha = jnp.float32(ema_alpha)
+            acc = (_pin(cur_ref[...] * (1.0 - alpha), strict)
+                   + _pin(acc * alpha, strict))
+        o_ref[...] = acc
+
+
+def _stat_rows(rows, denom, *, mults=(), append_ones=False, ema=None,
+               interpret=False):
+    """``rows^T @ (rows/denom)`` in fp32 with the row prep fused into
+    the tile load — the Pallas counterpart of ``factors._stat_gemm``
+    plus its callers' elementwise prep."""
+    nrows, d = rows.shape
+    f = d + 1 if append_ones else d
+    has_ema = _ema_static(ema)
+    two_pass_ema = ema if (ema is not None and not has_ema) else None
+    tr = _row_tile(nrows, d)
+    nsteps = nrows // tr
+    kernel = functools.partial(
+        _stat_kernel, denom=denom, mults=tuple(mults),
+        append_ones=append_ones, nsteps=nsteps,
+        ema_alpha=(float(ema[1]) if has_ema else 0.0), has_ema=has_ema,
+        strict=interpret)
+    in_specs = [pl.BlockSpec((tr, d), lambda i: (i, 0))]
+    operands = [rows]
+    vma_args = [rows]
+    if has_ema:
+        in_specs.append(pl.BlockSpec((f, f), lambda i: (0, 0)))
+        operands.append(ema[0])
+        vma_args.append(ema[0])
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(nsteps,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((f, f), lambda i: (0, 0)),
+            scratch_shapes=[pltpu.VMEM((f, f), jnp.float32)],
+        ),
+        out_shape=_sds((f, f), jnp.float32, _vma(*vma_args)),
+        interpret=interpret,
+        # the row-tile grid carries the accumulator recurrence in
+        # scratch -> must stay serial
+        **_params(interpret, ('arbitrary',)))(*operands)
+    return _apply_ema(out, two_pass_ema)
+
+
+# ---------------------------------------------------------------------------
+# conv A: patch extraction fused into the covariance GEMM
+# ---------------------------------------------------------------------------
+
+def _canon_padding(h, w, kernel_size, strides, padding):
+    """((top, bottom), (left, right)) zero padding with the exact
+    semantics ``lax.conv_general_dilated_patches`` gives
+    ``factors.extract_patches`` for each accepted padding form."""
+    kh, kw = kernel_size
+    sh, sw = strides
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == 'VALID':
+            return (0, 0), (0, 0)
+        if p == 'SAME':
+            out = []
+            for size, k, st in ((h, kh, sh), (w, kw, sw)):
+                o = -(-size // st)
+                total = max((o - 1) * st + k - size, 0)
+                out.append((total // 2, total - total // 2))
+            return tuple(out[0]), tuple(out[1])
+        raise ValueError(f'unknown padding string {padding!r}')
+    if len(padding) == 2 and not isinstance(padding[0], (tuple, list)):
+        return ((padding[0], padding[0]), (padding[1], padding[1]))
+    return tuple(tuple(p) for p in padding)
+
+
+def _conv_a_kernel(*refs, kh, kw, sh, sw, oh, ow, n, spatial,
+                   append_ones, nsteps, ema_alpha, has_ema, strict):
+    if has_ema:
+        x_ref, cur_ref, o_ref, acc_ref = refs
+    else:
+        x_ref, o_ref, acc_ref = refs
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                       # [tn, Hp, Wp, C] (zero-padded)
+    tn, _, _, c = x.shape
+    # im2col built in VMEM: one strided slice per (ki, kj) tap,
+    # concatenated feature-last -> (kh, kw, c) feature order, matching
+    # HWIO kernel flattening (factors.extract_patches)
+    cols = []
+    for ki in range(kh):
+        for kj in range(kw):
+            cols.append(lax.slice(
+                x, (0, ki, kj, 0),
+                (tn, ki + (oh - 1) * sh + 1, kj + (ow - 1) * sw + 1, c),
+                (1, sh, sw, 1)))         # [tn, oh, ow, c]
+    rows = jnp.concatenate(cols, axis=-1).reshape(tn * oh * ow,
+                                                  kh * kw * c)
+    if append_ones:
+        rows = jnp.concatenate(
+            [rows, jnp.ones(rows.shape[:-1] + (1,), rows.dtype)], axis=-1)
+    rows = _pin(_div(rows, spatial, strict), strict)
+    acc_ref[...] += lax.dot_general(
+        rows, _pin(_div(rows, n, strict), strict),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.float32)
+
+    @pl.when(i == nsteps - 1)
+    def _emit():
+        acc = acc_ref[...]
+        if has_ema:
+            # f32-arithmetic complement, like _stat_kernel's emit
+            alpha = jnp.float32(ema_alpha)
+            acc = (_pin(cur_ref[...] * (1.0 - alpha), strict)
+                   + _pin(acc * alpha, strict))
+        o_ref[...] = acc
+
+
+# ---------------------------------------------------------------------------
+# public API — signatures mirror ops/factors.py plus (ema=, interpret=)
+# ---------------------------------------------------------------------------
+
+def compute_a_dense(a, use_bias, *, ema=None, interpret=False):
+    """Pallas :func:`factors.compute_a_dense` with the bias ones-column
+    and the optional EMA epilogue fused. ``ema=(current [F, F] f32,
+    alpha)`` returns ``update_running_avg(stat, current, alpha)``."""
+    if a.ndim > 2:
+        a = a.mean(axis=tuple(range(1, a.ndim - 1)))
+    n = a.shape[0]
+    f = a.shape[1] + (1 if use_bias else 0)
+    if f > _max_fused_f():
+        _warn_once(f'a_dense:{f}',
+                   f'capture: dense A factor dim {f} exceeds the fused '
+                   'VMEM cap — this layer stays on the XLA path')
+        return _apply_ema(_ref.compute_a_dense(a, use_bias), ema)
+    return _stat_rows(a, n, append_ones=use_bias, ema=ema,
+                      interpret=interpret)
+
+
+def compute_g_dense(g, batch_averaged=True, *, ema=None, interpret=False):
+    """Pallas :func:`factors.compute_g_dense` (batch-averaged undo fused
+    into the tile load)."""
+    if g.ndim > 2:
+        g = g.mean(axis=tuple(range(1, g.ndim - 1)))
+    n = g.shape[0]
+    if g.shape[1] > _max_fused_f():
+        _warn_once(f'g_dense:{g.shape[1]}',
+                   f'capture: dense G factor dim {g.shape[1]} exceeds '
+                   'the fused VMEM cap — this layer stays on the XLA path')
+        return _apply_ema(_ref.compute_g_dense(g, batch_averaged), ema)
+    return _stat_rows(g, n, mults=((n,) if batch_averaged else ()),
+                      ema=ema, interpret=interpret)
+
+
+def compute_g_conv(g, batch_averaged=True, *, ema=None, interpret=False):
+    """Pallas :func:`factors.compute_g_conv` (the N and spatial scalings
+    applied to the tile in VMEM, in the reference's order)."""
+    n = g.shape[0]
+    spatial = g.shape[1] * g.shape[2]
+    rows = g.reshape(-1, g.shape[-1])
+    if rows.shape[1] > _max_fused_f():
+        _warn_once(f'g_conv:{rows.shape[1]}',
+                   f'capture: conv G factor dim {rows.shape[1]} exceeds '
+                   'the fused VMEM cap — this layer stays on the XLA path')
+        return _apply_ema(_ref.compute_g_conv(g, batch_averaged), ema)
+    mults = (n, spatial) if batch_averaged else (spatial,)
+    return _stat_rows(rows, rows.shape[0], mults=mults, ema=ema,
+                      interpret=interpret)
+
+
+def compute_a_conv(a, kernel_size, strides, padding, use_bias, *,
+                   ema=None, interpret=False):
+    """Pallas :func:`factors.compute_a_conv` with patch extraction fused
+    into the covariance GEMM: the kernel slices the im2col taps out of
+    the zero-padded NHWC activation tile in VMEM and contracts them
+    directly — the ``[N*OH*OW, kh*kw*C]`` patch matrix never lands in
+    HBM. Batch images ride the serial grid; the fp32 ``[F, F]``
+    accumulator lives in scratch."""
+    n, h, w, c = a.shape
+    kh, kw = kernel_size
+    sh, sw = strides
+    f = kh * kw * c + (1 if use_bias else 0)
+    if f > _max_fused_f():
+        _warn_once(f'a_conv:{f}',
+                   f'capture: conv A factor dim {f} exceeds the fused '
+                   'VMEM cap — this layer stays on the XLA path')
+        return _apply_ema(
+            _ref.compute_a_conv(a, kernel_size, strides, padding,
+                                use_bias), ema)
+    (pt, pb), (pl_, pr) = _canon_padding(h, w, kernel_size, strides,
+                                         padding)
+    # zero-pad once host-side (cheap; identical values to the reference's
+    # conv_general_dilated_patches padding) so the kernel taps are plain
+    # strided slices
+    xpad = jnp.pad(a, ((0, 0), (pt, pb), (pl_, pr), (0, 0)))
+    hp, wp = h + pt + pb, w + pl_ + pr
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    spatial = oh * ow
+    has_ema = _ema_static(ema)
+    two_pass_ema = ema if (ema is not None and not has_ema) else None
+    # per-image VMEM footprint: the padded input tile + the in-flight
+    # patch rows
+    tn = _row_tile(n, hp * wp * c + spatial * f)
+    nsteps = n // tn
+    kernel = functools.partial(
+        _conv_a_kernel, kh=kh, kw=kw, sh=sh, sw=sw, oh=oh, ow=ow, n=n,
+        spatial=spatial, append_ones=use_bias, nsteps=nsteps,
+        ema_alpha=(float(ema[1]) if has_ema else 0.0), has_ema=has_ema,
+        strict=interpret)
+    in_specs = [pl.BlockSpec((tn, hp, wp, c), lambda i: (i, 0, 0, 0))]
+    operands = [xpad]
+    vma_args = [xpad]
+    if has_ema:
+        in_specs.append(pl.BlockSpec((f, f), lambda i: (0, 0)))
+        operands.append(ema[0])
+        vma_args.append(ema[0])
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(nsteps,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((f, f), lambda i: (0, 0)),
+            scratch_shapes=[pltpu.VMEM((f, f), jnp.float32)],
+        ),
+        out_shape=_sds((f, f), jnp.float32, _vma(*vma_args)),
+        interpret=interpret,
+        **_params(interpret, ('arbitrary',)))(*operands)
+    return _apply_ema(out, two_pass_ema)
+
+
+# ---------------------------------------------------------------------------
+# wire-quantize + error-feedback epilogue (the compressed-reduce prep)
+# ---------------------------------------------------------------------------
+
+def _ef_kernel(x_ref, r_ref, w_ref, nr_ref):
+    xc = x_ref[...] + r_ref[...]
+    wire = xc.astype(jnp.bfloat16)
+    w_ref[...] = wire
+    nr_ref[...] = xc - wire.astype(x_ref.dtype)
+
+
+def ef_quantize(x, residual, *, interpret=False):
+    """One fused pass producing ``(wire bf16, new_residual)`` from the
+    stacked stats and the error-feedback residual — the exact
+    ``xc = x + r; wire = bf16(xc); r' = xc - f32(wire)`` algebra of
+    ``collectives.pmean_scatter_ef``, emitted as a single Pallas kernel
+    so the compressed reduce stops paying a separate elementwise pass.
+    The psum_scatter stays with the caller: the wire VALUES (hence the
+    ledger bytes) are byte-identical to the two-pass path."""
+    assert x.shape == residual.shape, (x.shape, residual.shape)
+    rows = x.shape[0]
+    tail = x.shape[1:]
+    elems = 1
+    for d in tail:
+        elems *= d
+    tr = _row_tile(rows, elems)
+    nsteps = rows // tr
+    blk = (tr,) + tail
+    idx = lambda i: (i,) + (0,) * len(tail)  # noqa: E731
+    vma = _vma(x, residual)
+    wire, new_residual = pl.pallas_call(
+        _ef_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(nsteps,),
+            in_specs=[pl.BlockSpec(blk, idx), pl.BlockSpec(blk, idx)],
+            out_specs=[pl.BlockSpec(blk, idx), pl.BlockSpec(blk, idx)],
+        ),
+        out_shape=[
+            _sds(x.shape, jnp.bfloat16, vma),
+            _sds(x.shape, x.dtype, vma),
+        ],
+        interpret=interpret,
+        **_params(interpret, ('parallel',)))(x, residual)
+    return wire, new_residual

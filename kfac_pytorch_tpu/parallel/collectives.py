@@ -158,7 +158,7 @@ def pmean_wire(x, axis_name, comm_precision='fp32'):
     return total / lax.axis_size(axis_name)
 
 
-def pmean_scatter_ef(x, axis_name, comm_precision, residual):
+def pmean_scatter_ef(x, axis_name, comm_precision, residual, fused=False):
     """Mean-reduce ``x`` across the axis and return THIS device's row
     block of the result (axis 0 is device-major-tiled, the stacked-
     bucket layout of plan.py) — a reduce-scatter, because the factor
@@ -182,6 +182,14 @@ def pmean_scatter_ef(x, axis_name, comm_precision, residual):
     None (fp32 mode) — passed through untouched. ``axis_name=None`` is
     the identity path: ``(x, residual)``, no compression, no residual
     mutation, full rows (P=1 owns everything).
+
+    ``fused=True`` computes the lossy branch's quantize + residual prep
+    as ONE Pallas pass (:func:`ops.pallas_capture.ef_quantize`, ISSUE
+    19) instead of the three elementwise ops below — same xc/bf16/EF
+    algebra, same wire values, so the FactorComm ledger bytes are
+    unchanged (pinned by scripts/comm_count.py's ``+pallas`` spec). The
+    psum_scatter itself stays out here: fusion moves compute, not wire
+    bytes.
     """
     if axis_name is None:
         return x, residual
@@ -194,9 +202,14 @@ def pmean_scatter_ef(x, axis_name, comm_precision, residual):
         'lossy pmean_scatter_ef requires an error-feedback residual '
         '(init the KFAC state with comm_precision set, see '
         'KFACState.comm_err)')
-    xc = x + residual
-    wire = xc.astype(jnp.bfloat16)
-    new_residual = xc - wire.astype(x.dtype)
+    if fused:
+        from kfac_pytorch_tpu.ops import pallas_capture as _pc
+        wire, new_residual = _pc.ef_quantize(
+            x, residual, interpret=_pc.interpret_default())
+    else:
+        xc = x + residual
+        wire = xc.astype(jnp.bfloat16)
+        new_residual = xc - wire.astype(x.dtype)
     red = lax.psum_scatter(wire, axis_name, scatter_dimension=0,
                            tiled=True).astype(x.dtype)
     return red / n, new_residual

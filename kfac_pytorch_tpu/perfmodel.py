@@ -110,6 +110,19 @@ SUBSPACE_FLOPS_PER_MATRIX = \
 NEWTON_SCHULZ_FLOPS_PER_MATRIX = \
     lambda d, iters=2: (4.0 * iters + 2.0) * d ** 3   # noqa: E731
 
+#: HBM-byte multiplier of the FUSED capture path (ops/pallas_capture,
+#: ISSUE 19) relative to the unfused ComputeFactor bytes: the fused
+#: kernels never materialize the im2col patch matrix in HBM (conv-A's
+#: dominant traffic — written once by extract_patches, read back by the
+#: GEMM) and fold the EMA read-modify-write into the accumulator
+#: epilogue instead of a separate elementwise pass. FLOPs are unchanged
+#: (the same statistic GEMMs run either way), so the fused rung only
+#: moves the memory-bound side of the roofline. 0.5 is a stated
+#: assumption bracketing "patch matrix round trip gone, activations
+#: still stream once"; the on-chip microbench re-baselines it when the
+#: tunnel answers.
+CAPTURE_FUSION_BYTES_FACTOR = 0.5
+
 _INPUTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             'data', 'perf_inputs_resnet50_bs32.json')
 
@@ -204,6 +217,24 @@ def decomp_impl_priors(block, method, anchor='central'):
     return {k: float(v) for k, v in out.items()}
 
 
+def capture_impl_priors(block, anchor='central'):
+    """{rung: predicted ComputeFactor seconds} for the capture_impl
+    ladder, from a ``predict_block()`` dict — the autotuner's seeding
+    input (``KnobController._seed_capture_impl``). Unfused XLA capture
+    vs the fused Pallas kernels (same GEMM FLOPs, HBM bytes scaled by
+    CAPTURE_FUSION_BYTES_FACTOR). Returns {} when the block carries no
+    usable phases (the tuner then probes from the configured rung)."""
+    try:
+        ph = block['scenarios'][anchor]['phases_s']
+    except (KeyError, TypeError):
+        return {}
+    out = {'xla': ph.get('ComputeFactor'),
+           'pallas': ph.get('ComputeFactor_pallas')}
+    if any(v is None for v in out.values()):
+        return {}
+    return {k: float(v) for k, v in out.items()}
+
+
 def predict(inputs=None):
     """Predicted steady-state s/iter + imgs/s per variant per scenario.
 
@@ -245,6 +276,12 @@ def predict(inputs=None):
         prec = t('precondition', f32)
         prec_e = t('precondition_eigen', f32)
         fac = t('factor')
+        # the fused capture rung: same GEMM FLOPs, the HBM side scaled
+        # by the no-patch-matrix/folded-EMA factor (capture_impl prior)
+        fac_f, fac_b = ph['factor']
+        fac_pallas = _phase_time(
+            fac_f, 0.0 if hbm is None
+            else fac_b * CAPTURE_FUSION_BYTES_FACTOR, eff, hbm or 1.0)
         chol = t('inverse_chol', f32)
         refresh = t('refresh', f32)
         scales = t('ekfac_scales', f32)
@@ -281,6 +318,9 @@ def predict(inputs=None):
         out[name]['phases_s'] = {
             'Model': round(model, 4), 'Precondition': round(prec, 4),
             'ComputeFactor': round(fac, 4),
+            # the fused capture rung (ops/pallas_capture, ISSUE 19):
+            # what the capture_impl knob buys on the modeled chip
+            'ComputeFactor_pallas': round(fac_pallas, 4),
             'ComputeInverse_chol': round(chol, 4),
             'ComputeInverse_eigh_full': round(eigh_full_s, 2),
             # the inverse-free ladder rungs (warm kernels, GEMM

@@ -45,6 +45,14 @@ from kfac_pytorch_tpu.plan import (build_cohorts, build_decomp_shard,
 #: method to the MXU-shaped warm kernel.
 DECOMP_IMPLS = ('xla', 'auto', 'jacobi', 'subspace', 'newton_schulz')
 
+#: capture-kernel ladder (ISSUE 19; autotune.CAPTURE_IMPLS restates
+#: this tuple — cross-module agreement is pinned by test). 'xla' = the
+#: reference ops/factors.py path; 'pallas' = the fused capture kernels
+#: (ops/pallas_capture.py: patch-extract + factor GEMM + EMA epilogue,
+#: interpreter mode off-TPU); 'auto' resolves to 'pallas'. None keeps
+#: the legacy path untouched AND hides the rung from the tuner.
+CAPTURE_IMPLS = ('xla', 'pallas', 'auto')
+
 #: impls that warm-start from the stored decomposition — an explicit
 #: iterative ``decomp_impl`` implies warm seeding without requiring
 #: ``warm_start_basis`` (the tuner flips the knob mid-run; the seeds
@@ -292,7 +300,8 @@ class KFAC:
                  basis_update_freq=None, warm_start_basis=False,
                  warm_sweeps=None, cold_restart_every=50, stagger=False,
                  health=True, comm_precision='fp32', comm_prefetch=False,
-                 decomp_impl=None, decomp_shard=False, comm_mode=None):
+                 decomp_impl=None, decomp_shard=False, comm_mode=None,
+                 capture_impl=None):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -388,6 +397,16 @@ class KFAC:
                     f'inverse; variant {variant!r} eigendecomposes — '
                     "use 'subspace' (or 'auto') there")
         self.decomp_impl = decomp_impl
+        # capture-implementation knob (ISSUE 19): an EXPLICIT value
+        # routes factor capture through ops/pallas_capture.py (fused
+        # patch-extract + statistic GEMMs + EMA/wire epilogues) and
+        # joins the autotuner's KNOB_ATTRS ladder; None preserves the
+        # ops/factors.py path exactly, so existing configs are untouched
+        if capture_impl is not None and capture_impl not in CAPTURE_IMPLS:
+            raise ValueError(
+                f'capture_impl must be one of {CAPTURE_IMPLS}, '
+                f'got {capture_impl!r}')
+        self.capture_impl = capture_impl
         self.decomp_shard = bool(decomp_shard)
         if self.decomp_shard and not stagger:
             # sharding repartitions the ACTIVE COHORT's rows — it is a
@@ -825,6 +844,16 @@ class KFAC:
         return impl
 
     @property
+    def resolved_capture_impl(self):
+        """The capture path the traced step actually selects: 'auto'
+        resolves to the fused Pallas kernels; None stays None — engine
+        keeps the ops/factors.py reference path."""
+        impl = self.capture_impl
+        if impl == 'auto':
+            return 'pallas'
+        return impl
+
+    @property
     def warm_impl(self):
         """Does the EXPLICIT decomp_impl warm-start from the stored
         decomposition? (The trainer's warm gate ORs this with
@@ -1004,22 +1033,37 @@ class KFAC:
         comm_err = state.comm_err
 
         if update_factors and not self.exclude_compute_factor:
-            # named scopes mirror the reference's phase taxonomy
-            # (exclude_parts names) so xprof traces attribute time the
-            # same way scripts/time_breakdown.py does
-            with jax.named_scope('kfac.ComputeFactor'):
-                a_list, g_list = engine.compute_layer_stats(
-                    plan, acts, gs, self.batch_averaged)
-                stats = engine.stack_stats(plan, a_list, g_list)
             reduce = self.stats_reduce
             if self.exclude_communicate_factor:
                 reduce = 'local'
-            with jax.named_scope('kfac.UpdateFactors'):
-                # the pmean inside carries its own CommunicateFactor scope
-                factors, comm_err = engine.update_factors(
-                    plan, factors, stats, self.factor_decay, reduce,
-                    axis_name, comm_precision=self.comm_precision,
-                    comm_err=comm_err)
+            cap_impl = self.resolved_capture_impl
+            if (cap_impl == 'pallas' and reduce == 'local'
+                    and plan.num_devices == 1):
+                # single-device local stats: the whole capture chain
+                # (patch-extract -> factor GEMM -> EMA) collapses into
+                # one fused kernel per factor — the UpdateFactors pass
+                # disappears from the trace by design (its cost is
+                # modeled under ComputeFactor_pallas in perfmodel.py)
+                with jax.named_scope('kfac.ComputeFactor'):
+                    factors = engine.update_factors_fused(
+                        plan, factors, acts, gs, self.batch_averaged,
+                        self.factor_decay)
+            else:
+                # named scopes mirror the reference's phase taxonomy
+                # (exclude_parts names) so xprof traces attribute time
+                # the same way scripts/time_breakdown.py does
+                with jax.named_scope('kfac.ComputeFactor'):
+                    a_list, g_list = engine.compute_layer_stats(
+                        plan, acts, gs, self.batch_averaged,
+                        capture_impl=cap_impl)
+                    stats = engine.stack_stats(plan, a_list, g_list)
+                with jax.named_scope('kfac.UpdateFactors'):
+                    # the pmean inside carries its own CommunicateFactor
+                    # scope
+                    factors, comm_err = engine.update_factors(
+                        plan, factors, stats, self.factor_decay, reduce,
+                        axis_name, comm_precision=self.comm_precision,
+                        comm_err=comm_err, capture_impl=cap_impl)
             if self.health is not None and comm_err is not None:
                 # a non-finite residual row resets to zero (the always-
                 # safe EF state: feedback is a correction, never load-

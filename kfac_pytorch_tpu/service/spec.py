@@ -57,11 +57,12 @@ TRAINERS = {
 #: regex-validated only — the trainers' own surfaces differ too much
 #: to table them all.
 KFAC_KNOBS = frozenset({
-    'kfac_autotune', 'kfac_basis_update_freq', 'kfac_comm_mode',
-    'kfac_comm_precision', 'kfac_comm_prefetch', 'kfac_cov_update_freq',
-    'kfac_decomp_impl', 'kfac_decomp_shard', 'kfac_name', 'kfac_stagger',
-    'kfac_type', 'kfac_update_freq', 'kfac_update_freq_alpha',
-    'kfac_update_freq_decay', 'kfac_warm_start',
+    'kfac_autotune', 'kfac_basis_update_freq', 'kfac_capture_impl',
+    'kfac_comm_mode', 'kfac_comm_precision', 'kfac_comm_prefetch',
+    'kfac_cov_update_freq', 'kfac_decomp_impl', 'kfac_decomp_shard',
+    'kfac_name', 'kfac_stagger', 'kfac_type', 'kfac_update_freq',
+    'kfac_update_freq_alpha', 'kfac_update_freq_decay',
+    'kfac_warm_start',
 })
 
 _TENANT = re.compile(r'^[a-z0-9][a-z0-9_-]{0,62}$')

@@ -112,6 +112,23 @@ if [ -n "$KFAC_DECOMP_IMPL" ]; then
   esac
 fi
 
+# Capture hot path (README "Capture hot path", ISSUE 19):
+# KFAC_CAPTURE_IMPL selects the capture kernels for every trainer of
+# the run (the trainers read it as the --kfac-capture-impl default; an
+# explicit flag still wins): xla = the reference patch-extract + GEMM
+# + EMA chain; pallas = the fused Pallas kernels (no HBM patch matrix,
+# EMA / wire-quantize folded into the epilogues); auto = the fused
+# rung, tuner decides. An explicit value is also a live autotuner
+# ladder rung.
+if [ -n "$KFAC_CAPTURE_IMPL" ]; then
+  case "$KFAC_CAPTURE_IMPL" in
+    xla|pallas|auto) export KFAC_CAPTURE_IMPL ;;
+    *) echo "launch_tpu.sh: KFAC_CAPTURE_IMPL must be" \
+            "xla|pallas|auto," \
+            "got '$KFAC_CAPTURE_IMPL'" >&2; exit 1 ;;
+  esac
+fi
+
 # KFAC_DECOMP_SHARD=1 turns on mesh-sharded decomposition (the
 # --kfac-decomp-shard default): each refresh cohort's eigh/inverse rows
 # are repartitioned cost-balanced across ALL devices instead of

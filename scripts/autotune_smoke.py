@@ -236,6 +236,44 @@ def leg_quality_hold(art_dir):
             'failures': failures}
 
 
+class _FakeCapturePrecond(_FakePrecond):
+    def __init__(self, capture_impl='xla', **kw):
+        super().__init__(**kw)
+        self.capture_impl = capture_impl
+
+
+def leg_capture_ladder(art_dir):
+    """Planted optimum on the fused-capture rung (ISSUE 19): the pallas
+    kernels' per-window capture marginal is 4x cheaper — the controller
+    must land on the fused rung with zero spurious vetoes."""
+    pre = _FakeCapturePrecond(kfac=4)
+    ctl = autotune.KnobController(
+        pre, window=8, settle=1, rel_improve=0.03, dwell_windows=1,
+        cooldown=2, steady_every=0, tune=('capture_impl',),
+        decision_log=os.path.join(art_dir,
+                                  'autotune-decisions-capture.jsonl'))
+
+    def model(F, i):
+        stats = 0.4 if pre.capture_impl == 'xla' else 0.1
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + stats
+        return ('pred',), 0.01
+
+    steps = _feed(ctl, pre, model, 1000)
+    failures = []
+    if pre.capture_impl != 'pallas':
+        failures.append(f'final capture_impl={pre.capture_impl} != '
+                        'planted optimum pallas')
+    if ctl.state != 'steady':
+        failures.append(f'no steady state after {steps} steps')
+    if ctl.vetoes:
+        failures.append(f'{ctl.vetoes} spurious vetoes')
+    return {'leg': 'capture_ladder', 'planted_optimum': 'pallas',
+            'final_capture_impl': pre.capture_impl, 'steps': steps,
+            'commits': ctl.commits, 'vetoes': ctl.vetoes,
+            'failures': failures}
+
+
 class _FakeCommModePrecond(_FakePrecond):
     """comm-mode-switchable fake (ISSUE 14): a planted analytic byte
     model (pred ships 64 MiB every step, inverse 8 MiB per refresh) and
@@ -354,8 +392,8 @@ def main():
     os.makedirs(art_dir, exist_ok=True)
     tol = float(os.environ.get('AUTOTUNE_SMOKE_TOL', '1.10'))
     legs = [leg_synthetic(art_dir), leg_drift_hold(art_dir),
-            leg_decomp_ladder(art_dir), leg_quality_hold(art_dir),
-            leg_comm_mode(art_dir)]
+            leg_decomp_ladder(art_dir), leg_capture_ladder(art_dir),
+            leg_quality_hold(art_dir), leg_comm_mode(art_dir)]
     if os.environ.get('AUTOTUNE_SMOKE_MEASURED') == '1':
         legs.append(leg_measured(art_dir, tol))
     failures = [f for leg in legs for f in leg['failures']]

@@ -17,15 +17,20 @@ Usage: KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=8 python scripts/comm_count.py
 Env knobs:
   COMM_COUNT_VARIANTS   space-separated variant specs; a ':bf16'/':int8'
                         suffix compiles the variant with that
-                        comm_precision wire dtype (e.g. 'eigen:bf16')
+                        comm_precision wire dtype (e.g. 'eigen:bf16');
+                        a '+pallas' tag compiles it with the fused
+                        Pallas capture kernels (e.g. 'eigen+pallas',
+                        'eigen+pallas:bf16')
   COMM_COUNT_JSON       write the machine-readable per-variant ledger
                         (ops/bytes per collective kind + per-phase
                         per-dtype breakdown) to this path
   COMM_COUNT_ASSERT     fail unless the SGD floor contains only
                         gradient allreduces, every variant's floor is
-                        byte-identical to SGD's, and each compressed
+                        byte-identical to SGD's, each compressed
                         spec shows >=40% K-FAC collective-byte reduction
-                        vs its fp32 counterpart (the CI smoke gate)
+                        vs its fp32 counterpart, and each '+pallas'
+                        spec's ledger is byte-identical to its unfused
+                        counterpart's (the CI smoke gate)
 """
 
 import collections
@@ -140,10 +145,10 @@ def _ce(outputs, batch):
 
 def parse_variant_spec(spec):
     """'eigen' | 'eigen:bf16' | 'eigen+shard:bf16' | 'eigen_dp>inverse'
-    -> (variant, comm_precision). The '+shard' tag stays part of the
-    variant name — a compressed shard spec's fp32 counterpart is the
-    shard spec, not the unsharded one (different programs, different
-    byte model). A '>mode' tag (ISSUE 14) likewise stays part of the
+    -> (variant, comm_precision). '+'-tags ('+shard', '+pallas') stay
+    part of the variant name — a compressed tagged spec's fp32
+    counterpart is the tagged spec, not the untagged one (different
+    programs, different byte model). A '>mode' tag (ISSUE 14) likewise stays part of the
     variant name: the spec lowers the variant AFTER a live
     ``KFAC.replan(comm_mode=mode)`` — the program the autotuner's
     applied comm-mode switch actually runs — and the assert gate pins
@@ -151,6 +156,20 @@ def parse_variant_spec(spec):
     switched mode."""
     variant, _, precision = spec.partition(':')
     return variant, (precision or 'fp32')
+
+
+def parse_capture_tags(variant_tagged):
+    """'eigen+pallas' -> ('eigen', shard=False, capture='pallas');
+    '+'-tags compose ('eigen+shard+pallas'). Unknown tags fail loudly —
+    a typo'd tag must not silently lower the untagged program."""
+    base, *tags = variant_tagged.split('+')
+    unknown = sorted(set(tags) - {'shard', 'pallas'})
+    if unknown:
+        raise SystemExit(
+            f'unknown variant tag(s) {unknown} in {variant_tagged!r} '
+            "(known: '+shard', '+pallas')")
+    return (base, 'shard' in tags,
+            'pallas' if 'pallas' in tags else None)
 
 
 def parse_replan_tag(variant):
@@ -188,9 +207,13 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
     # analytic model prices in closed form. 'variant>mode' (ISSUE 14):
     # lower the program AFTER a live KFAC.replan to the other comm
     # mode — the exact program the autotuner's applied switch runs.
+    # '+pallas' (ISSUE 19): the variant with capture_impl='pallas' —
+    # fused Pallas capture kernels compute the SAME factor statistics
+    # and the SAME wire values, so the program's collective ledger must
+    # be byte-identical to the untagged counterpart's (the assert gate
+    # below pins exactly that)
     variant_tagged, replan_to = parse_replan_tag(variant)
-    base, _, tag = variant_tagged.partition('+')
-    decomp_shard = tag == 'shard'
+    base, decomp_shard, capture_impl = parse_capture_tags(variant_tagged)
     precond = None
     if variant != 'sgd':
         precond = kfac.KFAC(variant=base, lr=0.1, damping=0.003,
@@ -200,7 +223,8 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
                             assignment='balanced',
                             comm_precision=comm_precision,
                             comm_prefetch=comm_prefetch,
-                            decomp_shard=decomp_shard)
+                            decomp_shard=decomp_shard,
+                            capture_impl=capture_impl)
     state = training.init_train_state(model, tx, precond,
                                       jax.random.PRNGKey(0),
                                       batch['input'])
@@ -250,6 +274,7 @@ def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
         'variant': variant,
         'comm_precision': comm_precision,
         'comm_prefetch': bool(comm_prefetch),
+        'capture_impl': capture_impl,
         'ops': dict(counts),
         'bytes': dict(bytes_by_kind),
         'by_phase': by_phase,
@@ -405,6 +430,13 @@ def main():
                           f'{meas / 2**20:.3f} MiB vs analytic '
                           f'{led["comm_mode_analytic"][phase] / 2**20:.3f}'
                           ' MiB')
+            if led.get('capture_impl') == 'pallas':
+                cp = spec.replace('+pallas', '')
+                if cp in ledgers:
+                    same = led['by_phase'] == ledgers[cp]['by_phase']
+                    print(f'{spec:>17}: fused-capture per-phase ledger '
+                          f'{"identical to" if same else "DIVERGED from"}'
+                          f' {cp}')
         if 'eigen' in ledgers and 'eigen_dp' in ledgers:
             e = ledgers['eigen']['total_bytes'] - sgd_bytes
             edp = ledgers['eigen_dp']['total_bytes'] - sgd_bytes
@@ -465,6 +497,36 @@ def main():
                 f'{spec}: grad/other floor {got} B != {unsharded} '
                 f'floor {base_floor} B — decomp_shard touched the '
                 'gradient path')
+        # the fused-capture pin (ISSUE 19): a '+pallas' spec lowers the
+        # variant with capture_impl='pallas' — the Pallas kernels fuse
+        # patch-extract, the factor GEMMs, the EMA and the wire-quantize
+        # epilogue into the CAPTURE compute, but emit the same xc/bf16/
+        # EF wire values (parallel/collectives.py pins the algebra), so
+        # the FactorComm ledger — and every other comm phase — must be
+        # byte-identical to the unfused counterpart's. Fusion moves
+        # compute, never wire bytes.
+        for spec, led in ledgers.items():
+            if led.get('capture_impl') != 'pallas':
+                continue
+            counterpart = spec.replace('+pallas', '')
+            assert counterpart in ledgers, (
+                f'{spec}: no unfused counterpart {counterpart!r} in the '
+                'ledger set — the fused-capture byte pin needs it; add '
+                f'{counterpart!r} to COMM_COUNT_VARIANTS')
+            other = ledgers[counterpart]
+            fc = led['by_phase'].get('FactorComm', {})
+            fc0 = other['by_phase'].get('FactorComm', {})
+            assert fc == fc0, (
+                f'{spec}: FactorComm ledger {fc} != {counterpart} '
+                f'FactorComm ledger {fc0} — the fused capture epilogue '
+                'changed the wire program (it must only move compute)')
+            assert led['by_phase'] == other['by_phase'], (
+                f'{spec}: per-phase ledger diverged from {counterpart} '
+                'outside FactorComm — the fused capture path leaked '
+                'into another comm phase')
+            assert led['total_bytes'] == other['total_bytes'], (
+                f'{spec}: total {led["total_bytes"]} B != {counterpart} '
+                f'total {other["total_bytes"]} B')
         # the comm-mode pin (ISSUE 14): a '>mode' spec's SWITCHED
         # program must price every K-FAC comm phase byte-for-byte at
         # FactorPlan.comm_volume's closed form for the new mode, and
@@ -494,7 +556,7 @@ def main():
                 f'{base_floor} B — the comm-mode replan touched the '
                 'gradient path')
         print('COMM_COUNT_ASSERT: floor + compression + decomp-shard '
-              '+ comm-mode gates passed')
+              '+ comm-mode + fused-capture gates passed')
 
 
 if __name__ == '__main__':

@@ -34,9 +34,9 @@ run flash_sweep 3600 python scripts/bench_flash.py \
 run flash_bwd_ab 3600 python scripts/bench_flash.py \
     --seq-lens 8192 32768 --bwd-impls pallas recompute
 
-# 3. eigh impl + matmul-precision A/B at ResNet-50 bucket dims (cold+warm
-#    jacobi vs QDWH) — decides the KFAC_EIGH_IMPL default
-run bench_ops 3600 python scripts/bench_ops.py
+# 3. op micro legs (scripts/bench_ops.py retired into bench.py's
+#    BENCH_MICRO mode, ISSUE 19) — decides the KFAC_EIGH_IMPL default
+run bench_ops 3600 env BENCH_MICRO=1 python bench.py
 
 # 4. headline bench (fresh compiles can take 30-45 min on a cold cache)
 run bench_headline 5400 python bench.py

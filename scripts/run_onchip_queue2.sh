@@ -43,9 +43,9 @@ run bench_headline 5400 python bench.py
 # 2. full bench: + eigen_dp stock / basis-amortized / warm-subspace legs
 run bench_full 7200 env BENCH_FULL=1 python bench.py
 
-# 3. real-fenced op A/B: XLA eigh vs chol_inv vs (<=1024) jacobi, three
-#    matmul precisions — decides the eigh precision default
-run bench_ops 5400 python scripts/bench_ops.py
+# 3. op micro legs (scripts/bench_ops.py retired into bench.py's
+#    BENCH_MICRO mode, ISSUE 19) — decides the eigh precision default
+run bench_ops 5400 env BENCH_MICRO=1 python bench.py
 
 # 4. flash A/B re-run under the fixed harness (confirm the auto-bwd
 #    crossover measured with the old fence)
@@ -53,8 +53,8 @@ run flash_ab 3600 python scripts/bench_flash.py \
     --seq-lens 8192 32768 --bwd-impls pallas recompute
 
 # 5. the gather-free paired-rotation jacobi: keep or delete the knob
-run bench_ops_paired 3600 env KFAC_JACOBI_ROT=paired \
-    python scripts/bench_ops.py --dims 512 1024
+run bench_ops_paired 3600 env KFAC_JACOBI_ROT=paired BENCH_MICRO=1 \
+    python bench.py
 
 # 6. per-phase breakdown on the flagship config (5 extra programs)
 run bench_breakdown 7200 env BENCH_BREAKDOWN=1 python bench.py

@@ -168,13 +168,22 @@ run bench_full 7200 env BENCH_FULL=1 BENCH_TIME_BUDGET=5000 \
     python bench.py
 harvest bench_full ekfac_iter_s_freq10_basis100 $?
 
-# 4. fenced op A/B at ResNet-50 bucket dims: XLA eigh vs chol vs subspace
-#    vs (<=1024) jacobi, three matmul precisions
-run bench_ops 5400 python scripts/bench_ops.py $OPS_ARGS
+# 4. fenced op micro legs (the retired scripts/bench_ops.py +
+#    bench_extract_patches.py folded into the BENCH_MICRO emission
+#    contract, ISSUE 19): decomp_impl ladder steady state + the
+#    capture-kernel head-to-head (fused Pallas vs unfused XLA, with
+#    the standalone patch-extract cost alongside) — one JSON line,
+#    partial-emission resumable like every other leg
+run bench_ops 5400 env BENCH_MICRO=1 \
+    BENCH_PARTIAL_PATH="$D"/bench_micro_ops.partial.json \
+    python bench.py
 
-# 5. paired-rotation jacobi keep/drop decision (VERDICT #9)
-run bench_ops_paired 3600 env KFAC_JACOBI_ROT=paired \
-    python scripts/bench_ops.py --dims $PAIRED_DIMS
+# 5. paired-rotation jacobi keep/drop decision (VERDICT #9), under the
+#    same micro contract (KFAC_JACOBI_ROT reaches ops.jacobi_eigh
+#    through the env at trace time)
+run bench_ops_paired 3600 env KFAC_JACOBI_ROT=paired BENCH_MICRO=1 \
+    BENCH_PARTIAL_PATH="$D"/bench_micro_paired.partial.json \
+    python bench.py
 
 # 6. flash forward crossover re-check under the fixed fence + the 32k
 #    XLA retry (VERDICT #3/#7): both columns at 8k/16k/32k

@@ -1071,3 +1071,183 @@ def test_decomp_impl_seeded_from_perfmodel_prior():
     # QDWH seconds on the modeled chip
     priors = perfmodel.decomp_impl_priors(block, 'eigh')
     assert priors['subspace'] < 0.1 * priors['xla']
+
+
+# ---------------------------------------------------------------------------
+# the capture_impl ladder (fused Pallas capture kernels, ISSUE 19)
+# ---------------------------------------------------------------------------
+
+class _CapturePrecond(_FakePrecond):
+    """Fake preconditioner carrying the capture_impl knob surface."""
+
+    def __init__(self, capture_impl='xla', **kw):
+        super().__init__(**kw)
+        self.capture_impl = capture_impl
+
+
+def test_capture_impls_restated_tuple_matches_preconditioner():
+    # autotune must stay stdlib-importable, so it restates the canon
+    from kfac_pytorch_tpu import preconditioner
+    assert autotune.CAPTURE_IMPLS == preconditioner.CAPTURE_IMPLS
+    # the ladder probes concrete rungs only ('auto' is a policy, not a
+    # program) and every rung is a valid knob value
+    assert 'auto' not in autotune.CAPTURE_LADDER
+    assert set(autotune.CAPTURE_LADDER) < set(autotune.CAPTURE_IMPLS)
+
+
+def test_controller_capture_impl_commits_planted_optimum():
+    """Fused-capture commit under a planted optimum: the pallas rung is
+    genuinely faster, the controller probes it, commits, and goes
+    steady on it — the capture analog of the decomp ladder tests."""
+    pre = _CapturePrecond(capture_impl='xla', kfac=4)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('capture_impl',))
+
+    def model(F, i):
+        # unfused capture costs 0.4/window; the fused kernels cost 0.1
+        stats = 0.4 if pre.capture_impl == 'xla' else 0.1
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + stats
+        return ('pred',), 0.01
+
+    _feed(ctl, pre, model, 200)
+    assert pre.capture_impl == 'pallas'
+    assert ctl.state == 'steady'
+    assert ctl.commits == 1
+    assert ctl.vetoes == 0                    # zero spurious vetoes
+    kinds = [d['kind'] for d in ctl.decisions]
+    assert 'commit' in kinds
+
+
+def test_controller_capture_impl_reverts_when_slower():
+    """The revert side: a fused rung that does NOT beat the unfused
+    capture reverts and cools down — the knob never flaps."""
+    pre = _CapturePrecond(capture_impl='xla', kfac=4)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=3, steady_every=0,
+                                  tune=('capture_impl',))
+
+    def model(F, i):
+        # fused is SLOWER here (tiny F: fusion overhead dominates)
+        stats = 0.2 if pre.capture_impl == 'xla' else 0.35
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + stats
+        return ('pred',), 0.01
+
+    _feed(ctl, pre, model, 200)
+    assert pre.capture_impl == 'xla'          # reverted, stays unfused
+    assert ctl.state == 'steady'
+    assert ctl.commits == 0
+    assert ctl.reverts >= 1
+
+
+def test_quality_gate_vetoes_regressing_capture_rung():
+    """A capture rung that IS faster but raises the badness counter
+    during its probe window never commits (quality veto) — the same
+    numerical-health gate the decomp ladder gets."""
+    pre = _CapturePrecond(capture_impl='xla', kfac=4)
+    events = {'n': 0}
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('capture_impl',),
+                                  quality_gate=lambda: events['n'])
+
+    def model(F, i):
+        if pre.capture_impl == 'pallas':
+            events['n'] += 1                  # health events every step
+            stats = 0.05                      # ...but much faster
+        else:
+            stats = 0.4
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + stats
+        return ('pred',), 0.01
+
+    _feed(ctl, pre, model, 300)
+    assert pre.capture_impl == 'xla'          # the fast-but-wrong rung
+    assert ctl.commits == 0
+    assert ctl.quality_vetoes >= 1
+    assert ctl.state == 'steady'
+    vetoes = [d for d in ctl.decisions if d['kind'] == 'veto']
+    assert vetoes and vetoes[0].get('reason') == 'quality'
+
+
+def test_arbiter_capture_impl_is_trace_affecting():
+    """A capture_impl change fires the variant-cache invalidators (the
+    capture kernels are baked into the traced programs) and direct
+    external writes are adopted as the new base."""
+    pre = _CapturePrecond(capture_impl='xla')
+    arb = autotune.arbiter_for(pre)
+    cleared = []
+    arb.add_invalidator(lambda: cleared.append(1))
+    arb.propose('tuner', capture_impl='pallas')
+    assert pre.capture_impl == 'pallas'
+    assert cleared == [1]
+    with pytest.raises(ValueError, match='capture_impl'):
+        arb.propose('tuner', capture_impl='bogus')
+    # external write adopted as base, tuner override dropped
+    pre.capture_impl = 'xla'
+    arb.adopt_external()
+    assert arb.base['capture_impl'] == 'xla'
+    assert 'capture_impl' not in arb.tuner
+
+
+def test_capture_impl_hidden_when_legacy_none():
+    """capture_impl=None is the legacy capture path: the rung is
+    invisible to the tuner — no seed, no candidates, no knob writes —
+    so pre-ISSUE-19 configs tune exactly as before."""
+    pre = _FakePrecond(kfac=4)                # no capture_impl attr
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('capture_impl',))
+    _feed(ctl, pre, _amortized, 200)
+    assert getattr(pre, 'capture_impl', None) is None
+    assert ctl.commits == 0
+    assert not any(d.get('knob') == 'capture_impl' for d in ctl.decisions)
+
+
+def test_controller_capture_auto_probes_the_other_rung():
+    """'auto' resolves to the fused rung as the effective program, so
+    the only candidate is 'xla' — and when unfused is genuinely faster
+    the controller commits the concrete rung."""
+    pre = _CapturePrecond(capture_impl='auto', kfac=4)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  rel_improve=0.03, dwell_windows=1,
+                                  cooldown=2, steady_every=0,
+                                  tune=('capture_impl',))
+
+    def model(F, i):
+        eff = ('pallas' if pre.capture_impl == 'auto'
+               else pre.capture_impl)
+        stats = 0.4 if eff == 'pallas' else 0.1
+        if i == 0:
+            return ('pred', 'stats', 'decomp'), 0.01 + stats
+        return ('pred',), 0.01
+
+    _feed(ctl, pre, model, 200)
+    assert pre.capture_impl == 'xla'
+    assert ctl.commits == 1
+
+
+def test_capture_impl_seeded_from_perfmodel_prior():
+    """On the modeled chip the fused capture kernels halve the factor
+    phase's HBM bytes: the controller seeds capture_impl from the
+    perfmodel prior before any measurement."""
+    from kfac_pytorch_tpu import perfmodel
+    block = perfmodel.predict_block()
+    pre = _CapturePrecond(capture_impl='xla', kfac=4)
+    ctl = autotune.KnobController(pre, window=8, settle=1,
+                                  tune=('capture_impl',),
+                                  predicted=block)
+    ctl.record(('pred',), 0.01)               # first record triggers seed
+    assert pre.capture_impl == 'pallas'
+    seeds = [d for d in ctl.decisions if d['kind'] == 'seed']
+    assert seeds and seeds[0]['knob'] == 'capture_impl'
+    # the prior itself: fused strictly under unfused on the HBM-bound
+    # factor phase (CAPTURE_FUSION_BYTES_FACTOR halves the bytes term)
+    priors = perfmodel.capture_impl_priors(block)
+    assert priors['pallas'] < priors['xla']
