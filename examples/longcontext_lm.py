@@ -15,6 +15,11 @@ examples/wikitext_rnn.py).
 Example (virtual mesh smoke):
   KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=8 python examples/longcontext_lm.py \
       --seq-len 512 --seq-devices 4 --data-devices 2 --epochs 1
+
+Composed-mesh form of the same run (meshplan grammar; axis-aware K-FAC
+derives the data/sequence worlds from the spec):
+  KFAC_PLATFORM=cpu KFAC_HOST_DEVICES=8 python examples/longcontext_lm.py \
+      --seq-len 512 --kfac-mesh dp2xsp4 --epochs 1
 """
 
 import argparse
@@ -57,6 +62,16 @@ def parse_args():
                    help="size of the 'seq' mesh axis")
     p.add_argument('--data-devices', type=int, default=1,
                    help="size of the 'data' mesh axis")
+    p.add_argument('--kfac-mesh',
+                   default=os.environ.get('KFAC_MESH') or None,
+                   metavar='SPEC',
+                   help="composed-mesh spec in the meshplan grammar "
+                        "('dp2xsp4', 'dp2xsp2xtp1', ...) — overrides "
+                        "--data-devices/--seq-devices and routes K-FAC "
+                        "through the axis-aware mesh plan "
+                        "(parallel/mesh.make_composed_mesh). Axes beyond "
+                        "data/sequence must be size 1 here: this workload "
+                        "shards batch and sequence only")
     p.add_argument('--base-lr', type=float, default=3e-2)
     p.add_argument('--kfac-update-freq', type=int, default=10)
     p.add_argument('--kfac-basis-update-freq', type=int, default=0,
@@ -214,15 +229,44 @@ def main():
     ids, vocab = load_corpus(args)
     split = int(len(ids) * 0.9)
     train_ids, val_ids = ids[:split], ids[split:]
-    nd, ns = args.data_devices, args.seq_devices
+    mesh_axes = None
+    if args.kfac_mesh:
+        from kfac_pytorch_tpu import meshplan
+        mesh_axes = meshplan.parse_mesh_spec(args.kfac_mesh)
+        bad = [a.name for a in mesh_axes
+               if a.role not in ('data', 'sequence') and a.size > 1]
+        if bad:
+            raise SystemExit(
+                f'--kfac-mesh: axes {bad} need model-level sharding this '
+                'workload does not implement (batch/sequence only); use '
+                'size-1 placeholders or drop them')
+        dsz = [a.size for a in mesh_axes if a.role == 'data']
+        ssz = [a.size for a in mesh_axes if a.role == 'sequence']
+        if len([s for s in dsz if s > 1]) > 1 or \
+                len([s for s in ssz if s > 1]) > 1:
+            raise SystemExit('--kfac-mesh: at most one data and one '
+                             'sequence axis of size > 1 here')
+        nd = int(np.prod(dsz)) if dsz else 1
+        ns = int(np.prod(ssz)) if ssz else 1
+        args.data_devices, args.seq_devices = nd, ns
+        log.info('composed mesh %s: data world %d x seq %d',
+                 meshplan.format_mesh_spec(mesh_axes), nd, ns)
+    else:
+        nd, ns = args.data_devices, args.seq_devices
     ndev = nd * ns
     devices = jax.devices()
     assert len(devices) >= ndev, (len(devices), ndev)
     assert args.seq_len % max(ns, 1) == 0
     assert args.batch_size % max(nd, 1) == 0
 
-    seq_axis = 'seq' if ns > 1 else None
-    data_axis = 'data' if nd > 1 else None
+    if mesh_axes is not None:
+        seq_axis = next((a.name for a in mesh_axes
+                         if a.role == 'sequence' and a.size > 1), None)
+        data_axis = next((a.name for a in mesh_axes
+                          if a.role == 'data' and a.size > 1), None)
+    else:
+        seq_axis = 'seq' if ns > 1 else None
+        data_axis = 'data' if nd > 1 else None
     model = models.transformer_lm(
         vocab_size=vocab, n_layer=args.n_layer, n_head=args.n_head,
         d_model=args.d_model, max_len=args.seq_len, seq_axis=seq_axis,
@@ -232,18 +276,32 @@ def main():
         d_model=args.d_model, max_len=args.seq_len, seq_axis=None)
 
     # K-FAC distributes factor work over the flattened mesh when both
-    # axes exist; with one axis it uses that axis directly.
-    if ndev > 1:
+    # axes exist; with one axis it uses that axis directly. A composed
+    # --kfac-mesh spec builds the mesh through the axis-aware plan
+    # (size-1 extra axes are carried so the same spec string is valid
+    # on chips that do shard them).
+    if mesh_axes is not None and ndev > 1:
+        from kfac_pytorch_tpu.parallel.mesh import make_composed_mesh
+        mesh, _ = make_composed_mesh(mesh_axes)
+        kfac_axis = tuple(a for a in (data_axis, seq_axis) if a)
+        kfac_axis = kfac_axis if len(kfac_axis) > 1 else kfac_axis[0]
+    elif ndev > 1:
         mesh = Mesh(np.array(devices[:ndev]).reshape(nd, ns),
                     ('data', 'seq'))
         kfac_axis = tuple(a for a, n in (('data', nd), ('seq', ns))
                           if n > 1)
         kfac_axis = kfac_axis if len(kfac_axis) > 1 else kfac_axis[0]
     else:
-        mesh, kfac_axis = None, None
+        mesh, kfac_axis, mesh_axes = None, None, None
 
     precond = None
     if args.kfac_update_freq > 0:
+        # a composed spec hands the whole world derivation (num_devices
+        # + axis_name from the data axes, per-layer axis roles for any
+        # sharded-module axes) to the mesh plan
+        world_kw = (dict(mesh_axes=mesh_axes)
+                    if mesh_axes is not None
+                    else dict(num_devices=ndev, axis_name=kfac_axis))
         precond = kfac.KFAC(
             variant=args.kfac_name, lr=args.base_lr, damping=args.damping,
             fac_update_freq=args.kfac_cov_update_freq,
@@ -257,8 +315,7 @@ def main():
             decomp_impl=args.kfac_decomp_impl,
             capture_impl=args.kfac_capture_impl,
             decomp_shard=args.kfac_decomp_shard,
-            num_devices=ndev, axis_name=kfac_axis,
-            exclude_vocabulary_size=vocab)
+            exclude_vocabulary_size=vocab, **world_kw)
 
     tx = training.sgd(args.base_lr, momentum=0.9)
     sample_local = jnp.zeros(

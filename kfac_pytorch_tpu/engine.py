@@ -216,7 +216,7 @@ def update_factors_fused(plan, factors_local, acts, gs, batch_averaged,
 
 def update_factors(plan, factors_local, stats_stacked, factor_decay,
                    stats_reduce, axis_name, comm_precision='fp32',
-                   comm_err=None, capture_impl=None):
+                   comm_err=None, capture_impl=None, extra_reduce=()):
     """Running-average update of the local factor shard.
 
     ``stats_reduce='pmean'``: MPD semantics — factors are the global-batch
@@ -239,6 +239,17 @@ def update_factors(plan, factors_local, stats_stacked, factor_decay,
     error-feedback prep into one Pallas pass
     (:func:`pallas_capture.ef_quantize`) — same wire bytes, one fewer
     elementwise sweep over the stacked stats.
+
+    ``extra_reduce``: ``MeshFactorPlan.extra_reduce()`` tables —
+    ``((tensor_axis, {bucket_key: int32 global rows}), ...)``. The
+    marked rows are factor stats REPLICATED across that tensor axis
+    (column-A / row-G, see meshplan.rules), pmean-reduced over it BEFORE
+    the data-axis reduce/slice: mathematically the identity on
+    synchronized ranks (exact-mean of identical f32 values), drift
+    repair otherwise. The tensor wire carries no residual of its own —
+    under a lossy ``comm_precision`` the cast error folds into the
+    data-axis EF residual and re-enters the next data reduce; DP
+    variants (``comm_err=None``) run the tensor wire EF-free.
     """
     new = {}
     new_err = None if comm_err is None else dict(comm_err)
@@ -246,14 +257,25 @@ def update_factors(plan, factors_local, stats_stacked, factor_decay,
         key = _key(bdim)
         b = plan.buckets[bdim]
         stats = stats_stacked[key]
+        err_in = None if comm_err is None else comm_err[key]
+        for t_axis, rows_by_key in (extra_reduce or ()):
+            rows = rows_by_key.get(key)
+            if rows is None or len(rows) == 0:
+                continue
+            idx = jnp.asarray(rows)
+            sub = jnp.take(stats, idx, axis=0)
+            with jax.named_scope('kfac.CommunicateFactor'):
+                red = coll.pmean_wire(sub, t_axis, comm_precision)
+            if err_in is not None and comm_precision != 'fp32':
+                err_in = err_in.at[idx].add(sub - red)
+            stats = stats.at[idx].set(red)
         if stats_reduce == 'pmean':
             # only the reduce is CommunicateFactor — the EMA below is
             # compute, so xprof attribution matches time_breakdown.py's
             # exclude-parts subtraction
             with jax.named_scope('kfac.CommunicateFactor'):
                 local, err = coll.pmean_scatter_ef(
-                    stats, axis_name, comm_precision,
-                    None if comm_err is None else comm_err[key],
+                    stats, axis_name, comm_precision, err_in,
                     fused=(capture_impl == 'pallas'))
             if new_err is not None and err is not None:
                 new_err[key] = err

@@ -1,0 +1,39 @@
+"""Axis-aware K-FAC planning for composed dp×sp×tp×ep×pp meshes.
+
+Public surface:
+
+- :mod:`~kfac_pytorch_tpu.meshplan.axes` — ``AxisSpec``, the
+  ``'dp2xtp2'`` spec grammar (``parse_mesh_spec``), ``LayerAxisRule``.
+  Stdlib-pure: safe for launchers and lint lanes.
+- :mod:`~kfac_pytorch_tpu.meshplan.rules` — stock per-layer rules for
+  the ``parallel/`` layer families (``default_rules`` and the
+  column/row/expert builders).
+- :mod:`~kfac_pytorch_tpu.meshplan.plan` — ``MeshFactorPlan`` /
+  ``build_mesh_plan``: a plain data-world ``FactorPlan`` plus per-axis
+  role tables, the ``extra_reduce()`` seam into
+  ``engine.update_factors``, and per-axis ``comm_volume()``.
+
+Entry points users actually touch: ``KFAC(mesh_axes='dp2xtp2', ...)``
+(preconditioner.py) and ``parallel.mesh.make_composed_mesh``.
+"""
+
+from kfac_pytorch_tpu.meshplan.axes import (AxisSpec, LayerAxisRule,
+                                            data_axis_names,
+                                            format_mesh_spec, match_rule,
+                                            mesh_shape, parse_mesh_spec,
+                                            total_devices, world_size)
+from kfac_pytorch_tpu.meshplan.plan import (MeshFactorPlan,
+                                            build_mesh_plan,
+                                            stage_partition)
+from kfac_pytorch_tpu.meshplan.rules import (column_parallel_rule,
+                                             default_rules,
+                                             expert_local_rule,
+                                             row_parallel_rule)
+
+__all__ = [
+    'AxisSpec', 'LayerAxisRule', 'MeshFactorPlan', 'build_mesh_plan',
+    'column_parallel_rule', 'data_axis_names', 'default_rules',
+    'expert_local_rule', 'format_mesh_spec', 'match_rule', 'mesh_shape',
+    'parse_mesh_spec', 'row_parallel_rule', 'stage_partition',
+    'total_devices', 'world_size',
+]

@@ -1,0 +1,222 @@
+"""Axis vocabulary of the mesh-plan subsystem: ``AxisSpec`` and the
+``'dp2xtp2'`` spec grammar.
+
+The preconditioner's world view today is one data-parallel axis: every
+factor row is reduced, owned and replicated over the same axis. Composed
+meshes break that symmetry — a mesh axis plays exactly one of five
+ROLES for K-FAC, and the role decides all three questions at once:
+
+=========  ==============================================================
+role       K-FAC semantics
+=========  ==============================================================
+data       the K-FAC world: factor statistics are reduced over it (MPD)
+           or owner-local on it (DP), factor rows are owned across it,
+           decompositions/preconditioned grads are exchanged over it.
+sequence   a second data-shaped axis (ring-attention token sharding):
+           joins the data axes in the K-FAC world — tokens are just
+           more batch for the factor statistics.
+tensor     Megatron column/row sharding: slice-varying factor rows stay
+           rank-local (block-diagonal K-FAC), while rows that are
+           REPLICATED across the axis (column-A, row-G) are additionally
+           pmean-reduced over it — mathematically the identity on
+           synchronized ranks, operationally the drift repair that keeps
+           bf16 capture paths bit-aligned, and the one collective the
+           tensor axis ever carries.
+expert     MoE expert sharding: every expert's factors are computed from
+           the tokens its expert processed and live with its data-axis
+           owners — the paper's zero-comm trick applied on the expert
+           axis. Reducing factor statistics over an expert axis would
+           mix DIFFERENT experts' curvature and is rejected at build
+           time. FactorComm bytes on this axis are exactly zero.
+pipeline   GPipe stage sharding: stage-local factor ownership — a rank
+           captures/decomposes only its own stage's layers (the SPMD
+           gpipe form already hands each rank only its stage's params;
+           ``stage_partition`` covers global meta dicts). No factor
+           collective ever crosses the axis.
+=========  ==============================================================
+
+Spec grammar (``parse_mesh_spec``): ``'x'``-separated tokens, each
+``<tag><size>[=name]`` with tags dp/sp/tp/ep/pp, e.g. ``'dp2xtp2'``,
+``'dp4xep2'``, ``'dp2xsp2xtp2'``, ``'dp2xtp2=mdl'``. Token order IS mesh
+axis order. Default axis names match the conventions used across
+``parallel/`` and the examples: dp->'data', sp->'seq', tp->'model',
+ep->'expert', pp->'stage'.
+
+This module is stdlib-pure (no jax, no numpy) so the launcher and lint
+lanes can validate mesh specs without an accelerator stack.
+"""
+
+import dataclasses
+import re
+from typing import Optional, Tuple
+
+ROLES = ('data', 'sequence', 'tensor', 'expert', 'pipeline')
+
+#: spec tag -> (role, default mesh axis name)
+TAGS = {
+    'dp': ('data', 'data'),
+    'sp': ('sequence', 'seq'),
+    'tp': ('tensor', 'model'),
+    'ep': ('expert', 'expert'),
+    'pp': ('pipeline', 'stage'),
+}
+
+_TOKEN = re.compile(r'^(dp|sp|tp|ep|pp)([0-9]+)(?:=([A-Za-z_][\w]*))?$')
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisSpec:
+    """One mesh axis as K-FAC sees it: name, size, role."""
+    name: str
+    size: int
+    role: str
+
+    def __post_init__(self):
+        if self.role not in ROLES:
+            raise ValueError(
+                f'axis {self.name!r}: role must be one of {ROLES}, '
+                f'got {self.role!r}')
+        if self.size < 1:
+            raise ValueError(f'axis {self.name!r}: size must be >= 1, '
+                             f'got {self.size}')
+
+
+def parse_mesh_spec(spec) -> Tuple[AxisSpec, ...]:
+    """``'dp2xtp2'`` -> ``(AxisSpec('data', 2, 'data'),
+    AxisSpec('model', 2, 'tensor'))``. Already-parsed tuples pass
+    through (validated)."""
+    if isinstance(spec, (tuple, list)):
+        axes = tuple(spec)
+        for a in axes:
+            if not isinstance(a, AxisSpec):
+                raise TypeError(f'expected AxisSpec, got {type(a)}')
+        _validate(axes, spec)
+        return axes
+    if not isinstance(spec, str) or not spec:
+        raise ValueError(f'mesh spec must be a non-empty string like '
+                         f"'dp2xtp2', got {spec!r}")
+    axes = []
+    for tok in spec.split('x'):
+        m = _TOKEN.match(tok)
+        if not m:
+            raise ValueError(
+                f'malformed mesh-spec token {tok!r} in {spec!r} '
+                "(grammar: <tag><size>[=name], tags dp|sp|tp|ep|pp — "
+                "e.g. 'dp2xtp2', 'dp4xep2=experts')")
+        tag, size, name = m.group(1), int(m.group(2)), m.group(3)
+        role, default_name = TAGS[tag]
+        axes.append(AxisSpec(name or default_name, size, role))
+    axes = tuple(axes)
+    _validate(axes, spec)
+    return axes
+
+
+def _validate(axes: Tuple[AxisSpec, ...], spec) -> None:
+    names = [a.name for a in axes]
+    if len(set(names)) != len(names):
+        raise ValueError(f'mesh spec {spec!r}: duplicate axis names '
+                         f'{names} — rename with =<name>')
+    for role in ('tensor', 'expert', 'pipeline'):
+        if sum(a.role == role for a in axes) > 1:
+            raise ValueError(
+                f'mesh spec {spec!r}: more than one {role} axis — '
+                'per-layer roles are defined against a single axis of '
+                'each non-data kind')
+    if not any(a.role in ('data', 'sequence') for a in axes):
+        raise ValueError(
+            f'mesh spec {spec!r}: no data/sequence axis — K-FAC needs '
+            'a data world to own and reduce factor rows over '
+            "(add a 'dp<N>' token; dp1 is valid)")
+
+
+def format_mesh_spec(axes: Tuple[AxisSpec, ...]) -> str:
+    """Canonical spec string for a parsed axis tuple (knob round-trip)."""
+    tag_of = {role: tag for tag, (role, _) in TAGS.items()}
+    toks = []
+    for a in axes:
+        tag = tag_of[a.role]
+        default_name = TAGS[tag][1]
+        toks.append(f'{tag}{a.size}'
+                    + ('' if a.name == default_name else f'={a.name}'))
+    return 'x'.join(toks)
+
+
+def data_axis_names(axes: Tuple[AxisSpec, ...]) -> Tuple[str, ...]:
+    """The K-FAC world: data + sequence axis names, mesh order."""
+    return tuple(a.name for a in axes if a.role in ('data', 'sequence'))
+
+
+def axes_of_role(axes: Tuple[AxisSpec, ...], role: str
+                 ) -> Tuple[AxisSpec, ...]:
+    return tuple(a for a in axes if a.role == role)
+
+
+def world_size(axes: Tuple[AxisSpec, ...]) -> int:
+    """Size of the K-FAC world (product of data/sequence axis sizes)."""
+    n = 1
+    for a in axes:
+        if a.role in ('data', 'sequence'):
+            n *= a.size
+    return n
+
+
+def mesh_shape(axes: Tuple[AxisSpec, ...]) -> Tuple[int, ...]:
+    return tuple(a.size for a in axes)
+
+
+def total_devices(axes: Tuple[AxisSpec, ...]) -> int:
+    n = 1
+    for a in axes:
+        n *= a.size
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Per-layer axis roles
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LayerAxisRule:
+    """How one family of layers (regex over ``LayerMeta.name``) relates
+    to the non-data mesh axes.
+
+    ``a_roles`` / ``g_roles``: roles whose axis ADDITIONALLY reduces the
+    layer's A / G factor statistics (only 'tensor' is reducible — the
+    rows so marked are replicated across the axis, so the pmean is the
+    identity on synchronized ranks and repairs drift otherwise).
+    ``local_roles``: roles whose axis the layer's factor STATE varies
+    over ('expert', 'pipeline') — never reduced, zero factor bytes on
+    the axis; declared so the plan can validate and account for it.
+
+    First matching rule wins; unmatched layers are plain data-world
+    layers (no extra reduces, state replicated over non-data axes).
+    """
+    pattern: str
+    a_roles: Tuple[str, ...] = ()
+    g_roles: Tuple[str, ...] = ()
+    local_roles: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        re.compile(self.pattern)  # fail loudly at declaration time
+        for r in self.a_roles + self.g_roles:
+            if r != 'tensor':
+                raise ValueError(
+                    f'rule {self.pattern!r}: only tensor-role axes can '
+                    f'reduce factor statistics, got {r!r} — reducing '
+                    'over an expert/pipeline axis would mix different '
+                    "experts'/stages' curvature")
+        for r in self.local_roles:
+            if r not in ('expert', 'pipeline'):
+                raise ValueError(
+                    f'rule {self.pattern!r}: local_roles must be '
+                    f"expert/pipeline, got {r!r}")
+
+    def matches(self, layer_name: str) -> bool:
+        return re.search(self.pattern, layer_name) is not None
+
+
+def match_rule(rules, layer_name: str) -> Optional[LayerAxisRule]:
+    for rule in rules:
+        if rule.matches(layer_name):
+            return rule
+    return None

@@ -1,0 +1,64 @@
+"""Default per-layer axis-role rules for the layer families shipped in
+``parallel/``.
+
+The rules are pure pattern data (no imports from the layer modules) so
+the plan layer stays jax-free; ``parallel/tp.py`` and ``parallel/moe.py``
+re-export parameterized builders (``tp.axis_rules``, ``moe.axis_rules``)
+next to the classes whose capture semantics the patterns encode.
+
+Capture semantics being encoded (see parallel/tp.py module docstring):
+
+- **column-parallel** (kernel sharded on the output dim): the inner
+  Dense's 'a' is the REPLICATED input — its A factor is the full layer's
+  A, identical on every tensor rank -> A joins the tensor-axis reduce.
+  Its 'g' is the local output slice's cotangent — the slice-diagonal G
+  block, DIFFERENT per rank -> G stays rank-local.
+- **row-parallel** (kernel sharded on the input dim): 'a' is the local
+  input slice (rank-local A block), 'g' is the pre-reduction cotangent
+  which the psum backward REPLICATES from the full dL/dy -> G joins the
+  tensor-axis reduce.
+- **expert FFN** (parallel/moe.py): every rank holds a DIFFERENT
+  expert's parameters and processes the tokens routed to it — both
+  factors are expert-local state; reducing them over the expert axis
+  would average unrelated experts' curvature (rejected at build time).
+"""
+
+from kfac_pytorch_tpu.meshplan.axes import LayerAxisRule
+
+#: Megatron sublayer names of parallel/tp.py's blocks (attention QKV +
+#: FFN up-projection are column-parallel; attention output + FFN
+#: down-projection are row-parallel). The inner capture Dense is always
+#: named 'slice'.
+MEGATRON_COLUMN_NAMES = ('w_q', 'w_k', 'w_v', 'w_1')
+MEGATRON_ROW_NAMES = ('w_o', 'w_2')
+
+#: parallel/moe.py names its rank-local expert module 'expert'.
+MOE_EXPERT_NAMES = ('expert',)
+
+
+def _slice_pattern(names):
+    return r'(?:^|/)(?:' + '|'.join(names) + r')/slice$'
+
+
+def column_parallel_rule(names=MEGATRON_COLUMN_NAMES) -> LayerAxisRule:
+    """A reduced over the tensor axis (replicated input), G rank-local."""
+    return LayerAxisRule(_slice_pattern(names), a_roles=('tensor',))
+
+
+def row_parallel_rule(names=MEGATRON_ROW_NAMES) -> LayerAxisRule:
+    """G reduced over the tensor axis (replicated cotangent), A local."""
+    return LayerAxisRule(_slice_pattern(names), g_roles=('tensor',))
+
+
+def expert_local_rule(names=MOE_EXPERT_NAMES) -> LayerAxisRule:
+    """Factors are expert-local state: zero comm on the expert axis."""
+    pattern = r'(?:^|/)(?:' + '|'.join(names) + r')/'
+    return LayerAxisRule(pattern, local_roles=('expert',))
+
+
+def default_rules():
+    """Rule set covering the stock parallel/ layer families, in
+    match-priority order. Custom models pass their own tuple (or use
+    ``tp.axis_rules`` / ``moe.axis_rules`` with their layer names)."""
+    return (column_parallel_rule(), row_parallel_rule(),
+            expert_local_rule())

@@ -100,3 +100,27 @@ def shard_batch(mesh, axis_name, batch):
     examples/pytorch_cifar10_resnet.py:180-192)."""
     sharding = NamedSharding(mesh, P(axis_name))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def make_composed_mesh(spec, devices=None):
+    """Mesh for a ``'dp2xtp2'``-style composed spec (meshplan grammar).
+
+    Axis order/names follow the spec tokens (dp->'data', sp->'seq',
+    tp->'model', ep->'expert', pp->'stage' unless renamed with
+    ``=<name>``), so the returned mesh lines up with the
+    ``MeshFactorPlan`` built from the same spec. Returns ``(mesh, axes)``
+    — the parsed ``AxisSpec`` tuple is what ``KFAC(mesh_axes=...)``
+    and ``build_mesh_plan`` take.
+    """
+    from kfac_pytorch_tpu.meshplan import axes as axes_mod
+    axes = axes_mod.parse_mesh_spec(spec)
+    shape = axes_mod.mesh_shape(axes)
+    need = axes_mod.total_devices(axes)
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < need:
+        raise ValueError(
+            f'mesh spec {axes_mod.format_mesh_spec(axes)!r} needs '
+            f'{need} devices, have {len(devices)}')
+    arr = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(arr, tuple(a.name for a in axes)), axes

@@ -110,3 +110,16 @@ class SwitchMoE(linen.Module):
                                   concat_axis=0, tiled=True)
         y = jnp.einsum('tec,ecd->td', disp, ybuf)
         return y * p_top[:, None], {'gate_probs': probs, 'dropped': ~keep}
+
+
+def axis_rules(experts=('expert',)):
+    """Mesh-plan rule marking these modules' factors expert-LOCAL state:
+    each rank's expert is a different set of parameters, so its factor
+    statistics must never reduce over the expert axis — zero factor
+    bytes on that axis (the DP-KFAC owner-local trick), which
+    ``MeshFactorPlan.comm_volume`` accounts and scripts/comm_count.py
+    asserts against the HLO. Default matches :class:`SwitchMoE`'s
+    rank-local ``ExpertFFN(name='expert')``.
+    """
+    from kfac_pytorch_tpu.meshplan import rules as _mr
+    return (_mr.expert_local_rule(tuple(experts)),)

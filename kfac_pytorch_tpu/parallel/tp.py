@@ -244,3 +244,23 @@ class TPEncoderLayer(linen.Module):
         return TPPositionwiseFFN(self.d_model, self.d_inner_per_shard,
                                  axis=self.axis, dropout=self.dropout,
                                  name='ffn')(x, train)
+
+
+def axis_rules(column=('w_q', 'w_k', 'w_v', 'w_1'), row=('w_o', 'w_2')):
+    """Mesh-plan ``LayerAxisRule`` pair for column/row-parallel layers
+    named here (the module names WRAPPING the inner capture Dense,
+    e.g. ``column=('l1',)`` for ``ColumnParallelDense(name='l1')``).
+
+    Defaults are this module's Megatron sublayer names, so
+    ``tp.axis_rules()`` covers :class:`TPEncoderLayer` stacks as-is.
+    Column-parallel: A joins the tensor-axis reduce (replicated input);
+    row-parallel: G does (psum-replicated cotangent). See
+    ``meshplan.rules`` for the full derivation.
+    """
+    from kfac_pytorch_tpu.meshplan import rules as _mr
+    out = []
+    if column:
+        out.append(_mr.column_parallel_rule(tuple(column)))
+    if row:
+        out.append(_mr.row_parallel_rule(tuple(row)))
+    return tuple(out)

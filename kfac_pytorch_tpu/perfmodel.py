@@ -123,6 +123,23 @@ NEWTON_SCHULZ_FLOPS_PER_MATRIX = \
 #: tunnel answers.
 CAPTURE_FUSION_BYTES_FACTOR = 0.5
 
+#: TPU v5e ICI per-chip interconnect bandwidth, one direction
+#: (~45 GB/s per link, public scaling-book figure) — the stated
+#: assumption behind the per-axis comm scenarios. DCN (cross-slice)
+#: rides a ~25 Gb/s-class NIC share per chip.
+ICI_BW = 4.5e10
+DCN_BW = 3.1e9
+
+#: link-efficiency scenarios for the collective comm model (fraction of
+#: the wire rate an all-reduce/reduce-scatter actually sustains at the
+#: factor payload sizes; bracketed the same way SCENARIOS brackets the
+#: MXU roofline).
+COMM_SCENARIOS = {
+    'optimistic': 0.85,
+    'central': 0.70,
+    'conservative': 0.45,
+}
+
 _INPUTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             'data', 'perf_inputs_resnet50_bs32.json')
 
@@ -426,3 +443,62 @@ def predict_block(inputs=None):
     except Exception as e:  # noqa: BLE001 — bench must still emit
         return {'predicted_not_measured': True,
                 'error': f'{type(e).__name__}: {e}'}
+
+
+def comm_scenarios(per_axis_volume, axis_bw=None, dcn_axes=()):
+    """Per-axis K-FAC communication time scenarios for a composed mesh.
+
+    ``per_axis_volume`` is the dict returned by
+    ``meshplan.MeshFactorPlan.comm_volume()``: axis name -> phase-bytes
+    dict ({'FactorComm': ..., 'InverseComm': ..., 'PredComm': ...}).
+    Each axis is priced independently at ``bytes / (eff * bw)`` under
+    the COMM_SCENARIOS link-efficiency ladder — the per-axis collectives
+    are disjoint device groups, but XLA serialises them within one step,
+    so the per-step total is the SUM over axes, not the max.
+
+    ``axis_bw`` optionally overrides the wire rate per axis (B/s);
+    axes listed in ``dcn_axes`` default to DCN_BW instead of ICI_BW
+    (e.g. a cross-slice data axis). Zero-byte axes (expert, pipeline)
+    stay in the output at 0.0 s — the zero-comm claim priced, not
+    elided.
+
+    Predicted, not measured: the byte counts are compiler-verified by
+    scripts/comm_count.py; only the wire rates here are assumptions.
+    """
+    axis_bw = dict(axis_bw or {})
+    out = {}
+    for scen, eff in COMM_SCENARIOS.items():
+        axes = {}
+        total_s = 0.0
+        for ax, phases in per_axis_volume.items():
+            bw = axis_bw.get(ax, DCN_BW if ax in dcn_axes else ICI_BW)
+            byts = int(sum(phases.values()))
+            t = byts / (eff * bw)
+            axes[ax] = {'bytes': byts,
+                        'phase_bytes': dict(phases),
+                        'bw_assumed': bw,
+                        's': t}
+            total_s += t
+        out[scen] = {'axes': axes, 'total_s': total_s}
+    return out
+
+
+def comm_block(per_axis_volume, axis_bw=None, dcn_axes=()):
+    """Self-describing wrapper around :func:`comm_scenarios`."""
+    return {
+        'predicted_not_measured': True,
+        'method': ('per-axis serial sum of bytes/(eff*bw); bytes from '
+                   'meshplan.MeshFactorPlan.comm_volume (pinned byte-'
+                   'for-byte against compiled HLO by '
+                   'scripts/comm_count.py composed-mesh specs)'),
+        'assumptions': {
+            'ici_bw_B_per_s': ICI_BW,
+            'dcn_bw_B_per_s': DCN_BW,
+            'link_eff_scenarios': dict(COMM_SCENARIOS),
+            'serialisation': 'axes summed (XLA serialises same-step '
+                             'collectives), intra-axis perfectly '
+                             'overlapped within each phase',
+        },
+        'scenarios': comm_scenarios(per_axis_volume, axis_bw=axis_bw,
+                                    dcn_axes=dcn_axes),
+    }

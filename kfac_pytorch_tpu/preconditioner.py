@@ -165,6 +165,19 @@ class KFAC:
         inverse KFs instead of preconditioned grads (inv.py:41).
       num_devices / axis_name: size of the kfac mesh axis and its name
         inside shard_map; axis_name=None is the world=1 zero-comm path.
+      mesh_axes: composed-mesh spec ('dp2xtp2', 'dp4xep2', a parsed
+        ``meshplan.AxisSpec`` tuple) — the axis-aware lane (README
+        "K-FAC on composed meshes"). The K-FAC world derives from its
+        data/sequence axes (so num_devices/axis_name must be left
+        unset), the factor plan stays the plain data-world plan, and
+        tensor-replicated factor rows (column-A / row-G per
+        ``mesh_rules``) gain a pmean over the tensor axis; expert- and
+        pipeline-axis factors are owner-local — zero factor bytes on
+        those axes. Live moves go through ``replan(mesh_axes=...)``.
+      mesh_rules: per-layer ``meshplan.LayerAxisRule`` tuple (default:
+        the stock parallel/ families — ``tp.axis_rules()`` names; use
+        ``tp.axis_rules(column=..., row=...)`` / ``moe.axis_rules``
+        for custom layer names). Requires mesh_axes.
       assignment: 'round_robin' (reference) | 'balanced' (LPT scheduler).
       distribute_layer_factors: eigen variant — put A and G of one layer on
         different devices when the mesh outnumbers layers (eigen.py:66-71);
@@ -301,7 +314,7 @@ class KFAC:
                  warm_sweeps=None, cold_restart_every=50, stagger=False,
                  health=True, comm_precision='fp32', comm_prefetch=False,
                  decomp_impl=None, decomp_shard=False, comm_mode=None,
-                 capture_impl=None):
+                 capture_impl=None, mesh_axes=None, mesh_rules=None):
         if variant not in _VARIANTS:
             raise KeyError(f'unknown variant {variant!r}')
         cfg = dict(_VARIANTS[variant])
@@ -337,6 +350,38 @@ class KFAC:
         self.batch_averaged = batch_averaged
         self.num_devices = num_devices
         self.axis_name = axis_name
+        # mesh-plan subsystem: a composed-mesh spec ('dp2xtp2' or parsed
+        # AxisSpec tuple) makes the preconditioner axis-aware — the
+        # K-FAC world (num_devices/axis_name) derives from the DATA
+        # axes, and setup() builds a MeshFactorPlan whose base is the
+        # plain data-world plan (the step path reads only that; the one
+        # mesh-specific seam is engine.update_factors' extra_reduce)
+        self.mesh_axes = None
+        self.mesh_rules = mesh_rules
+        self._mesh_plan = None
+        if mesh_axes is not None:
+            from kfac_pytorch_tpu.meshplan import axes as _ma
+            _axes = _ma.parse_mesh_spec(mesh_axes)
+            world = _ma.world_size(_axes)
+            dnames = _ma.data_axis_names(_axes)
+            derived = dnames[0] if len(dnames) == 1 else dnames
+            if num_devices not in (1, world):
+                raise ValueError(
+                    f'mesh_axes={_ma.format_mesh_spec(_axes)!r} has a '
+                    f'{world}-way data world but num_devices={num_devices} '
+                    '— drop num_devices (it derives from the mesh spec)')
+            if axis_name is not None and axis_name != derived:
+                raise ValueError(
+                    f'mesh_axes={_ma.format_mesh_spec(_axes)!r} puts the '
+                    f'K-FAC world on {derived!r} but axis_name='
+                    f'{axis_name!r} — drop axis_name (it derives from '
+                    'the mesh spec)')
+            self.mesh_axes = _axes
+            self.num_devices = world
+            self.axis_name = derived
+        elif mesh_rules is not None:
+            raise ValueError('mesh_rules without mesh_axes has nothing '
+                             'to apply to — pass mesh_axes')
         self.assignment = assignment
         self.distribute_layer_factors = distribute_layer_factors
         self.bucket_fn = bucket_fn or default_bucket_fn
@@ -509,11 +554,23 @@ class KFAC:
             # (the adopted-knobs relaunch restarts trainers there)
             distribute = (self.comm_mode != 'pred'
                           and self.num_devices > len(metas))
-        self.plan = build_plan(
-            metas, num_devices=self.num_devices, comm_mode=self.comm_mode,
-            assignment=self.assignment,
-            distribute_layer_factors=bool(distribute),
-            bucket_fn=self.bucket_fn)
+        if self.mesh_axes is not None:
+            from kfac_pytorch_tpu.meshplan.plan import build_mesh_plan
+            self._mesh_plan = build_mesh_plan(
+                metas, self.mesh_axes, comm_mode=self.comm_mode,
+                assignment=self.assignment,
+                distribute_layer_factors=bool(distribute),
+                bucket_fn=self.bucket_fn, rules=self.mesh_rules)
+            # the step path reads the plain data-world base plan — the
+            # mesh layer only adds the extra_reduce tables at step time
+            self.plan = self._mesh_plan.base
+        else:
+            self._mesh_plan = None
+            self.plan = build_plan(
+                metas, num_devices=self.num_devices,
+                comm_mode=self.comm_mode, assignment=self.assignment,
+                distribute_layer_factors=bool(distribute),
+                bucket_fn=self.bucket_fn)
         self._distributed = bool(distribute)
         self._cohorts = None
         if self.stagger:
@@ -552,6 +609,14 @@ class KFAC:
         """The mesh-sharded decomposition layout
         (plan.DecompShardPlan), or None when ``decomp_shard`` is off."""
         return self._shard_plan
+
+    @property
+    def mesh_plan(self):
+        """The axis-aware :class:`~kfac_pytorch_tpu.meshplan.plan.
+        MeshFactorPlan` (or None without ``mesh_axes``). Its ``base``
+        IS ``self.plan``; the per-axis comm ledger is
+        ``mesh_plan.comm_volume(...)``."""
+        return self._mesh_plan
 
     # -- live replanning (ISSUE 14) ---------------------------------------
 
@@ -594,7 +659,8 @@ class KFAC:
 
     def replan(self, kfac_state=None, *, comm_mode=None, num_devices=None,
                bucket_overrides=None, variant=None,
-               axis_name='__unchanged__', _invalidate=True):
+               axis_name='__unchanged__', mesh_axes='__unchanged__',
+               _invalidate=True):
         """Rebuild the :class:`~kfac_pytorch_tpu.plan.FactorPlan` (and
         the staggered cohort/shard tables) MID-RUN and transport the
         factor state into the new layout — the primitive behind applied
@@ -630,6 +696,13 @@ class KFAC:
             seen-inverse gate re-arms through the invalidator).
           axis_name: the mesh axis of the new plan (elastic 1<->N
             moves); default keeps the current one.
+          mesh_axes: a composed-mesh spec ('dp2xtp2' / AxisSpec tuple /
+            None to clear) — the axis-aware lane. The K-FAC world
+            (num_devices + axis_name) derives from its data axes, so
+            it is mutually exclusive with passing those directly. A
+            move that keeps the data world (dp2xtp2 -> dp2) keeps the
+            base row layout — the factor state carries VERBATIM, only
+            the extra tensor-axis reduce enters/leaves the trace.
 
         The swap is atomic at the host level: the new plan, tables and
         transported state are fully built BEFORE any attribute of this
@@ -670,6 +743,30 @@ class KFAC:
             raise ValueError(f'num_devices must be >= 1, got {new_P}')
         new_axis = (self.axis_name if axis_name == '__unchanged__'
                     else axis_name)
+        if mesh_axes == '__unchanged__':
+            new_mesh = self.mesh_axes
+            if (new_mesh is not None
+                    and (num_devices is not None
+                         or axis_name != '__unchanged__')):
+                raise ValueError(
+                    'this preconditioner is mesh-planned — resize its '
+                    "K-FAC world through mesh_axes ('dp4xtp2', ...), "
+                    'not num_devices/axis_name, so the axis tables '
+                    'move with it')
+        else:
+            if num_devices is not None or axis_name != '__unchanged__':
+                raise ValueError(
+                    'mesh_axes derives num_devices and axis_name from '
+                    'its data axes — do not also pass them')
+            if mesh_axes is None:
+                new_mesh = None  # clear: plain plan over current world
+            else:
+                from kfac_pytorch_tpu.meshplan import axes as _ma
+                new_mesh = _ma.parse_mesh_spec(mesh_axes)
+                new_P = _ma.world_size(new_mesh)
+                dnames = _ma.data_axis_names(new_mesh)
+                new_axis = dnames[0] if len(dnames) == 1 else dnames
+        mesh_changed = new_mesh != self.mesh_axes
         if bucket_overrides is None:
             new_overrides = dict(self.bucket_stagger_freq or {})
         else:
@@ -743,10 +840,21 @@ class KFAC:
             distribute = False
 
         # -- build the new layout + transported state FIRST ---------------
-        new_plan = build_plan(
-            {m.path: m for m in old_plan.metas}, num_devices=new_P,
-            comm_mode=new_mode, assignment=self.assignment,
-            distribute_layer_factors=distribute, bucket_fn=self.bucket_fn)
+        new_mesh_plan = None
+        if new_mesh is not None:
+            from kfac_pytorch_tpu.meshplan.plan import build_mesh_plan
+            new_mesh_plan = build_mesh_plan(
+                {m.path: m for m in old_plan.metas}, new_mesh,
+                comm_mode=new_mode, assignment=self.assignment,
+                distribute_layer_factors=distribute,
+                bucket_fn=self.bucket_fn, rules=self.mesh_rules)
+            new_plan = new_mesh_plan.base
+        else:
+            new_plan = build_plan(
+                {m.path: m for m in old_plan.metas}, num_devices=new_P,
+                comm_mode=new_mode, assignment=self.assignment,
+                distribute_layer_factors=distribute,
+                bucket_fn=self.bucket_fn)
         clone = copy.copy(self)
         clone.variant = new_variant
         clone.stats_reduce = new_reduce
@@ -756,6 +864,8 @@ class KFAC:
         clone.num_devices = new_P
         clone.axis_name = new_axis
         clone.plan = new_plan
+        clone.mesh_axes = new_mesh
+        clone._mesh_plan = new_mesh_plan
         clone._distributed = distribute
         clone.bucket_stagger_freq = new_overrides
         clone._cohorts = None
@@ -787,6 +897,7 @@ class KFAC:
             not same_layout or new_mode != self.comm_mode
             or new_method != self.method or new_reduce != self.stats_reduce
             or new_ekfac != self.ekfac or new_axis != self.axis_name
+            or mesh_changed
             or new_overrides != (self.bucket_stagger_freq or {}))
         try:
             from kfac_pytorch_tpu.autotune import _applying
@@ -806,6 +917,8 @@ class KFAC:
         self.num_devices = new_P
         self.axis_name = new_axis
         self.plan = new_plan
+        self.mesh_axes = new_mesh
+        self._mesh_plan = new_mesh_plan
         self._distributed = distribute
         self.bucket_stagger_freq = new_overrides
         self._cohorts = None
@@ -1060,10 +1173,13 @@ class KFAC:
                 with jax.named_scope('kfac.UpdateFactors'):
                     # the pmean inside carries its own CommunicateFactor
                     # scope
+                    extra = (self._mesh_plan.extra_reduce()
+                             if self._mesh_plan is not None else ())
                     factors, comm_err = engine.update_factors(
                         plan, factors, stats, self.factor_decay, reduce,
                         axis_name, comm_precision=self.comm_precision,
-                        comm_err=comm_err, capture_impl=cap_impl)
+                        comm_err=comm_err, capture_impl=cap_impl,
+                        extra_reduce=extra)
             if self.health is not None and comm_err is not None:
                 # a non-finite residual row resets to zero (the always-
                 # safe EF state: feedback is a correction, never load-

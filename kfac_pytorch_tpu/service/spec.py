@@ -60,7 +60,8 @@ KFAC_KNOBS = frozenset({
     'kfac_autotune', 'kfac_basis_update_freq', 'kfac_capture_impl',
     'kfac_comm_mode', 'kfac_comm_precision', 'kfac_comm_prefetch',
     'kfac_cov_update_freq', 'kfac_decomp_impl', 'kfac_decomp_shard',
-    'kfac_name', 'kfac_stagger', 'kfac_type', 'kfac_update_freq',
+    'kfac_mesh', 'kfac_name', 'kfac_stagger', 'kfac_type',
+    'kfac_update_freq',
     'kfac_update_freq_alpha', 'kfac_update_freq_decay',
     'kfac_warm_start',
 })

@@ -286,6 +286,12 @@ def build_train_step(model, tx, precond, loss_fn, axis_name=None, mesh=None,
     if fisher_type not in ('Femp', 'F1mc'):
         raise ValueError(f'fisher_type must be Femp or F1mc, '
                          f'got {fisher_type!r}')
+    if (axis_name is None
+            and getattr(precond, 'mesh_axes', None) is not None):
+        # mesh-planned preconditioner: the K-FAC world derives from the
+        # mesh spec's data axes — inherit it so callers name the mesh
+        # in exactly one place (KFAC(mesh_axes=...))
+        axis_name = precond.axis_name
     if health == 'auto':
         health_cfg = getattr(precond, 'health', None)
     else:

@@ -80,6 +80,22 @@ if [ -n "$KFAC_COMM_MODE" ]; then
   esac
 fi
 
+# Composed meshes (README "K-FAC on composed meshes"): KFAC_MESH is a
+# meshplan spec ('dp2xsp4', 'dp4xtp2', ...) the trainers read as the
+# --kfac-mesh default — the axis-aware mesh plan derives the K-FAC
+# world from its data/sequence axes. Grammar-checked here so a typo
+# fails at launch, not after the pod spins up.
+if [ -n "$KFAC_MESH" ]; then
+  if echo "$KFAC_MESH" | grep -Eq \
+      '^(dp|sp|tp|ep|pp)[0-9]+(=[A-Za-z_][A-Za-z0-9_]*)?(x(dp|sp|tp|ep|pp)[0-9]+(=[A-Za-z_][A-Za-z0-9_]*)?)*$'; then
+    export KFAC_MESH
+  else
+    echo "launch_tpu.sh: KFAC_MESH must be an 'x'-separated list of" \
+         "dp/sp/tp/ep/pp axis tokens ('dp2xsp4'), got '$KFAC_MESH'" >&2
+    exit 1
+  fi
+fi
+
 # Closed-loop autotuning: KFAC_AUTOTUNE=1 enables the online knob
 # controller in every trainer of the run (the trainers read it as the
 # --kfac-autotune default; an explicit flag still wins). The controller

@@ -178,6 +178,87 @@ def parse_replan_tag(variant):
     return base, (mode or None)
 
 
+def parse_mesh_tag(variant):
+    """'eigen@dp2xtp2' -> ('eigen', 'dp2xtp2'); no tag -> (v, None).
+    An '@mesh' spec lowers the AXIS-AWARE program: the preconditioner
+    step on a composed mesh (meshplan subsystem), with every collective
+    attributed to the mesh axis its replica groups actually cross."""
+    base, _, spec = variant.partition('@')
+    return base, (spec or None)
+
+
+# -- per-axis attribution (composed meshes) ---------------------------------
+
+REPLICA_GROUPS_RE = re.compile(
+    r'replica_groups=(\{\{[0-9, ]*(?:\},\{[0-9, ]*)*\}\}'
+    r'|\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)')
+SOURCE_TARGET_RE = re.compile(r'source_target_pairs=(\{\{[0-9,{} ]*\}\})')
+_IOTA_RE = re.compile(
+    r'^\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?$')
+
+
+def parse_replica_groups(line):
+    """Device-id groups of one HLO collective line, or None.
+
+    Handles both serializations XLA emits: the literal
+    ``{{0,2},{1,3}}`` list and the iota form ``[2,2]<=[4]`` /
+    ``[2,2]<=[2,2]T(1,0)`` (groups = iota over the total, reshaped to
+    the source dims, transposed, re-flattened to [n_groups, size]).
+    collective-permute's ``source_target_pairs`` parse as 2-element
+    groups — a pair crosses whatever axis separates its endpoints.
+    """
+    m = REPLICA_GROUPS_RE.search(line)
+    if m is None:
+        m = SOURCE_TARGET_RE.search(line)
+        if m is None:
+            return None
+        body = m.group(1)[2:-2]
+        return [tuple(int(x) for x in grp.split(','))
+                for grp in body.split('},{') if grp]
+    text = m.group(1)
+    im = _IOTA_RE.match(text)
+    if im:
+        out_dims = [int(x) for x in im.group(1).split(',')]
+        src_dims = [int(x) for x in im.group(2).split(',')]
+        ids = np.arange(int(np.prod(src_dims))).reshape(src_dims)
+        if im.group(3):
+            ids = ids.transpose([int(x) for x in im.group(3).split(',')])
+        ids = ids.reshape(out_dims)
+        return [tuple(int(x) for x in row) for row in ids]
+    body = text[2:-2]
+    return [tuple(int(x) for x in grp.split(','))
+            for grp in body.split('},{') if grp]
+
+
+def axis_of_groups(groups, mesh_shape, axis_names, data_names):
+    """Which mesh axis a collective's replica groups cross.
+
+    Device ids are global and row-major over the mesh shape (the
+    make_composed_mesh construction), so each member's axis coordinates
+    are its unravel. Returns 'data' when every varying coordinate is a
+    data/sequence axis (the K-FAC world — multi-axis worlds still count
+    as one), the axis name when exactly one non-data axis varies, 'self'
+    for degenerate single-member groups, and a '+'-joined label for
+    anything mixed (no K-FAC collective should ever produce one).
+    """
+    varying = set()
+    for grp in groups:
+        coords = [np.unravel_index(d, mesh_shape) for d in grp]
+        for k, name in enumerate(axis_names):
+            if len({c[k] for c in coords}) > 1:
+                varying.add(name)
+    if not varying:
+        return 'self'
+    if varying <= set(data_names):
+        return 'data'
+    non_data = sorted(varying - set(data_names))
+    if len(non_data) == 1 and len(varying) == 1:
+        return non_data[0]
+    # crosses a non-data axis AND something else — no K-FAC collective
+    # should produce this; the '+' label makes it loud in the ledger
+    return '+'.join(sorted(varying))
+
+
 def collective_ledger(variant, ndev=8, model_name='resnet20', model=None,
                       hw=32, comm_precision='fp32', comm_prefetch=False):
     """Machine-readable collective ledger over the compiled
@@ -314,6 +395,214 @@ def collective_counts(variant, ndev=8, model_name='resnet20', model=None,
     return led['ops'], led['bytes']
 
 
+def composed_ledger(base_variant, mesh_spec, comm_precision='fp32',
+                    batch=8):
+    """Per-AXIS collective ledger of the axis-aware preconditioner step
+    on a composed mesh (meshplan subsystem) — the compiler-level proof
+    of the composed-mesh communication story: factor statistics psum
+    over the tensor axis exactly the rows the plan marks (column-A /
+    row-G), the expert axis carries ZERO factor bytes (owner-local
+    DP-KFAC per expert), and the data-axis phases price exactly as the
+    base ``FactorPlan.comm_volume``.
+
+    The lowered program feeds ORACLE capture inputs (acts/gs/grads as
+    explicit shard_map operands) into ``KFAC.step``: the ledger pins the
+    preconditioner's own collectives, independent of how the model
+    forward/backward produced the statistics — and independent of the
+    legacy-jax in-body autodiff defect tests/helpers.py documents.
+    """
+    import functools
+    from jax.sharding import PartitionSpec as P
+    from kfac_pytorch_tpu.capture import LayerMeta
+    from kfac_pytorch_tpu.meshplan import axes as axes_mod
+    from kfac_pytorch_tpu.parallel import mesh as meshlib
+    from kfac_pytorch_tpu.parallel import moe, tp
+
+    axes = axes_mod.parse_mesh_spec(mesh_spec)
+    need = axes_mod.total_devices(axes)
+    if len(jax.devices()) < need:
+        raise SystemExit(
+            f'mesh {mesh_spec!r} needs {need} devices (have '
+            f'{len(jax.devices())}) — run with KFAC_PLATFORM=cpu '
+            f'KFAC_HOST_DEVICES={need}')
+    mesh, _ = meshlib.make_composed_mesh(mesh_spec)
+    names = tuple(a.name for a in axes)
+    shape = axes_mod.mesh_shape(axes)
+    data_names = axes_mod.data_axis_names(axes)
+
+    # synthetic capture layer set: column/row tensor slices when the
+    # mesh has a tensor axis, an expert-local FFN when it has an expert
+    # axis, plus one plain data-world head (unmatched by any rule)
+    def dense(name, din, dout):
+        return LayerMeta(name=name, path=tuple(name.split('/')),
+                         kind='dense', use_bias=True, in_dim=din + 1,
+                         out_dim=dout, kernel_shape=(din, dout))
+    DIN, DH, DOUT = 24, 32, 16
+    metas, rules = {}, []
+    if any(a.role == 'tensor' for a in axes):
+        metas[('l1', 'slice')] = dense('l1/slice', DIN, DH)
+        metas[('l2', 'slice')] = dense('l2/slice', DH, DOUT)
+        rules += list(tp.axis_rules(column=('l1',), row=('l2',)))
+    if any(a.role == 'expert' for a in axes):
+        metas[('expert', 'w_in')] = dense('expert/w_in', DIN, DH)
+        metas[('expert', 'w_out')] = dense('expert/w_out', DH, DIN)
+        rules += list(moe.axis_rules())
+    metas[('head',)] = dense('head', DIN, DOUT)
+
+    pre = kfac.KFAC(variant=base_variant, lr=0.1, damping=0.003,
+                    assignment='balanced', comm_precision=comm_precision,
+                    mesh_axes=mesh_spec,
+                    mesh_rules=tuple(rules) or None)
+    pre.setup(metas)
+    state0 = pre.init()
+
+    rng = np.random.RandomState(0)
+
+    def leaf(*dims):
+        a = jnp.asarray(rng.randn(*dims), jnp.float32)
+        return jnp.broadcast_to(a, shape + tuple(dims))
+
+    def insert(tree, path, value):
+        d = tree
+        for p in path[:-1]:
+            d = d.setdefault(p, {})
+        d[path[-1]] = value
+
+    acts, gs, grads = {}, {}, {}
+    for path, m in metas.items():
+        din = m.in_dim - 1
+        insert(acts, path, {'a': leaf(batch, din)})
+        insert(gs, path, {'g': leaf(batch, m.out_dim)})
+        insert(grads, path, {'kernel': leaf(din, m.out_dim),
+                             'bias': leaf(m.out_dim)})
+
+    kspecs = pre.state_pspecs()
+    lead = P(*names)
+    tree_specs = jax.tree.map(lambda _: lead, (grads, acts, gs))
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(kspecs,) + tree_specs,
+                       out_specs=(lead, kspecs))
+    def step(kstate, grads, acts, gs):
+        sq = lambda t: jax.tree.map(
+            lambda a: a.reshape(a.shape[len(shape):]), t)
+        new_grads, new_state = pre.step(kstate, sq(grads), sq(acts),
+                                        sq(gs))
+        exp = lambda t: jax.tree.map(
+            lambda a: a.reshape((1,) * len(shape) + a.shape), t)
+        return exp(new_grads), new_state
+
+    txt = jax.jit(step).lower(state0, grads, acts, gs) \
+                       .compile().as_text()
+
+    counts = collections.Counter()
+    bytes_by_kind = collections.Counter()
+    by_phase = {}
+    by_axis = {}
+    total_devices = int(np.prod(shape))
+    for line in txt.splitlines():
+        m = COLLECTIVE_LINE_RE.search(line)
+        if not m:
+            continue
+        result_type, kind = m.groups()
+        per_dtype = _payload_bytes_by_dtype(result_type, kind)
+        total = sum(per_dtype.values())
+        counts[kind] += 1
+        bytes_by_kind[kind] += total
+        om = OP_NAME_RE.search(line)
+        phase = _phase_of(om.group(1) if om else '')
+        rec = by_phase.setdefault(
+            phase, {'ops': 0, 'bytes': 0, 'by_dtype': {}})
+        rec['ops'] += 1
+        rec['bytes'] += total
+        for dt, b in per_dtype.items():
+            rec['by_dtype'][dt] = rec['by_dtype'].get(dt, 0) + b
+        groups = parse_replica_groups(line)
+        if groups is None and 'replica_groups={}' in line:
+            groups = [tuple(range(total_devices))]
+        axis = (axis_of_groups(groups, shape, names, data_names)
+                if groups is not None else 'unattributed')
+        arec = by_axis.setdefault(axis, {})
+        prec = arec.setdefault(phase, {'ops': 0, 'bytes': 0})
+        prec['ops'] += 1
+        prec['bytes'] += total
+    mp = pre.mesh_plan
+    analytic = {ax: {k: int(v) for k, v in d.items()}
+                for ax, d in mp.comm_volume(
+                    stats_reduce=pre.stats_reduce, method=pre.method,
+                    comm_precision=comm_precision).items()}
+    return {
+        'variant': f'{base_variant}@{mesh_spec}',
+        'comm_precision': comm_precision,
+        'comm_prefetch': False,
+        'capture_impl': None,
+        'mesh': mesh_spec,
+        'mesh_axes': names,
+        'data_axes': list(data_names),
+        'tensor_axes': list(mp.tensor_axes),
+        'expert_axes': list(mp.expert_axes),
+        'pipeline_axes': list(mp.pipeline_axes),
+        'ops': dict(counts),
+        'bytes': dict(bytes_by_kind),
+        'by_phase': by_phase,
+        'by_axis_phase': by_axis,
+        'axis_analytic': analytic,
+        'total_bytes': int(sum(bytes_by_kind.values())),
+    }
+
+
+def check_composed(ledgers):
+    """The composed-mesh assert gate: for every '@mesh' spec,
+
+    (a) the EXPERT (and pipeline) axes carry ZERO collective bytes — in
+        every phase, gradient floor included: the owner-local factor
+        trick means nothing the preconditioner lowers may cross them;
+    (b) the TENSOR axis carries exactly the analytic FactorComm bytes
+        (``MeshFactorPlan.comm_volume``) and NOTHING else;
+    (c) the data-axis K-FAC phases price byte-for-byte at the base
+        ``FactorPlan.comm_volume`` closed form — the mesh layer changes
+        where bytes flow, never how many the data world pays;
+    (d) no collective crosses a mixed axis set ('+'-labels) or escapes
+        attribution.
+    """
+    for spec, led in ledgers.items():
+        if 'by_axis_phase' not in led:
+            continue
+        by_axis = led['by_axis_phase']
+        analytic = led['axis_analytic']
+        for ax in led['expert_axes'] + led['pipeline_axes']:
+            got = by_axis.get(ax)
+            assert got is None, (
+                f'{spec}: collectives cross the {ax} axis: {got} — '
+                'expert/pipeline factor state is owner-local; this '
+                'axis must carry exactly zero bytes')
+        bad = [ax for ax in by_axis
+               if '+' in ax or ax == 'unattributed']
+        assert not bad, (
+            f'{spec}: unattributable/mixed-axis collectives {bad}: '
+            f'{ {ax: by_axis[ax] for ax in bad} }')
+        for ax in led['tensor_axes']:
+            t = dict(by_axis.get(ax, {}))
+            want = analytic[ax]['FactorComm']
+            got = t.pop('FactorComm', {}).get('bytes', 0)
+            assert got == want, (
+                f'{spec}: tensor-axis FactorComm {got} B != analytic '
+                f'{want} B — the marked-row psum and its byte model '
+                'diverged')
+            assert not t, (
+                f'{spec}: tensor axis {ax} carries non-FactorComm '
+                f'collectives {t} — the tensor axis prices exactly one '
+                'collective family')
+        data = by_axis.get('data', {})
+        for phase in ('FactorComm', 'InverseComm', 'PredComm'):
+            got = data.get(phase, {}).get('bytes', 0)
+            want = analytic['data'][phase]
+            assert got == want, (
+                f'{spec}: data-axis {phase} {got} B != analytic '
+                f'{want} B — the composed program and the base '
+                'comm_volume diverged')
+
+
 def check_floor(ledgers):
     """The smoke-job gate: (a) the 'sgd' ledger contains ONLY
     gradient-path collectives (all-reduce kinds, no gathers, nothing
@@ -362,10 +651,23 @@ def main():
     # (compressed factor collectives, parallel/collectives.py wire dtypes)
     specs = tuple(os.environ.get(
         'COMM_COUNT_VARIANTS',
-        'sgd eigen inverse eigen_dp inverse_dp').split())
+        'sgd eigen inverse eigen_dp inverse_dp '
+        'eigen@dp2xtp2 eigen_dp@dp2xtp2 eigen_dp@dp2xep2').split())
     ledgers = {}
     for spec in specs:
         variant, precision = parse_variant_spec(spec)
+        mesh_base, mesh_spec = parse_mesh_tag(variant)
+        if mesh_spec:
+            led = composed_ledger(mesh_base, mesh_spec,
+                                  comm_precision=precision)
+            ledgers[spec] = led
+            per_axis = '; '.join(
+                f'{ax}: ' + ', '.join(
+                    f'{p} {r["bytes"]}B' for p, r in sorted(d.items()))
+                for ax, d in sorted(led['by_axis_phase'].items()))
+            print(f'{spec:>17}: ops {led["ops"]}  per-axis {{{per_axis}}}',
+                  flush=True)
+            continue
         led = collective_ledger(variant, ndev=ndev, model_name=model_name,
                                 comm_precision=precision)
         ledgers[spec] = led
@@ -448,6 +750,7 @@ def main():
 
     if os.environ.get('COMM_COUNT_ASSERT'):
         check_floor(ledgers)
+        check_composed(ledgers)
         for spec, led in ledgers.items():
             variant, precision = parse_variant_spec(spec)
             if precision == 'fp32':
@@ -556,7 +859,7 @@ def main():
                 f'{base_floor} B — the comm-mode replan touched the '
                 'gradient path')
         print('COMM_COUNT_ASSERT: floor + compression + decomp-shard '
-              '+ comm-mode + fused-capture gates passed')
+              '+ comm-mode + fused-capture + composed-mesh gates passed')
 
 
 if __name__ == '__main__':
